@@ -1,0 +1,21 @@
+"""dynamo_tpu — a TPU-native LLM inference platform.
+
+A ground-up rebuild of the capabilities of the `emolinaro/dynamo-k8s-llm-inference`
+stack (which deploys NVIDIA Dynamo on Kubernetes for GPU serving), designed
+TPU-first on JAX/XLA/Pallas:
+
+- JAX-native engine workers (paged KV cache in HBM, continuous batching,
+  jit-compiled prefill/decode) replacing the vLLM/SGLang/TRT-LLM CUDA engines
+  (reference: examples/deploy/*/agg.yaml).
+- Tensor parallelism as `jax.sharding.Mesh` named shardings over ICI, replacing
+  NCCL (reference: examples/deploy/sglang/agg.yaml:40-41 `--tp`).
+- Disaggregated prefill/decode with KV-cache handoff over ICI/DCN, replacing
+  NIXL (reference: examples/deploy/sglang/disagg.yaml:45-52).
+- An OpenAI-compatible frontend emitting the same `dynamo_frontend_*` metric
+  names consumed by the reference Grafana dashboard
+  (reference: examples/dgdr/trtllm/grafana-dynamo-dashboard-configmap.yaml).
+- A Kubernetes operator reconciling `TpuGraphDeployment` CRDs into pods that
+  request `google.com/tpu` (reference: install-dynamo-1node.sh GPU Operator flow).
+"""
+
+__version__ = "0.1.0"

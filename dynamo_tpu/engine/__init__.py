@@ -1,0 +1,1 @@
+from dynamo_tpu.engine.config import EngineConfig  # noqa: F401

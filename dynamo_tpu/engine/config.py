@@ -1,0 +1,107 @@
+"""Engine configuration.
+
+The flag surface mirrors the reference's engine CLI contracts so the DGD
+manifests port mechanically:
+- `--model` / `--model-path` / `--served-model-name`
+  (/root/reference/examples/deploy/vllm/agg.yaml:33-35,
+   /root/reference/examples/deploy/sglang/agg.yaml:33-37)
+- `--page-size` (/root/reference/examples/deploy/sglang/agg.yaml:38-39)
+- `--tp` (/root/reference/examples/deploy/sglang/agg.yaml:40-41)
+- `--disaggregation-mode prefill|decode`, `--disaggregation-bootstrap-port`,
+  `--disaggregation-transfer-backend`
+  (/root/reference/examples/deploy/sglang/disagg.yaml:45-52)
+- `--is-prefill-worker` / `--is-decode-worker`
+  (/root/reference/examples/deploy/vllm/disagg.yaml:37,57)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "tiny-debug"
+    served_model_name: Optional[str] = None
+    model_path: Optional[str] = None  # local checkpoint dir (safetensors)
+    dtype: Optional[str] = None  # default: bfloat16 on TPU, float32 on CPU
+
+    # KV cache / batching
+    page_size: int = 16
+    num_pages: int = 512  # total KV pages (page 0 is reserved as trash)
+    max_num_seqs: int = 8  # concurrent decode slots
+    max_seq_len: int = 1024  # max context per sequence
+
+    # parallelism
+    tensor_parallel: int = 1
+    data_parallel: int = 1
+    expert_parallel: int = 1
+
+    # disaggregation (NIXL-contract mirror)
+    disaggregation_mode: str = "agg"  # agg | prefill | decode
+    disaggregation_transfer_backend: str = "ici"  # ici | dcn
+    disaggregation_bootstrap_port: int = 12345
+
+    seed: int = 0
+
+    # runtime
+    enforce_eager: bool = False  # skip jit (debug only)
+
+    @property
+    def served_name(self) -> str:
+        return self.served_model_name or self.model
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return (self.max_seq_len + self.page_size - 1) // self.page_size
+
+    @staticmethod
+    def add_cli_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
+        p.add_argument("--model", default="tiny-debug")
+        p.add_argument("--model-path", default=None)
+        p.add_argument("--served-model-name", default=None)
+        p.add_argument("--dtype", default=None)
+        p.add_argument("--page-size", type=int, default=16)
+        p.add_argument("--num-pages", type=int, default=512)
+        p.add_argument("--max-num-seqs", type=int, default=8)
+        p.add_argument("--max-seq-len", type=int, default=1024)
+        p.add_argument("--tp", "--tensor-parallel-size", type=int, default=1, dest="tp")
+        p.add_argument("--dp", type=int, default=1)
+        p.add_argument("--ep", type=int, default=1)
+        p.add_argument("--disaggregation-mode", default="agg",
+                       choices=["agg", "prefill", "decode"])
+        p.add_argument("--is-prefill-worker", action="store_true")
+        p.add_argument("--is-decode-worker", action="store_true")
+        p.add_argument("--disaggregation-transfer-backend", default="ici")
+        p.add_argument("--disaggregation-bootstrap-port", type=int, default=12345)
+        p.add_argument("--trust-remote-code", action="store_true")  # accepted, unused
+        p.add_argument("--skip-tokenizer-init", action="store_true")
+        p.add_argument("--seed", type=int, default=0)
+        return p
+
+    @staticmethod
+    def from_cli_args(args: argparse.Namespace) -> "EngineConfig":
+        mode = args.disaggregation_mode
+        if getattr(args, "is_prefill_worker", False):
+            mode = "prefill"
+        if getattr(args, "is_decode_worker", False):
+            mode = "decode"
+        return EngineConfig(
+            model=args.model,
+            model_path=args.model_path,
+            served_model_name=args.served_model_name,
+            dtype=args.dtype,
+            page_size=args.page_size,
+            num_pages=args.num_pages,
+            max_num_seqs=args.max_num_seqs,
+            max_seq_len=args.max_seq_len,
+            tensor_parallel=args.tp,
+            data_parallel=args.dp,
+            expert_parallel=args.ep,
+            disaggregation_mode=mode,
+            disaggregation_transfer_backend=args.disaggregation_transfer_backend,
+            disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,
+            seed=args.seed,
+        )

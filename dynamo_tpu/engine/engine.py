@@ -1,0 +1,435 @@
+"""The JAX engine: jit-compiled prefill/decode over a paged KV cache with
+continuous batching.
+
+This is the TPU-native replacement for the reference's consumed engine workers
+(`python3 -m dynamo.vllm` / `dynamo.sglang` / `dynamo.trtllm`,
+/root/reference/examples/deploy/vllm/agg.yaml:29-35). Key properties:
+
+- **Shape-static decode**: every decode step runs the full `max_num_seqs`
+  batch; inactive slots point at the reserved trash page. One compiled
+  program, zero recompiles in steady state.
+- **Bucketed prefill**: prompt lengths are padded to power-of-two buckets
+  (multiples of page_size), so at most log2(max_seq_len/page_size)+1 prefill
+  programs are ever compiled. This is the recompile-control strategy that
+  replaces the TRT engine-build step (SURVEY.md §7 hard part #3).
+- **Sampling fused in-jit** with the decode step: one device round-trip per
+  step, returning only the [B] int32 next-token array to the host.
+- **Donated KV buffers**: the page pools are donated to each jit call, so XLA
+  updates them in place in HBM.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.kv_cache import (
+    KVCacheSpec,
+    OutOfPages,
+    PageAllocator,
+    SeqState,
+    alloc_kv_pages,
+)
+from dynamo_tpu.engine.request import GenRequest, TokenEvent
+from dynamo_tpu.engine import sampling as smp
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+from dynamo_tpu.parallel import sharding as shd
+
+log = logging.getLogger("dynamo_tpu.engine")
+
+
+def _next_bucket(n: int, page_size: int, max_len: int) -> int:
+    """Smallest power-of-two multiple of page_size >= n (capped at max_len
+    rounded up to a page multiple, so the bucket always page-aligns)."""
+    cap = -(-max_len // page_size) * page_size
+    b = page_size
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class EngineMetrics:
+    """Counters surfaced via the worker's /metrics endpoint."""
+
+    def __init__(self):
+        self.num_requests = 0
+        self.num_finished = 0
+        self.prompt_tokens = 0
+        self.output_tokens = 0
+        self.decode_steps = 0
+        self.prefill_time_s = 0.0
+        self.decode_time_s = 0.0
+        self.kv_oom = 0
+
+    def snapshot(self) -> Dict[str, float]:
+        return dict(self.__dict__)
+
+
+class Engine:
+    """Single-replica engine: owns params, KV pages, and the batching loop."""
+
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        model_cfg: Optional[ModelConfig] = None,
+        params=None,
+    ):
+        self.cfg = cfg
+        backend = jax.default_backend()
+        default_dtype = "float32" if backend == "cpu" else "bfloat16"
+        self.model_cfg = model_cfg or ModelConfig.from_model_name(
+            cfg.model_path or cfg.model, dtype=cfg.dtype or default_dtype
+        )
+        self.mesh = build_mesh(
+            MeshConfig(
+                tensor_parallel=cfg.tensor_parallel,
+                data_parallel=cfg.data_parallel,
+                expert_parallel=cfg.expert_parallel,
+            )
+        )
+        self.metrics = EngineMetrics()
+        self._lock = threading.Lock()
+
+        # --- parameters ---
+        if params is None:
+            from dynamo_tpu.models.loader import load_or_init_params
+
+            params = load_or_init_params(self.model_cfg, cfg.model_path, seed=cfg.seed)
+        with self.mesh:
+            self.params = shd.shard_params(params, self.mesh)
+
+        # --- KV cache ---
+        self.kv_spec = KVCacheSpec.from_model(
+            self.model_cfg, cfg.num_pages, cfg.page_size
+        )
+        self.k_pages, self.v_pages = alloc_kv_pages(
+            self.kv_spec, shd.kv_sharding(self.mesh)
+        )
+        self.allocator = PageAllocator(cfg.num_pages)
+
+        # --- batch slots (host-side mirrors of device batch state) ---
+        b, pmax = cfg.max_num_seqs, cfg.max_pages_per_seq
+        self.block_tables = np.zeros((b, pmax), dtype=np.int32)
+        self.cur_tokens = np.zeros((b,), dtype=np.int32)
+        self.positions = np.zeros((b,), dtype=np.int32)
+        self.context_lens = np.zeros((b,), dtype=np.int32)  # 0 = inactive
+        self.temperature = np.zeros((b,), dtype=np.float32)
+        self.top_p = np.ones((b,), dtype=np.float32)
+        self.top_k = np.zeros((b,), dtype=np.int32)
+        self.seqs: Dict[int, SeqState] = {}
+        self._free_slots = list(range(b - 1, -1, -1))
+        self.pending: collections.deque[GenRequest] = collections.deque()
+        self._aborted: set = set()
+
+        self.rng = jax.random.PRNGKey(cfg.seed)
+        self._build_jit()
+
+    # ------------------------------------------------------------------ jit --
+
+    def _build_jit(self):
+        cfg, mcfg = self.cfg, self.model_cfg
+        page_size = cfg.page_size
+
+        def prefill_fn(params, tokens, seq_len, k_pages, v_pages, pages):
+            out = llama.prefill(
+                mcfg, params, tokens, seq_len, k_pages, v_pages, pages,
+                page_size=page_size,
+            )
+            return out.last_logits, out.k_pages, out.v_pages
+
+        def decode_fn(
+            params, tokens, positions, block_tables, context_lens,
+            k_pages, v_pages, temperature, top_p, top_k, key,
+        ):
+            out = llama.decode_step(
+                mcfg, params, tokens, positions, block_tables, context_lens,
+                k_pages, v_pages, page_size=page_size,
+            )
+            state = smp.SamplingState(temperature, top_p, top_k)
+            next_tokens = smp.sample(out.logits, state, key)
+            return next_tokens, out.k_pages, out.v_pages
+
+        def sample_one(logits, temperature, top_p, top_k, key):
+            state = smp.SamplingState(temperature, top_p, top_k)
+            return smp.sample(logits[None], state, key)[0]
+
+        if cfg.enforce_eager:
+            self._prefill = prefill_fn
+            self._decode = decode_fn
+            self._sample_one = sample_one
+        else:
+            # donate KV pools: XLA updates them in place in HBM
+            self._prefill = jax.jit(prefill_fn, donate_argnums=(3, 4))
+            self._decode = jax.jit(decode_fn, donate_argnums=(5, 6))
+            self._sample_one = jax.jit(sample_one)
+
+    # ------------------------------------------------------- request intake --
+
+    def add_request(self, req: GenRequest) -> None:
+        """Enqueue a request. Raises ValueError if it can never be served
+        (over-length prompt or a KV footprint larger than the whole pool)."""
+        if len(req.prompt_token_ids) >= self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(req.prompt_token_ids)} tokens exceeds "
+                f"max_seq_len={self.cfg.max_seq_len}"
+            )
+        n_pages = max(1, -(-len(req.prompt_token_ids) // self.cfg.page_size))
+        if n_pages > self.cfg.num_pages - 1:
+            raise ValueError(
+                f"prompt needs {n_pages} KV pages; pool only has "
+                f"{self.cfg.num_pages - 1}"
+            )
+        with self._lock:
+            self.pending.append(req)
+            self.metrics.num_requests += 1
+
+    def abort_request(self, request_id: str) -> None:
+        """Mark a request aborted; the scheduler thread applies it in step()."""
+        with self._lock:
+            self._aborted.add(request_id)
+
+    @property
+    def num_active(self) -> int:
+        return len(self.seqs)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.seqs) or bool(self.pending)
+
+    # ------------------------------------------------------------ scheduling --
+
+    def step(self) -> List[TokenEvent]:
+        """One scheduler iteration: apply aborts, admit (prefill), decode.
+
+        step() is single-consumer: only one scheduler thread may call it.
+        Producers (add_request/abort_request) synchronise via self._lock."""
+        events: List[TokenEvent] = []
+        events.extend(self._apply_aborts())
+        events.extend(self._admit())
+        if self.seqs:
+            events.extend(self._decode_once())
+        return events
+
+    def _apply_aborts(self) -> List[TokenEvent]:
+        with self._lock:
+            aborted, self._aborted = self._aborted, set()
+            if not aborted:
+                return []
+            events = []
+            kept = collections.deque()
+            for r in self.pending:
+                if r.request_id in aborted:
+                    events.append(TokenEvent(r.request_id, -1, 0, True, "abort"))
+                else:
+                    kept.append(r)
+            self.pending = kept
+        for slot, seq in list(self.seqs.items()):
+            if seq.request_id in aborted:
+                events.append(
+                    TokenEvent(seq.request_id, -1, len(seq.output_tokens), True,
+                               "abort")
+                )
+                self._finish_slot(slot, "abort")
+        return events
+
+    def _admit(self) -> List[TokenEvent]:
+        events: List[TokenEvent] = []
+        while self._free_slots:
+            with self._lock:
+                if not self.pending:
+                    break
+                req = self.pending[0]
+                n_pages = max(
+                    1, -(-len(req.prompt_token_ids) // self.cfg.page_size)
+                )
+                if not self.allocator.can_alloc(n_pages):
+                    break  # wait for running sequences to release pages
+                self.pending.popleft()
+            try:
+                ev = self._prefill_request(req)
+            except OutOfPages:
+                self.metrics.kv_oom += 1
+                events.append(
+                    TokenEvent(req.request_id, -1, 0, True, "kv_oom")
+                )
+                continue
+            events.append(ev)
+        return events
+
+    def _prefill_request(self, req: GenRequest) -> TokenEvent:
+        cfg = self.cfg
+        t0 = time.monotonic()
+        prompt = req.prompt_token_ids
+        prompt_len = len(prompt)
+        bucket = _next_bucket(prompt_len, cfg.page_size, cfg.max_seq_len)
+        n_pages = bucket // cfg.page_size
+        pages = self.allocator.alloc(max(1, -(-prompt_len // cfg.page_size)))
+        # pad the page list to the bucket's page count with trash page 0
+        pages_arr = np.zeros((n_pages,), dtype=np.int32)
+        pages_arr[: len(pages)] = pages
+
+        tokens = np.zeros((bucket,), dtype=np.int32)
+        tokens[:prompt_len] = prompt
+
+        last_logits, self.k_pages, self.v_pages = self._prefill(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.int32(prompt_len),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(pages_arr),
+        )
+        self.rng, key = jax.random.split(self.rng)
+        first = int(
+            self._sample_one(
+                last_logits,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_p], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                key,
+            )
+        )
+        self.metrics.prefill_time_s += time.monotonic() - t0
+        self.metrics.prompt_tokens += prompt_len
+
+        slot = self._free_slots.pop()
+        seq = SeqState(
+            req.request_id,
+            slot,
+            pages,
+            prompt_len,
+            max_tokens=req.max_tokens,
+            temperature=req.temperature,
+            top_p=req.top_p,
+            top_k=req.top_k,
+            stop_token_ids=(
+                [] if req.ignore_eos
+                else (req.stop_token_ids or [self.model_cfg.eos_token_id])
+            ),
+        )
+        seq.output_tokens.append(first)
+        self.seqs[slot] = seq
+        self.block_tables[slot, :] = 0
+        self.block_tables[slot, : len(pages)] = pages
+        self.cur_tokens[slot] = first
+        self.temperature[slot] = req.temperature
+        self.top_p[slot] = req.top_p
+        self.top_k[slot] = req.top_k
+        self.metrics.output_tokens += 1
+
+        finished, reason = self._check_stop(seq, first)
+        ev = TokenEvent(req.request_id, first, 0, finished, reason)
+        if finished:
+            self._finish_slot(slot, reason)
+        return ev
+
+    def _decode_once(self) -> List[TokenEvent]:
+        t0 = time.monotonic()
+        cfg = self.cfg
+        events: List[TokenEvent] = []
+
+        # grow page lists for sequences whose next token starts a new page
+        for slot, seq in list(self.seqs.items()):
+            if seq.needs_page(cfg.page_size):
+                if not self.allocator.can_alloc(1):
+                    self.metrics.kv_oom += 1
+                    events.append(
+                        TokenEvent(
+                            seq.request_id, -1, len(seq.output_tokens), True, "kv_oom"
+                        )
+                    )
+                    self._finish_slot(slot, "kv_oom")
+                    continue
+                page = self.allocator.alloc(1)[0]
+                seq.pages.append(page)
+                self.block_tables[slot, len(seq.pages) - 1] = page
+
+        if not self.seqs:
+            return events
+
+        for slot, seq in self.seqs.items():
+            self.positions[slot] = seq.num_tokens
+            self.context_lens[slot] = seq.num_tokens + 1
+        # inactive slots: position 0 / trash page / context 1 (masked by result drop)
+        active = set(self.seqs)
+        for slot in range(cfg.max_num_seqs):
+            if slot not in active:
+                self.positions[slot] = 0
+                self.context_lens[slot] = 1
+                self.block_tables[slot, :] = 0
+
+        self.rng, key = jax.random.split(self.rng)
+        next_tokens, self.k_pages, self.v_pages = self._decode(
+            self.params,
+            jnp.asarray(self.cur_tokens),
+            jnp.asarray(self.positions),
+            jnp.asarray(self.block_tables),
+            jnp.asarray(self.context_lens),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(self.temperature),
+            jnp.asarray(self.top_p),
+            jnp.asarray(self.top_k),
+            key,
+        )
+        next_np = np.asarray(next_tokens)
+        self.metrics.decode_steps += 1
+        self.metrics.decode_time_s += time.monotonic() - t0
+
+        for slot, seq in list(self.seqs.items()):
+            tok = int(next_np[slot])
+            seq.num_tokens += 1  # the token we just attended over is now cached
+            seq.output_tokens.append(tok)
+            self.cur_tokens[slot] = tok
+            self.metrics.output_tokens += 1
+            finished, reason = self._check_stop(seq, tok)
+            events.append(
+                TokenEvent(
+                    seq.request_id, tok, len(seq.output_tokens) - 1, finished, reason
+                )
+            )
+            if finished:
+                self._finish_slot(slot, reason)
+        return events
+
+    def _check_stop(self, seq: SeqState, token: int):
+        if token in seq.stop_token_ids:
+            return True, "stop"
+        if len(seq.output_tokens) >= seq.max_tokens:
+            return True, "length"
+        if seq.prompt_len + len(seq.output_tokens) >= self.cfg.max_seq_len:
+            return True, "length"
+        return False, None
+
+    def _finish_slot(self, slot: int, reason: Optional[str]):
+        seq = self.seqs.pop(slot, None)
+        if seq is None:
+            return
+        self.allocator.free(seq.pages)
+        self.block_tables[slot, :] = 0
+        self.context_lens[slot] = 0
+        self._free_slots.append(slot)
+        self.metrics.num_finished += 1
+
+    # ------------------------------------------------------------ conveniences
+
+    def generate(self, req: GenRequest) -> List[int]:
+        """Blocking single-request generation (tests, CLI)."""
+        self.add_request(req)
+        out: List[int] = []
+        while self.has_work:
+            for ev in self.step():
+                if ev.request_id == req.request_id and ev.token_id >= 0:
+                    out.append(ev.token_id)
+        return out

@@ -1,0 +1,157 @@
+"""Paged KV-cache: device-resident page pool + host-side page allocator.
+
+The device arrays are `[num_layers, num_kv_heads, num_pages, page_size,
+head_dim]` for K and V, sharded on the KV-head axis over the `model` mesh axis
+(dynamo_tpu.parallel.sharding.KV_SPEC) so each tensor-parallel shard owns its
+local heads' pages and the decode loop never crosses ICI for cache reads.
+
+Page 0 is a reserved "trash" page: inactive batch slots point at it so the
+full-batch decode step stays shape-static without masking scatter writes.
+
+Page size defaults to 16 — parity with the reference's SGLang flag
+(/root/reference/examples/deploy/sglang/agg.yaml:38-39).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+
+class OutOfPages(Exception):
+    """KV pool exhausted — scheduler should defer admission."""
+
+
+@dataclasses.dataclass
+class KVCacheSpec:
+    num_layers: int
+    num_kv_heads: int
+    num_pages: int
+    page_size: int
+    head_dim: int
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def from_model(
+        cfg: ModelConfig, num_pages: int, page_size: int
+    ) -> "KVCacheSpec":
+        return KVCacheSpec(
+            num_layers=cfg.num_layers,
+            num_kv_heads=cfg.num_kv_heads,
+            num_pages=num_pages,
+            page_size=page_size,
+            head_dim=cfg.head_dim,
+            dtype=cfg.dtype,
+        )
+
+    @property
+    def shape(self):
+        return (
+            self.num_layers,
+            self.num_kv_heads,
+            self.num_pages,
+            self.page_size,
+            self.head_dim,
+        )
+
+    def bytes_per_token(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.num_kv_heads * self.head_dim * itemsize
+
+
+def alloc_kv_pages(spec: KVCacheSpec, sharding=None):
+    """Allocate zeroed K/V page pools (optionally with a NamedSharding)."""
+    k = jnp.zeros(spec.shape, dtype=jnp.dtype(spec.dtype))
+    v = jnp.zeros(spec.shape, dtype=jnp.dtype(spec.dtype))
+    if sharding is not None:
+        k = jax.device_put(k, sharding)
+        v = jax.device_put(v, sharding)
+    return k, v
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the device page pool.
+
+    Pure-Python bookkeeping (no device sync) — the analogue of vLLM's block
+    manager, kept intentionally simple: pages are identical, a sequence holds
+    an ordered page list, and prefix-sharing/copy-on-write can layer on top
+    (ref-counted pages are supported via `ref`)."""
+
+    def __init__(self, num_pages: int):
+        # page 0 reserved as trash
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._refs = np.zeros(num_pages, dtype=np.int32)
+        self._refs[0] = 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise OutOfPages(f"need {n} pages, {len(self._free)} free")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def ref(self, pages: List[int]) -> None:
+        for p in pages:
+            assert self._refs[p] > 0
+            self._refs[p] += 1
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p == 0:
+                continue
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+
+class SeqState:
+    """Host-side state for one in-flight sequence (one decode slot)."""
+
+    __slots__ = (
+        "request_id", "slot", "pages", "num_tokens", "output_tokens",
+        "max_tokens", "temperature", "top_p", "top_k", "stop_token_ids",
+        "prompt_len",
+    )
+
+    def __init__(
+        self,
+        request_id: str,
+        slot: int,
+        pages: List[int],
+        prompt_len: int,
+        max_tokens: int,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        top_k: int = 0,
+        stop_token_ids: Optional[List[int]] = None,
+    ):
+        self.request_id = request_id
+        self.slot = slot
+        self.pages = pages
+        self.prompt_len = prompt_len
+        self.num_tokens = prompt_len  # tokens whose KV is in cache
+        self.output_tokens: List[int] = []
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+        self.top_p = top_p
+        self.top_k = top_k
+        self.stop_token_ids = stop_token_ids or []
+
+    def needs_page(self, page_size: int) -> bool:
+        """Will the next decoded token spill onto a new page?"""
+        return self.num_tokens >= len(self.pages) * page_size

@@ -1,0 +1,29 @@
+"""Engine-level request/event types (token-id domain; text lives in serving/)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class GenRequest:
+    request_id: str
+    prompt_token_ids: List[int]
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    stop_token_ids: List[int] = dataclasses.field(default_factory=list)
+    ignore_eos: bool = False
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    request_id: str
+    token_id: int
+    index: int  # 0-based output-token index
+    finished: bool = False
+    finish_reason: Optional[str] = None  # stop | length | abort | kv_oom

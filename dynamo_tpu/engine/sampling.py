@@ -1,0 +1,56 @@
+"""In-jit token sampling: greedy / temperature / top-k / top-p per batch slot.
+
+All parameters are per-slot arrays so one compiled sampler serves a
+heterogeneous continuous batch (requests arrive with their own OpenAI
+sampling params via /v1/chat/completions, mirroring the reference frontend's
+contract, /root/reference/README.md:284-292).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingState(NamedTuple):
+    temperature: jax.Array  # [B] float32; 0 -> greedy
+    top_p: jax.Array  # [B] float32 in (0, 1]
+    top_k: jax.Array  # [B] int32; 0 -> disabled
+
+
+def sample(
+    logits: jax.Array,  # [B, V]
+    state: SamplingState,
+    key: jax.Array,
+) -> jax.Array:
+    """Return [B] sampled token ids."""
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # temperature
+    temp = jnp.maximum(state.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask everything below the k-th largest logit
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
+    k = jnp.clip(jnp.where(state.top_k <= 0, v, state.top_k), 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [B,1]
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p (nucleus): keep the smallest prefix of the sorted distribution with
+    # cumulative probability >= top_p
+    sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # keep token i if the cumulative mass BEFORE it is < top_p
+    keep_sorted = (cum - probs_sorted) < state.top_p[:, None]
+    # threshold logit = smallest kept logit
+    num_keep = jnp.maximum(keep_sorted.sum(axis=-1), 1)
+    thresh = jnp.take_along_axis(sorted_desc2, (num_keep - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(state.temperature <= 0.0, greedy, sampled)

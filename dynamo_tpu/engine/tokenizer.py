@@ -1,0 +1,77 @@
+"""Tokenizer layer: HF tokenizers when available locally, byte-level fallback.
+
+The byte fallback keeps every test and the CPU fake-engine path fully offline
+(the environment has zero egress), mirroring the reference's
+`--skip-tokenizer-init` escape hatch
+(/root/reference/examples/deploy/sglang/agg.yaml:42-43).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: ids 0-255 are bytes; specials above."""
+
+    BOS = 256
+    EOS = 257
+    PAD = 258
+
+    vocab_size = 259
+    bos_token_id = BOS
+    eos_token_id = EOS
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.BOS] if add_bos else []) + ids
+
+    def decode(self, ids: List[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        parts = []
+        for m in messages:
+            parts.append(f"<|{m['role']}|>\n{m['content']}\n")
+        parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+class HFTokenizer:
+    """transformers AutoTokenizer wrapper (local files only)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self.tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self.tok)
+        self.bos_token_id = self.tok.bos_token_id
+        self.eos_token_id = self.tok.eos_token_id
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self.tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: List[int]) -> str:
+        return self.tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        try:
+            return self.tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        except Exception:
+            return ByteTokenizer.apply_chat_template(self, messages)  # type: ignore
+
+
+def get_tokenizer(model: str, model_path: Optional[str] = None):
+    """HF tokenizer if a local checkpoint dir carries tokenizer files, else bytes."""
+    for cand in (model_path, model):
+        if cand and os.path.isdir(cand):
+            for f in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json"):
+                if os.path.exists(os.path.join(cand, f)):
+                    try:
+                        return HFTokenizer(cand)
+                    except Exception:
+                        break
+    return ByteTokenizer()

@@ -1,0 +1,1 @@
+from dynamo_tpu.models.config import ModelConfig, PRESETS  # noqa: F401

@@ -1,0 +1,200 @@
+"""Model configuration for the llama-family decoder architectures served by the
+engine workers.
+
+The reference stack serves models by HF id via engine CLI flags
+(`/root/reference/examples/deploy/vllm/agg.yaml:33-35` `--model
+meta-llama/Llama-3.2-1B-Instruct`); here the analogous contract is
+`ModelConfig.from_model_name`, which understands either a preset name, a local
+HF checkpoint directory (config.json), or falls back to a tiny debug model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny-debug"
+    vocab_size: int = 512
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: int = 32
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 8192
+    tie_word_embeddings: bool = True
+    # qwen3-style per-head q/k RMSNorm
+    qk_norm: bool = False
+    # qwen2-style attention bias on q/k/v projections
+    attention_bias: bool = False
+    # MoE (mixtral/deepseek-style). num_experts == 0 -> dense MLP.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # dtype for params/compute (bfloat16 on TPU; float32 for CPU tests)
+    dtype: str = "bfloat16"
+    eos_token_id: int = 2
+    bos_token_id: int = 1
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @staticmethod
+    def from_hf_config(cfg: dict, name: str = "hf-model", dtype: str = "bfloat16") -> "ModelConfig":
+        """Map a HuggingFace config.json dict onto ModelConfig.
+
+        Covers LlamaForCausalLM / Qwen2ForCausalLM / Qwen3ForCausalLM /
+        MixtralForCausalLM config keys.
+        """
+        arch = (cfg.get("architectures") or [""])[0]
+        num_heads = cfg["num_attention_heads"]
+        hidden = cfg["hidden_size"]
+        head_dim = cfg.get("head_dim") or hidden // num_heads
+        eos = cfg.get("eos_token_id", 2)
+        if isinstance(eos, list):
+            eos = eos[0]
+        return ModelConfig(
+            name=name,
+            vocab_size=cfg["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=cfg.get("intermediate_size")
+            or cfg.get("moe_intermediate_size")
+            or 4 * hidden,
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=num_heads,
+            num_kv_heads=cfg.get("num_key_value_heads", num_heads),
+            head_dim=head_dim,
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=cfg.get("max_position_embeddings", 8192),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            qk_norm="Qwen3" in arch,
+            attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
+            num_experts=cfg.get("num_local_experts", cfg.get("n_routed_experts", 0)) or 0,
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            dtype=dtype,
+            eos_token_id=eos,
+            bos_token_id=cfg.get("bos_token_id", 1),
+        )
+
+    @staticmethod
+    def from_model_name(model: str, dtype: Optional[str] = None) -> "ModelConfig":
+        """Resolve a model identifier the way the reference's engine flags do.
+
+        Accepts: a preset key (see PRESETS), a local directory containing an HF
+        config.json, or an HF-style id whose basename matches a preset.
+        """
+        if model in PRESETS:
+            cfg = PRESETS[model]
+        else:
+            cfg_path = os.path.join(model, "config.json")
+            if os.path.isdir(model) and os.path.exists(cfg_path):
+                with open(cfg_path) as f:
+                    cfg = ModelConfig.from_hf_config(json.load(f), name=model)
+            else:
+                base = model.rstrip("/").split("/")[-1].lower()
+                if base not in PRESETS:
+                    raise ValueError(
+                        f"unknown model {model!r}: not a preset "
+                        f"({sorted(PRESETS)}), and not a local checkpoint dir "
+                        f"with a config.json"
+                    )
+                cfg = dataclasses.replace(PRESETS[base], name=model)
+        if dtype is not None:
+            cfg = dataclasses.replace(cfg, dtype=dtype)
+        return cfg
+
+
+# Architecture presets for the model families named in BASELINE.json configs.
+# Sizes match the public HF configs for each model.
+PRESETS = {
+    "tiny-debug": ModelConfig(),
+    "tiny-moe-debug": ModelConfig(
+        name="tiny-moe-debug", num_experts=4, num_experts_per_tok=2
+    ),
+    "llama-3.2-1b-instruct": ModelConfig(
+        name="llama-3.2-1b-instruct",
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        rope_theta=500000.0,
+        tie_word_embeddings=True,
+        eos_token_id=128009,
+        bos_token_id=128000,
+    ),
+    "meta-llama-3-8b-instruct": ModelConfig(
+        name="meta-llama-3-8b-instruct",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        tie_word_embeddings=False,
+        eos_token_id=128009,
+        bos_token_id=128000,
+    ),
+    "meta-llama-3-70b-instruct": ModelConfig(
+        name="meta-llama-3-70b-instruct",
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        tie_word_embeddings=False,
+        eos_token_id=128009,
+        bos_token_id=128000,
+    ),
+    "qwen3-0.6b": ModelConfig(
+        name="qwen3-0.6b",
+        vocab_size=151936,
+        hidden_size=1024,
+        intermediate_size=3072,
+        num_layers=28,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        tie_word_embeddings=True,
+        qk_norm=True,
+        eos_token_id=151645,
+        bos_token_id=151643,
+    ),
+    "mixtral-8x7b-instruct-v0.1": ModelConfig(
+        name="mixtral-8x7b-instruct-v0.1",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        num_experts=8,
+        num_experts_per_tok=2,
+        eos_token_id=2,
+        bos_token_id=1,
+    ),
+}
+# Aliases matching the ids used in the reference manifests
+# (/root/reference/examples/deploy/vllm/agg.yaml:33, .../dgdr/trtllm/disagg.yaml).
+PRESETS["meta-llama/Llama-3.2-1B-Instruct".lower().split("/")[-1]] = PRESETS[
+    "llama-3.2-1b-instruct"
+]
+PRESETS["qwen/qwen3-0.6b".split("/")[-1]] = PRESETS["qwen3-0.6b"]

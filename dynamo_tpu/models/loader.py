@@ -1,0 +1,136 @@
+"""Checkpoint loading: HF safetensors -> the engine's stacked param layout.
+
+Replaces the reference engines' HF-hub weight loading (the manifests mount a
+HF cache PVC at /home/dynamo/.cache/huggingface,
+/root/reference/examples/dgdr/trtllm/disagg_cache.yaml:29-34). This
+environment has zero egress, so loading is strictly local-dir; absent weights
+fall back to seeded random init (tests, smoke benches, fake-engine mode).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models import llama
+
+log = logging.getLogger("dynamo_tpu.loader")
+
+
+def load_or_init_params(
+    cfg: ModelConfig, model_path: Optional[str], seed: int = 0
+) -> Dict[str, jax.Array]:
+    if model_path and os.path.isdir(model_path):
+        files = sorted(glob.glob(os.path.join(model_path, "*.safetensors")))
+        if files:
+            return load_hf_safetensors(cfg, files)
+        log.warning("no safetensors under %s; using random init", model_path)
+    return llama.init_params(cfg, jax.random.PRNGKey(seed))
+
+
+def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
+    """Stream HF-layout tensors into the stacked [num_layers, ...] layout."""
+    from safetensors import safe_open
+
+    dt = jnp.dtype(cfg.dtype)
+    e, h, kv, d, f, l = (
+        cfg.hidden_size,
+        cfg.num_heads,
+        cfg.num_kv_heads,
+        cfg.head_dim,
+        cfg.intermediate_size,
+        cfg.num_layers,
+    )
+
+    raw: Dict[str, jax.Array] = {}
+
+    def want(name: str) -> bool:
+        return name.startswith(("model.", "lm_head."))
+
+    # framework="flax" hands back jnp arrays and handles bfloat16 natively
+    for path in files:
+        with safe_open(path, framework="flax") as fh:
+            for name in fh.keys():
+                if want(name):
+                    raw[name] = fh.get_tensor(name)
+
+    def g(name: str) -> jax.Array:
+        return raw.pop(name)
+
+    def to_dt(x) -> jax.Array:
+        return jnp.asarray(x).astype(dt)
+
+    def stack(fmt: str, transform) -> jax.Array:
+        return jnp.stack([transform(g(fmt.format(i=i))) for i in range(l)])
+
+    p: Dict[str, jax.Array] = {}
+    p["embed"] = to_dt(g("model.embed_tokens.weight"))
+    p["final_norm"] = to_dt(g("model.norm.weight"))
+    p["attn_norm"] = stack(
+        "model.layers.{i}.input_layernorm.weight", lambda w: to_dt(w)
+    )
+    p["mlp_norm"] = stack(
+        "model.layers.{i}.post_attention_layernorm.weight", lambda w: to_dt(w)
+    )
+    p["wq"] = stack(
+        "model.layers.{i}.self_attn.q_proj.weight",
+        lambda w: to_dt(w).T.reshape(e, h, d),
+    )
+    p["wk"] = stack(
+        "model.layers.{i}.self_attn.k_proj.weight",
+        lambda w: to_dt(w).T.reshape(e, kv, d),
+    )
+    p["wv"] = stack(
+        "model.layers.{i}.self_attn.v_proj.weight",
+        lambda w: to_dt(w).T.reshape(e, kv, d),
+    )
+    p["wo"] = stack(
+        "model.layers.{i}.self_attn.o_proj.weight",
+        lambda w: to_dt(w).T.reshape(h, d, e),
+    )
+    if cfg.attention_bias:
+        p["bq"] = stack(
+            "model.layers.{i}.self_attn.q_proj.bias", lambda w: to_dt(w).reshape(h, d)
+        )
+        p["bk"] = stack(
+            "model.layers.{i}.self_attn.k_proj.bias", lambda w: to_dt(w).reshape(kv, d)
+        )
+        p["bv"] = stack(
+            "model.layers.{i}.self_attn.v_proj.bias", lambda w: to_dt(w).reshape(kv, d)
+        )
+    if cfg.qk_norm:
+        p["q_norm"] = stack("model.layers.{i}.self_attn.q_norm.weight", to_dt)
+        p["k_norm"] = stack("model.layers.{i}.self_attn.k_norm.weight", to_dt)
+    if cfg.is_moe:
+        x = cfg.num_experts
+        p["router"] = stack(
+            "model.layers.{i}.block_sparse_moe.gate.weight", lambda w: to_dt(w).T
+        )
+
+        def experts(i: int, which: str) -> jnp.ndarray:
+            ws = [
+                to_dt(g(f"model.layers.{i}.block_sparse_moe.experts.{j}.{which}.weight")).T
+                for j in range(x)
+            ]
+            return jnp.stack(ws)  # [X, in, out]
+
+        p["moe_w_gate"] = jnp.stack([experts(i, "w1") for i in range(l)])
+        p["moe_w_up"] = jnp.stack([experts(i, "w3") for i in range(l)])
+        p["moe_w_down"] = jnp.stack([experts(i, "w2") for i in range(l)])
+    else:
+        p["w_gate"] = stack(
+            "model.layers.{i}.mlp.gate_proj.weight", lambda w: to_dt(w).T
+        )
+        p["w_up"] = stack("model.layers.{i}.mlp.up_proj.weight", lambda w: to_dt(w).T)
+        p["w_down"] = stack(
+            "model.layers.{i}.mlp.down_proj.weight", lambda w: to_dt(w).T
+        )
+    if not cfg.tie_word_embeddings:
+        p["lm_head"] = to_dt(g("lm_head.weight")).T
+    return p

@@ -1,0 +1,129 @@
+"""Paged attention ops: XLA reference implementations.
+
+These define the op contract used by the engine. A TPU Pallas kernel with the
+same signature can be swapped in per-backend. The KV layout is paged —
+page_size defaults to 16
+for parity with the reference's SGLang flag `--page-size 16`
+(/root/reference/examples/deploy/sglang/agg.yaml:38-39).
+
+Layout:
+  k_pages, v_pages: [num_kv_heads, num_pages, page_size, head_dim]
+  block_table:      [batch, max_pages_per_seq] int32 (page ids; 0 is the trash page)
+  context_lens:     [batch] int32 — tokens in context INCLUDING the current one
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(x: jax.Array, n_rep: int, axis: int) -> jax.Array:
+    """GQA: repeat KV heads along `axis` to match the query head count."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=axis)
+
+
+def write_kv_token(
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    k_new: jax.Array,  # [B, KV, D]
+    v_new: jax.Array,
+    block_table: jax.Array,  # [B, Pmax]
+    positions: jax.Array,  # [B] position being written (0-based)
+    *,
+    page_size: int,
+):
+    """Scatter one new token's K/V per sequence into its page.
+
+    Inactive batch slots must carry block_table rows of zeros and position 0 so
+    their writes land in the reserved trash page 0.
+    """
+    page_idx = jnp.take_along_axis(
+        block_table, (positions // page_size)[:, None], axis=1
+    ).squeeze(1)  # [B]
+    slot_idx = positions % page_size  # [B]
+    # advanced indexing over (page, slot) pairs -> [KV, B, D]
+    k_pages = k_pages.at[:, page_idx, slot_idx, :].set(
+        k_new.transpose(1, 0, 2), mode="drop"
+    )
+    v_pages = v_pages.at[:, page_idx, slot_idx, :].set(
+        v_new.transpose(1, 0, 2), mode="drop"
+    )
+    return k_pages, v_pages
+
+
+def write_kv_prefill(
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    k_new: jax.Array,  # [S, KV, D] padded to a multiple of page_size
+    v_new: jax.Array,
+    pages: jax.Array,  # [S // page_size] page ids for this sequence (0 pads)
+    *,
+    page_size: int,
+):
+    """Scatter a full (padded) prompt's K/V into its pages."""
+    s, kv, d = k_new.shape
+    n_pages = s // page_size
+    k_r = k_new.reshape(n_pages, page_size, kv, d).transpose(2, 0, 1, 3)
+    v_r = v_new.reshape(n_pages, page_size, kv, d).transpose(2, 0, 1, 3)
+    k_pages = k_pages.at[:, pages, :, :].set(k_r, mode="drop")
+    v_pages = v_pages.at[:, pages, :, :].set(v_r, mode="drop")
+    return k_pages, v_pages
+
+
+def paged_attention_decode(
+    q: jax.Array,  # [B, H, D] — one query token per sequence
+    k_pages: jax.Array,  # [KV, P, ps, D]
+    v_pages: jax.Array,
+    block_table: jax.Array,  # [B, Pmax]
+    context_lens: jax.Array,  # [B]
+    *,
+    page_size: int,
+) -> jax.Array:
+    """Reference paged decode attention (gather + masked softmax).
+
+    XLA fuses the gather with the QK matmul reasonably well on TPU; the Pallas
+    kernel avoids materialising the gathered KV in HBM entirely.
+    """
+    bsz, n_heads, head_dim = q.shape
+    n_kv = k_pages.shape[0]
+    pmax = block_table.shape[1]
+    # gather pages: [KV, B, Pmax, ps, D] -> [B, KV, S, D]
+    k = jnp.moveaxis(k_pages[:, block_table], 0, 1).reshape(
+        bsz, n_kv, pmax * page_size, head_dim
+    )
+    v = jnp.moveaxis(v_pages[:, block_table], 0, 1).reshape(
+        bsz, n_kv, pmax * page_size, head_dim
+    )
+    k = repeat_kv(k, n_heads // n_kv, axis=1)
+    v = repeat_kv(v, n_heads // n_kv, axis=1)
+    scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+    scores = jnp.einsum("bhd,bhsd->bhs", q * scale, k)
+    span = jnp.arange(pmax * page_size)[None, None, :]
+    mask = span < context_lens[:, None, None]
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhs,bhsd->bhd", probs, v)
+
+
+def prefill_attention(
+    q: jax.Array,  # [S, H, D]
+    k: jax.Array,  # [S, KV, D]
+    v: jax.Array,
+    seq_len,  # int or scalar array: true (unpadded) length
+) -> jax.Array:
+    """Causal self-attention over a single padded prompt."""
+    s, n_heads, head_dim = q.shape
+    n_kv = k.shape[1]
+    k = repeat_kv(k, n_heads // n_kv, axis=1)
+    v = repeat_kv(v, n_heads // n_kv, axis=1)
+    scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+    scores = jnp.einsum("qhd,khd->hqk", q * scale, k)
+    qi = jnp.arange(s)[:, None]
+    ki = jnp.arange(s)[None, :]
+    mask = (ki <= qi) & (ki < seq_len)
+    scores = jnp.where(mask[None], scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("hqk,khd->qhd", probs, v)

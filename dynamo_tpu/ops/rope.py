@@ -1,0 +1,26 @@
+"""Rotary position embeddings (HF llama "rotate_half" convention)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, theta: float) -> jax.Array:
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [..., seq?, heads, head_dim]; positions broadcastable to x's token dims.
+
+    Accepts [S, H, D] with positions [S], or [B, H, D] with positions [B]
+    (decode: one token per sequence).
+    """
+    head_dim = x.shape[-1]
+    inv = rope_freqs(head_dim, theta)  # [D/2]
+    angles = positions.astype(jnp.float32)[..., None] * inv  # [..., D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

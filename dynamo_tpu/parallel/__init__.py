@@ -1,0 +1,1 @@
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh  # noqa: F401

@@ -1,0 +1,64 @@
+"""Device-mesh construction for TPU slices.
+
+The reference exposes tensor parallelism as an engine CLI flag (`--tp N`,
+/root/reference/examples/deploy/sglang/agg.yaml:40-41) and data parallelism as
+K8s `replicas`. Here `--tp` maps to the size of the `model` mesh axis laid out
+over ICI; `data` is the in-engine batch axis; `expert` is the MoE axis
+(BASELINE.json config #5). Multi-host slices extend the same mesh over DCN —
+XLA places collectives on ICI within a host-connected slice automatically when
+the mesh axis ordering matches the physical device order
+(`jax.experimental.mesh_utils.create_device_mesh`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "expert", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    tensor_parallel: int = 1  # `model` axis (intra-slice ICI)
+    data_parallel: int = 1  # `data` axis
+    expert_parallel: int = 1  # `expert` axis (MoE)
+
+    @property
+    def num_devices(self) -> int:
+        return self.tensor_parallel * self.data_parallel * self.expert_parallel
+
+
+def build_mesh(
+    cfg: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, expert, model) mesh.
+
+    The `model` axis is innermost so tensor-parallel collectives ride the
+    fastest ICI links (nearest-neighbour on the torus).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = cfg.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices (dp={cfg.data_parallel} x "
+            f"ep={cfg.expert_parallel} x tp={cfg.tensor_parallel}), "
+            f"only {len(devices)} available"
+        )
+    shape = (cfg.data_parallel, cfg.expert_parallel, cfg.tensor_parallel)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices[:n])
+    except Exception:
+        dev_array = np.array(devices[:n]).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshConfig())

@@ -1,0 +1,78 @@
+"""Named-sharding rules for llama-family parameters, KV cache, and activations.
+
+Megatron-style tensor parallelism expressed declaratively: column-parallel
+projections shard their output feature dim on `model`, row-parallel shard the
+input feature dim; XLA inserts the psum/all-gather collectives over ICI.
+This replaces the NCCL tensor-parallel groups inside the reference's consumed
+engines (SURVEY.md §2d).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Param-tree leaf name -> PartitionSpec. Layer-stacked params carry a leading
+# `num_layers` axis (scanned over), which is never sharded.
+PARAM_RULES: Dict[str, P] = {
+    # [V, E]: shard vocab so the embed table and (tied) lm_head split evenly.
+    "embed": P("model", None),
+    "lm_head": P(None, "model"),  # [E, V]
+    "final_norm": P(None),
+    # attention (leading L axis from the layer stack)
+    "attn_norm": P(None, None),
+    "wq": P(None, None, "model", None),  # [L, E, H, D] column-parallel
+    "wk": P(None, None, "model", None),  # [L, E, KV, D]
+    "wv": P(None, None, "model", None),
+    "wo": P(None, "model", None, None),  # [L, H, D, E] row-parallel
+    "bq": P(None, "model", None),
+    "bk": P(None, "model", None),
+    "bv": P(None, "model", None),
+    "q_norm": P(None, None),
+    "k_norm": P(None, None),
+    # dense MLP
+    "mlp_norm": P(None, None),
+    "w_gate": P(None, None, "model"),  # [L, E, F] column-parallel
+    "w_up": P(None, None, "model"),
+    "w_down": P(None, "model", None),  # [L, F, E] row-parallel
+    # MoE: experts shard on `expert`, features on `model`
+    "router": P(None, None, None),  # [L, E, num_experts]
+    "moe_w_gate": P(None, "expert", None, "model"),  # [L, X, E, F]
+    "moe_w_up": P(None, "expert", None, "model"),
+    "moe_w_down": P(None, "expert", "model", None),  # [L, X, F, E]
+}
+
+# KV cache: [L, KV_heads, pages, page_size, head_dim] — heads on `model` so
+# each TP shard appends/reads only its local heads; pages stay local to the
+# shard (no cross-device traffic in the decode inner loop).
+KV_SPEC = P(None, "model", None, None, None)
+# decode activations: batch on data, hidden replicated across model
+ACT_SPEC = P("data", None)
+
+
+def param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Map a param tree to PartitionSpecs by leaf name (dict key)."""
+
+    def spec_for(name: str, x) -> P:
+        if name in PARAM_RULES:
+            return PARAM_RULES[name]
+        return P(*([None] * x.ndim))
+
+    return {k: spec_for(k, v) for k, v in params.items()}
+
+
+def shard_params(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    specs = param_specs(params)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in params.items()
+    }
+
+
+def kv_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, KV_SPEC)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

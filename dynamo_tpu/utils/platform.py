@@ -1,0 +1,39 @@
+"""Backend selection helpers.
+
+The TPU plugin in this environment registers itself at interpreter start and
+programmatically forces `jax_platforms` to prefer the TPU, overriding the
+`JAX_PLATFORMS` env var. `force_cpu()` re-overrides at the config layer —
+call it before any JAX backend initialization (tests, multi-chip dry runs on
+virtual CPU devices, the fake-engine path).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(num_virtual_devices: int | None = None) -> None:
+    if num_virtual_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{num_virtual_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def want_cpu_from_env() -> bool:
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+
+
+def maybe_force_cpu_from_env() -> None:
+    """Honor JAX_PLATFORMS=cpu even when a plugin overrode jax config."""
+    if want_cpu_from_env():
+        force_cpu()

@@ -1,0 +1,33 @@
+"""Test fixtures: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the multi-device simulation strategy from SURVEY.md §4 — the
+reference has no test suite at all (verification is operational only), so the
+fake-device mesh is how we exceed it: TP/DP/EP sharding and disagg KV transfer
+are all testable on CPU.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The environment's TPU plugin forces jax_platforms at the config layer
+# (overriding the env var), so re-override before any backend init.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,38 @@
+import pytest
+
+from dynamo_tpu.engine.kv_cache import OutOfPages, PageAllocator
+
+
+def test_alloc_free_roundtrip():
+    a = PageAllocator(num_pages=8)  # page 0 reserved
+    assert a.free_pages == 7
+    pages = a.alloc(3)
+    assert len(set(pages)) == 3
+    assert 0 not in pages
+    assert a.free_pages == 4
+    a.free(pages)
+    assert a.free_pages == 7
+
+
+def test_oom_raises():
+    a = PageAllocator(num_pages=4)
+    a.alloc(3)
+    with pytest.raises(OutOfPages):
+        a.alloc(1)
+
+
+def test_refcounted_sharing():
+    a = PageAllocator(num_pages=8)
+    pages = a.alloc(2)
+    a.ref(pages)  # second holder (prefix sharing)
+    a.free(pages)
+    assert a.free_pages == 5  # still held
+    a.free(pages)
+    assert a.free_pages == 7
+
+
+def test_trash_page_never_freed():
+    a = PageAllocator(num_pages=4)
+    a.free([0, 0])
+    assert a.free_pages == 3
+    assert 0 not in a.alloc(3)

@@ -14,28 +14,16 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
 
 def _init_backend() -> str:
+    from dynamo_tpu.utils.platform import force_cpu, init_backend_with_fallback
+
     if os.environ.get("BENCH_FORCE_CPU"):
-        from dynamo_tpu.utils.platform import force_cpu
-
         force_cpu()
         return "cpu"
-    import jax
-
-    try:
-        jax.devices()
-        return jax.default_backend()
-    except Exception as e:  # TPU tunnel unavailable -> CPU fallback
-        print(f"bench: TPU backend unavailable ({e}); falling back to CPU",
-              file=sys.stderr)
-        from dynamo_tpu.utils.platform import force_cpu
-
-        force_cpu()
-        return "cpu"
+    return init_backend_with_fallback()
 
 
 def main() -> None:

@@ -199,6 +199,18 @@ class Engine:
         with self._lock:
             self._aborted.add(request_id)
 
+    def abort_all(self) -> List[str]:
+        """Tear down every pending and running request (fatal-step recovery),
+        releasing slots and KV pages. Returns the affected request ids."""
+        with self._lock:
+            ids = [r.request_id for r in self.pending]
+            self.pending.clear()
+            self._aborted.clear()
+        for slot, seq in list(self.seqs.items()):
+            ids.append(seq.request_id)
+            self._finish_slot(slot, "abort")
+        return ids
+
     @property
     def num_active(self) -> int:
         return len(self.seqs)

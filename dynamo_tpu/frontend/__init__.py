@@ -1,0 +1,1 @@
+"""Frontend/router process package (`python -m dynamo_tpu.frontend`)."""

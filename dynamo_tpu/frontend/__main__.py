@@ -1,0 +1,51 @@
+"""Frontend entrypoint: OpenAI-compatible router over registered workers.
+
+TPU-native stand-in for the Dynamo frontend pod every reference manifest
+declares (/root/reference/examples/deploy/vllm/agg.yaml:12-17).
+"""
+
+import argparse
+import logging
+import os
+import signal
+import threading
+
+from dynamo_tpu.serving.frontend import FrontendContext, make_frontend_server
+
+
+def main(argv=None):
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    p = argparse.ArgumentParser(prog="dynamo_tpu.frontend")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("DYNAMO_PORT", 8000)))
+    p.add_argument("--heartbeat-ttl", type=float, default=15.0)
+    p.add_argument("--static-workers", default=os.environ.get("STATIC_WORKERS"),
+                   help="comma-separated worker URLs (skip heartbeat discovery)")
+    p.add_argument("--static-model", default=os.environ.get("STATIC_MODEL"))
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.serving.router import Router
+
+    router = Router(heartbeat_ttl=args.heartbeat_ttl)
+    if args.static_workers:
+        # static registration never expires
+        router.ttl = float("inf")
+        for url in args.static_workers.split(","):
+            router.register(url.strip(), args.static_model or "?", "agg")
+    ctx = FrontendContext(router)
+    srv = make_frontend_server(ctx, args.host, args.port)
+
+    def shutdown(*_):
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    logging.getLogger("dynamo_tpu.frontend").info(
+        "frontend listening on %s:%d", args.host, args.port
+    )
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""JetStream-style JAX-native engine backend alias (`python -m
+dynamo_tpu.jetstream`), the TPU counterpart of `python3 -m dynamo.sglang`
+(/root/reference/examples/deploy/sglang/agg.yaml:31-43)."""

@@ -1,0 +1,268 @@
+"""OpenAI-compatible HTTP server serving a local Engine — the aggregated-worker
+path, equivalent to the reference's engine worker + frontend collapsed into one
+pod (/root/reference/examples/deploy/vllm/agg.yaml).
+
+Endpoints: GET /v1/models, POST /v1/chat/completions, POST /v1/completions
+(both with SSE streaming), GET /metrics (Prometheus), GET /health, /live,
+/ready, GET /worker/stats (router introspection).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.engine.tokenizer import get_tokenizer
+from dynamo_tpu.serving import protocol as proto
+from dynamo_tpu.serving.engine_service import EngineService
+from dynamo_tpu.serving.http_base import (
+    JsonHTTPHandler,
+    make_http_server,
+    serve_forever_in_thread,  # noqa: F401  (re-export for callers/tests)
+)
+from dynamo_tpu.serving.metrics import FrontendMetrics, Gauge
+
+log = logging.getLogger("dynamo_tpu.api")
+
+
+class IncrementalDetokenizer:
+    """Streaming detokenization with bounded re-decode (vLLM-style windows):
+    each push decodes only the tokens since the last emitted boundary, holding
+    back trailing bytes that don't yet form complete UTF-8."""
+
+    def __init__(self, tokenizer):
+        self.tok = tokenizer
+        self.ids: List[int] = []
+        self.prefix_offset = 0
+        self.read_offset = 0
+        self.emitted = ""
+
+    def push(self, token_id: int) -> str:
+        self.ids.append(token_id)
+        prefix_text = self.tok.decode(self.ids[self.prefix_offset:self.read_offset])
+        new_text = self.tok.decode(self.ids[self.prefix_offset:])
+        if new_text.endswith("�"):
+            return ""
+        delta = new_text[len(prefix_text):]
+        self.prefix_offset = self.read_offset
+        self.read_offset = len(self.ids)
+        self.emitted += delta
+        return delta
+
+
+class GenerationHandle:
+    """A submitted request plus its event stream — submission (and its
+    validation errors) happens strictly before any response bytes."""
+
+    def __init__(self, ctx: "ServingContext", rid: str, prompt_ids: List[int],
+                 params: dict):
+        self.ctx = ctx
+        self.rid = rid
+        self.prompt_ids = prompt_ids
+        self.req = GenRequest(
+            rid,
+            list(prompt_ids),
+            max_tokens=params["max_tokens"],
+            temperature=params["temperature"],
+            top_p=params["top_p"],
+            top_k=params["top_k"],
+            ignore_eos=params.get("ignore_eos", False),
+        )
+        self.queue = ctx.service.submit(self.req)  # raises ValueError early
+        ctx.metrics.requests_total.inc(model=ctx.served_model)
+        ctx.metrics.isl.observe(len(prompt_ids), model=ctx.served_model)
+
+    def run(self, emit) -> tuple:
+        """Drive the stream; emit(delta, finish|None) -> bool keeps going while
+        True. A False return (client gone) aborts the engine request.
+
+        Returns (text, finish_reason, completion_tokens)."""
+        ctx, m = self.ctx, self.ctx.metrics
+        model = ctx.served_model
+        t0 = time.monotonic()
+        t_prev: Optional[float] = None
+        detok = IncrementalDetokenizer(ctx.tokenizer)
+        n_out = 0
+        finish = "stop"
+        for ev in ctx.service.drain(self.req, self.queue):
+            now = time.monotonic()
+            if t_prev is None:
+                m.ttft.observe(now - t0, model=model)
+            else:
+                m.itl.observe(now - t_prev, model=model)
+            t_prev = now
+            delta = ""
+            if ev.token_id >= 0:
+                n_out += 1
+                delta = detok.push(ev.token_id)
+            fr = proto.map_finish_reason(ev.finish_reason) if ev.finished else None
+            if ev.finished:
+                finish = fr or "stop"
+            if delta or ev.finished:
+                if not emit(delta, fr) and not ev.finished:
+                    log.info("client disconnected; aborting %s", self.rid)
+                    ctx.service.abort(self.rid)
+                    finish = "abort"
+                    break
+        m.duration.observe(time.monotonic() - t0, model=model)
+        m.osl.observe(n_out, model=model)
+        ctx.kv_gauge.set(ctx.engine.allocator.free_pages)
+        return detok.emitted, finish, n_out
+
+
+class ServingContext:
+    """Everything the request handlers need, bundled for the handler class."""
+
+    def __init__(self, engine: Engine, served_model: str):
+        self.engine = engine
+        self.service = EngineService(engine)
+        self.served_model = served_model
+        self.tokenizer = get_tokenizer(engine.cfg.model, engine.cfg.model_path)
+        self.metrics = FrontendMetrics()
+        self.kv_gauge = Gauge(
+            "dynamo_worker_kv_free_pages", "Free KV pages", self.metrics.registry
+        )
+        self.start_time = time.time()
+
+    def close(self):
+        self.service.close()
+
+    def start_generation(self, rid, prompt_ids, params) -> GenerationHandle:
+        return GenerationHandle(self, rid, prompt_ids, params)
+
+
+class _Handler(JsonHTTPHandler):
+    ctx: ServingContext  # bound by make_server
+
+    # ------------------------------------------------------------- routes --
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        if path == "/v1/models":
+            self._json(200, proto.models_response([self.ctx.served_model]))
+        elif path == "/metrics":
+            self._raw(200, self.ctx.metrics.registry.expose().encode(),
+                      "text/plain; version=0.0.4")
+        elif path in ("/health", "/live", "/ready"):
+            self._json(200, {"status": "ok", "uptime_s": round(
+                time.time() - self.ctx.start_time, 1)})
+        elif path == "/worker/stats":
+            eng = self.ctx.engine
+            self._json(200, {
+                "model": self.ctx.served_model,
+                "active_seqs": eng.num_active,
+                "pending": len(eng.pending),
+                "free_pages": eng.allocator.free_pages,
+                "total_pages": eng.cfg.num_pages,
+                "max_num_seqs": eng.cfg.max_num_seqs,
+                "disaggregation_mode": eng.cfg.disaggregation_mode,
+                "metrics": eng.metrics.snapshot(),
+            })
+        else:
+            self._error(404, f"no route {path}")
+
+    def do_POST(self):
+        path = self.path.split("?")[0]
+        try:
+            if path == "/v1/chat/completions":
+                self._chat(self._read_json_body())
+            elif path == "/v1/completions":
+                self._completion(self._read_json_body())
+            else:
+                self._error(404, f"no route {path}")
+        except proto.BadRequest as e:
+            self._fail(400, str(e))
+        except ValueError as e:  # engine-level rejection (over-length, ...)
+            self._fail(400, str(e))
+        except TimeoutError as e:
+            self._fail(504, str(e), "timeout")
+        except Exception:
+            log.exception("request failed")
+            self._fail(500, "internal error", "internal_error")
+
+    def _fail(self, code: int, msg: str, etype: str = "invalid_request_error"):
+        if self.sse_started:
+            self._sse_error(msg)
+        else:
+            self._error(code, msg, etype)
+
+    # ------------------------------------------------------------ handlers --
+    def _check_model(self, model: str):
+        if model not in (self.ctx.served_model, self.ctx.engine.cfg.model):
+            raise proto.BadRequest(
+                f"model {model!r} not served (serving {self.ctx.served_model!r})"
+            )
+
+    def _chat(self, body):
+        p = proto.parse_chat_request(body)
+        self._check_model(p["model"])
+        prompt_text = self.ctx.tokenizer.apply_chat_template(p["messages"])
+        prompt_ids = self.ctx.tokenizer.encode(prompt_text)
+        rid = proto.new_id("chatcmpl")
+        gen = self.ctx.start_generation(rid, prompt_ids, p)  # may raise -> 400
+
+        if p["stream"]:
+            self._start_sse()
+            self._sse_chunk(
+                proto.chat_chunk(rid, p["model"], {"role": "assistant"}, None)
+            )
+
+            def emit(delta, finish) -> bool:
+                ok = True
+                if delta:
+                    ok = self._sse_chunk(
+                        proto.chat_chunk(rid, p["model"], {"content": delta}, None)
+                    )
+                if finish is not None:
+                    ok = self._sse_chunk(
+                        proto.chat_chunk(rid, p["model"], {}, finish)) and ok
+                return ok
+
+            gen.run(emit)
+            self._sse_chunk("[DONE]")
+            self._end_sse()
+        else:
+            text, finish, n_out = gen.run(lambda d, f: True)
+            self._json(
+                200,
+                proto.chat_completion_response(
+                    rid, p["model"], text, finish, len(prompt_ids), n_out
+                ),
+            )
+
+    def _completion(self, body):
+        p = proto.parse_completion_request(body)
+        self._check_model(p["model"])
+        prompt_ids = self.ctx.tokenizer.encode(p["prompt"])
+        rid = proto.new_id("cmpl")
+        gen = self.ctx.start_generation(rid, prompt_ids, p)
+        if p["stream"]:
+            self._start_sse()
+
+            def emit(delta, finish) -> bool:
+                if delta or finish is not None:
+                    return self._sse_chunk({
+                        "id": rid, "object": "text_completion",
+                        "created": int(time.time()), "model": p["model"],
+                        "choices": [{"index": 0, "text": delta,
+                                     "finish_reason": finish}],
+                    })
+                return True
+
+            gen.run(emit)
+            self._sse_chunk("[DONE]")
+            self._end_sse()
+        else:
+            text, finish, n_out = gen.run(lambda d, f: True)
+            self._json(
+                200,
+                proto.completion_response(
+                    rid, p["model"], text, finish, len(prompt_ids), n_out
+                ),
+            )
+
+
+def make_server(ctx: ServingContext, host: str = "0.0.0.0", port: int = 8000):
+    return make_http_server(_Handler, {"ctx": ctx}, host, port)

@@ -1,0 +1,115 @@
+"""Background scheduler thread bridging the synchronous Engine to concurrent
+HTTP handlers via per-request event queues.
+
+This is the in-process analogue of the reference's worker runtime loop: HTTP
+threads enqueue GenRequests; one scheduler thread drives Engine.step() and
+fans TokenEvents out to stream queues.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest, TokenEvent
+
+log = logging.getLogger("dynamo_tpu.service")
+
+
+class EngineService:
+    def __init__(self, engine: Engine):
+        self.engine = engine
+        self._queues: Dict[str, "queue.Queue[TokenEvent]"] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="engine-scheduler")
+        self._thread.start()
+
+    # ------------------------------------------------------------- lifecycle
+    def close(self):
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    # --------------------------------------------------------------- intake
+    def submit(self, req: GenRequest) -> "queue.Queue[TokenEvent]":
+        """Validate and enqueue; raises ValueError BEFORE any output starts,
+        so HTTP handlers can reject with a clean status line."""
+        q: "queue.Queue[TokenEvent]" = queue.Queue()
+        with self._lock:
+            self._queues[req.request_id] = q
+        try:
+            self.engine.add_request(req)
+        except ValueError:
+            with self._lock:
+                self._queues.pop(req.request_id, None)
+            raise
+        self._wake.set()
+        return q
+
+    def abort(self, request_id: str):
+        self.engine.abort_request(request_id)
+        self._wake.set()
+
+    def stream(self, req: GenRequest, timeout: float = 600.0) -> Iterator[TokenEvent]:
+        """Submit and yield TokenEvents until the request finishes."""
+        q = self.submit(req)
+        return self.drain(req, q, timeout)
+
+    def drain(self, req: GenRequest, q: "queue.Queue[TokenEvent]",
+              timeout: float = 600.0) -> Iterator[TokenEvent]:
+        """Yield TokenEvents for an already-submitted request."""
+        deadline = time.monotonic() + timeout
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.abort(req.request_id)
+                    raise TimeoutError(f"request {req.request_id} timed out")
+                try:
+                    # short poll so a server shutdown can't strand the handler;
+                    # a slow first token (jit compile) just keeps polling until
+                    # the overall deadline
+                    ev = q.get(timeout=min(remaining, 5.0))
+                except queue.Empty:
+                    continue
+                yield ev
+                if ev.finished:
+                    return
+        finally:
+            with self._lock:
+                self._queues.pop(req.request_id, None)
+
+    # ------------------------------------------------------------ scheduler
+    def _run(self):
+        while not self._stop:
+            if not self.engine.has_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                events = self.engine.step()
+            except Exception:
+                log.exception("engine step failed; aborting in-flight requests")
+                # release engine slots/KV pages so the worker can recover,
+                # notify every waiter, and back off before the next attempt
+                ids = self.engine.abort_all()
+                with self._lock:
+                    for rid in ids:
+                        q = self._queues.pop(rid, None)
+                        if q is not None:
+                            q.put(TokenEvent(rid, -1, 0, True, "abort"))
+                time.sleep(0.5)
+                continue
+            if events:
+                with self._lock:
+                    for ev in events:
+                        q = self._queues.get(ev.request_id)
+                        if q is not None:
+                            q.put(ev)

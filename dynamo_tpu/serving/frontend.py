@@ -1,0 +1,183 @@
+"""Frontend: OpenAI-compatible HTTP entrypoint that routes to engine workers.
+
+The TPU-native equivalent of the reference's consumed Dynamo frontend/router
+pod (every DGD manifest's `Frontend` service,
+/root/reference/examples/deploy/vllm/agg.yaml:12-17). Responsibilities:
+- serve /v1/models (union of registered workers) and proxy
+  /v1/chat/completions + /v1/completions with SSE passthrough;
+- KV-affinity routing via serving.router.Router (HRW prefix hashing);
+- worker membership via HTTP heartbeats (POST /internal/register) — the
+  lightweight stand-in for the reference's etcd registry + NATS request plane
+  (SURVEY.md §2d); an etcd-backed registry can be swapped in via
+  dynamo_tpu.distributed.registry;
+- emit the dynamo_frontend_* metric contract at /metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from dynamo_tpu.serving import protocol as proto
+from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
+from dynamo_tpu.serving.metrics import FrontendMetrics, Gauge
+from dynamo_tpu.serving.router import Router, prefix_key
+
+log = logging.getLogger("dynamo_tpu.frontend")
+
+
+class FrontendContext:
+    def __init__(self, router: Optional[Router] = None):
+        self.router = router or Router()
+        self.metrics = FrontendMetrics()
+        self.worker_gauge = Gauge(
+            "dynamo_frontend_workers", "Registered live workers",
+            self.metrics.registry,
+        )
+        self.start_time = time.time()
+
+
+class _FrontendHandler(JsonHTTPHandler):
+    ctx: FrontendContext
+
+    # ---------------------------------------------------------------- routes
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        ctx = self.ctx
+        if path == "/v1/models":
+            self._json(200, proto.models_response(ctx.router.models()))
+        elif path == "/metrics":
+            ctx.worker_gauge.set(len(ctx.router.alive(("agg", "prefill", "decode"))))
+            self._raw(200, ctx.metrics.registry.expose().encode(),
+                      "text/plain; version=0.0.4")
+        elif path in ("/health", "/live", "/ready"):
+            workers = len(ctx.router.alive(("agg", "prefill", "decode")))
+            code = 200 if path != "/ready" or workers > 0 else 503
+            self._json(code, {"status": "ok" if code == 200 else "no-workers",
+                              "workers": workers})
+        elif path == "/internal/workers":
+            self._json(200, {
+                "workers": [
+                    {"url": w.url, "model": w.model, "mode": w.mode,
+                     "headroom": round(w.headroom, 3), "stats": w.stats}
+                    for w in ctx.router.alive(("agg", "prefill", "decode"))
+                ]
+            })
+        else:
+            self._error(404, f"no route {path}")
+
+    def do_POST(self):
+        path = self.path.split("?")[0]
+        try:
+            if path == "/internal/register":
+                body = self._read_json_body()
+                self.ctx.router.register(
+                    body["url"], body.get("model", "?"),
+                    body.get("mode", "agg"), body.get("stats"),
+                )
+                self._json(200, {"ok": True})
+            elif path in ("/v1/chat/completions", "/v1/completions"):
+                self._proxy(path)
+            else:
+                self._error(404, f"no route {path}")
+        except proto.BadRequest as e:
+            self._error(400, str(e))
+        except Exception:
+            log.exception("frontend request failed")
+            self._error(500, "internal error", "internal_error")
+
+    # ----------------------------------------------------------------- proxy
+    def _proxy(self, path: str):
+        ctx = self.ctx
+        raw = self._read_raw_body()
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise proto.BadRequest(f"invalid JSON: {e}")
+        if path.endswith("chat/completions"):
+            parsed = proto.parse_chat_request(body)
+            affinity = prefix_key(
+                json.dumps(parsed["messages"])[:512]
+            )
+        else:
+            parsed = proto.parse_completion_request(body)
+            affinity = prefix_key(parsed["prompt"])
+        model = parsed["model"]
+        worker = ctx.router.pick(model, affinity)
+        if worker is None:
+            self._error(503, f"no live worker for model {model!r}",
+                        "service_unavailable")
+            return
+
+        m = ctx.metrics
+        m.requests_total.inc(model=model)
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            worker.url.rstrip("/") + path,
+            data=raw,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=600)
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            self.send_response(e.code)
+            self.send_header("Content-Type",
+                             e.headers.get("Content-Type", "application/json"))
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        except (urllib.error.URLError, socket.error) as e:
+            ctx.router.deregister(worker.url)
+            self._error(502, f"worker {worker.url} unreachable: {e}",
+                        "bad_gateway")
+            return
+
+        ctype = resp.headers.get("Content-Type", "application/json")
+        if "text/event-stream" in ctype:
+            # SSE passthrough; observe TTFT on the first forwarded byte
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            first = True
+            try:
+                while True:
+                    chunk = resp.read1(65536) if hasattr(resp, "read1") else resp.read(65536)
+                    if not chunk:
+                        break
+                    if first:
+                        m.ttft.observe(time.monotonic() - t0, model=model)
+                        first = False
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, socket.error):
+                pass
+        else:
+            payload = resp.read()
+            m.ttft.observe(time.monotonic() - t0, model=model)
+            try:
+                usage = json.loads(payload).get("usage", {})
+                m.isl.observe(usage.get("prompt_tokens", 0), model=model)
+                m.osl.observe(usage.get("completion_tokens", 0), model=model)
+            except Exception:
+                pass
+            self.send_response(resp.status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        m.duration.observe(time.monotonic() - t0, model=model)
+
+
+def make_frontend_server(ctx: FrontendContext, host="0.0.0.0", port=8000):
+    return make_http_server(_FrontendHandler, {"ctx": ctx}, host, port)

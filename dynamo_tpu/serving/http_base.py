@@ -1,0 +1,108 @@
+"""Shared HTTP plumbing for the worker API server and the frontend router:
+JSON responses, error envelopes, body reading, chunked SSE framing."""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+
+from dynamo_tpu.serving import protocol as proto
+
+log = logging.getLogger("dynamo_tpu.http")
+
+MAX_BODY_BYTES = 10 * 1024 * 1024
+
+
+class JsonHTTPHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _json(self, code: int, obj: Dict[str, Any]):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, code: int, msg: str, etype: str = "invalid_request_error"):
+        self._json(code, {"error": {"message": msg, "type": etype, "code": code}})
+
+    def _raw(self, code: int, data: bytes, content_type: str):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _read_raw_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0 or length > MAX_BODY_BYTES:
+            raise proto.BadRequest("missing or oversized request body")
+        return self.rfile.read(length)
+
+    def _read_json_body(self) -> Dict[str, Any]:
+        try:
+            return json.loads(self._read_raw_body())
+        except json.JSONDecodeError as e:
+            raise proto.BadRequest(f"invalid JSON: {e}")
+
+    # -------------------------------------------------------------- SSE ----
+    sse_started: bool = False
+
+    def _start_sse(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        self.sse_started = True
+
+    def _write_chunk(self, payload: bytes) -> bool:
+        try:
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(payload), payload))
+            self.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionResetError, socket.error):
+            return False
+
+    def _sse_chunk(self, obj) -> bool:
+        payload = (
+            f"data: {obj}\n\n".encode()
+            if isinstance(obj, str)
+            else b"data: " + json.dumps(obj).encode() + b"\n\n"
+        )
+        return self._write_chunk(payload)
+
+    def _end_sse(self):
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, socket.error):
+            pass
+
+    def _sse_error(self, msg: str):
+        """Error delivery after SSE headers are already on the wire: an error
+        event followed by [DONE], never a second HTTP status line."""
+        self._sse_chunk({"error": {"message": msg, "type": "stream_error"}})
+        self._sse_chunk("[DONE]")
+        self._end_sse()
+
+
+def make_http_server(handler_cls, attrs: Dict[str, Any], host: str, port: int):
+    handler = type(f"Bound{handler_cls.__name__}", (handler_cls,), attrs)
+    srv = ThreadingHTTPServer((host, port), handler)
+    srv.daemon_threads = True
+    return srv
+
+
+def serve_forever_in_thread(srv) -> threading.Thread:
+    t = threading.Thread(target=srv.serve_forever, daemon=True, name="http-server")
+    t.start()
+    return t

@@ -1,0 +1,184 @@
+"""Prometheus metrics, stdlib-only.
+
+The metric names ARE the compatibility contract: the reference's Grafana
+dashboard queries these exact series
+(/root/reference/examples/dgdr/trtllm/grafana-dynamo-dashboard-configmap.yaml:
+121 requests_total, 214 time_to_first_token, 307 inter_token_latency,
+400 request_duration, 493/504 input/output_sequence_tokens), so the dashboard
+ports to this stack unchanged. Implemented in-process (counter/gauge/histogram
+with _sum/_count/_bucket text exposition) to avoid a prometheus_client
+dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0, 60.0,
+)
+_TOKEN_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384)
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, registry: "Registry"):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        registry._register(self)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, registry):
+        super().__init__(name, help_, registry)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def labels(self, **labels) -> "_CounterChild":
+        return _CounterChild(self, tuple(sorted(labels.items())))
+
+    def inc(self, amount: float = 1.0, **labels):
+        self.labels(**labels).inc(amount)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+            for lbl, v in items:
+                out.append(f"{self.name}{_fmt_labels(lbl)} {v}")
+        return out
+
+
+class _CounterChild:
+    def __init__(self, parent: Counter, labels):
+        self.parent, self.lbl = parent, labels
+
+    def inc(self, amount: float = 1.0):
+        with self.parent._lock:
+            self.parent._values[self.lbl] = (
+                self.parent._values.get(self.lbl, 0.0) + amount
+            )
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_, registry):
+        super().__init__(name, help_, registry)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = value
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = list(self._values.items()) or [((), 0.0)]
+            for lbl, v in items:
+                out.append(f"{self.name}{_fmt_labels(lbl)} {v}")
+        return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, registry, buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, registry)
+        self.buckets = tuple(buckets)
+        self._counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        self._sum: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._n: Dict[Tuple[Tuple[str, str], ...], int] = {}
+
+    def observe(self, value: float, **labels):
+        lbl = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(lbl, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            self._sum[lbl] = self._sum.get(lbl, 0.0) + value
+            self._n[lbl] = self._n.get(lbl, 0) + 1
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            lbls = list(self._counts) or [()]
+            for lbl in lbls:
+                counts = self._counts.get(lbl, [0] * (len(self.buckets) + 1))
+                for i, b in enumerate(self.buckets):
+                    out.append(
+                        f"{self.name}_bucket{_fmt_labels(lbl, f'le=\"{b}\"')} "
+                        f"{counts[i]}"
+                    )
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(lbl, 'le=\"+Inf\"')} {counts[-1]}"
+                )
+                out.append(
+                    f"{self.name}_sum{_fmt_labels(lbl)} {self._sum.get(lbl, 0.0)}"
+                )
+                out.append(f"{self.name}_count{_fmt_labels(lbl)} {self._n.get(lbl, 0)}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def _register(self, m: _Metric):
+        with self._lock:
+            self._metrics.append(m)
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class FrontendMetrics:
+    """The dynamo_frontend_* serving-metric contract (SURVEY.md §5)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.requests_total = Counter(
+            "dynamo_frontend_requests_total", "Total LLM requests", r
+        )
+        self.ttft = Histogram(
+            "dynamo_frontend_time_to_first_token_seconds",
+            "Time to first token", r,
+        )
+        self.itl = Histogram(
+            "dynamo_frontend_inter_token_latency_seconds",
+            "Inter-token latency", r,
+        )
+        self.duration = Histogram(
+            "dynamo_frontend_request_duration_seconds",
+            "End-to-end request duration", r,
+        )
+        self.isl = Histogram(
+            "dynamo_frontend_input_sequence_tokens",
+            "Input sequence length (tokens)", r, buckets=_TOKEN_BUCKETS,
+        )
+        self.osl = Histogram(
+            "dynamo_frontend_output_sequence_tokens",
+            "Output sequence length (tokens)", r, buckets=_TOKEN_BUCKETS,
+        )
+        self.queued = Gauge(
+            "dynamo_frontend_queued_requests", "Requests queued or in flight", r
+        )

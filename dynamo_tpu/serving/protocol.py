@@ -1,0 +1,156 @@
+"""OpenAI-compatible request/response shaping (dict-level, stdlib-only).
+
+API surface contract: /v1/models and /v1/chat/completions (+ /v1/completions)
+exactly as the reference exposes them (/root/reference/README.md:277-292,
+/root/reference/deploy-incluster.sh:497-501), including SSE streaming chunks.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class BadRequest(Exception):
+    pass
+
+
+def new_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:24]}"
+
+
+def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
+    if not isinstance(body, dict):
+        raise BadRequest("body must be a JSON object")
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise BadRequest("'messages' must be a non-empty array")
+    for m in messages:
+        if not isinstance(m, dict) or "role" not in m or "content" not in m:
+            raise BadRequest("each message needs 'role' and 'content'")
+    model = body.get("model")
+    if not isinstance(model, str) or not model:
+        raise BadRequest("'model' is required")
+    mt = body.get("max_tokens", body.get("max_completion_tokens", 512))
+    if not isinstance(mt, int) or mt < 1:
+        raise BadRequest("'max_tokens' must be a positive integer")
+    temperature = _num(body, "temperature", 1.0)
+    if temperature < 0:
+        raise BadRequest("'temperature' must be >= 0")
+    return {
+        "model": model,
+        "messages": messages,
+        "max_tokens": mt,
+        "temperature": temperature,
+        "top_p": _num(body, "top_p", 1.0),
+        "top_k": int(_num(body, "top_k", 0)),
+        "stream": bool(body.get("stream", False)),
+        "ignore_eos": bool(body.get("ignore_eos", False)),
+    }
+
+
+def _num(body: Dict[str, Any], key: str, default: float) -> float:
+    v = body.get(key, default)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise BadRequest(f"'{key}' must be a number")
+    return float(v)
+
+
+def parse_completion_request(body: Dict[str, Any]) -> Dict[str, Any]:
+    if not isinstance(body, dict):
+        raise BadRequest("body must be a JSON object")
+    prompt = body.get("prompt")
+    if isinstance(prompt, list):
+        if not prompt or not all(isinstance(p, str) for p in prompt):
+            raise BadRequest("'prompt' array must contain strings")
+        prompt = prompt[0]
+    if not isinstance(prompt, str):
+        raise BadRequest("'prompt' must be a string")
+    model = body.get("model")
+    if not isinstance(model, str) or not model:
+        raise BadRequest("'model' is required")
+    mt = body.get("max_tokens", 16)
+    if not isinstance(mt, int) or mt < 1:
+        raise BadRequest("'max_tokens' must be a positive integer")
+    return {
+        "model": model,
+        "prompt": prompt,
+        "max_tokens": mt,
+        "temperature": _num(body, "temperature", 1.0),
+        "top_p": _num(body, "top_p", 1.0),
+        "top_k": int(_num(body, "top_k", 0)),
+        "stream": bool(body.get("stream", False)),
+        "ignore_eos": bool(body.get("ignore_eos", False)),
+    }
+
+
+def models_response(models: List[str]) -> Dict[str, Any]:
+    now = int(time.time())
+    return {
+        "object": "list",
+        "data": [
+            {"id": m, "object": "model", "created": now, "owned_by": "dynamo_tpu"}
+            for m in models
+        ],
+    }
+
+
+def chat_completion_response(
+    rid: str, model: str, text: str, finish_reason: str,
+    prompt_tokens: int, completion_tokens: int,
+) -> Dict[str, Any]:
+    return {
+        "id": rid,
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": finish_reason,
+            }
+        ],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
+        },
+    }
+
+
+def chat_chunk(
+    rid: str, model: str, delta: Dict[str, Any], finish_reason: Optional[str]
+) -> Dict[str, Any]:
+    return {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+    }
+
+
+def completion_response(
+    rid: str, model: str, text: str, finish_reason: str,
+    prompt_tokens: int, completion_tokens: int,
+) -> Dict[str, Any]:
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason,
+                     "logprobs": None}],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
+        },
+    }
+
+
+def map_finish_reason(reason: Optional[str]) -> str:
+    return {"stop": "stop", "length": "length", "abort": "stop",
+            "kv_oom": "length"}.get(reason or "stop", "stop")

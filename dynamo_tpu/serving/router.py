@@ -1,0 +1,118 @@
+"""KV-affinity request router.
+
+The reference's router lives in the consumed Dynamo runtime (Rust) and spreads
+requests across worker replicas with KV-cache awareness (SURVEY.md §2b
+"OpenAI-compatible frontend + router"). This implementation:
+
+- **Rendezvous (HRW) hashing** on the prompt prefix: identical/shared prefixes
+  deterministically land on the same worker, maximising paged-KV prefix reuse
+  — without any shared state between frontend replicas.
+- **Load shading**: the hash score is scaled by worker capacity headroom
+  (free slots / free KV pages from heartbeats), so a hot worker sheds new
+  prefixes to its peers.
+- **Role filtering** for disaggregated topologies: chat traffic goes to
+  `agg`/`decode` workers; `prefill` workers are selected separately by the
+  decode worker's KV-fetch path (mirrors the reference's frontend→decode→
+  prefill flow, /root/reference/examples/deploy/sglang/disagg.yaml).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class WorkerInfo:
+    url: str
+    model: str
+    mode: str = "agg"  # agg | prefill | decode
+    last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
+    stats: Dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def headroom(self) -> float:
+        """0..1 capacity signal from the last heartbeat."""
+        s = self.stats or {}
+        max_seqs = max(1, s.get("max_num_seqs", 1))
+        active = s.get("active_seqs", 0) + s.get("pending", 0)
+        slot_room = max(0.0, 1.0 - active / max_seqs)
+        total_pages = max(1, s.get("total_pages", 1))
+        page_room = s.get("free_pages", total_pages) / total_pages
+        return 0.5 * slot_room + 0.5 * page_room
+
+
+def prefix_key(text: str, prefix_chars: int = 256) -> str:
+    """Affinity key: the first prefix_chars of the prompt (system prompt +
+    early turns), which is what shared KV pages actually cover."""
+    return text[:prefix_chars]
+
+
+class Router:
+    def __init__(self, heartbeat_ttl: float = 15.0):
+        self.ttl = heartbeat_ttl
+        self._workers: Dict[str, WorkerInfo] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- membership --
+    def register(self, url: str, model: str, mode: str = "agg",
+                 stats: Optional[Dict] = None):
+        with self._lock:
+            w = self._workers.get(url)
+            if w is None:
+                self._workers[url] = WorkerInfo(url, model, mode,
+                                                stats=stats or {})
+            else:
+                w.model, w.mode = model, mode
+                w.last_heartbeat = time.monotonic()
+                if stats is not None:
+                    w.stats = stats
+
+    def deregister(self, url: str):
+        with self._lock:
+            self._workers.pop(url, None)
+
+    def alive(self, roles=("agg", "decode"), model: Optional[str] = None
+              ) -> List[WorkerInfo]:
+        cutoff = time.monotonic() - self.ttl
+        with self._lock:
+            return [
+                w for w in self._workers.values()
+                if w.last_heartbeat >= cutoff and w.mode in roles
+                and (model is None or w.model == model)
+            ]
+
+    def models(self) -> List[str]:
+        cutoff = time.monotonic() - self.ttl
+        with self._lock:
+            return sorted({
+                w.model for w in self._workers.values()
+                if w.last_heartbeat >= cutoff
+            })
+
+    # ------------------------------------------------------------- routing --
+    def pick(self, model: str, affinity_key: str,
+             roles=("agg", "decode")) -> Optional[WorkerInfo]:
+        cands = self.alive(roles, model)
+        if not cands:
+            # no worker serves this model -> let the frontend 503 rather than
+            # bouncing the request off a wrong-model worker's 400
+            return None
+        best, best_score = None, -1.0
+        for w in cands:
+            h = hashlib.sha256(
+                (affinity_key + "|" + w.url).encode()
+            ).digest()
+            hash_score = int.from_bytes(h[:8], "big") / 2**64
+            # weighted rendezvous: capacity scales the hash draw; a worker
+            # with zero headroom can still win if it is the only candidate
+            score = hash_score * (0.25 + 0.75 * w.headroom)
+            if score > best_score:
+                best, best_score = w, score
+        return best
+
+    def pick_prefill(self, model: str, affinity_key: str) -> Optional[WorkerInfo]:
+        return self.pick(model, affinity_key, roles=("prefill",))

@@ -1,0 +1,117 @@
+"""Engine-worker process entrypoint (shared by the jetstream / vllm_tpu
+backend aliases).
+
+CLI contract mirrors the reference's worker invocations
+(`python3 -m dynamo.vllm --model ...`,
+/root/reference/examples/deploy/vllm/agg.yaml:29-35; disagg role flags per
+/root/reference/examples/deploy/vllm/disagg.yaml:37,57 and
+/root/reference/examples/deploy/sglang/disagg.yaml:45-52), plus
+`--frontend-url` for heartbeat registration with the frontend/router.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.request
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.serving.api import ServingContext, make_server
+
+log = logging.getLogger("dynamo_tpu.worker")
+
+
+def _self_url(host: str, port: int) -> str:
+    if host not in ("0.0.0.0", "::"):
+        return f"http://{host}:{port}"
+    # advertise the pod/host IP (downward-API env in K8s, hostname locally)
+    adv = os.environ.get("POD_IP") or socket.gethostbyname(socket.gethostname())
+    return f"http://{adv}:{port}"
+
+
+def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
+                   interval: float, stop: threading.Event):
+    payload_url = frontend_url.rstrip("/") + "/internal/register"
+    first = True
+    while True:
+        if not first and stop.wait(interval):
+            return
+        first = False
+        eng = ctx.engine
+        body = json.dumps({
+            "url": self_url,
+            "model": ctx.served_model,
+            "mode": eng.cfg.disaggregation_mode,
+            "stats": {
+                "active_seqs": eng.num_active,
+                "pending": len(eng.pending),
+                "free_pages": eng.allocator.free_pages,
+                "total_pages": eng.cfg.num_pages,
+                "max_num_seqs": eng.cfg.max_num_seqs,
+            },
+        }).encode()
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    payload_url, data=body,
+                    headers={"Content-Type": "application/json"}, method="POST",
+                ),
+                timeout=5,
+            )
+        except Exception as e:
+            log.warning("heartbeat to %s failed: %s", payload_url, e)
+
+
+def main(argv=None, backend_name: str = "jetstream") -> None:
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    p = argparse.ArgumentParser(prog=f"dynamo_tpu.{backend_name}")
+    EngineConfig.add_cli_args(p)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
+    p.add_argument("--frontend-url", default=os.environ.get("FRONTEND_URL"))
+    p.add_argument("--heartbeat-interval", type=float, default=3.0)
+    args = p.parse_args(argv)
+
+    cfg = EngineConfig.from_cli_args(args)
+    from dynamo_tpu.utils.platform import init_backend_with_fallback
+
+    backend = init_backend_with_fallback()
+    log.info("starting %s worker: model=%s mode=%s tp=%d backend=%s",
+             backend_name, cfg.model, cfg.disaggregation_mode,
+             cfg.tensor_parallel, backend)
+    engine = Engine(cfg)
+    ctx = ServingContext(engine, cfg.served_name)
+    srv = make_server(ctx, args.host, args.port)
+
+    stop = threading.Event()
+    if args.frontend_url:
+        self_url = _self_url(args.host, args.port)
+        t = threading.Thread(
+            target=heartbeat_loop,
+            args=(ctx, args.frontend_url, self_url, args.heartbeat_interval, stop),
+            daemon=True, name="heartbeat",
+        )
+        t.start()
+
+    def shutdown(*_):
+        stop.set()
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    log.info("worker listening on %s:%d", args.host, args.port)
+    try:
+        srv.serve_forever()
+    finally:
+        ctx.close()
+
+
+if __name__ == "__main__":
+    main()

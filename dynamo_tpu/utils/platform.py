@@ -37,3 +37,22 @@ def maybe_force_cpu_from_env() -> None:
     """Honor JAX_PLATFORMS=cpu even when a plugin overrode jax config."""
     if want_cpu_from_env():
         force_cpu()
+
+
+def init_backend_with_fallback() -> str:
+    """Initialize the JAX backend, falling back to CPU when no accelerator is
+    reachable (e.g. TPU tunnel down). Returns the backend name in use."""
+    maybe_force_cpu_from_env()
+    import jax
+
+    try:
+        jax.devices()
+        return jax.default_backend()
+    except Exception as e:
+        import logging
+
+        logging.getLogger("dynamo_tpu.platform").warning(
+            "accelerator backend unavailable (%s); falling back to CPU", e
+        )
+        force_cpu()
+        return "cpu"

@@ -1,0 +1,3 @@
+"""vLLM-TPU-style engine backend alias (`python -m dynamo_tpu.vllm_tpu`), the
+TPU counterpart of `python3 -m dynamo.vllm`
+(/root/reference/examples/deploy/vllm/agg.yaml:29-35)."""

@@ -1,0 +1,131 @@
+"""Frontend routing integration: worker registration, proxying, SSE passthrough."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.serving.api import ServingContext, make_server, serve_forever_in_thread
+from dynamo_tpu.serving.frontend import FrontendContext, make_frontend_server
+
+MODEL = "tiny-debug"
+
+
+@pytest.fixture(scope="module")
+def stack():
+    engine = Engine(
+        EngineConfig(model=MODEL, page_size=4, num_pages=128, max_num_seqs=4,
+                     max_seq_len=128)
+    )
+    wctx = ServingContext(engine, MODEL)
+    wsrv = make_server(wctx, "127.0.0.1", 0)
+    serve_forever_in_thread(wsrv)
+    worker_url = f"http://127.0.0.1:{wsrv.server_address[1]}"
+
+    fctx = FrontendContext()
+    fsrv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(fsrv)
+    frontend_url = f"http://127.0.0.1:{fsrv.server_address[1]}"
+    yield {"frontend": frontend_url, "worker": worker_url, "fctx": fctx}
+    fsrv.shutdown()
+    wsrv.shutdown()
+    wctx.close()
+
+
+def post(url, path, body, raw=False):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    resp = urllib.request.urlopen(req, timeout=120)
+    return resp if raw else json.loads(resp.read())
+
+
+def get(url, path):
+    return urllib.request.urlopen(url + path, timeout=30).read().decode()
+
+
+def register(stack):
+    post(stack["frontend"], "/internal/register", {
+        "url": stack["worker"], "model": MODEL, "mode": "agg",
+        "stats": {"max_num_seqs": 4, "free_pages": 100, "total_pages": 128},
+    })
+
+
+def test_no_workers_503(stack):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["frontend"], "/v1/chat/completions", {
+            "model": MODEL, "messages": [{"role": "user", "content": "x"}],
+        })
+    assert ei.value.code == 503
+
+
+def test_register_and_models(stack):
+    register(stack)
+    data = json.loads(get(stack["frontend"], "/v1/models"))
+    assert [m["id"] for m in data["data"]] == [MODEL]
+    workers = json.loads(get(stack["frontend"], "/internal/workers"))["workers"]
+    assert workers[0]["url"] == stack["worker"]
+
+
+def test_proxied_chat_completion(stack):
+    register(stack)
+    out = post(stack["frontend"], "/v1/chat/completions", {
+        "model": MODEL, "messages": [{"role": "user", "content": "route me"}],
+        "max_tokens": 5, "temperature": 0, "ignore_eos": True,
+    })
+    assert out["object"] == "chat.completion"
+    assert out["usage"]["completion_tokens"] == 5
+
+
+def test_proxied_streaming(stack):
+    register(stack)
+    resp = post(stack["frontend"], "/v1/chat/completions", {
+        "model": MODEL, "messages": [{"role": "user", "content": "s"}],
+        "max_tokens": 4, "temperature": 0, "stream": True, "ignore_eos": True,
+    }, raw=True)
+    assert "text/event-stream" in resp.headers["Content-Type"]
+    lines = [l.decode().strip() for l in resp if l.strip()]
+    assert lines[-1] == "data: [DONE]"
+
+
+def test_proxied_error_passthrough(stack):
+    register(stack)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["frontend"], "/v1/chat/completions", {
+            "model": MODEL, "messages": [{"role": "user", "content": "x"}],
+            "max_tokens": -1,
+        })
+    assert ei.value.code == 400  # frontend-side validation mirrors worker's
+
+
+def test_frontend_metrics(stack):
+    register(stack)
+    text = get(stack["frontend"], "/metrics")
+    assert "dynamo_frontend_requests_total" in text
+    assert "dynamo_frontend_workers" in text
+
+
+def test_dead_worker_evicted(stack):
+    fctx = stack["fctx"]
+    fctx.router.register("http://127.0.0.1:9/", MODEL, "agg",
+                         {"free_pages": 1000, "total_pages": 1000,
+                          "max_num_seqs": 64})
+    # route until the dead worker is picked once: it must be deregistered and
+    # the request must NOT 502 forever afterwards
+    for i in range(30):
+        try:
+            post(stack["frontend"], "/v1/chat/completions", {
+                "model": MODEL,
+                "messages": [{"role": "user", "content": f"probe {i}"}],
+                "max_tokens": 2, "temperature": 0, "ignore_eos": True,
+            })
+        except urllib.error.HTTPError as e:
+            assert e.code == 502
+        if "http://127.0.0.1:9/" not in {w.url for w in fctx.router.alive()}:
+            break
+    alive = {w.url for w in fctx.router.alive()}
+    assert "http://127.0.0.1:9/" not in alive

@@ -1,0 +1,63 @@
+import time
+
+from dynamo_tpu.serving.router import Router, prefix_key
+
+
+def reg(r, url, model="m", mode="agg", **stats):
+    r.register(url, model, mode, stats or None)
+
+
+def test_affinity_deterministic():
+    r = Router()
+    for i in range(4):
+        reg(r, f"http://w{i}:8000")
+    key = prefix_key("You are a helpful assistant. Hello!")
+    picks = {r.pick("m", key).url for _ in range(10)}
+    assert len(picks) == 1, "same prefix must map to one worker"
+
+
+def test_different_prefixes_spread():
+    r = Router()
+    for i in range(4):
+        reg(r, f"http://w{i}:8000")
+    picks = {r.pick("m", prefix_key(f"prompt variant {i}")).url for i in range(64)}
+    assert len(picks) >= 3, f"HRW should spread across workers, got {picks}"
+
+
+def test_role_filtering():
+    r = Router()
+    reg(r, "http://prefill:8000", mode="prefill")
+    reg(r, "http://decode:8000", mode="decode")
+    assert r.pick("m", "x").url == "http://decode:8000"
+    assert r.pick_prefill("m", "x").url == "http://prefill:8000"
+
+
+def test_model_filtering_strict():
+    r = Router()
+    reg(r, "http://a:1", model="llama")
+    reg(r, "http://b:1", model="qwen")
+    assert r.pick("llama", "k").url == "http://a:1"
+    # unknown model must NOT be routed to a wrong-model worker (frontend 503s)
+    assert r.pick("gpt-x", "k") is None
+
+
+def test_heartbeat_expiry():
+    r = Router(heartbeat_ttl=0.05)
+    reg(r, "http://w:1")
+    assert r.pick("m", "k") is not None
+    time.sleep(0.08)
+    assert r.pick("m", "k") is None
+    assert r.models() == []
+
+
+def test_load_shedding_prefers_headroom():
+    r = Router()
+    reg(r, "http://busy:1", active_seqs=8, pending=4, max_num_seqs=8,
+        free_pages=0, total_pages=100)
+    reg(r, "http://idle:1", active_seqs=0, pending=0, max_num_seqs=8,
+        free_pages=100, total_pages=100)
+    # over many distinct prefixes, the idle worker should win far more often
+    wins = sum(
+        r.pick("m", prefix_key(f"p{i}")).url == "http://idle:1" for i in range(100)
+    )
+    assert wins > 60, f"idle worker only won {wins}/100"

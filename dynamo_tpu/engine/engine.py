@@ -100,6 +100,10 @@ class Engine:
         )
         self.metrics = EngineMetrics()
         self._lock = threading.Lock()
+        # serialises every computation that touches the donated KV pools
+        # (step() on the scheduler thread vs prefill_only/export_kv/import_kv
+        # on HTTP threads in disaggregated roles)
+        self._exec_lock = threading.RLock()
 
         # --- parameters ---
         if params is None:
@@ -131,6 +135,8 @@ class Engine:
         self._free_slots = list(range(b - 1, -1, -1))
         self.pending: collections.deque[GenRequest] = collections.deque()
         self._aborted: set = set()
+        # disagg prefill role: request_id -> (pages, n_tokens) held for export
+        self._parked: Dict[str, tuple] = {}
 
         self.rng = jax.random.PRNGKey(cfg.seed)
         self._build_jit()
@@ -164,15 +170,24 @@ class Engine:
             state = smp.SamplingState(temperature, top_p, top_k)
             return smp.sample(logits[None], state, key)[0]
 
+        def import_fn(k_pages, v_pages, idx, k_new, v_new):
+            # disagg KV install: in-place page scatter (pools donated)
+            return (
+                k_pages.at[:, :, idx].set(k_new),
+                v_pages.at[:, :, idx].set(v_new),
+            )
+
         if cfg.enforce_eager:
             self._prefill = prefill_fn
             self._decode = decode_fn
             self._sample_one = sample_one
+            self._import = import_fn
         else:
             # donate KV pools: XLA updates them in place in HBM
             self._prefill = jax.jit(prefill_fn, donate_argnums=(3, 4))
             self._decode = jax.jit(decode_fn, donate_argnums=(5, 6))
             self._sample_one = jax.jit(sample_one)
+            self._import = jax.jit(import_fn, donate_argnums=(0, 1))
 
     # ------------------------------------------------------- request intake --
 
@@ -226,12 +241,13 @@ class Engine:
 
         step() is single-consumer: only one scheduler thread may call it.
         Producers (add_request/abort_request) synchronise via self._lock."""
-        events: List[TokenEvent] = []
-        events.extend(self._apply_aborts())
-        events.extend(self._admit())
-        if self.seqs:
-            events.extend(self._decode_once())
-        return events
+        with self._exec_lock:
+            events: List[TokenEvent] = []
+            events.extend(self._apply_aborts())
+            events.extend(self._admit())
+            if self.seqs:
+                events.extend(self._decode_once())
+            return events
 
     def _apply_aborts(self) -> List[TokenEvent]:
         with self._lock:
@@ -279,16 +295,19 @@ class Engine:
             events.append(ev)
         return events
 
-    def _prefill_request(self, req: GenRequest) -> TokenEvent:
+    def _run_prefill(self, req: GenRequest):
+        """Shared prefill: bucket, allocate pages, run the jitted prefill, and
+        sample the first token. Used by both the aggregated admission path and
+        the disagg prefill role. Returns (first_token, pages, prompt_len)."""
         cfg = self.cfg
         t0 = time.monotonic()
         prompt = req.prompt_token_ids
         prompt_len = len(prompt)
         bucket = _next_bucket(prompt_len, cfg.page_size, cfg.max_seq_len)
-        n_pages = bucket // cfg.page_size
+        n_bucket_pages = bucket // cfg.page_size
         pages = self.allocator.alloc(max(1, -(-prompt_len // cfg.page_size)))
         # pad the page list to the bucket's page count with trash page 0
-        pages_arr = np.zeros((n_pages,), dtype=np.int32)
+        pages_arr = np.zeros((n_bucket_pages,), dtype=np.int32)
         pages_arr[: len(pages)] = pages
 
         tokens = np.zeros((bucket,), dtype=np.int32)
@@ -314,7 +333,10 @@ class Engine:
         )
         self.metrics.prefill_time_s += time.monotonic() - t0
         self.metrics.prompt_tokens += prompt_len
+        return first, pages, prompt_len
 
+    def _prefill_request(self, req: GenRequest) -> TokenEvent:
+        first, pages, prompt_len = self._run_prefill(req)
         slot = self._free_slots.pop()
         seq = SeqState(
             req.request_id,
@@ -433,6 +455,119 @@ class Engine:
         self.context_lens[slot] = 0
         self._free_slots.append(slot)
         self.metrics.num_finished += 1
+
+    # --------------------------------------------------- disaggregation API --
+
+    def prefill_only(self, req: GenRequest):
+        """Prefill-worker role: run the prompt, sample the first token, and
+        PARK the sequence (no decode slot) until its KV is exported.
+
+        Mirrors the reference's `--is-prefill-worker` / `--disaggregation-mode
+        prefill` role (/root/reference/examples/deploy/vllm/disagg.yaml:37).
+        Returns (first_token, n_prompt_tokens). The KV stays resident until
+        export_kv()/release_parked() — the NIXL-style hold-until-pulled
+        contract (/root/reference/examples/deploy/sglang/disagg.yaml:47-52).
+        """
+        if len(req.prompt_token_ids) >= self.cfg.max_seq_len:
+            raise ValueError("prompt exceeds max_seq_len")
+        n_pages = max(1, -(-len(req.prompt_token_ids) // self.cfg.page_size))
+        if n_pages > self.cfg.num_pages - 1:
+            raise ValueError(
+                f"prompt needs {n_pages} KV pages; pool only has "
+                f"{self.cfg.num_pages - 1}"
+            )
+        with self._exec_lock:
+            first, pages, prompt_len = self._run_prefill(req)
+        with self._lock:
+            stale = self._parked.pop(req.request_id, None)
+            self._parked[req.request_id] = (pages, prompt_len, time.monotonic())
+        if stale is not None:
+            self.allocator.free(stale[0])
+        return first, prompt_len
+
+    def export_kv(self, request_id: str):
+        """Gather a parked sequence's KV pages off the cache for transfer.
+
+        Returns (k, v, n_tokens): arrays [L, KV, n_pages, ps, D] (numpy).
+        TPU-native replacement for the NIXL KV pull: a single XLA gather per
+        pool (device->host once), shipped over ICI/DCN by the transfer layer.
+        """
+        with self._lock:
+            pages, n_tokens, _ = self._parked[request_id]
+        with self._exec_lock:
+            idx = jnp.asarray(pages, jnp.int32)
+            k = np.asarray(jnp.take(self.k_pages, idx, axis=2))
+            v = np.asarray(jnp.take(self.v_pages, idx, axis=2))
+        return k, v, n_tokens
+
+    def release_parked(self, request_id: str):
+        with self._lock:
+            parked = self._parked.pop(request_id, None)
+        if parked:
+            self.allocator.free(parked[0])
+
+    def expire_parked(self, ttl_s: float = 120.0) -> int:
+        """Free parked sequences never pulled by a decode worker (crashed peer
+        or lost ack). Returns the number expired."""
+        cutoff = time.monotonic() - ttl_s
+        with self._lock:
+            stale = [rid for rid, (_, _, ts) in self._parked.items()
+                     if ts < cutoff]
+        for rid in stale:
+            log.warning("expiring parked KV for %s (never pulled)", rid)
+            self.release_parked(rid)
+        return len(stale)
+
+    def import_kv(self, req: GenRequest, first_token: int, k, v):
+        """Decode-worker role: install transferred KV + first token as a live
+        sequence, then continue decoding in the normal batch loop.
+
+        Returns (finished, reason): finished=True when the first (prefill-
+        sampled) token already terminates the request, in which case nothing
+        is installed."""
+        cfg = self.cfg
+        n_prompt = len(req.prompt_token_ids)
+        n_pages = k.shape[2]
+        stop_ids = (
+            [] if req.ignore_eos
+            else (req.stop_token_ids or [self.model_cfg.eos_token_id])
+        )
+        if first_token in stop_ids:
+            return True, "stop"
+        if req.max_tokens <= 1 or n_prompt + 1 >= cfg.max_seq_len:
+            return True, "length"
+        with self._exec_lock:
+            return self._import_kv_locked(req, first_token, k, v, n_prompt,
+                                          n_pages, stop_ids)
+
+    def _import_kv_locked(self, req, first_token, k, v, n_prompt, n_pages,
+                          stop_ids):
+        if not self._free_slots:
+            raise OutOfPages("no free decode slot for imported sequence")
+        pages = self.allocator.alloc(n_pages)
+        idx = jnp.asarray(pages, jnp.int32)
+        self.k_pages, self.v_pages = self._import(
+            self.k_pages, self.v_pages, idx,
+            jnp.asarray(k).astype(self.k_pages.dtype),
+            jnp.asarray(v).astype(self.v_pages.dtype),
+        )
+        slot = self._free_slots.pop()
+        seq = SeqState(
+            req.request_id, slot, pages, n_prompt,
+            max_tokens=req.max_tokens, temperature=req.temperature,
+            top_p=req.top_p, top_k=req.top_k, stop_token_ids=stop_ids,
+        )
+        seq.output_tokens.append(first_token)
+        self.seqs[slot] = seq
+        self.block_tables[slot, :] = 0
+        self.block_tables[slot, : len(pages)] = pages
+        self.cur_tokens[slot] = first_token
+        self.temperature[slot] = req.temperature
+        self.top_p[slot] = req.top_p
+        self.top_k[slot] = req.top_k
+        self.metrics.num_requests += 1
+        self.metrics.output_tokens += 1
+        return False, None
 
     # ------------------------------------------------------------ conveniences
 

@@ -1,0 +1,84 @@
+"""ctypes binding + on-demand build of the native transport library.
+
+The shared object is compiled once into a per-user cache dir (g++ is in the
+image; pybind11 is not, hence the plain C ABI). A build failure degrades to
+`lib = None`; the transfer layer then uses its pure-Python socket fallback
+with identical wire format, so functionality never depends on a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("dynamo_tpu.native")
+
+_SRC = os.path.join(os.path.dirname(__file__), "csrc", "dynamo_transport.cpp")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build_dir() -> str:
+    d = os.environ.get(
+        "DYNAMO_TPU_BUILD_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "dynamo_tpu", "native"),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_library() -> str:
+    """Compile (if needed) and return the .so path. Raises on failure."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_build_dir(), f"libdynamo_transport_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-Wall",
+        _SRC, "-o", so_path + ".tmp",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(so_path + ".tmp", so_path)
+    return so_path
+
+
+def get_lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            path = build_library()
+            lib = ctypes.CDLL(path)
+            lib.dt_listen.argtypes = [ctypes.c_uint16,
+                                      ctypes.POINTER(ctypes.c_uint16)]
+            lib.dt_listen.restype = ctypes.c_int
+            lib.dt_accept.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+            lib.dt_accept.restype = ctypes.c_int
+            lib.dt_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                       ctypes.c_char_p]
+            lib.dt_connect.restype = ctypes.c_int
+            lib.dt_send_msg.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                        ctypes.c_int64]
+            lib.dt_send_msg.restype = ctypes.c_int
+            lib.dt_recv_len.argtypes = [ctypes.c_int]
+            lib.dt_recv_len.restype = ctypes.c_int64
+            lib.dt_recv_into.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                         ctypes.c_int64]
+            lib.dt_recv_into.restype = ctypes.c_int
+            lib.dt_close.argtypes = [ctypes.c_int]
+            lib.dt_key_len.restype = ctypes.c_int
+            _lib = lib
+            log.info("native transport loaded: %s", path)
+        except Exception as e:
+            log.warning("native transport unavailable (%s); python fallback", e)
+            _lib = None
+        return _lib

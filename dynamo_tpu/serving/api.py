@@ -14,6 +14,7 @@ import time
 from typing import List, Optional
 
 from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.kv_cache import OutOfPages
 from dynamo_tpu.engine.request import GenRequest
 from dynamo_tpu.engine.tokenizer import get_tokenizer
 from dynamo_tpu.serving import protocol as proto
@@ -71,7 +72,11 @@ class GenerationHandle:
             top_k=params["top_k"],
             ignore_eos=params.get("ignore_eos", False),
         )
-        self.queue = ctx.service.submit(self.req)  # raises ValueError early
+        if ctx.disagg_client is not None:
+            # decode role: prefill remotely, pull KV, continue locally
+            self.queue = ctx.disagg_client.start(self.req)
+        else:
+            self.queue = ctx.service.submit(self.req)  # raises ValueError early
         ctx.metrics.requests_total.inc(model=ctx.served_model)
         ctx.metrics.isl.observe(len(prompt_ids), model=ctx.served_model)
 
@@ -116,7 +121,8 @@ class GenerationHandle:
 class ServingContext:
     """Everything the request handlers need, bundled for the handler class."""
 
-    def __init__(self, engine: Engine, served_model: str):
+    def __init__(self, engine: Engine, served_model: str,
+                 prefill_urls=None, frontend_url=None):
         self.engine = engine
         self.service = EngineService(engine)
         self.served_model = served_model
@@ -127,10 +133,31 @@ class ServingContext:
         )
         self.start_time = time.time()
 
+        # --- disaggregation wiring (mirrors the reference's role flags,
+        # /root/reference/examples/deploy/sglang/disagg.yaml:45-52) ---
+        self.kv_source = None
+        self.disagg_client = None
+        mode = engine.cfg.disaggregation_mode
+        if mode == "prefill":
+            from dynamo_tpu.transfer.kv_transfer import KVSource
+
+            self.kv_source = KVSource(
+                engine, port=engine.cfg.disaggregation_bootstrap_port
+            )
+            log.info("prefill role: KV bootstrap on port %d", self.kv_source.port)
+        elif mode == "decode":
+            from dynamo_tpu.serving.disagg import DisaggDecodeClient, PrefillPool
+
+            self.disagg_client = DisaggDecodeClient(
+                self, PrefillPool(prefill_urls, frontend_url)
+            )
+
     def close(self):
+        if self.kv_source is not None:
+            self.kv_source.close()
         self.service.close()
 
-    def start_generation(self, rid, prompt_ids, params) -> GenerationHandle:
+    def start_generation(self, rid, prompt_ids, params) -> "GenerationHandle":
         return GenerationHandle(self, rid, prompt_ids, params)
 
 
@@ -170,10 +197,16 @@ class _Handler(JsonHTTPHandler):
                 self._chat(self._read_json_body())
             elif path == "/v1/completions":
                 self._completion(self._read_json_body())
+            elif path == "/disagg/prefill":
+                self._disagg_prefill(self._read_json_body())
             else:
                 self._error(404, f"no route {path}")
         except proto.BadRequest as e:
             self._fail(400, str(e))
+        except OutOfPages as e:  # transient capacity: client should retry
+            self._fail(503, str(e), "service_unavailable")
+        except RuntimeError as e:  # disagg dependency unavailable
+            self._fail(503, str(e), "service_unavailable")
         except ValueError as e:  # engine-level rejection (over-length, ...)
             self._fail(400, str(e))
         except TimeoutError as e:
@@ -189,6 +222,37 @@ class _Handler(JsonHTTPHandler):
             self._error(code, msg, etype)
 
     # ------------------------------------------------------------ handlers --
+    def _disagg_prefill(self, body):
+        """Prefill-role RPC: run the prompt, park KV, return the bootstrap
+        coordinates for the decode side's pull."""
+        ctx = self.ctx
+        if ctx.kv_source is None:
+            raise proto.BadRequest(
+                "this worker is not in --disaggregation-mode prefill"
+            )
+        rid = body.get("request_id")
+        ids = body.get("prompt_token_ids")
+        if not rid or not isinstance(ids, list) or not ids:
+            raise proto.BadRequest("need request_id and prompt_token_ids")
+        req = GenRequest(
+            rid, [int(t) for t in ids],
+            temperature=float(body.get("temperature", 0.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+        )
+        t0 = time.monotonic()
+        first, n_tokens = ctx.engine.prefill_only(req)
+        ctx.metrics.ttft.observe(time.monotonic() - t0, model=ctx.served_model)
+        ctx.metrics.requests_total.inc(model=ctx.served_model)
+        ctx.metrics.isl.observe(n_tokens, model=ctx.served_model)
+        self._json(200, {
+            "request_id": rid,
+            "first_token": first,
+            "n_tokens": n_tokens,
+            "bootstrap_port": ctx.kv_source.port,
+            "transfer_backend": ctx.engine.cfg.disaggregation_transfer_backend,
+        })
+
     def _check_model(self, model: str):
         if model not in (self.ctx.served_model, self.ctx.engine.cfg.model):
             raise proto.BadRequest(

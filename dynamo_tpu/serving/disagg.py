@@ -1,0 +1,141 @@
+"""Decode-worker side of disaggregated serving.
+
+Request flow (mirror of the reference's disagg topology,
+/root/reference/examples/deploy/sglang/disagg.yaml): the frontend routes the
+user request to a DECODE worker; the decode worker picks a PREFILL worker,
+POSTs /disagg/prefill, pulls the KV over the bootstrap channel, imports it
+into its own paged cache, and streams tokens from there.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from dynamo_tpu.engine.request import GenRequest, TokenEvent
+from dynamo_tpu.transfer.kv_transfer import fetch_kv
+
+log = logging.getLogger("dynamo_tpu.disagg")
+
+
+class PrefillPool:
+    """Known prefill workers: static (--prefill-url) plus frontend discovery."""
+
+    def __init__(self, static_urls: Optional[List[str]] = None,
+                 frontend_url: Optional[str] = None,
+                 refresh_interval: float = 5.0):
+        self._static = [u.strip() for u in (static_urls or []) if u.strip()]
+        self._discovered: List[str] = []
+        self._frontend_url = frontend_url
+        self._lock = threading.Lock()
+        if frontend_url:
+            t = threading.Thread(target=self._refresh_loop,
+                                 args=(refresh_interval,), daemon=True,
+                                 name="prefill-discovery")
+            t.start()
+
+    def _refresh_loop(self, interval: float):
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    self._frontend_url.rstrip("/") + "/internal/workers",
+                    timeout=5,
+                ) as resp:
+                    workers = json.loads(resp.read())["workers"]
+                urls = [w["url"] for w in workers if w.get("mode") == "prefill"]
+                with self._lock:
+                    self._discovered = urls
+            except Exception as e:
+                log.debug("prefill discovery failed: %s", e)
+            time.sleep(interval)
+
+    def urls(self) -> List[str]:
+        with self._lock:
+            return list(dict.fromkeys(self._static + self._discovered))
+
+    def pick(self, affinity_key: str) -> Optional[str]:
+        urls = self.urls()
+        if not urls:
+            return None
+        best, best_score = None, -1
+        for u in urls:
+            h = hashlib.sha256((affinity_key + "|" + u).encode()).digest()
+            score = int.from_bytes(h[:8], "big")
+            if score > best_score:
+                best, best_score = u, score
+        return best
+
+
+class DisaggDecodeClient:
+    """Runs the prefill RPC + KV pull + import for one request."""
+
+    def __init__(self, ctx, pool: PrefillPool):
+        self.ctx = ctx  # ServingContext
+        self.pool = pool
+
+    def start(self, req: GenRequest) -> "object":
+        """Returns the event queue, with the first token already delivered."""
+        ctx = self.ctx
+        affinity = "".join(map(str, req.prompt_token_ids[:64]))
+        prefill_url = self.pool.pick(affinity)
+        if prefill_url is None:
+            raise RuntimeError("no prefill worker available")
+
+        body = json.dumps({
+            "request_id": req.request_id,
+            "prompt_token_ids": req.prompt_token_ids,
+            "temperature": req.temperature,
+            "top_p": req.top_p,
+            "top_k": req.top_k,
+        }).encode()
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    prefill_url.rstrip("/") + "/disagg/prefill", data=body,
+                    headers={"Content-Type": "application/json"}, method="POST",
+                ),
+                timeout=300,
+            ) as resp:
+                out = json.loads(resp.read())
+            first_token = out["first_token"]
+            host = urllib.parse.urlparse(prefill_url).hostname
+            k, v, n_tokens = fetch_kv(host, out["bootstrap_port"],
+                                      req.request_id)
+        except urllib.error.HTTPError as e:
+            # a definitive client error from the prefill side stays definitive
+            # (400), so callers don't retry a request that can never succeed
+            try:
+                msg = json.loads(e.read())["error"]["message"]
+            except Exception:
+                msg = str(e)
+            if e.code == 400:
+                raise ValueError(f"prefill rejected request: {msg}") from e
+            raise RuntimeError(
+                f"prefill worker {prefill_url} failed ({e.code}): {msg}"
+            ) from e
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise RuntimeError(
+                f"prefill worker {prefill_url} unreachable: {e}"
+            ) from e
+        log.info(
+            "disagg: prefill(%d tok)+transfer(%.1f MB) in %.3fs via %s",
+            n_tokens, (k.nbytes + v.nbytes) / 1e6, time.monotonic() - t0,
+            prefill_url,
+        )
+
+        q = ctx.service.attach(req.request_id)
+        try:
+            finished, reason = ctx.engine.import_kv(req, first_token, k, v)
+        except Exception:
+            ctx.service.detach(req.request_id)
+            raise
+        q.put(TokenEvent(req.request_id, first_token, 0, finished, reason))
+        ctx.service.wake()
+        return q

@@ -57,6 +57,21 @@ class EngineService:
         self.engine.abort_request(request_id)
         self._wake.set()
 
+    def attach(self, request_id: str) -> "queue.Queue[TokenEvent]":
+        """Register an event queue for a request that enters the engine via a
+        side door (disagg KV import) rather than add_request()."""
+        q: "queue.Queue[TokenEvent]" = queue.Queue()
+        with self._lock:
+            self._queues[request_id] = q
+        return q
+
+    def detach(self, request_id: str):
+        with self._lock:
+            self._queues.pop(request_id, None)
+
+    def wake(self):
+        self._wake.set()
+
     def stream(self, req: GenRequest, timeout: float = 600.0) -> Iterator[TokenEvent]:
         """Submit and yield TokenEvents until the request finishes."""
         q = self.submit(req)

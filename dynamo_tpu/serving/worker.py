@@ -76,6 +76,8 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
     p.add_argument("--frontend-url", default=os.environ.get("FRONTEND_URL"))
+    p.add_argument("--prefill-url", default=os.environ.get("PREFILL_URL"),
+                   help="comma-separated prefill worker URLs (decode role)")
     p.add_argument("--heartbeat-interval", type=float, default=3.0)
     args = p.parse_args(argv)
 
@@ -87,7 +89,11 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
              backend_name, cfg.model, cfg.disaggregation_mode,
              cfg.tensor_parallel, backend)
     engine = Engine(cfg)
-    ctx = ServingContext(engine, cfg.served_name)
+    ctx = ServingContext(
+        engine, cfg.served_name,
+        prefill_urls=(args.prefill_url.split(",") if args.prefill_url else None),
+        frontend_url=args.frontend_url,
+    )
     srv = make_server(ctx, args.host, args.port)
 
     stop = threading.Event()

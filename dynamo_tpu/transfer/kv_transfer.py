@@ -1,0 +1,153 @@
+"""KV-cache handoff between prefill and decode workers.
+
+Two data planes, selected by `--disaggregation-transfer-backend`
+(mirroring /root/reference/examples/deploy/sglang/disagg.yaml:47-48):
+
+- "ici": both roles share a process/slice — the handoff is a device-to-device
+  page copy placed by XLA over ICI (`Engine.export_kv`/`import_kv` on
+  jax.Arrays; no host roundtrip when src/dst shardings are compatible).
+  Used by the colocated topology and by in-process tests.
+- "dcn": cross-host — pages serialize to bytes and stream over the native
+  transport (transfer.transport), with NIXL-style key rendezvous on the
+  prefill worker's bootstrap port.
+
+Wire schema (dcn): one message = JSON header (dtype/shape/n_tokens/first_token)
++ one message per tensor (k then v, raw bytes, C-order).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.transfer import transport
+
+log = logging.getLogger("dynamo_tpu.kv_transfer")
+
+
+def _tobytes(arr: np.ndarray) -> bytes:
+    # bfloat16 has no numpy dtype string; ship raw bytes + jax dtype name
+    return np.ascontiguousarray(arr).view(np.uint8).tobytes()
+
+
+def _dtype_name(arr) -> str:
+    return str(arr.dtype)
+
+
+def _frombytes(data: bytes, dtype: str, shape) -> np.ndarray:
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        np_dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        np_dtype = np.dtype(dtype)
+    return np.frombuffer(data, dtype=np_dtype).reshape(shape)
+
+
+class KVSource:
+    """Prefill-worker side: holds exported KV until the decode side pulls it.
+
+    One accept thread serves the bootstrap port; each parked request is keyed
+    by request_id. After a successful pull (or expiry) the engine's parked
+    pages are released."""
+
+    def __init__(self, engine, port: int = 0, parked_ttl_s: float = 120.0):
+        self.engine = engine
+        self.parked_ttl_s = parked_ttl_s
+        self.listener = transport.Listener(port)
+        self.port = self.listener.port
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="kv-source")
+        self._thread.start()
+
+    def close(self):
+        self._stop = True
+        self.listener.close()
+
+    def _serve(self):
+        last_expiry = 0.0
+        while not self._stop:
+            import time as _time
+
+            now = _time.monotonic()
+            if now - last_expiry > 10.0:
+                # reclaim KV parked for peers that never pulled (crash / lost
+                # ack) so failures can't bleed the page pool dry
+                self.engine.expire_parked(self.parked_ttl_s)
+                last_expiry = now
+            try:
+                conn, key = self.listener.accept(timeout_ms=500)
+            except TimeoutError:
+                continue
+            except Exception:
+                if self._stop:
+                    return
+                log.exception("kv-source accept failed")
+                continue
+            threading.Thread(
+                target=self._handle, args=(conn, key), daemon=True
+            ).start()
+
+    def _handle(self, conn: transport.Connection, request_id: str):
+        try:
+            k, v, n_tokens = self.engine.export_kv(request_id)
+            header = {
+                "request_id": request_id,
+                "n_tokens": n_tokens,
+                "dtype": _dtype_name(k),
+                "shape": list(k.shape),
+            }
+            conn.send_msg(json.dumps(header).encode())
+            conn.send_msg(_tobytes(k))
+            conn.send_msg(_tobytes(v))
+            # wait for ack so pages outlive a mid-transfer failure
+            ack = conn.recv_msg(max_len=64)
+            if ack == b"OK":
+                self.engine.release_parked(request_id)
+        except KeyError:
+            try:
+                conn.send_msg(json.dumps({"error": "unknown request"}).encode())
+            except Exception:
+                pass
+        except Exception:
+            log.exception("kv transfer for %s failed", request_id)
+        finally:
+            conn.close()
+
+
+def fetch_kv(host: str, port: int, request_id: str
+             ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Decode-worker side: pull one sequence's KV. Returns (k, v, n_tokens)."""
+    conn = transport.connect(host, port, request_id)
+    try:
+        header = json.loads(conn.recv_msg(max_len=1 << 16))
+        if "error" in header:
+            raise KeyError(f"prefill side: {header['error']}")
+        k = _frombytes(conn.recv_msg(), header["dtype"], header["shape"])
+        v = _frombytes(conn.recv_msg(), header["dtype"], header["shape"])
+        conn.send_msg(b"OK")
+        return k, v, header["n_tokens"]
+    finally:
+        conn.close()
+
+
+class ICIHandoff:
+    """Colocated prefill/decode engines on one slice: device-to-device copy.
+
+    export_kv/import_kv operate on jax.Arrays; when both engines share devices
+    XLA turns the gather+scatter into on-device copies (ICI for cross-chip
+    shards) with no host bounce."""
+
+    def __init__(self, prefill_engine, decode_engine):
+        self.src = prefill_engine
+        self.dst = decode_engine
+
+    def transfer(self, req, first_token: int) -> None:
+        k, v, _ = self.src.export_kv(req.request_id)
+        self.dst.import_kv(req, first_token, k, v)
+        self.src.release_parked(req.request_id)

@@ -1,0 +1,186 @@
+"""Disaggregated prefill/decode: KV handoff correctness and the full
+two-worker HTTP topology (the reference's disagg.yaml flow)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.transfer.kv_transfer import ICIHandoff, KVSource, fetch_kv
+
+KW = dict(model="tiny-debug", page_size=4, num_pages=64, max_num_seqs=4,
+          max_seq_len=64)
+
+
+def drain(engine, rid):
+    out = []
+    while engine.has_work:
+        for ev in engine.step():
+            if ev.request_id == rid and ev.token_id >= 0:
+                out.append(ev.token_id)
+    return out
+
+
+@pytest.fixture(scope="module")
+def engines():
+    agg = Engine(EngineConfig(**KW))
+    prefill = Engine(EngineConfig(**{**KW, "disaggregation_mode": "prefill"}),
+                     params=agg.params)
+    decode = Engine(EngineConfig(**{**KW, "disaggregation_mode": "decode"}),
+                    params=agg.params)
+    return agg, prefill, decode
+
+
+def test_ici_handoff_matches_aggregated(engines):
+    agg, prefill, decode = engines
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    ref = agg.generate(GenRequest("ref", prompt, max_tokens=8, temperature=0.0,
+                                  ignore_eos=True))
+
+    req = GenRequest("d1", prompt, max_tokens=8, temperature=0.0,
+                     ignore_eos=True)
+    first, n = prefill.prefill_only(req)
+    assert n == len(prompt)
+    assert first == ref[0], "prefill-side first token diverged"
+    ICIHandoff(prefill, decode).transfer(req, first)
+    rest = drain(decode, "d1")
+    assert [first] + rest == ref, "disagg continuation diverged from agg"
+    # prefill side released its parked pages after transfer
+    assert prefill.allocator.free_pages == prefill.cfg.num_pages - 1
+
+
+def test_dcn_transfer_matches_aggregated(engines):
+    agg, prefill, decode = engines
+    prompt = [7, 7, 3, 2, 9]
+    ref = agg.generate(GenRequest("ref2", prompt, max_tokens=6, temperature=0.0,
+                                  ignore_eos=True))
+
+    req = GenRequest("d2", prompt, max_tokens=6, temperature=0.0,
+                     ignore_eos=True)
+    first, _ = prefill.prefill_only(req)
+    src = KVSource(prefill, port=0)
+    try:
+        k, v, n_tokens = fetch_kv("127.0.0.1", src.port, "d2")
+        assert n_tokens == len(prompt)
+        finished, _ = decode.import_kv(req, first, k, v)
+        assert not finished
+        rest = drain(decode, "d2")
+        assert [first] + rest == ref
+        assert prefill.allocator.free_pages == prefill.cfg.num_pages - 1
+    finally:
+        src.close()
+
+
+def test_unknown_request_key(engines):
+    _, prefill, _ = engines
+    src = KVSource(prefill, port=0)
+    try:
+        with pytest.raises(KeyError):
+            fetch_kv("127.0.0.1", src.port, "no-such-request")
+    finally:
+        src.close()
+
+
+def test_parked_expiry_reclaims_pages(engines):
+    _, prefill, _ = engines
+    free0 = prefill.allocator.free_pages
+    req = GenRequest("leak1", [1, 2, 3, 4, 5], max_tokens=4, temperature=0.0)
+    prefill.prefill_only(req)
+    assert prefill.allocator.free_pages < free0
+    assert prefill.expire_parked(ttl_s=0.0) == 1
+    assert prefill.allocator.free_pages == free0
+
+
+def test_reprefill_same_id_frees_old_pages(engines):
+    _, prefill, _ = engines
+    free0 = prefill.allocator.free_pages
+    req = GenRequest("dup", [1] * 8, max_tokens=4, temperature=0.0)
+    prefill.prefill_only(req)
+    prefill.prefill_only(req)  # decode-side retry with the same request id
+    prefill.release_parked("dup")
+    assert prefill.allocator.free_pages == free0
+
+
+def test_import_first_token_stop(engines):
+    agg, prefill, decode = engines
+    req = GenRequest("s1", [1, 2, 3], max_tokens=1, temperature=0.0,
+                     ignore_eos=True)
+    first, _ = prefill.prefill_only(req)
+    k, v, _ = prefill.export_kv("s1")
+    finished, reason = decode.import_kv(req, first, k, v)
+    prefill.release_parked("s1")
+    assert finished and reason == "length"
+    assert decode.num_active == 0
+
+
+@pytest.fixture(scope="module")
+def disagg_http_stack():
+    """Real two-worker topology over HTTP: prefill + decode + frontend."""
+    from dynamo_tpu.serving.api import (
+        ServingContext, make_server, serve_forever_in_thread,
+    )
+    from dynamo_tpu.serving.frontend import FrontendContext, make_frontend_server
+
+    shared = Engine(EngineConfig(**KW))  # just for shared params
+    pe = Engine(EngineConfig(**{**KW, "disaggregation_mode": "prefill",
+                                "disaggregation_bootstrap_port": 0}),
+                params=shared.params)
+    pctx = ServingContext(pe, "tiny-debug")
+    psrv = make_server(pctx, "127.0.0.1", 0)
+    serve_forever_in_thread(psrv)
+    prefill_url = f"http://127.0.0.1:{psrv.server_address[1]}"
+
+    de = Engine(EngineConfig(**{**KW, "disaggregation_mode": "decode"}),
+                params=shared.params)
+    dctx = ServingContext(de, "tiny-debug", prefill_urls=[prefill_url])
+    dsrv = make_server(dctx, "127.0.0.1", 0)
+    serve_forever_in_thread(dsrv)
+    decode_url = f"http://127.0.0.1:{dsrv.server_address[1]}"
+
+    fctx = FrontendContext()
+    fsrv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(fsrv)
+    frontend_url = f"http://127.0.0.1:{fsrv.server_address[1]}"
+    # register both roles; frontend must route chat to the DECODE worker
+    for url, mode in ((prefill_url, "prefill"), (decode_url, "decode")):
+        body = json.dumps({"url": url, "model": "tiny-debug", "mode": mode,
+                           "stats": {"max_num_seqs": 4, "free_pages": 60,
+                                     "total_pages": 64}}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            frontend_url + "/internal/register", data=body,
+            headers={"Content-Type": "application/json"}), timeout=10)
+
+    yield {"frontend": frontend_url, "agg_ref": shared}
+    fsrv.shutdown()
+    dsrv.shutdown()
+    psrv.shutdown()
+    dctx.close()
+    pctx.close()
+
+
+def test_disagg_end_to_end_via_frontend(disagg_http_stack):
+    frontend = disagg_http_stack["frontend"]
+    body = json.dumps({
+        "model": "tiny-debug",
+        "messages": [{"role": "user", "content": "hello disagg"}],
+        "max_tokens": 8, "temperature": 0, "ignore_eos": True,
+    }).encode()
+    resp = urllib.request.urlopen(urllib.request.Request(
+        frontend + "/v1/chat/completions", data=body,
+        headers={"Content-Type": "application/json"}), timeout=120)
+    out = json.loads(resp.read())
+    assert out["usage"]["completion_tokens"] == 8
+
+    # compare against the aggregated engine with identical params
+    agg = disagg_http_stack["agg_ref"]
+    from dynamo_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    prompt_ids = tok.encode(tok.apply_chat_template(
+        [{"role": "user", "content": "hello disagg"}]))
+    ref = agg.generate(GenRequest("ref", prompt_ids, max_tokens=8,
+                                  temperature=0.0, ignore_eos=True))
+    assert out["choices"][0]["message"]["content"] == tok.decode(ref)

@@ -48,6 +48,9 @@ class EngineConfig:
 
     # runtime
     enforce_eager: bool = False  # skip jit (debug only)
+    # attention kernel backend: auto (Pallas on TPU, XLA elsewhere) | xla |
+    # pallas | pallas_interpret (CPU debugging)
+    attention_backend: str = "auto"
 
     @property
     def served_name(self) -> str:
@@ -79,6 +82,8 @@ class EngineConfig:
         p.add_argument("--trust-remote-code", action="store_true")  # accepted, unused
         p.add_argument("--skip-tokenizer-init", action="store_true")
         p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--attention-backend", default="auto",
+                       choices=["auto", "xla", "pallas", "pallas_interpret"])
         return p
 
     @staticmethod
@@ -104,4 +109,5 @@ class EngineConfig:
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,
             seed=args.seed,
+            attention_backend=args.attention_backend,
         )

@@ -98,6 +98,14 @@ class Engine:
                 expert_parallel=cfg.expert_parallel,
             )
         )
+        # attention kernel selection: Pallas on TPU (with shard_map over the
+        # mesh under TP/DP), XLA reference elsewhere
+        from dynamo_tpu.ops import attention as _att
+
+        _att.set_attention_backend(
+            None if cfg.attention_backend == "auto" else cfg.attention_backend
+        )
+        _att.set_attention_mesh(self.mesh)
         self.metrics = EngineMetrics()
         self._lock = threading.Lock()
         # serialises every computation that touches the donated KV pools

@@ -1,10 +1,17 @@
-"""Paged attention ops: XLA reference implementations.
+"""Paged attention ops: XLA reference implementations + Pallas TPU dispatch.
 
-These define the op contract used by the engine. A TPU Pallas kernel with the
-same signature can be swapped in per-backend. The KV layout is paged —
-page_size defaults to 16
-for parity with the reference's SGLang flag `--page-size 16`
+These define the op contract used by the engine. The public entry points
+(`paged_attention_decode`, `prefill_attention`) dispatch between the XLA
+reference path (CPU tests, fallback) and the Pallas TPU kernels in
+`dynamo_tpu.ops.pallas_attention`. The KV layout is paged — page_size
+defaults to 16 for parity with the reference's SGLang flag `--page-size 16`
 (/root/reference/examples/deploy/sglang/agg.yaml:38-39).
+
+Backend selection: `set_attention_backend()` or env `DYNAMO_TPU_ATTN_BACKEND`
+in {auto, xla, pallas, pallas_interpret}; `auto` uses Pallas on TPU and XLA
+elsewhere. Under tensor parallelism the engine registers its mesh via
+`set_attention_mesh()`, and the Pallas path runs inside `shard_map` over the
+(`data`, `model`) axes — attention is head-parallel, so no collectives.
 
 Layout:
   k_pages, v_pages: [num_kv_heads, num_pages, page_size, head_dim]
@@ -14,8 +21,50 @@ Layout:
 
 from __future__ import annotations
 
+import os
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_BACKEND: Optional[str] = None  # None -> resolve from env / default
+_MESH: Optional[Mesh] = None
+
+_VALID_BACKENDS = ("auto", "xla", "pallas", "pallas_interpret")
+
+
+def set_attention_backend(name: Optional[str]) -> None:
+    """Override attention backend (None reverts to env/auto resolution)."""
+    global _BACKEND
+    if name is not None and name not in _VALID_BACKENDS:
+        raise ValueError(f"backend {name!r} not in {_VALID_BACKENDS}")
+    _BACKEND = name
+
+
+def set_attention_mesh(mesh: Optional[Mesh]) -> None:
+    """Register the engine's device mesh so Pallas kernels run under shard_map."""
+    global _MESH
+    _MESH = mesh
+
+
+def _resolve_backend() -> str:
+    b = _BACKEND or os.environ.get("DYNAMO_TPU_ATTN_BACKEND", "auto")
+    if b not in _VALID_BACKENDS:
+        raise ValueError(f"DYNAMO_TPU_ATTN_BACKEND {b!r} not in {_VALID_BACKENDS}")
+    if b == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return b
+
+
+def _mesh_for_shard_map() -> Optional[Mesh]:
+    """The registered mesh, when any relevant axis actually needs sharding."""
+    if _MESH is None:
+        return None
+    sizes = dict(zip(_MESH.axis_names, _MESH.devices.shape))
+    if sizes.get("model", 1) == 1 and sizes.get("data", 1) == 1:
+        return None
+    return _MESH
 
 
 def repeat_kv(x: jax.Array, n_rep: int, axis: int) -> jax.Array:
@@ -73,7 +122,7 @@ def write_kv_prefill(
     return k_pages, v_pages
 
 
-def paged_attention_decode(
+def paged_attention_decode_xla(
     q: jax.Array,  # [B, H, D] — one query token per sequence
     k_pages: jax.Array,  # [KV, P, ps, D]
     v_pages: jax.Array,
@@ -108,7 +157,7 @@ def paged_attention_decode(
     return jnp.einsum("bhs,bhsd->bhd", probs, v)
 
 
-def prefill_attention(
+def prefill_attention_xla(
     q: jax.Array,  # [S, H, D]
     k: jax.Array,  # [S, KV, D]
     v: jax.Array,
@@ -127,3 +176,83 @@ def prefill_attention(
     scores = jnp.where(mask[None], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("hqk,khd->qhd", probs, v)
+
+
+# --------------------------------------------------------------- dispatch --
+
+
+def paged_attention_decode(
+    q: jax.Array,  # [B, H, D]
+    k_pages: jax.Array,  # [KV, P, ps, D]
+    v_pages: jax.Array,
+    block_table: jax.Array,  # [B, Pmax]
+    context_lens: jax.Array,  # [B]
+    *,
+    page_size: int,
+) -> jax.Array:
+    backend = _resolve_backend()
+    if backend == "xla":
+        return paged_attention_decode_xla(
+            q, k_pages, v_pages, block_table, context_lens, page_size=page_size
+        )
+    from dynamo_tpu.ops import pallas_attention as pa
+
+    interpret = backend == "pallas_interpret"
+
+    def call(q, kp, vp, bt, cl):
+        return pa.paged_attention_decode(
+            q, kp, vp, bt, cl, page_size=page_size, interpret=interpret
+        )
+
+    mesh = _mesh_for_shard_map()
+    if mesh is None:
+        return call(q, k_pages, v_pages, block_table, context_lens)
+    # Heads (and KV pages) shard on `model`, batch on `data`: attention is
+    # embarrassingly parallel over both — no collectives inside the shard.
+    return jax.shard_map(
+        call,
+        mesh=mesh,
+        in_specs=(
+            P("data", "model", None),
+            P("model", None, None, None),
+            P("model", None, None, None),
+            P("data", None),
+            P("data"),
+        ),
+        out_specs=P("data", "model", None),
+        check_vma=False,
+    )(q, k_pages, v_pages, block_table, context_lens)
+
+
+def prefill_attention(
+    q: jax.Array,  # [S, H, D]
+    k: jax.Array,  # [S, KV, D]
+    v: jax.Array,
+    seq_len,  # int or scalar array: true (unpadded) length
+) -> jax.Array:
+    backend = _resolve_backend()
+    if backend == "xla":
+        return prefill_attention_xla(q, k, v, seq_len)
+    from dynamo_tpu.ops import pallas_attention as pa
+
+    interpret = backend == "pallas_interpret"
+
+    def call(q, k, v, sl):
+        return pa.prefill_attention(q, k, v, sl, interpret=interpret)
+
+    mesh = _mesh_for_shard_map()
+    if mesh is None:
+        return call(q, k, v, jnp.asarray(seq_len, jnp.int32))
+    # Prefill is single-sequence: replicated over `data`, heads on `model`.
+    return jax.shard_map(
+        call,
+        mesh=mesh,
+        in_specs=(
+            P(None, "model", None),
+            P(None, "model", None),
+            P(None, "model", None),
+            P(),
+        ),
+        out_specs=P(None, "model", None),
+        check_vma=False,
+    )(q, k, v, jnp.asarray(seq_len, jnp.int32))

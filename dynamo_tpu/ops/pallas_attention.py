@@ -1,0 +1,292 @@
+"""Pallas TPU kernels for paged decode attention and prefill flash attention.
+
+Same contracts as the XLA reference ops in `dynamo_tpu.ops.attention` (the KV
+layout parity point is the reference's SGLang `--page-size 16` flag,
+/root/reference/examples/deploy/sglang/agg.yaml:38-39). The kernels avoid
+materialising the gathered KV in HBM: pages are DMA'd page-by-page into VMEM
+via scalar-prefetched block tables, with flash (online-softmax) accumulation
+in VMEM scratch.
+
+Both kernels are head-parallel (no cross-head or cross-page communication
+besides the sequential flash accumulator), so under tensor parallelism they
+run inside `shard_map` over the `model` mesh axis with zero collectives —
+each TP shard attends over its local KV heads only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+# ------------------------------------------------------------------ decode --
+
+
+def _decode_kernel(
+    # scalar prefetch
+    bt_ref,  # [B, Pmax] int32 block table
+    cl_ref,  # [B] int32 context lens (incl. current token)
+    # blocks
+    q_ref,  # [1, G, D]
+    k_ref,  # [1, 1, ps, D]
+    v_ref,  # [1, 1, ps, D]
+    o_ref,  # [1, G, D]
+    # scratch
+    m_ref,  # [G, 128] f32 running max
+    l_ref,  # [G, 128] f32 running denominator
+    acc_ref,  # [G, D] f32 running numerator
+    *,
+    page_size: int,
+    pages_per_seq: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _reset():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = cl_ref[b]
+    page_start = i * page_size
+
+    # Pages at/past the context length contribute nothing — skip their compute
+    # (their DMA still runs; the grid is static).
+    @pl.when(page_start < ctx)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32)  # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [ps, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # [G, ps]
+        span = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(span < ctx, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [G, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # first page: exp(-inf - finite) = 0
+        p = jnp.exp(s - m_new)  # [G, ps]
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == pages_per_seq - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # inactive slot (ctx == 0): emit zeros
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_decode(
+    q: jax.Array,  # [B, H, D]
+    k_pages: jax.Array,  # [KV, P, ps, D]
+    v_pages: jax.Array,
+    block_table: jax.Array,  # [B, Pmax] int32
+    context_lens: jax.Array,  # [B] int32
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    bsz, n_heads, head_dim = q.shape
+    n_kv = k_pages.shape[0]
+    group = n_heads // n_kv
+    pmax = block_table.shape[1]
+    scale = 1.0 / (head_dim**0.5)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bsz, n_kv, pmax),
+        in_specs=[
+            pl.BlockSpec((1, group, head_dim), lambda b, h, i, bt, cl: (b, h, 0)),
+            pl.BlockSpec(
+                (1, 1, page_size, head_dim),
+                lambda b, h, i, bt, cl: (h, bt[b, i], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, page_size, head_dim),
+                lambda b, h, i, bt, cl: (h, bt[b, i], 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, group, head_dim), lambda b, h, i, bt, cl: (b, h, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, head_dim), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, pages_per_seq=pmax, scale=scale
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bsz, n_heads, head_dim), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), context_lens.astype(jnp.int32), q, k_pages, v_pages)
+
+
+# ----------------------------------------------------------------- prefill --
+
+
+def _prefill_kernel(
+    sl_ref,  # [1] int32 true sequence length
+    q_ref,  # [1, Tq, D]
+    k_ref,  # [1, Tk, D]
+    v_ref,  # [1, Tk, D]
+    o_ref,  # [1, Tq, D]
+    m_ref,  # [Tq, 128] f32
+    l_ref,  # [Tq, 128] f32
+    acc_ref,  # [Tq, D] f32
+    *,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+    scale: float,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _reset():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    sl = sl_ref[0]
+
+    # Skip fully-masked blocks: strictly above the causal diagonal, or wholly
+    # past the true sequence length.
+    @pl.when((k_start <= q_start + block_q - 1) & (k_start < sl))
+    def _attend():
+        q = q_ref[0].astype(jnp.float32)  # [Tq, D]
+        k = k_ref[0].astype(jnp.float32)  # [Tk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # [Tq, Tk]
+        qi = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        ki = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where((ki <= qi) & (ki < sl), s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Rows fully masked in this block keep m_new = m_prev; at ik == 0 every
+        # row sees ki == 0 unmasked, so m_new is finite from the first block on.
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def prefill_attention(
+    q: jax.Array,  # [S, H, D]
+    k: jax.Array,  # [S, KV, D]
+    v: jax.Array,
+    seq_len,  # scalar int or int32 array: true (unpadded) length
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    s, n_heads, head_dim = q.shape
+    n_kv = k.shape[1]
+    group = n_heads // n_kv
+    scale = 1.0 / (head_dim**0.5)
+
+    block_q = min(block_q, max(s, 8))
+    block_k = min(block_k, max(s, 8))
+    s_pad = -(-s // max(block_q, block_k)) * max(block_q, block_k)
+
+    # head-major layout for clean (head, seq-block) blocking
+    qt = jnp.moveaxis(q, 1, 0)  # [H, S, D]
+    kt = jnp.moveaxis(k, 1, 0)  # [KV, S, D]
+    vt = jnp.moveaxis(v, 1, 0)
+    if s_pad != s:
+        pad = ((0, 0), (0, s_pad - s), (0, 0))
+        qt, kt, vt = jnp.pad(qt, pad), jnp.pad(kt, pad), jnp.pad(vt, pad)
+
+    nq = s_pad // block_q
+    nk = s_pad // block_k
+    sl = jnp.asarray(seq_len, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_heads, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda h, iq, ik, sl: (h, iq, 0)),
+            pl.BlockSpec(
+                (1, block_k, head_dim),
+                # GQA: query head h reads kv head h // group (repeat_kv layout)
+                lambda h, iq, ik, sl: (h // group, ik, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_k, head_dim), lambda h, iq, ik, sl: (h // group, ik, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, head_dim), lambda h, iq, ik, sl: (h, iq, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=nk,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_heads, s_pad, head_dim), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(sl, qt, kt, vt)
+    return jnp.moveaxis(out[:, :s], 0, 1)  # [S, H, D]

@@ -98,14 +98,6 @@ class Engine:
                 expert_parallel=cfg.expert_parallel,
             )
         )
-        # attention kernel selection: Pallas on TPU (with shard_map over the
-        # mesh under TP/DP), XLA reference elsewhere
-        from dynamo_tpu.ops import attention as _att
-
-        _att.set_attention_backend(
-            None if cfg.attention_backend == "auto" else cfg.attention_backend
-        )
-        _att.set_attention_mesh(self.mesh)
         self.metrics = EngineMetrics()
         self._lock = threading.Lock()
         # serialises every computation that touches the donated KV pools
@@ -185,17 +177,32 @@ class Engine:
                 v_pages.at[:, :, idx].set(v_new),
             )
 
+        # Bind this engine's attention backend + mesh around every call
+        # (traces happen inside the first call, so the kernel selection and
+        # shard_map mesh are baked per-engine — not via process globals).
+        from dynamo_tpu.ops import attention as _att
+
+        backend = None if cfg.attention_backend == "auto" else cfg.attention_backend
+        mesh = self.mesh
+
+        def ctx(fn):
+            def wrapped(*args):
+                with _att.attention_context(backend, mesh):
+                    return fn(*args)
+
+            return wrapped
+
         if cfg.enforce_eager:
-            self._prefill = prefill_fn
-            self._decode = decode_fn
-            self._sample_one = sample_one
-            self._import = import_fn
+            self._prefill = ctx(prefill_fn)
+            self._decode = ctx(decode_fn)
+            self._sample_one = ctx(sample_one)
+            self._import = ctx(import_fn)
         else:
             # donate KV pools: XLA updates them in place in HBM
-            self._prefill = jax.jit(prefill_fn, donate_argnums=(3, 4))
-            self._decode = jax.jit(decode_fn, donate_argnums=(5, 6))
-            self._sample_one = jax.jit(sample_one)
-            self._import = jax.jit(import_fn, donate_argnums=(0, 1))
+            self._prefill = ctx(jax.jit(prefill_fn, donate_argnums=(3, 4)))
+            self._decode = ctx(jax.jit(decode_fn, donate_argnums=(5, 6)))
+            self._sample_one = ctx(jax.jit(sample_one))
+            self._import = ctx(jax.jit(import_fn, donate_argnums=(0, 1)))
 
     # ------------------------------------------------------- request intake --
 

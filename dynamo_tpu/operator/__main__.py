@@ -1,0 +1,40 @@
+"""Operator entrypoint: `python -m dynamo_tpu.operator`.
+
+Deployed by deploy/operator.yaml as the controller-manager Deployment the
+install script gate-waits on — the analogue of
+`dynamo-platform-dynamo-operator-controller-manager`
+(/root/reference/install-dynamo-1node.sh:244-245).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+from dynamo_tpu.operator.controller import Controller
+from dynamo_tpu.operator.k8s_client import K8sClient
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    p = argparse.ArgumentParser(prog="dynamo_tpu.operator")
+    p.add_argument("--namespace", default=os.environ.get("NAMESPACE") or None,
+                   help="restrict to one namespace (default: cluster-wide)")
+    p.add_argument("--interval", type=float,
+                   default=float(os.environ.get("RECONCILE_INTERVAL", "3")))
+    p.add_argument("--once", action="store_true",
+                   help="single reconcile pass (CI / debugging)")
+    args = p.parse_args(argv)
+
+    ctrl = Controller(K8sClient.from_env(), namespace=args.namespace)
+    if args.once:
+        n = ctrl.reconcile_once()
+        scope = args.namespace or "all namespaces"
+        print(f"reconciled {n} custom resources in {scope}")
+        return
+    ctrl.run(interval=args.interval)
+
+
+if __name__ == "__main__":
+    main()

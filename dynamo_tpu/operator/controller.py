@@ -1,0 +1,245 @@
+"""Reconcile loop: DynamoGraphDeployment(Request) CRs -> child resources.
+
+Level-triggered, poll-based reconciliation (list + diff every interval)
+rather than watches — single-node scale doesn't need informer caches, and a
+relist loop is self-healing by construction (the reference's recovery posture
+is the same K8s-native self-healing, SURVEY.md §5).
+
+DGD flow:  CR -> materialize() -> upsert Deployments/Services/PVCs, delete
+stale children by ownership labels, roll child readiness up into CR status.
+DGDR flow: CR -> render the DGD template from its ConfigMap, apply the SLA
+profiler's deployment overrides, then (autoApply) create the DGD — mirroring
+the operator-side DGDR pipeline (/root/reference/examples/dgdr/trtllm/
+dgdr.yaml:14-36, run-dgdr.sh:22-29).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.operator import materialize as mat
+from dynamo_tpu.operator.k8s_client import ApiError, K8sClient
+
+log = logging.getLogger("dynamo_tpu.operator")
+
+
+def _yaml_load(text: str) -> Dict[str, Any]:
+    try:
+        import yaml
+
+        return yaml.safe_load(text)
+    except ImportError:  # pragma: no cover - pyyaml is in the baked image
+        return json.loads(text)
+
+
+class Controller:
+    def __init__(self, client: K8sClient, namespace: Optional[str] = "default"):
+        """namespace=None watches every namespace (cluster-wide list), the
+        reference operator's default; a concrete namespace restricts it (the
+        NAMESPACE_RESTRICTED_OPERATOR analogue,
+        /root/reference/install-dynamo-1node.sh:32,203-205)."""
+        self.k8s = client
+        self.namespace = namespace
+
+    @staticmethod
+    def _ns(cr: Dict[str, Any]) -> str:
+        return cr["metadata"].get("namespace") or "default"
+
+    # ------------------------------------------------------------- children --
+    def _owned(self, api_version: str, plural: str, ns: str,
+               ns_label: str) -> List[Dict]:
+        sel = f"{mat.MANAGED_BY_LABEL}={mat.OPERATOR_NAME},{mat.NS_LABEL}={ns_label}"
+        return self.k8s.list(api_version, plural, ns, label_selector=sel)
+
+    def reconcile_dgd(self, cr: Dict[str, Any]) -> None:
+        name = cr["metadata"]["name"]
+        ns = self._ns(cr)
+        ns_label = mat.discovery_label_value(ns, name)
+        desired = mat.materialize(cr)
+
+        for dep in desired["deployments"]:
+            self.k8s.upsert("apps/v1", "deployments", ns, dep)
+        for svc in desired["services"]:
+            self.k8s.upsert("v1", "services", ns, svc)
+        for pvc in desired["pvcs"]:
+            try:
+                self.k8s.create("v1", "persistentvolumeclaims", ns, pvc)
+            except ApiError as e:
+                if not e.conflict:  # PVC specs are immutable; leave existing
+                    raise
+
+        # prune children whose service was removed from the CR
+        want_deps = {d["metadata"]["name"] for d in desired["deployments"]}
+        for existing in self._owned("apps/v1", "deployments", ns, ns_label):
+            if existing["metadata"]["name"] not in want_deps:
+                log.info("pruning stale deployment %s", existing["metadata"]["name"])
+                self.k8s.delete(
+                    "apps/v1", "deployments", ns, existing["metadata"]["name"],
+                )
+        want_svcs = {s["metadata"]["name"] for s in desired["services"]}
+        for existing in self._owned("v1", "services", ns, ns_label):
+            if existing["metadata"]["name"] not in want_svcs:
+                self.k8s.delete(
+                    "v1", "services", ns, existing["metadata"]["name"]
+                )
+
+        self._update_dgd_status(cr, ns_label)
+
+    def _update_dgd_status(self, cr: Dict[str, Any], ns_label: str) -> None:
+        ns = self._ns(cr)
+        ready = 0
+        total = 0
+        for dep in self._owned("apps/v1", "deployments", ns, ns_label):
+            total += int(dep.get("spec", {}).get("replicas", 1))
+            ready += int(dep.get("status", {}).get("readyReplicas") or 0)
+        state = "successful" if total > 0 and ready >= total else "pending"
+        status = {
+            "state": state,
+            "readyReplicas": ready,
+            "desiredReplicas": total,
+            "conditions": [
+                {
+                    "type": "Ready",
+                    "status": "True" if state == "successful" else "False",
+                    "reason": f"{ready}/{total} replicas ready",
+                }
+            ],
+        }
+        try:
+            self.k8s.patch_status(
+                mat.API_VERSION, mat.DGD_PLURAL, ns,
+                cr["metadata"]["name"], status,
+            )
+        except ApiError as e:
+            if not e.not_found:  # CR deleted mid-reconcile
+                log.warning("status update failed: %s", e)
+
+    # ----------------------------------------------------------------- DGDR --
+    def reconcile_dgdr(self, cr: Dict[str, Any]) -> None:
+        """SLA-driven deployment request: template + profiler -> DGD."""
+        name = cr["metadata"]["name"]
+        ns = self._ns(cr)
+        if (cr.get("status") or {}).get("state") in ("successful", "failed"):
+            return  # one-shot: profiling requests don't re-run
+        spec = cr.get("spec", {})
+        prof = spec.get("profilingConfig") or {}
+        cm_ref = ((prof.get("config") or {}).get("configMapRef")) or {}
+        template: Optional[Dict[str, Any]] = None
+        if cm_ref.get("name"):
+            cm = self.k8s.get("v1", "configmaps", ns, cm_ref["name"])
+            key = cm_ref.get("key") or next(iter(cm.get("data", {})), None)
+            if key and key in cm.get("data", {}):
+                template = _yaml_load(cm["data"][key])
+        if template is None:
+            self._set_dgdr_status(ns, name, "failed", "template ConfigMap missing")
+            return
+
+        sla = prof.get("sla") or {}
+        overrides = spec.get("deploymentOverrides") or {}
+        dgd = self._render_dgd(cr, template, sla, overrides)
+        if spec.get("autoApply", False):
+            try:
+                self.k8s.create(mat.API_VERSION, mat.DGD_PLURAL, ns, dgd)
+            except ApiError as e:
+                if not e.conflict:
+                    raise
+                self.k8s.merge_patch(
+                    mat.API_VERSION, mat.DGD_PLURAL, ns,
+                    dgd["metadata"]["name"], {"spec": dgd["spec"]},
+                )
+        self._set_dgdr_status(
+            ns, name, "successful", f"generated {dgd['metadata']['name']}",
+            generated=dgd,
+        )
+
+    def _render_dgd(
+        self,
+        cr: Dict[str, Any],
+        template: Dict[str, Any],
+        sla: Dict[str, Any],
+        overrides: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        dgd = json.loads(json.dumps(template))  # deep copy
+        dgd.setdefault("metadata", {})
+        dgd["metadata"]["namespace"] = self._ns(cr)
+        dgd["metadata"].setdefault("name", cr["metadata"]["name"] + "-generated")
+        dgd["metadata"].setdefault("labels", {})[
+            f"{mat.GROUP}/generated-by"
+        ] = cr["metadata"]["name"]
+        # SLA profiling sweep (the aiconfigurator analogue): pick mesh/batch
+        # for the request's isl/osl/ttft/itl on the target TPU system.
+        if sla:
+            try:
+                from dynamo_tpu.profiler.configurator import apply_sla_overrides
+
+                dgd = apply_sla_overrides(
+                    dgd, sla,
+                    system=(cr["spec"].get("profilingConfig") or {}).get(
+                        "tpuSystem", "v5e-8"
+                    ),
+                )
+            except ImportError:
+                log.warning("profiler unavailable; applying template unchanged")
+        workers_image = overrides.get("workersImage")
+        if workers_image:
+            for svc in (dgd.get("spec", {}).get("services") or {}).values():
+                if svc.get("componentType") != "frontend":
+                    svc.setdefault("extraPodSpec", {}).setdefault(
+                        "mainContainer", {}
+                    )["image"] = workers_image
+        return dgd
+
+    def _set_dgdr_status(
+        self, ns: str, name: str, state: str, message: str,
+        generated: Optional[Dict] = None,
+    ) -> None:
+        status: Dict[str, Any] = {"state": state, "message": message}
+        if generated is not None:
+            status["generatedDeployment"] = generated["metadata"]["name"]
+        try:
+            self.k8s.patch_status(
+                mat.API_VERSION, mat.DGDR_PLURAL, ns, name, status
+            )
+        except ApiError as e:
+            log.warning("DGDR status update failed: %s", e)
+
+    # ----------------------------------------------------------------- loop --
+    def reconcile_once(self) -> int:
+        """One full pass over both CRD kinds; returns number of CRs seen."""
+        n = 0
+        try:
+            dgdrs = self.k8s.list(mat.API_VERSION, mat.DGDR_PLURAL, self.namespace)
+        except ApiError as e:
+            if not e.not_found:
+                raise
+            dgdrs = []
+        for cr in dgdrs:
+            n += 1
+            try:
+                self.reconcile_dgdr(cr)
+            except Exception:
+                log.exception("DGDR %s reconcile failed", cr["metadata"]["name"])
+        for cr in self.k8s.list(mat.API_VERSION, mat.DGD_PLURAL, self.namespace):
+            n += 1
+            try:
+                self.reconcile_dgd(cr)
+            except Exception:
+                log.exception("DGD %s reconcile failed", cr["metadata"]["name"])
+        return n
+
+    def run(self, interval: float = 3.0, stop=None) -> None:
+        log.info("operator reconciling namespace %s every %.1fs",
+                 self.namespace, interval)
+        while stop is None or not stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:
+                log.exception("reconcile pass failed")
+            if stop is not None:
+                if stop.wait(interval):
+                    return
+            else:
+                time.sleep(interval)

@@ -1,0 +1,303 @@
+"""Materialize a DynamoGraphDeployment CR into Deployments + Services.
+
+Pure functions (no I/O) so the reconcile math is unit-testable without a
+cluster. Mirrors the behavior of the reference's consumed Go operator:
+- CRD chain DGD -> per-service Deployments/Services
+  (/root/reference/docs/k8s-cheatsheet.md:127-156)
+- discovery label on children — ours is `tpu.dynamo.ai/dynamo-namespace=
+  <ns>-<dgd>`, the analogue of `nvidia.com/dynamo-namespace`
+  (/root/reference/deploy-incluster.sh:252-256)
+- spec shape: services / componentType / subComponentType / replicas /
+  resources.limits / envFromSecret / envs / pvcs / volumeMounts /
+  extraPodSpec.mainContainer (/root/reference/examples/deploy/vllm/agg.yaml,
+  /root/reference/examples/dgdr/trtllm/disagg_cache.yaml:11-34)
+- garbage collection via ownerReferences on every child
+
+TPU-native differences: `resources.limits.tpu` maps to `google.com/tpu`;
+optional per-service `tpuAccelerator`/`tpuTopology` become GKE TPU
+nodeSelectors; multi-host slices get all-or-nothing gang semantics via a
+pod-group label consumed by the gang scheduler (the Grove/KAI analogue,
+/root/reference/install-dynamo-1node.sh:35-36,207-212).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+GROUP = "tpu.dynamo.ai"
+API_VERSION = f"{GROUP}/v1alpha1"
+DGD_KIND = "DynamoGraphDeployment"
+DGD_PLURAL = "dynamographdeployments"
+DGDR_KIND = "DynamoGraphDeploymentRequest"
+DGDR_PLURAL = "dynamographdeploymentrequests"
+
+NS_LABEL = f"{GROUP}/dynamo-namespace"
+COMPONENT_LABEL = f"{GROUP}/component"
+COMPONENT_TYPE_LABEL = f"{GROUP}/component-type"
+MANAGED_BY_LABEL = "app.kubernetes.io/managed-by"
+OPERATOR_NAME = "dynamo-tpu-operator"
+POD_GROUP_LABEL = f"{GROUP}/pod-group"
+
+FRONTEND_PORT = 8000
+WORKER_PORT = 8000
+
+# resources.limits key -> K8s resource name (tpu is the native path; gpu kept
+# so reference manifests apply unchanged during migration)
+RESOURCE_KEYS = {
+    "tpu": "google.com/tpu",
+    "gpu": "nvidia.com/gpu",
+    "cpu": "cpu",
+    "memory": "memory",
+    "ephemeral-storage": "ephemeral-storage",
+}
+
+
+def child_name(dgd_name: str, service_name: str) -> str:
+    return f"{dgd_name}-{service_name.lower()}"
+
+
+def discovery_label_value(namespace: str, dgd_name: str) -> str:
+    return f"{namespace}-{dgd_name}"
+
+
+def owner_reference(cr: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "apiVersion": cr.get("apiVersion", API_VERSION),
+        "kind": cr.get("kind", DGD_KIND),
+        "name": cr["metadata"]["name"],
+        "uid": cr["metadata"].get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def _labels(namespace: str, dgd_name: str, svc_name: str, ctype: str) -> Dict[str, str]:
+    return {
+        NS_LABEL: discovery_label_value(namespace, dgd_name),
+        COMPONENT_LABEL: svc_name.lower(),
+        COMPONENT_TYPE_LABEL: ctype,
+        MANAGED_BY_LABEL: OPERATOR_NAME,
+    }
+
+
+def _resources(spec: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Dict[str, str]] = {}
+    for section in ("requests", "limits"):
+        vals = (spec.get("resources") or {}).get(section) or {}
+        mapped = {
+            RESOURCE_KEYS.get(k, k): str(v)
+            for k, v in vals.items()
+            if v is not None
+        }
+        if mapped:
+            out[section] = mapped
+    # TPU containers must request == limit for google.com/tpu
+    lim = out.get("limits", {})
+    if "google.com/tpu" in lim:
+        out.setdefault("requests", {})["google.com/tpu"] = lim["google.com/tpu"]
+    return out
+
+
+def _container(
+    dgd_name: str, svc_name: str, spec: Dict[str, Any], ctype: str
+) -> Dict[str, Any]:
+    main = ((spec.get("extraPodSpec") or {}).get("mainContainer")) or {}
+    c: Dict[str, Any] = {
+        "name": "main",
+        "image": main.get("image", "dynamo-tpu/runtime:latest"),
+        "ports": [{"containerPort": FRONTEND_PORT, "name": "http"}],
+    }
+    if main.get("workingDir"):
+        c["workingDir"] = main["workingDir"]
+    if main.get("command"):
+        c["command"] = list(main["command"])
+    if main.get("args"):
+        c["args"] = list(main["args"])
+    if not c.get("command") and not c.get("args"):
+        # sensible defaults matching our runtime modules
+        if ctype == "frontend":
+            c["command"] = ["python3", "-m", "dynamo_tpu.frontend"]
+        else:
+            c["command"] = ["python3", "-m", "dynamo_tpu.jetstream"]
+
+    env: List[Dict[str, Any]] = [
+        {
+            "name": "POD_IP",
+            "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
+        },
+        {"name": "DYNAMO_COMPONENT", "value": svc_name},
+    ]
+    if ctype != "frontend":
+        env.append(
+            {
+                "name": "FRONTEND_URL",
+                "value": f"http://{dgd_name}-frontend:{FRONTEND_PORT}",
+            }
+        )
+    for e in spec.get("envs") or []:
+        env.append(dict(e))
+    c["env"] = env
+
+    if spec.get("envFromSecret"):
+        c["envFrom"] = [{"secretRef": {"name": spec["envFromSecret"]}}]
+
+    mounts = []
+    for vm in spec.get("volumeMounts") or []:
+        mounts.append(
+            {"name": vm["name"], "mountPath": vm.get("mountPoint", vm.get("mountPath"))}
+        )
+    if mounts:
+        c["volumeMounts"] = mounts
+
+    res = _resources(spec)
+    if res:
+        c["resources"] = res
+    return c
+
+
+def _pod_spec(
+    namespace: str, dgd_name: str, svc_name: str, spec: Dict[str, Any], ctype: str
+) -> Dict[str, Any]:
+    pod: Dict[str, Any] = {
+        "containers": [_container(dgd_name, svc_name, spec, ctype)]
+    }
+    volumes = []
+    for pvc in spec.get("pvcs") or []:
+        # pvcs[].create: false references an existing claim
+        # (/root/reference/examples/dgdr/trtllm/disagg_cache.yaml:11-13)
+        volumes.append(
+            {
+                "name": pvc["name"],
+                "persistentVolumeClaim": {"claimName": pvc["name"]},
+            }
+        )
+    if volumes:
+        pod["volumes"] = volumes
+    node_sel: Dict[str, str] = {}
+    if spec.get("tpuAccelerator"):
+        node_sel["cloud.google.com/gke-tpu-accelerator"] = spec["tpuAccelerator"]
+    if spec.get("tpuTopology"):
+        node_sel["cloud.google.com/gke-tpu-topology"] = spec["tpuTopology"]
+    if node_sel:
+        pod["nodeSelector"] = node_sel
+    extra = spec.get("extraPodSpec") or {}
+    for key in ("tolerations", "affinity", "schedulerName", "priorityClassName"):
+        if extra.get(key):
+            pod[key] = extra[key]
+    return pod
+
+
+def build_deployment(
+    cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any]
+) -> Dict[str, Any]:
+    namespace = cr["metadata"].get("namespace", "default")
+    dgd_name = cr["metadata"]["name"]
+    ctype = spec.get("componentType", "worker")
+    name = child_name(dgd_name, svc_name)
+    labels = _labels(namespace, dgd_name, svc_name, ctype)
+    if spec.get("subComponentType"):
+        labels[f"{GROUP}/sub-component"] = spec["subComponentType"]
+    pod_labels = dict(labels)
+    # gang semantics for multi-host slices: one pod-group per service
+    pod_labels[POD_GROUP_LABEL] = name
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels,
+            "ownerReferences": [owner_reference(cr)],
+        },
+        "spec": {
+            "replicas": int(spec.get("replicas", 1)),
+            "selector": {"matchLabels": {COMPONENT_LABEL: svc_name.lower(),
+                                         NS_LABEL: labels[NS_LABEL]}},
+            "template": {
+                "metadata": {"labels": pod_labels},
+                "spec": _pod_spec(namespace, dgd_name, svc_name, spec, ctype),
+            },
+        },
+    }
+
+
+def build_service(
+    cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Frontend gets a ClusterIP Service; workers get headless Services.
+
+    The deploy orchestrator skips headless services when converting to
+    NodePort (/root/reference/deploy-incluster.sh:409-413) and excludes
+    `-d`/`-p` suffixed names from frontend selection (:459-464) — worker
+    services here are headless, so both filters behave identically.
+    """
+    namespace = cr["metadata"].get("namespace", "default")
+    dgd_name = cr["metadata"]["name"]
+    ctype = spec.get("componentType", "worker")
+    name = child_name(dgd_name, svc_name)
+    labels = _labels(namespace, dgd_name, svc_name, ctype)
+    svc: Dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels,
+            "ownerReferences": [owner_reference(cr)],
+        },
+        "spec": {
+            "selector": {COMPONENT_LABEL: svc_name.lower(),
+                         NS_LABEL: labels[NS_LABEL]},
+            "ports": [{"port": FRONTEND_PORT, "targetPort": FRONTEND_PORT,
+                       "name": "http"}],
+        },
+    }
+    if ctype != "frontend":
+        svc["spec"]["clusterIP"] = "None"
+    return svc
+
+
+def build_pvcs(cr: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """PVCs with create: true are materialized by the operator."""
+    namespace = cr["metadata"].get("namespace", "default")
+    out = []
+    seen = set()
+    for spec in (cr.get("spec", {}).get("services") or {}).values():
+        for pvc in spec.get("pvcs") or []:
+            if not pvc.get("create") or pvc["name"] in seen:
+                continue
+            seen.add(pvc["name"])
+            out.append(
+                {
+                    "apiVersion": "v1",
+                    "kind": "PersistentVolumeClaim",
+                    "metadata": {
+                        "name": pvc["name"],
+                        "namespace": namespace,
+                        "ownerReferences": [owner_reference(cr)],
+                    },
+                    "spec": {
+                        "accessModes": [pvc.get("accessMode", "ReadWriteOnce")],
+                        "storageClassName": pvc.get("storageClass", "local-path"),
+                        "resources": {
+                            "requests": {"storage": pvc.get("size", "10Gi")}
+                        },
+                    },
+                }
+            )
+    return out
+
+
+def materialize(cr: Dict[str, Any]) -> Dict[str, List[Dict[str, Any]]]:
+    """CR -> {deployments, services, pvcs} (desired child state)."""
+    services = cr.get("spec", {}).get("services") or {}
+    deployments = []
+    svcs = []
+    for svc_name, spec in services.items():
+        deployments.append(build_deployment(cr, svc_name, spec))
+        svcs.append(build_service(cr, svc_name, spec))
+    return {
+        "deployments": deployments,
+        "services": svcs,
+        "pvcs": build_pvcs(cr),
+    }

@@ -21,6 +21,8 @@ Layout:
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 from typing import Optional
 
@@ -28,14 +30,34 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-_BACKEND: Optional[str] = None  # None -> resolve from env / default
+# (backend, mesh) bound by the engine around each jit call (incl. tracing),
+# so attention config is per-engine, not process-global — two engines with
+# different meshes/backends in one process (e.g. colocated disagg roles)
+# never reconfigure each other.
+_ATTN_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "dynamo_tpu_attn_ctx", default=(None, None)
+)
+
+_BACKEND: Optional[str] = None  # process-wide override (tests, ad-hoc use)
 _MESH: Optional[Mesh] = None
 
 _VALID_BACKENDS = ("auto", "xla", "pallas", "pallas_interpret")
 
 
+@contextlib.contextmanager
+def attention_context(backend: Optional[str], mesh: Optional[Mesh]):
+    """Scope the attention backend + mesh for calls (and traces) within."""
+    if backend is not None and backend not in _VALID_BACKENDS:
+        raise ValueError(f"backend {backend!r} not in {_VALID_BACKENDS}")
+    token = _ATTN_CTX.set((backend, mesh))
+    try:
+        yield
+    finally:
+        _ATTN_CTX.reset(token)
+
+
 def set_attention_backend(name: Optional[str]) -> None:
-    """Override attention backend (None reverts to env/auto resolution)."""
+    """Process-wide backend override (None reverts to env/auto resolution)."""
     global _BACKEND
     if name is not None and name not in _VALID_BACKENDS:
         raise ValueError(f"backend {name!r} not in {_VALID_BACKENDS}")
@@ -43,13 +65,14 @@ def set_attention_backend(name: Optional[str]) -> None:
 
 
 def set_attention_mesh(mesh: Optional[Mesh]) -> None:
-    """Register the engine's device mesh so Pallas kernels run under shard_map."""
+    """Process-wide mesh override so Pallas kernels run under shard_map."""
     global _MESH
     _MESH = mesh
 
 
 def _resolve_backend() -> str:
-    b = _BACKEND or os.environ.get("DYNAMO_TPU_ATTN_BACKEND", "auto")
+    ctx_backend, _ = _ATTN_CTX.get()
+    b = ctx_backend or _BACKEND or os.environ.get("DYNAMO_TPU_ATTN_BACKEND", "auto")
     if b not in _VALID_BACKENDS:
         raise ValueError(f"DYNAMO_TPU_ATTN_BACKEND {b!r} not in {_VALID_BACKENDS}")
     if b == "auto":
@@ -58,13 +81,15 @@ def _resolve_backend() -> str:
 
 
 def _mesh_for_shard_map() -> Optional[Mesh]:
-    """The registered mesh, when any relevant axis actually needs sharding."""
-    if _MESH is None:
+    """The scoped (or global) mesh, when any axis actually needs sharding."""
+    _, ctx_mesh = _ATTN_CTX.get()
+    mesh = ctx_mesh if ctx_mesh is not None else _MESH
+    if mesh is None:
         return None
-    sizes = dict(zip(_MESH.axis_names, _MESH.devices.shape))
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if sizes.get("model", 1) == 1 and sizes.get("data", 1) == 1:
         return None
-    return _MESH
+    return mesh
 
 
 def repeat_kv(x: jax.Array, n_rep: int, axis: int) -> jax.Array:

@@ -7,10 +7,10 @@ materialising the gathered KV in HBM: pages are DMA'd page-by-page into VMEM
 via scalar-prefetched block tables, with flash (online-softmax) accumulation
 in VMEM scratch.
 
-Both kernels are head-parallel (no cross-head or cross-page communication
-besides the sequential flash accumulator), so under tensor parallelism they
-run inside `shard_map` over the `model` mesh axis with zero collectives —
-each TP shard attends over its local KV heads only.
+Both kernels grid over KV heads (queries blocked `group` per KV head), so
+each K/V block is fetched from HBM exactly once, and both are head-parallel —
+under tensor parallelism they run inside `shard_map` over the `model` mesh
+axis with zero collectives: each TP shard attends over its local KV heads.
 """
 
 from __future__ import annotations
@@ -23,6 +23,41 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
+
+
+# ------------------------------------------------------ flash accumulation --
+
+
+def _flash_reset(m_ref, l_ref, acc_ref):
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+
+def _flash_update(m_ref, l_ref, acc_ref, s, v):
+    """Online-softmax step: fold scores s [R, C] and values v [C, D] into the
+    running (max, denominator, numerator) scratch. Rows whose entries are all
+    -inf so far keep alpha = exp(-inf - finite) = 0, which zeroes nothing
+    incorrectly because acc is also still zero."""
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_ref[...] = jnp.broadcast_to(
+        alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
+    )
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+        p, v, preferred_element_type=jnp.float32
+    )
+
+
+def _flash_normalize(l_ref, acc_ref):
+    """acc / l with rows that saw no valid token (l == 0) emitting zeros."""
+    l = l_ref[:, :1]
+    return acc_ref[...] / jnp.where(l == 0.0, 1.0, l)
 
 
 # ------------------------------------------------------------------ decode --
@@ -51,9 +86,7 @@ def _decode_kernel(
 
     @pl.when(i == 0)
     def _reset():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        _flash_reset(m_ref, l_ref, acc_ref)
 
     ctx = cl_ref[b]
     page_start = i * page_size
@@ -73,26 +106,11 @@ def _decode_kernel(
         )  # [G, ps]
         span = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(span < ctx, s, NEG_INF)
-
-        m_prev = m_ref[:, :1]  # [G, 1]
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)  # first page: exp(-inf - finite) = 0
-        p = jnp.exp(s - m_new)  # [G, ps]
-        l_ref[...] = jnp.broadcast_to(
-            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
-        )
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        _flash_update(m_ref, l_ref, acc_ref, s, v)
 
     @pl.when(i == pages_per_seq - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)  # inactive slot (ctx == 0): emit zeros
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        o_ref[0] = _flash_normalize(l_ref, acc_ref).astype(o_ref.dtype)
 
 
 def paged_attention_decode(
@@ -153,14 +171,15 @@ def paged_attention_decode(
 
 def _prefill_kernel(
     sl_ref,  # [1] int32 true sequence length
-    q_ref,  # [1, Tq, D]
+    q_ref,  # [1, G, Tq, D] — all `group` query heads of this KV head
     k_ref,  # [1, Tk, D]
     v_ref,  # [1, Tk, D]
-    o_ref,  # [1, Tq, D]
-    m_ref,  # [Tq, 128] f32
-    l_ref,  # [Tq, 128] f32
-    acc_ref,  # [Tq, D] f32
+    o_ref,  # [1, G, Tq, D]
+    m_ref,  # [G*Tq, 128] f32
+    l_ref,  # [G*Tq, 128] f32
+    acc_ref,  # [G*Tq, D] f32
     *,
+    group: int,
     block_q: int,
     block_k: int,
     num_k_blocks: int,
@@ -171,9 +190,7 @@ def _prefill_kernel(
 
     @pl.when(ik == 0)
     def _reset():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        _flash_reset(m_ref, l_ref, acc_ref)
 
     q_start = iq * block_q
     k_start = ik * block_k
@@ -183,7 +200,8 @@ def _prefill_kernel(
     # past the true sequence length.
     @pl.when((k_start <= q_start + block_q - 1) & (k_start < sl))
     def _attend():
-        q = q_ref[0].astype(jnp.float32)  # [Tq, D]
+        head_dim = q_ref.shape[-1]
+        q = q_ref[0].astype(jnp.float32).reshape(group * block_q, head_dim)
         k = k_ref[0].astype(jnp.float32)  # [Tk, D]
         v = v_ref[0].astype(jnp.float32)
         s = (
@@ -191,32 +209,21 @@ def _prefill_kernel(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )
             * scale
-        )  # [Tq, Tk]
-        qi = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        )  # [G*Tq, Tk]
+        # row r of the (group, Tq) reshape is query position q_start + r % Tq
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        qi = q_start + jax.lax.rem(row, block_q)
         ki = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where((ki <= qi) & (ki < sl), s, NEG_INF)
-
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        # Rows fully masked in this block keep m_new = m_prev; at ik == 0 every
-        # row sees ki == 0 unmasked, so m_new is finite from the first block on.
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_ref[...] = jnp.broadcast_to(
-            alpha * l_prev + jnp.sum(p, axis=1, keepdims=True), l_ref.shape
-        )
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        # at ik == 0 every row has ki == 0 unmasked (sl >= 1), so m stays
+        # finite from the first block on — no exp(-inf - -inf) NaN.
+        _flash_update(m_ref, l_ref, acc_ref, s, v)
 
     @pl.when(ik == num_k_blocks - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        head_dim = q_ref.shape[-1]
+        out = _flash_normalize(l_ref, acc_ref)
+        o_ref[0] = out.reshape(group, block_q, head_dim).astype(o_ref.dtype)
 
 
 def prefill_attention(
@@ -238,13 +245,15 @@ def prefill_attention(
     block_k = min(block_k, max(s, 8))
     s_pad = -(-s // max(block_q, block_k)) * max(block_q, block_k)
 
-    # head-major layout for clean (head, seq-block) blocking
-    qt = jnp.moveaxis(q, 1, 0)  # [H, S, D]
+    # [KV, G, S, D] so one grid step covers all `group` query heads of a KV
+    # head — each K/V block is DMA'd exactly once.
+    qt = jnp.moveaxis(q, 1, 0).reshape(n_kv, group, s, head_dim)
     kt = jnp.moveaxis(k, 1, 0)  # [KV, S, D]
     vt = jnp.moveaxis(v, 1, 0)
     if s_pad != s:
-        pad = ((0, 0), (0, s_pad - s), (0, 0))
-        qt, kt, vt = jnp.pad(qt, pad), jnp.pad(kt, pad), jnp.pad(vt, pad)
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+        kt = jnp.pad(kt, ((0, 0), (0, s_pad - s), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, s_pad - s), (0, 0)))
 
     nq = s_pad // block_q
     nk = s_pad // block_k
@@ -252,29 +261,26 @@ def prefill_attention(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n_heads, nq, nk),
+        grid=(n_kv, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda h, iq, ik, sl: (h, iq, 0)),
             pl.BlockSpec(
-                (1, block_k, head_dim),
-                # GQA: query head h reads kv head h // group (repeat_kv layout)
-                lambda h, iq, ik, sl: (h // group, ik, 0),
+                (1, group, block_q, head_dim), lambda h, iq, ik, sl: (h, 0, iq, 0)
             ),
-            pl.BlockSpec(
-                (1, block_k, head_dim), lambda h, iq, ik, sl: (h // group, ik, 0)
-            ),
+            pl.BlockSpec((1, block_k, head_dim), lambda h, iq, ik, sl: (h, ik, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda h, iq, ik, sl: (h, ik, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, block_q, head_dim), lambda h, iq, ik, sl: (h, iq, 0)
+            (1, group, block_q, head_dim), lambda h, iq, ik, sl: (h, 0, iq, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((group * block_q, 128), jnp.float32),
+            pltpu.VMEM((group * block_q, 128), jnp.float32),
+            pltpu.VMEM((group * block_q, head_dim), jnp.float32),
         ],
     )
     kernel = functools.partial(
         _prefill_kernel,
+        group=group,
         block_q=block_q,
         block_k=block_k,
         num_k_blocks=nk,
@@ -283,10 +289,11 @@ def prefill_attention(
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_heads, s_pad, head_dim), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_kv, group, s_pad, head_dim), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(sl, qt, kt, vt)
+    out = out.reshape(n_heads, s_pad, head_dim)
     return jnp.moveaxis(out[:, :s], 0, 1)  # [S, H, D]

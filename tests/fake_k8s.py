@@ -1,0 +1,229 @@
+"""In-process fake Kubernetes API server (test double for the operator).
+
+Implements the REST subset the operator's stdlib client speaks: namespaced +
+cluster-wide GET/LIST, POST (409 on duplicate), PUT, JSON merge-PATCH, DELETE,
+labelSelector equality filtering, and the /status subresource. This is the
+fake-backend strategy from SURVEY.md §4 — the reference has no tests at all,
+so operator logic here is verified against this double instead of a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+# /api/v1/... or /apis/group/version/... ; optional namespace; plural; name; subresource
+_PATH = re.compile(
+    r"^/(?:api/(?P<corever>v1)|apis/(?P<group>[^/]+)/(?P<ver>[^/]+))"
+    r"(?:/namespaces/(?P<ns>[^/]+))?"
+    r"/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<sub>status))?$"
+)
+
+
+def _merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out
+
+
+def _matches_selector(obj: Dict[str, Any], selector: Optional[str]) -> bool:
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels") or {}
+    for clause in selector.split(","):
+        if "=" in clause:
+            k, v = clause.split("=", 1)
+            if labels.get(k) != v:
+                return False
+    return True
+
+
+class FakeK8sStore:
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (api_key, ns, plural) -> {name: obj}
+        self.objs: Dict[Tuple[str, str, str], Dict[str, Dict[str, Any]]] = {}
+        self._rv = 0
+
+    def _bucket(self, api_key: str, ns: str, plural: str) -> Dict[str, Dict]:
+        return self.objs.setdefault((api_key, ns, plural), {})
+
+    def all_namespaces(self, api_key: str, plural: str):
+        out = []
+        for (ak, _ns, pl), bucket in self.objs.items():
+            if ak == api_key and pl == plural:
+                out.extend(bucket.values())
+        return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: FakeK8sStore  # injected
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code: int, body: Dict[str, Any]):
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, code: int, reason: str):
+        self._send(code, {"kind": "Status", "code": code, "message": reason})
+
+    def _read_body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(n).decode()) if n else {}
+
+    def _route(self):
+        parsed = urlparse(self.path)
+        m = _PATH.match(parsed.path)
+        if not m:
+            return None
+        g = m.groupdict()
+        api_key = "v1" if g["corever"] else f"{g['group']}/{g['ver']}"
+        qs = parse_qs(parsed.query)
+        selector = qs.get("labelSelector", [None])[0]
+        return api_key, g["ns"], g["plural"], g["name"], g["sub"], selector
+
+    def do_GET(self):
+        r = self._route()
+        if not r:
+            return self._error(404, "bad path")
+        api_key, ns, plural, name, _sub, selector = r
+        st = self.store
+        with st.lock:
+            if name is None:
+                items = (
+                    st.all_namespaces(api_key, plural)
+                    if ns is None
+                    else list(st._bucket(api_key, ns, plural).values())
+                )
+                items = [o for o in items if _matches_selector(o, selector)]
+                return self._send(200, {"kind": "List", "items": items})
+            obj = st._bucket(api_key, ns or "default", plural).get(name)
+            if obj is None:
+                return self._error(404, f"{plural}/{name} not found")
+            return self._send(200, obj)
+
+    def do_POST(self):
+        r = self._route()
+        if not r:
+            return self._error(404, "bad path")
+        api_key, ns, plural, _name, _sub, _sel = r
+        obj = self._read_body()
+        name = obj.get("metadata", {}).get("name")
+        if not name:
+            return self._error(422, "metadata.name required")
+        st = self.store
+        with st.lock:
+            bucket = st._bucket(api_key, ns or "default", plural)
+            if name in bucket:
+                return self._error(409, f"{plural}/{name} already exists")
+            obj.setdefault("metadata", {})["uid"] = str(uuid.uuid4())
+            obj["metadata"]["namespace"] = ns or "default"
+            st._rv += 1
+            obj["metadata"]["resourceVersion"] = str(st._rv)
+            bucket[name] = obj
+            return self._send(201, obj)
+
+    def do_PUT(self):
+        r = self._route()
+        if not r or not r[3]:
+            return self._error(404, "bad path")
+        api_key, ns, plural, name, _sub, _sel = r
+        obj = self._read_body()
+        st = self.store
+        with st.lock:
+            bucket = st._bucket(api_key, ns or "default", plural)
+            if name not in bucket:
+                return self._error(404, f"{plural}/{name} not found")
+            prev = bucket[name]
+            obj.setdefault("metadata", {})["uid"] = prev["metadata"].get("uid")
+            obj["metadata"]["namespace"] = ns or "default"
+            st._rv += 1
+            obj["metadata"]["resourceVersion"] = str(st._rv)
+            bucket[name] = obj
+            return self._send(200, obj)
+
+    def do_PATCH(self):
+        r = self._route()
+        if not r or not r[3]:
+            return self._error(404, "bad path")
+        api_key, ns, plural, name, sub, _sel = r
+        patch = self._read_body()
+        st = self.store
+        with st.lock:
+            bucket = st._bucket(api_key, ns or "default", plural)
+            if name not in bucket:
+                return self._error(404, f"{plural}/{name} not found")
+            if sub == "status":
+                patch = {"status": patch.get("status", patch)}
+            merged = _merge_patch(bucket[name], patch)
+            st._rv += 1
+            merged.setdefault("metadata", {})["resourceVersion"] = str(st._rv)
+            bucket[name] = merged
+            return self._send(200, merged)
+
+    def do_DELETE(self):
+        r = self._route()
+        if not r or not r[3]:
+            return self._error(404, "bad path")
+        api_key, ns, plural, name, _sub, _sel = r
+        st = self.store
+        with st.lock:
+            bucket = st._bucket(api_key, ns or "default", plural)
+            if name not in bucket:
+                return self._error(404, f"{plural}/{name} not found")
+            del bucket[name]
+            return self._send(200, {"kind": "Status", "status": "Success"})
+
+
+class FakeK8s:
+    """Context manager: fake API server on an ephemeral localhost port."""
+
+    def __init__(self):
+        self.store = FakeK8sStore()
+        handler = type("Handler", (_Handler,), {"store": self.store})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def __enter__(self) -> "FakeK8s":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # test conveniences
+    def put_object(self, api_key: str, ns: str, plural: str, obj: Dict[str, Any]):
+        with self.store.lock:
+            obj.setdefault("metadata", {}).setdefault("uid", str(uuid.uuid4()))
+            obj["metadata"]["namespace"] = ns
+            self.store._bucket(api_key, ns, plural)[obj["metadata"]["name"]] = obj
+
+    def get_object(self, api_key: str, ns: str, plural: str, name: str):
+        with self.store.lock:
+            return self.store._bucket(api_key, ns, plural).get(name)

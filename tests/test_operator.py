@@ -1,0 +1,254 @@
+"""Operator tests: pure materialization math + reconcile loops against the
+in-process fake K8s API server (tests/fake_k8s.py)."""
+
+import copy
+
+import pytest
+
+from dynamo_tpu.operator import materialize as mat
+from dynamo_tpu.operator.controller import Controller
+from dynamo_tpu.operator.k8s_client import ApiError, K8sClient
+from tests.fake_k8s import FakeK8s
+
+DGD = {
+    "apiVersion": mat.API_VERSION,
+    "kind": mat.DGD_KIND,
+    "metadata": {"name": "agg-demo", "namespace": "dynamo", "uid": "u-123"},
+    "spec": {
+        "services": {
+            "Frontend": {
+                "componentType": "frontend",
+                "replicas": 1,
+                "envFromSecret": "hf-token-secret",
+                "extraPodSpec": {
+                    "mainContainer": {"image": "dynamo-tpu/runtime:v1"}
+                },
+            },
+            "JetstreamDecodeWorker": {
+                "componentType": "worker",
+                "subComponentType": "decode",
+                "replicas": 2,
+                "resources": {"limits": {"tpu": "8"}},
+                "tpuAccelerator": "tpu-v5-lite-podslice",
+                "tpuTopology": "2x4",
+                "envs": [{"name": "EXTRA", "value": "1"}],
+                "pvcs": [{"name": "llm-models", "create": True, "size": "200Gi"}],
+                "volumeMounts": [
+                    {"name": "llm-models", "mountPoint": "/root/.cache/huggingface"}
+                ],
+                "extraPodSpec": {
+                    "mainContainer": {
+                        "image": "dynamo-tpu/runtime:v1",
+                        "command": ["python3", "-m", "dynamo_tpu.jetstream"],
+                        "args": ["--model", "meta-llama/Llama-3.2-1B-Instruct"],
+                    }
+                },
+            },
+        }
+    },
+}
+
+
+# ------------------------------------------------------------ materialize --
+
+
+def test_materialize_deployment_shape():
+    out = mat.materialize(DGD)
+    deps = {d["metadata"]["name"]: d for d in out["deployments"]}
+    assert set(deps) == {"agg-demo-frontend", "agg-demo-jetstreamdecodeworker"}
+
+    w = deps["agg-demo-jetstreamdecodeworker"]
+    assert w["spec"]["replicas"] == 2
+    c = w["spec"]["template"]["spec"]["containers"][0]
+    # tpu -> google.com/tpu with request==limit
+    assert c["resources"]["limits"]["google.com/tpu"] == "8"
+    assert c["resources"]["requests"]["google.com/tpu"] == "8"
+    # worker gets FRONTEND_URL pointing at the frontend child service
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["FRONTEND_URL"] == "http://agg-demo-frontend:8000"
+    assert env["EXTRA"] == "1"
+    # pvc volume + mount
+    assert w["spec"]["template"]["spec"]["volumes"][0]["persistentVolumeClaim"][
+        "claimName"
+    ] == "llm-models"
+    assert c["volumeMounts"][0]["mountPath"] == "/root/.cache/huggingface"
+    # TPU slice node selectors (GKE convention)
+    sel = w["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    # discovery label mirrors nvidia.com/dynamo-namespace=<ns>-<dgd>
+    assert w["metadata"]["labels"][mat.NS_LABEL] == "dynamo-agg-demo"
+    # ownership for GC
+    assert w["metadata"]["ownerReferences"][0]["uid"] == "u-123"
+
+    f = deps["agg-demo-frontend"]
+    fc = f["spec"]["template"]["spec"]["containers"][0]
+    assert fc["envFrom"][0]["secretRef"]["name"] == "hf-token-secret"
+    assert fc["command"] == ["python3", "-m", "dynamo_tpu.frontend"]
+
+
+def test_materialize_services_frontend_clusterip_workers_headless():
+    out = mat.materialize(DGD)
+    svcs = {s["metadata"]["name"]: s for s in out["services"]}
+    assert "clusterIP" not in svcs["agg-demo-frontend"]["spec"]
+    assert svcs["agg-demo-jetstreamdecodeworker"]["spec"]["clusterIP"] == "None"
+
+
+def test_materialize_pvcs_created_once():
+    out = mat.materialize(DGD)
+    assert len(out["pvcs"]) == 1
+    pvc = out["pvcs"][0]
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "200Gi"
+    assert pvc["spec"]["storageClassName"] == "local-path"
+
+
+def test_materialize_gpu_key_still_maps():
+    cr = copy.deepcopy(DGD)
+    cr["spec"]["services"]["JetstreamDecodeWorker"]["resources"] = {
+        "limits": {"gpu": "1"}
+    }
+    out = mat.materialize(cr)
+    w = [d for d in out["deployments"]
+         if d["metadata"]["name"].endswith("worker")][0]
+    c = w["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["limits"]["nvidia.com/gpu"] == "1"
+
+
+# ------------------------------------------------------------- controller --
+
+
+def test_reconcile_creates_children_and_status():
+    with FakeK8s() as fake:
+        fake.put_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                        copy.deepcopy(DGD))
+        ctrl = Controller(K8sClient(fake.url), namespace=None)
+        n = ctrl.reconcile_once()
+        assert n == 1
+        dep = fake.get_object("apps/v1", "dynamo", "deployments",
+                              "agg-demo-jetstreamdecodeworker")
+        assert dep is not None
+        svc = fake.get_object("v1", "dynamo", "services", "agg-demo-frontend")
+        assert svc is not None
+        pvc = fake.get_object("v1", "dynamo", "persistentvolumeclaims",
+                              "llm-models")
+        assert pvc is not None
+        cr = fake.get_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                             "agg-demo")
+        assert cr["status"]["state"] == "pending"  # no readyReplicas yet
+
+        # children report ready -> CR flips to successful
+        for name in ("agg-demo-frontend", "agg-demo-jetstreamdecodeworker"):
+            d = fake.get_object("apps/v1", "dynamo", "deployments", name)
+            d["status"] = {"readyReplicas": d["spec"]["replicas"]}
+        ctrl.reconcile_once()
+        cr = fake.get_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                             "agg-demo")
+        assert cr["status"]["state"] == "successful"
+
+
+def test_reconcile_prunes_removed_services():
+    with FakeK8s() as fake:
+        fake.put_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                        copy.deepcopy(DGD))
+        ctrl = Controller(K8sClient(fake.url), namespace=None)
+        ctrl.reconcile_once()
+        assert fake.get_object("apps/v1", "dynamo", "deployments",
+                               "agg-demo-jetstreamdecodeworker")
+        # drop the worker from the CR
+        cr = fake.get_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                             "agg-demo")
+        del cr["spec"]["services"]["JetstreamDecodeWorker"]
+        ctrl.reconcile_once()
+        assert fake.get_object("apps/v1", "dynamo", "deployments",
+                               "agg-demo-jetstreamdecodeworker") is None
+        assert fake.get_object("apps/v1", "dynamo", "deployments",
+                               "agg-demo-frontend")
+
+
+def test_reconcile_updates_replicas():
+    with FakeK8s() as fake:
+        fake.put_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                        copy.deepcopy(DGD))
+        ctrl = Controller(K8sClient(fake.url), namespace=None)
+        ctrl.reconcile_once()
+        cr = fake.get_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                             "agg-demo")
+        cr["spec"]["services"]["JetstreamDecodeWorker"]["replicas"] = 4
+        ctrl.reconcile_once()
+        dep = fake.get_object("apps/v1", "dynamo", "deployments",
+                              "agg-demo-jetstreamdecodeworker")
+        assert dep["spec"]["replicas"] == 4
+
+
+def test_dgdr_generates_and_applies_dgd():
+    import json
+
+    template = {
+        "apiVersion": mat.API_VERSION,
+        "kind": mat.DGD_KIND,
+        "metadata": {"name": "qwen-disagg"},
+        "spec": {
+            "services": {
+                "Frontend": {"componentType": "frontend", "replicas": 1},
+                "PrefillWorker": {
+                    "componentType": "worker",
+                    "subComponentType": "prefill",
+                    "replicas": 1,
+                    "resources": {"limits": {"tpu": "4"}},
+                },
+            }
+        },
+    }
+    dgdr = {
+        "apiVersion": mat.API_VERSION,
+        "kind": mat.DGDR_KIND,
+        "metadata": {"name": "qwen-request", "namespace": "dynamo"},
+        "spec": {
+            "model": "qwen/qwen3-0.6b",
+            "backend": "jetstream",
+            "autoApply": True,
+            "profilingConfig": {
+                "config": {"configMapRef": {"name": "qwen-config",
+                                            "key": "disagg.yaml"}},
+                "sla": {"isl": 4000, "osl": 500, "ttft": 600, "itl": 25},
+                "tpuSystem": "v5e-8",
+            },
+            "deploymentOverrides": {"workersImage": "dynamo-tpu/runtime:v2"},
+        },
+    }
+    with FakeK8s() as fake:
+        fake.put_object("v1", "dynamo", "configmaps", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "qwen-config"},
+            "data": {"disagg.yaml": json.dumps(template)},
+        })
+        fake.put_object(mat.API_VERSION, "dynamo", mat.DGDR_PLURAL, dgdr)
+        ctrl = Controller(K8sClient(fake.url), namespace=None)
+        ctrl.reconcile_once()
+        gen = fake.get_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                              "qwen-disagg")
+        assert gen is not None, "autoApply should create the DGD"
+        # workersImage override applied to workers, not the frontend
+        assert (
+            gen["spec"]["services"]["PrefillWorker"]["extraPodSpec"]
+            ["mainContainer"]["image"] == "dynamo-tpu/runtime:v2"
+        )
+        assert "extraPodSpec" not in gen["spec"]["services"]["Frontend"]
+        req = fake.get_object(mat.API_VERSION, "dynamo", mat.DGDR_PLURAL,
+                              "qwen-request")
+        assert req["status"]["state"] == "successful"
+        assert req["status"]["generatedDeployment"] == "qwen-disagg"
+
+        # second pass materializes the generated DGD's children
+        ctrl.reconcile_once()
+        assert fake.get_object("apps/v1", "dynamo", "deployments",
+                               "qwen-disagg-prefillworker")
+
+
+def test_client_404_handling():
+    with FakeK8s() as fake:
+        client = K8sClient(fake.url)
+        with pytest.raises(ApiError) as ei:
+            client.get("v1", "services", "nowhere", "missing")
+        assert ei.value.not_found
+        client.delete("v1", "services", "nowhere", "missing")  # no raise

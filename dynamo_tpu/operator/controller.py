@@ -73,12 +73,15 @@ class Controller:
 
         # prune children whose service was removed from the CR
         want_deps = {d["metadata"]["name"] for d in desired["deployments"]}
+        kept_deps = []
         for existing in self._owned("apps/v1", "deployments", ns, ns_label):
             if existing["metadata"]["name"] not in want_deps:
                 log.info("pruning stale deployment %s", existing["metadata"]["name"])
                 self.k8s.delete(
                     "apps/v1", "deployments", ns, existing["metadata"]["name"],
                 )
+            else:
+                kept_deps.append(existing)
         want_svcs = {s["metadata"]["name"] for s in desired["services"]}
         for existing in self._owned("v1", "services", ns, ns_label):
             if existing["metadata"]["name"] not in want_svcs:
@@ -86,13 +89,15 @@ class Controller:
                     "v1", "services", ns, existing["metadata"]["name"]
                 )
 
-        self._update_dgd_status(cr, ns_label)
+        self._update_dgd_status(cr, kept_deps)
 
-    def _update_dgd_status(self, cr: Dict[str, Any], ns_label: str) -> None:
+    def _update_dgd_status(
+        self, cr: Dict[str, Any], owned_deps: List[Dict[str, Any]]
+    ) -> None:
         ns = self._ns(cr)
         ready = 0
         total = 0
-        for dep in self._owned("apps/v1", "deployments", ns, ns_label):
+        for dep in owned_deps:
             total += int(dep.get("spec", {}).get("replicas", 1))
             ready += int(dep.get("status", {}).get("readyReplicas") or 0)
         state = "successful" if total > 0 and ready >= total else "pending"
@@ -134,7 +139,13 @@ class Controller:
             if key and key in cm.get("data", {}):
                 template = _yaml_load(cm["data"][key])
         if template is None:
-            self._set_dgdr_status(ns, name, "failed", "template ConfigMap missing")
+            # Transient: the user may create/fix the ConfigMap after the DGDR
+            # (run-dgdr.sh creates them together; ordering isn't guaranteed).
+            # "pending" is retried on every pass — only render success is
+            # terminal, matching the wholly-missing-ConfigMap (404) path.
+            self._set_dgdr_status(
+                ns, name, "pending", "waiting for template ConfigMap/key"
+            )
             return
 
         sla = prof.get("sla") or {}

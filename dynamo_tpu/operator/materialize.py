@@ -98,8 +98,23 @@ def _resources(spec: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def frontend_host(cr: Dict[str, Any]) -> str:
+    """Child-service DNS name of the graph's frontend component.
+
+    Keyed on componentType (not the service's map key) so a DGD that names
+    its frontend service anything (e.g. `Router:`) still gives workers a
+    resolvable FRONTEND_URL.
+    """
+    dgd_name = cr["metadata"]["name"]
+    for svc_name, spec in (cr.get("spec", {}).get("services") or {}).items():
+        if spec.get("componentType") == "frontend":
+            return child_name(dgd_name, svc_name)
+    return f"{dgd_name}-frontend"
+
+
 def _container(
-    dgd_name: str, svc_name: str, spec: Dict[str, Any], ctype: str
+    dgd_name: str, svc_name: str, spec: Dict[str, Any], ctype: str,
+    frontend: str = "",
 ) -> Dict[str, Any]:
     main = ((spec.get("extraPodSpec") or {}).get("mainContainer")) or {}
     c: Dict[str, Any] = {
@@ -131,7 +146,7 @@ def _container(
         env.append(
             {
                 "name": "FRONTEND_URL",
-                "value": f"http://{dgd_name}-frontend:{FRONTEND_PORT}",
+                "value": f"http://{frontend or dgd_name + '-frontend'}:{FRONTEND_PORT}",
             }
         )
     for e in spec.get("envs") or []:
@@ -156,10 +171,11 @@ def _container(
 
 
 def _pod_spec(
-    namespace: str, dgd_name: str, svc_name: str, spec: Dict[str, Any], ctype: str
+    namespace: str, dgd_name: str, svc_name: str, spec: Dict[str, Any], ctype: str,
+    frontend: str = "",
 ) -> Dict[str, Any]:
     pod: Dict[str, Any] = {
-        "containers": [_container(dgd_name, svc_name, spec, ctype)]
+        "containers": [_container(dgd_name, svc_name, spec, ctype, frontend)]
     }
     volumes = []
     for pvc in spec.get("pvcs") or []:
@@ -193,6 +209,7 @@ def build_deployment(
     namespace = cr["metadata"].get("namespace", "default")
     dgd_name = cr["metadata"]["name"]
     ctype = spec.get("componentType", "worker")
+    frontend = frontend_host(cr)
     name = child_name(dgd_name, svc_name)
     labels = _labels(namespace, dgd_name, svc_name, ctype)
     if spec.get("subComponentType"):
@@ -215,7 +232,8 @@ def build_deployment(
                                          NS_LABEL: labels[NS_LABEL]}},
             "template": {
                 "metadata": {"labels": pod_labels},
-                "spec": _pod_spec(namespace, dgd_name, svc_name, spec, ctype),
+                "spec": _pod_spec(namespace, dgd_name, svc_name, spec, ctype,
+                                  frontend),
             },
         },
     }

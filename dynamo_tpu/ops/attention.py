@@ -9,8 +9,10 @@ defaults to 16 for parity with the reference's SGLang flag `--page-size 16`
 
 Backend selection: `set_attention_backend()` or env `DYNAMO_TPU_ATTN_BACKEND`
 in {auto, xla, pallas, pallas_interpret}; `auto` uses Pallas on TPU and XLA
-elsewhere. Under tensor parallelism the engine registers its mesh via
-`set_attention_mesh()`, and the Pallas path runs inside `shard_map` over the
+elsewhere. The engine scopes backend + mesh per call via the
+`attention_context()` contextvar (set_attention_backend/set_attention_mesh
+only set the process-global fallback for code outside an engine). Under
+tensor parallelism the Pallas path runs inside `shard_map` over the
 (`data`, `model`) axes — attention is head-parallel, so no collectives.
 
 Layout:

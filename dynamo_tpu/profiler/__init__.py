@@ -1,0 +1,16 @@
+"""SLA-driven deployment profiler (the aiconfigurator analogue).
+
+The reference's DGDR workflow sweeps engine configs against an SLA block
+(isl/osl/ttft/itl) with `useAiConfigurator: true` and a GPU system profile
+(`aicSystem: a100_sxm`, /root/reference/examples/dgdr/trtllm/dgdr.yaml:22-31).
+This package is the TPU-native equivalent: an analytic roofline model over TPU
+chip profiles (v5e/v5p/v6e) sweeping mesh shape (tp×dp), batch size, and
+prefill/decode worker split, returning the cheapest config that meets the SLA.
+"""
+
+from dynamo_tpu.profiler.configurator import (  # noqa: F401
+    apply_sla_overrides,
+    best_config,
+    sweep,
+)
+from dynamo_tpu.profiler.systems import SYSTEMS, get_system  # noqa: F401

@@ -1,0 +1,76 @@
+"""CLI for the SLA profiler: sweep a model on a TPU system against an SLA.
+
+Usage (mirrors the aiconfigurator invocation semantics of
+/root/reference/examples/dgdr/trtllm/dgdr.yaml:22-31):
+
+    python3 -m dynamo_tpu.profiler --model meta-llama-3-8b-instruct \
+        --system v5e-8 --isl 4000 --osl 500 --ttft 600 --itl 25
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.profiler import best_config, get_system, sweep
+from dynamo_tpu.profiler.configurator import disagg_split
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="dynamo_tpu.profiler")
+    p.add_argument("--model", required=True)
+    p.add_argument("--system", default="v5e-8")
+    p.add_argument("--isl", type=int, default=4000)
+    p.add_argument("--osl", type=int, default=500)
+    p.add_argument("--ttft", type=float, default=None, help="SLA TTFT ms")
+    p.add_argument("--itl", type=float, default=None, help="SLA ITL ms")
+    p.add_argument("--top", type=int, default=8, help="candidates to print")
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    args = p.parse_args(argv)
+
+    cfg = ModelConfig.from_model_name(args.model)
+    system = get_system(args.system)
+    cands = sweep(cfg, system, args.isl, args.osl)
+    best = best_config(cfg, system, args.isl, args.osl, args.ttft, args.itl)
+
+    if args.json:
+        def enc(e):
+            return {
+                "tp": e.tp, "replicas": e.replicas, "batch": e.batch,
+                "ttft_ms": round(e.ttft_s * 1e3, 2),
+                "itl_ms": round(e.itl_s * 1e3, 2),
+                "tok_s_per_chip": round(e.tok_s_per_chip, 1),
+                "hbm_used_frac": round(e.hbm_used_frac, 3),
+                "meets_sla": e.meets(args.ttft, args.itl),
+            }
+        print(json.dumps({
+            "model": cfg.name, "system": system.name,
+            "sla": {"isl": args.isl, "osl": args.osl,
+                    "ttft": args.ttft, "itl": args.itl},
+            "best": enc(best) if best else None,
+            "disagg_split": disagg_split(best, args.isl, args.osl) if best else None,
+            "candidates": [enc(e) for e in cands[: args.top]],
+        }))
+        return
+
+    print(f"model={cfg.name} system={system.name} "
+          f"sla: isl={args.isl} osl={args.osl} ttft={args.ttft} itl={args.itl}")
+    if not cands:
+        print("INFEASIBLE: model does not fit on this system at batch 1")
+        return
+    hdr = f"{'tp':>4} {'rep':>4} {'batch':>6} {'ttft_ms':>9} {'itl_ms':>8} {'tok/s/chip':>11} {'hbm%':>6} {'sla':>4}"
+    print(hdr)
+    for e in cands[: args.top]:
+        mark = "ok" if e.meets(args.ttft, args.itl) else "-"
+        print(f"{e.tp:>4} {e.replicas:>4} {e.batch:>6} "
+              f"{e.ttft_s*1e3:>9.1f} {e.itl_s*1e3:>8.2f} "
+              f"{e.tok_s_per_chip:>11.1f} {e.hbm_used_frac*100:>5.1f}% {mark:>4}")
+    if best:
+        split = disagg_split(best, args.isl, args.osl)
+        print(f"chosen: tp={best.tp} replicas={best.replicas} batch={best.batch} "
+              f"(disagg split prefill:decode = {split['prefill']}:{split['decode']})")
+
+
+if __name__ == "__main__":
+    main()

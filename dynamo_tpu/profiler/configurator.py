@@ -1,0 +1,205 @@
+"""SLA config sweep + DGD override application (aiconfigurator analogue).
+
+The operator's DGDR path calls `apply_sla_overrides(dgd, sla, system=...)` to
+rewrite a DGD template so that it meets the request's SLA block
+(`isl/osl/ttft/itl`, /root/reference/examples/dgdr/trtllm/dgdr.yaml:22-26) on
+the target TPU system: worker `--tp`, `--max-num-seqs`, `resources.limits.tpu`
+and replica counts are set from the sweep winner, and the decision is recorded
+in an annotation for operators to inspect (the analogue of aiconfigurator's
+profiling-job output).
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+from typing import Any, Dict, List, Optional
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.profiler import roofline
+from dynamo_tpu.profiler.systems import SystemSpec, get_system, valid_tp_sizes
+
+_BATCHES = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+ANNOTATION = "tpu.dynamo.ai/profiler-decision"
+
+
+def sweep(
+    cfg: ModelConfig,
+    system: SystemSpec,
+    isl: int,
+    osl: int,
+) -> List[roofline.Estimate]:
+    """All feasible (tp, batch) points on the system, throughput-sorted."""
+    out = []
+    for tp in valid_tp_sizes(system):
+        for b in _BATCHES:
+            e = roofline.estimate(cfg, system, tp, b, isl, osl)
+            if e.feasible:
+                out.append(e)
+    out.sort(key=lambda e: e.tok_s_per_chip, reverse=True)
+    return out
+
+
+def best_config(
+    cfg: ModelConfig,
+    system: SystemSpec,
+    isl: int,
+    osl: int,
+    ttft_ms: Optional[float] = None,
+    itl_ms: Optional[float] = None,
+) -> Optional[roofline.Estimate]:
+    """Highest-throughput feasible point that meets the SLA.
+
+    Falls back to the highest-throughput feasible point (ignoring the SLA) if
+    nothing meets it — mirroring the reference posture of warn-and-continue
+    rather than refuse (deploy waits warn, /root/reference/deploy-incluster.sh:528-529).
+    Returns None only when the model cannot fit on the system at batch 1.
+    """
+    cands = sweep(cfg, system, isl, osl)
+    if not cands:
+        return None
+    meeting = [e for e in cands if e.meets(ttft_ms, itl_ms)]
+    return (meeting or cands)[0]
+
+
+def disagg_split(est: roofline.Estimate, isl: int, osl: int) -> Dict[str, int]:
+    """Prefill:decode worker ratio balancing the two pools' work.
+
+    A decode replica spends ~osl*ITL per request; a prefill replica ~TTFT.
+    Provisioning prefill_replicas/decode_replicas ≈ TTFT/(osl*ITL) keeps the
+    pools in equilibrium (neither starves the other).
+    """
+    decode_time = max(osl * est.itl_s, 1e-9)
+    ratio = est.ttft_s / decode_time
+    total = max(est.replicas, 2)
+    prefill = min(max(round(total * ratio / (1 + ratio)), 1), total - 1)
+    return {"prefill": prefill, "decode": total - prefill}
+
+
+# ---------------------------------------------------------------------------
+# DGD rewriting
+
+
+def _worker_services(dgd: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    svcs = (dgd.get("spec") or {}).get("services") or {}
+    return {
+        name: s for name, s in svcs.items()
+        if (s.get("componentType") or "worker") != "frontend"
+    }
+
+
+def _get_args(svc: Dict[str, Any]) -> List[str]:
+    main = ((svc.get("extraPodSpec") or {}).get("mainContainer")) or {}
+    args = main.get("args") or []
+    if isinstance(args, str):
+        args = shlex.split(args)
+    return list(args)
+
+
+def _set_args(svc: Dict[str, Any], args: List[str]) -> None:
+    svc.setdefault("extraPodSpec", {}).setdefault("mainContainer", {})["args"] = args
+
+
+def _set_flag(args: List[str], flag: str, value: str) -> List[str]:
+    """Replace `flag value` in an argv list, appending if absent."""
+    out, i, done = [], 0, False
+    while i < len(args):
+        a = args[i]
+        if a == flag:
+            out += [flag, value]
+            i += 2
+            done = True
+        elif a.startswith(flag + "="):
+            out.append(f"{flag}={value}")
+            i += 1
+            done = True
+        else:
+            out.append(a)
+            i += 1
+    if not done:
+        out += [flag, value]
+    return out
+
+
+def _find_flag(args: List[str], *flags: str) -> Optional[str]:
+    for i, a in enumerate(args):
+        if a in flags and i + 1 < len(args):
+            return args[i + 1]
+        for f in flags:
+            if a.startswith(f + "="):
+                return a.split("=", 1)[1]
+    return None
+
+
+def _model_from_dgd(dgd: Dict[str, Any]) -> str:
+    for svc in _worker_services(dgd).values():
+        m = _find_flag(_get_args(svc), "--model", "--model-path")
+        if m:
+            return m
+    return "tiny-debug"
+
+
+def apply_sla_overrides(
+    dgd: Dict[str, Any],
+    sla: Dict[str, Any],
+    system: str = "v5e-8",
+) -> Dict[str, Any]:
+    """Rewrite a DGD in place from the SLA sweep winner; returns the DGD.
+
+    Applied fields per worker service: `--tp`, `--max-num-seqs` args,
+    `resources.limits.tpu`, `replicas` (split across prefill/decode pools for
+    disaggregated graphs). No-ops (logging only via annotation) when the model
+    doesn't fit the system at all.
+    """
+    sys_spec = get_system(system)
+    isl = int(sla.get("isl", 4000))
+    osl = int(sla.get("osl", 500))
+    ttft = float(sla["ttft"]) if "ttft" in sla else None
+    itl = float(sla["itl"]) if "itl" in sla else None
+
+    model = _model_from_dgd(dgd)
+    cfg = ModelConfig.from_model_name(model)
+    est = best_config(cfg, sys_spec, isl, osl, ttft, itl)
+
+    meta = dgd.setdefault("metadata", {})
+    ann = meta.setdefault("annotations", {})
+    if est is None:
+        ann[ANNOTATION] = json.dumps(
+            {"system": sys_spec.name, "model": model, "result": "infeasible"}
+        )
+        return dgd
+
+    workers = _worker_services(dgd)
+    roles = {
+        name: (svc.get("subComponentType") or "").lower()
+        for name, svc in workers.items()
+    }
+    has_disagg = "prefill" in roles.values()
+    split = disagg_split(est, isl, osl) if has_disagg else None
+
+    for name, svc in workers.items():
+        args = _get_args(svc)
+        args = _set_flag(args, "--tp", str(est.tp))
+        args = _set_flag(args, "--max-num-seqs", str(est.batch))
+        _set_args(svc, args)
+        svc.setdefault("resources", {}).setdefault("limits", {})["tpu"] = str(est.tp)
+        if split and roles[name] in ("prefill", "decode"):
+            svc["replicas"] = split[roles[name]]
+        else:
+            svc["replicas"] = est.replicas
+
+    ann[ANNOTATION] = json.dumps({
+        "system": sys_spec.name,
+        "model": model,
+        "tp": est.tp,
+        "replicas": est.replicas,
+        "max_num_seqs": est.batch,
+        "split": split,
+        "est_ttft_ms": round(est.ttft_s * 1e3, 2),
+        "est_itl_ms": round(est.itl_s * 1e3, 2),
+        "est_tok_s_per_chip": round(est.tok_s_per_chip, 1),
+        "sla": {"isl": isl, "osl": osl, "ttft": ttft, "itl": itl},
+        "meets_sla": est.meets(ttft, itl),
+    })
+    return dgd

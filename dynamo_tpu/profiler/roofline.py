@@ -1,0 +1,139 @@
+"""Analytic roofline model for TPU LLM serving.
+
+Estimates TTFT / ITL / throughput for a (model, mesh, batch) point on a TPU
+system, the way aiconfigurator estimates GPU engine configs for the DGDR SLA
+sweep (/root/reference/examples/dgdr/trtllm/dgdr.yaml:22-31). The model is the
+standard serving roofline:
+
+- prefill is compute-bound on the MXU: TTFT ~ FLOPs(isl) / (chips * peak * MFU)
+  plus TP all-reduce time over ICI and a fixed dispatch overhead;
+- decode is HBM-bandwidth-bound: ITL ~ bytes(weights + KV batch) / aggregate
+  HBM bandwidth, floored by the compute term, plus collectives + dispatch;
+- capacity requires sharded weights + paged KV for the batch to fit in HBM.
+
+All sizes assume bfloat16 (2 bytes) params and KV, the TPU-native dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.profiler.systems import SystemSpec
+
+BYTES = 2  # bfloat16
+
+# Utilization factors: peak-fraction actually achieved. Prefill MFU on TPU for
+# dense transformer matmuls is high (large static shapes feed the MXU well);
+# decode matmuls are thin so compute efficiency is lower; HBM streaming
+# achieves most of datasheet bandwidth.
+MFU_PREFILL = 0.55
+MFU_DECODE = 0.30
+HBM_EFF = 0.80
+ICI_EFF = 0.75
+DISPATCH_OVERHEAD_S = 0.004  # per-step host dispatch + scheduling
+
+
+def param_count(cfg: ModelConfig) -> float:
+    """Total parameter count (all experts for MoE)."""
+    h, hd = cfg.hidden_size, cfg.head_dim
+    attn = h * cfg.num_heads * hd + 2 * h * cfg.num_kv_heads * hd + cfg.num_heads * hd * h
+    mlp_one = 3 * h * cfg.intermediate_size
+    mlp = mlp_one * max(cfg.num_experts, 1)
+    router = h * cfg.num_experts if cfg.is_moe else 0
+    per_layer = attn + mlp + router + 2 * h  # + rmsnorm scales
+    embed = cfg.vocab_size * h * (1 if cfg.tie_word_embeddings else 2)
+    return cfg.num_layers * per_layer + embed + h
+
+
+def active_param_count(cfg: ModelConfig) -> float:
+    """Params touched per token (MoE: only routed experts)."""
+    if not cfg.is_moe:
+        return param_count(cfg)
+    h = cfg.hidden_size
+    mlp_one = 3 * h * cfg.intermediate_size
+    inactive = (cfg.num_experts - cfg.num_experts_per_tok) * mlp_one
+    return param_count(cfg) - cfg.num_layers * inactive
+
+
+def kv_bytes_per_token(cfg: ModelConfig) -> float:
+    return 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * BYTES
+
+
+@dataclasses.dataclass(frozen=True)
+class Estimate:
+    """Roofline estimate for one (tp, batch) point."""
+    tp: int
+    replicas: int            # data-parallel engine replicas (chips // tp)
+    batch: int               # per-replica decode batch (max_num_seqs)
+    ttft_s: float
+    itl_s: float
+    tok_s_per_chip: float    # aggregate decode throughput / total chips
+    hbm_used_frac: float     # worst-chip HBM occupancy at full batch
+    feasible: bool
+
+    def meets(self, ttft_ms: Optional[float], itl_ms: Optional[float]) -> bool:
+        if not self.feasible:
+            return False
+        if ttft_ms is not None and self.ttft_s * 1e3 > ttft_ms:
+            return False
+        if itl_ms is not None and self.itl_s * 1e3 > itl_ms:
+            return False
+        return True
+
+
+def _allreduce_time(bytes_per_device: float, tp: int, sys: SystemSpec) -> float:
+    """Ring all-reduce over ICI: 2*(tp-1)/tp of the buffer crosses each link."""
+    if tp <= 1:
+        return 0.0
+    wire = 2.0 * (tp - 1) / tp * bytes_per_device
+    return wire / (sys.chip.ici_bisection_bw * ICI_EFF)
+
+
+def estimate(
+    cfg: ModelConfig,
+    sys: SystemSpec,
+    tp: int,
+    batch: int,
+    isl: int,
+    osl: int,
+) -> Estimate:
+    """Roofline TTFT/ITL/throughput for tp-way sharding and a decode batch."""
+    replicas = max(sys.num_chips // tp, 1)
+    p_total = param_count(cfg)
+    p_active = active_param_count(cfg)
+    chip = sys.chip
+
+    # --- capacity: per-chip share of weights + this replica's KV pages.
+    avg_ctx = isl + osl / 2.0
+    kv_per_seq_full = kv_bytes_per_token(cfg) * (isl + osl)
+    weights_per_chip = p_total * BYTES / tp
+    kv_per_chip = batch * kv_per_seq_full / tp
+    hbm_frac = (weights_per_chip + kv_per_chip) / (chip.hbm_bytes * 0.92)
+    feasible = hbm_frac <= 1.0
+
+    # --- prefill (one request of isl tokens on one tp group).
+    l, nh, hd = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    flops_prefill = 2.0 * p_active * isl + 4.0 * l * nh * hd * isl * isl
+    t_compute = flops_prefill / (tp * chip.bf16_flops * MFU_PREFILL)
+    # 2 all-reduces per layer of the activations (attn out + mlp out)
+    act_bytes = isl * cfg.hidden_size * BYTES
+    t_coll = 2 * l * _allreduce_time(act_bytes, tp, sys)
+    ttft = t_compute + t_coll + DISPATCH_OVERHEAD_S
+
+    # --- decode step for the full batch at average context length.
+    read_bytes = p_total * BYTES + batch * kv_bytes_per_token(cfg) * avg_ctx
+    t_mem = read_bytes / (tp * chip.hbm_bw * HBM_EFF)
+    t_flops = 2.0 * p_active * batch / (tp * chip.bf16_flops * MFU_DECODE)
+    dec_act = batch * cfg.hidden_size * BYTES
+    t_dcoll = 2 * l * _allreduce_time(dec_act, tp, sys)
+    itl = max(t_mem, t_flops) + t_dcoll + DISPATCH_OVERHEAD_S
+
+    tok_s = replicas * batch / itl
+    return Estimate(
+        tp=tp, replicas=replicas, batch=batch,
+        ttft_s=ttft, itl_s=itl,
+        tok_s_per_chip=tok_s / sys.num_chips,
+        hbm_used_frac=hbm_frac, feasible=feasible,
+    )

@@ -252,3 +252,62 @@ def test_client_404_handling():
             client.get("v1", "services", "nowhere", "missing")
         assert ei.value.not_found
         client.delete("v1", "services", "nowhere", "missing")  # no raise
+
+
+def test_materialize_custom_named_frontend_service():
+    """FRONTEND_URL must key on componentType, not the service map key."""
+    cr = {
+        "apiVersion": mat.API_VERSION, "kind": mat.DGD_KIND,
+        "metadata": {"name": "g", "namespace": "ns", "uid": "u-9"},
+        "spec": {"services": {
+            "Router": {"componentType": "frontend", "replicas": 1},
+            "Worker": {"componentType": "worker", "replicas": 1},
+        }},
+    }
+    out = mat.materialize(cr)
+    deps = {d["metadata"]["name"]: d for d in out["deployments"]}
+    c = deps["g-worker"]["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["FRONTEND_URL"] == "http://g-router:8000"
+
+
+def test_dgdr_missing_template_retries_after_fix():
+    """A DGDR whose template ConfigMap key is missing stays pending (not
+    terminally failed) and succeeds once the ConfigMap is fixed."""
+    import json
+
+    template = {
+        "apiVersion": mat.API_VERSION, "kind": mat.DGD_KIND,
+        "metadata": {"name": "late"},
+        "spec": {"services": {
+            "Frontend": {"componentType": "frontend", "replicas": 1},
+        }},
+    }
+    dgdr = {
+        "apiVersion": mat.API_VERSION, "kind": mat.DGDR_KIND,
+        "metadata": {"name": "late-req", "namespace": "dynamo"},
+        "spec": {"autoApply": True, "profilingConfig": {
+            "config": {"configMapRef": {"name": "late-cm", "key": "d.yaml"}}}},
+    }
+    with FakeK8s() as fake:
+        # ConfigMap exists but the referenced key doesn't yet
+        fake.put_object("v1", "dynamo", "configmaps", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "late-cm"}, "data": {}})
+        fake.put_object(mat.API_VERSION, "dynamo", mat.DGDR_PLURAL, dgdr)
+        ctrl = Controller(K8sClient(fake.url), namespace=None)
+        ctrl.reconcile_once()
+        req = fake.get_object(mat.API_VERSION, "dynamo", mat.DGDR_PLURAL,
+                              "late-req")
+        assert req["status"]["state"] == "pending"
+        # fix the ConfigMap; the next pass must pick it up
+        fake.put_object("v1", "dynamo", "configmaps", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "late-cm"},
+            "data": {"d.yaml": json.dumps(template)}})
+        ctrl.reconcile_once()
+        req = fake.get_object(mat.API_VERSION, "dynamo", mat.DGDR_PLURAL,
+                              "late-req")
+        assert req["status"]["state"] == "successful"
+        assert fake.get_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
+                               "late")

@@ -1,0 +1,147 @@
+"""SLA profiler (aiconfigurator analogue) tests.
+
+Contract under test mirrors /root/reference/examples/dgdr/trtllm/dgdr.yaml:22-31:
+an SLA block (isl/osl/ttft/itl) + a system profile produce a concrete engine
+config (parallelism, batch, replica split) written back into the DGD.
+"""
+
+import json
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.profiler import best_config, get_system, sweep
+from dynamo_tpu.profiler.configurator import (
+    ANNOTATION,
+    apply_sla_overrides,
+    disagg_split,
+)
+from dynamo_tpu.profiler.roofline import estimate, param_count
+
+
+def test_param_count_llama8b_close_to_8b():
+    cfg = ModelConfig.from_model_name("meta-llama-3-8b-instruct")
+    p = param_count(cfg)
+    assert 7.5e9 < p < 8.5e9
+
+
+def test_param_count_mixtral_total_vs_active():
+    from dynamo_tpu.profiler.roofline import active_param_count
+
+    cfg = ModelConfig.from_model_name("mixtral-8x7b-instruct-v0.1")
+    total, active = param_count(cfg), active_param_count(cfg)
+    assert 44e9 < total < 50e9        # ~46.7B
+    assert 11e9 < active < 14.5e9     # ~12.9B
+    assert active < total
+
+
+def test_sweep_8b_on_v5e8_meets_reference_sla():
+    cfg = ModelConfig.from_model_name("meta-llama-3-8b-instruct")
+    best = best_config(cfg, get_system("v5e-8"), 4000, 500, ttft_ms=600, itl_ms=25)
+    assert best is not None
+    assert best.meets(600, 25)
+    assert best.tp * best.replicas <= 8
+    assert best.tok_s_per_chip > 100
+
+
+def test_70b_does_not_fit_single_v5e():
+    cfg = ModelConfig.from_model_name("meta-llama-3-70b-instruct")
+    assert sweep(cfg, get_system("v5e-1"), 4000, 500) == []
+    assert best_config(cfg, get_system("v5e-1"), 4000, 500) is None
+
+
+def test_70b_fits_v5p64():
+    cfg = ModelConfig.from_model_name("meta-llama-3-70b-instruct")
+    best = best_config(cfg, get_system("v5p-64"), 4000, 500, 600, 25)
+    assert best is not None and best.feasible
+
+
+def test_unmet_sla_falls_back_to_best_feasible():
+    cfg = ModelConfig.from_model_name("meta-llama-3-8b-instruct")
+    # 0.01ms ITL is unmeetable; posture is warn-and-continue, not refuse
+    best = best_config(cfg, get_system("v5e-8"), 4000, 500, ttft_ms=600, itl_ms=0.01)
+    assert best is not None
+    assert not best.meets(600, 0.01)
+
+
+def test_estimate_monotonic_in_model_size():
+    small = ModelConfig.from_model_name("llama-3.2-1b-instruct")
+    big = ModelConfig.from_model_name("meta-llama-3-8b-instruct")
+    sys8 = get_system("v5e-8")
+    e_small = estimate(small, sys8, 8, 32, 4000, 500)
+    e_big = estimate(big, sys8, 8, 32, 4000, 500)
+    assert e_small.tok_s_per_chip > e_big.tok_s_per_chip
+    assert e_small.ttft_s < e_big.ttft_s
+
+
+def test_disagg_split_sums_to_replicas():
+    cfg = ModelConfig.from_model_name("qwen3-0.6b")
+    est = best_config(cfg, get_system("v5e-16"), 4000, 500)
+    split = disagg_split(est, 4000, 500)
+    assert split["prefill"] >= 1 and split["decode"] >= 1
+    assert split["prefill"] + split["decode"] == max(est.replicas, 2)
+
+
+def test_get_system_parses_arbitrary_shape():
+    s = get_system("v6e-512")
+    assert s.num_chips == 512 and s.chip.name == "v6e"
+
+
+def _disagg_dgd(model: str):
+    worker = lambda role: {  # noqa: E731
+        "componentType": "worker",
+        "subComponentType": role,
+        "replicas": 1,
+        "extraPodSpec": {"mainContainer": {
+            "args": ["--model", model, "--tp", "1"],
+        }},
+    }
+    return {
+        "apiVersion": "tpu.dynamo.ai/v1alpha1",
+        "kind": "DynamoGraphDeployment",
+        "metadata": {"name": "t"},
+        "spec": {"services": {
+            "Frontend": {"componentType": "frontend", "replicas": 1},
+            "PrefillWorker": worker("prefill"),
+            "DecodeWorker": worker("decode"),
+        }},
+    }
+
+
+def test_apply_sla_overrides_rewrites_workers():
+    dgd = _disagg_dgd("meta-llama-3-8b-instruct")
+    out = apply_sla_overrides(
+        dgd, {"isl": 4000, "osl": 500, "ttft": 600, "itl": 25}, system="v5e-16"
+    )
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["meets_sla"] is True
+    svcs = out["spec"]["services"]
+    for name in ("PrefillWorker", "DecodeWorker"):
+        args = svcs[name]["extraPodSpec"]["mainContainer"]["args"]
+        tp = int(args[args.index("--tp") + 1])
+        assert tp == decision["tp"]
+        assert args.count("--tp") == 1, "must replace, not duplicate"
+        assert svcs[name]["resources"]["limits"]["tpu"] == str(tp)
+    # split across the two pools covers the slice's replica groups
+    total = svcs["PrefillWorker"]["replicas"] + svcs["DecodeWorker"]["replicas"]
+    assert total == max(decision["replicas"], 2)
+    # frontend untouched
+    assert "resources" not in svcs["Frontend"]
+
+
+def test_apply_sla_overrides_infeasible_annotates_only():
+    dgd = _disagg_dgd("meta-llama-3-70b-instruct")
+    before = json.dumps(dgd["spec"])
+    out = apply_sla_overrides(dgd, {"isl": 4000, "osl": 500}, system="v5e-1")
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["result"] == "infeasible"
+    assert json.dumps(out["spec"]) == before
+
+
+def test_profiler_cli_json(capsys):
+    from dynamo_tpu.profiler.__main__ import main
+
+    main(["--model", "meta-llama-3-8b-instruct", "--system", "v5e-8",
+          "--isl", "4000", "--osl", "500", "--ttft", "600", "--itl", "25",
+          "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["best"]["meets_sla"] is True
+    assert out["disagg_split"]["prefill"] >= 1

@@ -134,7 +134,12 @@ class Controller:
         cm_ref = ((prof.get("config") or {}).get("configMapRef")) or {}
         template: Optional[Dict[str, Any]] = None
         if cm_ref.get("name"):
-            cm = self.k8s.get("v1", "configmaps", ns, cm_ref["name"])
+            try:
+                cm = self.k8s.get("v1", "configmaps", ns, cm_ref["name"])
+            except ApiError as e:
+                if not e.not_found:
+                    raise
+                cm = {}
             key = cm_ref.get("key") or next(iter(cm.get("data", {})), None)
             if key and key in cm.get("data", {}):
                 template = _yaml_load(cm["data"][key])
@@ -192,8 +197,10 @@ class Controller:
                         "tpuSystem", "v5e-8"
                     ),
                 )
-            except ImportError:
-                log.warning("profiler unavailable; applying template unchanged")
+            except Exception as e:  # warn-and-continue posture: an unknown
+                # model/system must not wedge the reconcile loop — the
+                # template still deploys as written.
+                log.warning("profiler skipped (%s); applying template unchanged", e)
         workers_image = overrides.get("workersImage")
         if workers_image:
             for svc in (dgd.get("spec", {}).get("services") or {}).values():

@@ -82,10 +82,30 @@ def _resolve_backend() -> str:
     return b
 
 
-def _mesh_for_shard_map() -> Optional[Mesh]:
-    """The scoped (or global) mesh, when any axis actually needs sharding."""
+def _scoped_mesh() -> Optional[Mesh]:
     _, ctx_mesh = _ATTN_CTX.get()
-    mesh = ctx_mesh if ctx_mesh is not None else _MESH
+    return ctx_mesh if ctx_mesh is not None else _MESH
+
+
+def _seq_parallel_mesh() -> Optional[Mesh]:
+    """The scoped mesh when it carries a real `seq` (context-parallel) axis."""
+    mesh = _scoped_mesh()
+    if mesh is None:
+        return None
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return mesh if sizes.get("seq", 1) > 1 else None
+
+
+def _mesh_for_shard_map() -> Optional[Mesh]:
+    """The scoped (or global) mesh, when any axis actually needs sharding.
+
+    Long-context ("seq") meshes are excluded — those route through
+    dynamo_tpu.ops.ring_attention before backend dispatch, and the paged
+    decode specs only know the (data, model) axes.
+    """
+    if _seq_parallel_mesh() is not None:
+        return None
+    mesh = _scoped_mesh()
     if mesh is None:
         return None
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -257,6 +277,24 @@ def prefill_attention(
     v: jax.Array,
     seq_len,  # int or scalar array: true (unpadded) length
 ) -> jax.Array:
+    sp_mesh = _seq_parallel_mesh()
+    if sp_mesh is not None:
+        # Long-context path: sequence sharded over the `seq` axis, ring
+        # attention over ICI (the reference has no analogue — SURVEY.md §5).
+        # The engine pads prompts to page_size multiples, not sp multiples,
+        # so pad here to the ring's divisibility requirement and slice back
+        # (the tail past seq_len is masked inside the kernel either way).
+        from dynamo_tpu.ops.ring_attention import ring_prefill_attention
+
+        sp = dict(zip(sp_mesh.axis_names, sp_mesh.devices.shape))["seq"]
+        s = q.shape[0]
+        pad = (-s) % sp
+        if pad:
+            q = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
+            k = jnp.pad(k, ((0, pad), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, pad), (0, 0), (0, 0)))
+        out = ring_prefill_attention(q, k, v, seq_len, sp_mesh)
+        return out[:s] if pad else out
     backend = _resolve_backend()
     if backend == "xla":
         return prefill_attention_xla(q, k, v, seq_len)

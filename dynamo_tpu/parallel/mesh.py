@@ -62,3 +62,35 @@ def build_mesh(
 
 def single_device_mesh() -> Mesh:
     return build_mesh(MeshConfig())
+
+
+LONG_CONTEXT_AXES = ("seq", "model")
+
+
+def build_long_context_mesh(
+    sequence_parallel: int,
+    tensor_parallel: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """("seq", "model") mesh for ring/Ulysses long-context prefill.
+
+    `seq` is outermost so each ring hop (ppermute neighbour) is one ICI step;
+    `model` stays innermost for the usual TP collectives. Used by the
+    long-context prefill path (dynamo_tpu.ops.ring_attention), which the
+    reference has no analogue for (SURVEY.md §5).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = sequence_parallel * tensor_parallel
+    if n > len(devices):
+        raise ValueError(
+            f"long-context mesh needs {n} devices (sp={sequence_parallel} x "
+            f"tp={tensor_parallel}), only {len(devices)} available"
+        )
+    shape = (sequence_parallel, tensor_parallel)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices[:n])
+    except Exception:
+        dev_array = np.array(devices[:n]).reshape(shape)
+    return Mesh(dev_array, LONG_CONTEXT_AXES)

@@ -13,7 +13,7 @@ import argparse
 import json
 
 from dynamo_tpu.models.config import ModelConfig
-from dynamo_tpu.profiler import best_config, get_system, sweep
+from dynamo_tpu.profiler import get_system, sweep
 from dynamo_tpu.profiler.configurator import disagg_split
 
 
@@ -32,7 +32,8 @@ def main(argv=None) -> None:
     cfg = ModelConfig.from_model_name(args.model)
     system = get_system(args.system)
     cands = sweep(cfg, system, args.isl, args.osl)
-    best = best_config(cfg, system, args.isl, args.osl, args.ttft, args.itl)
+    meeting = [e for e in cands if e.meets(args.ttft, args.itl)]
+    best = (meeting or cands)[0] if cands else None
 
     if args.json:
         def enc(e):
@@ -68,8 +69,12 @@ def main(argv=None) -> None:
               f"{e.tok_s_per_chip:>11.1f} {e.hbm_used_frac*100:>5.1f}% {mark:>4}")
     if best:
         split = disagg_split(best, args.isl, args.osl)
-        print(f"chosen: tp={best.tp} replicas={best.replicas} batch={best.batch} "
-              f"(disagg split prefill:decode = {split['prefill']}:{split['decode']})")
+        note = (
+            f"(disagg split prefill:decode = {split['prefill']}:{split['decode']})"
+            if split else "(single replica group: disagg needs a larger system)"
+        )
+        print(f"chosen: tp={best.tp} replicas={best.replicas} "
+              f"batch={best.batch} {note}")
 
 
 if __name__ == "__main__":

@@ -63,16 +63,21 @@ def best_config(
     return (meeting or cands)[0]
 
 
-def disagg_split(est: roofline.Estimate, isl: int, osl: int) -> Dict[str, int]:
+def disagg_split(est: roofline.Estimate, isl: int, osl: int) -> Optional[Dict[str, int]]:
     """Prefill:decode worker ratio balancing the two pools' work.
 
     A decode replica spends ~osl*ITL per request; a prefill replica ~TTFT.
     Provisioning prefill_replicas/decode_replicas ≈ TTFT/(osl*ITL) keeps the
-    pools in equilibrium (neither starves the other).
+    pools in equilibrium (neither starves the other). Returns None when the
+    config has fewer than two replica groups — disaggregation needs at least
+    one of each, so the caller must pick a config with replicas >= 2 (or give
+    up on disagg for this system).
     """
+    if est.replicas < 2:
+        return None
     decode_time = max(osl * est.itl_s, 1e-9)
     ratio = est.ttft_s / decode_time
-    total = max(est.replicas, 2)
+    total = est.replicas
     prefill = min(max(round(total * ratio / (1 + ratio)), 1), total - 1)
     return {"prefill": prefill, "decode": total - prefill}
 
@@ -132,12 +137,17 @@ def _find_flag(args: List[str], *flags: str) -> Optional[str]:
     return None
 
 
-def _model_from_dgd(dgd: Dict[str, Any]) -> str:
+def _model_from_dgd(dgd: Dict[str, Any]) -> Optional[str]:
+    """Worker model id, or None when no --model/--model-path flag exists.
+
+    None means "don't profile": sweeping a fallback model would rewrite
+    production workers from the wrong roofline.
+    """
     for svc in _worker_services(dgd).values():
         m = _find_flag(_get_args(svc), "--model", "--model-path")
         if m:
             return m
-    return "tiny-debug"
+    return None
 
 
 def apply_sla_overrides(
@@ -158,17 +168,22 @@ def apply_sla_overrides(
     ttft = float(sla["ttft"]) if "ttft" in sla else None
     itl = float(sla["itl"]) if "itl" in sla else None
 
-    model = _model_from_dgd(dgd)
-    cfg = ModelConfig.from_model_name(model)
-    est = best_config(cfg, sys_spec, isl, osl, ttft, itl)
-
     meta = dgd.setdefault("metadata", {})
     ann = meta.setdefault("annotations", {})
-    if est is None:
+
+    def skip(result: str, **extra) -> Dict[str, Any]:
         ann[ANNOTATION] = json.dumps(
-            {"system": sys_spec.name, "model": model, "result": "infeasible"}
+            {"system": sys_spec.name, "result": result, **extra}
         )
         return dgd
+
+    model = _model_from_dgd(dgd)
+    if model is None:
+        return skip("skipped", reason="no --model/--model-path flag on workers")
+    try:
+        cfg = ModelConfig.from_model_name(model)
+    except (ValueError, KeyError) as e:
+        return skip("skipped", model=model, reason=f"unknown model: {e}")
 
     workers = _worker_services(dgd)
     roles = {
@@ -176,6 +191,19 @@ def apply_sla_overrides(
         for name, svc in workers.items()
     }
     has_disagg = "prefill" in roles.values()
+
+    cands = sweep(cfg, sys_spec, isl, osl)
+    if not cands:
+        return skip("infeasible", model=model)
+    if has_disagg:
+        # disaggregation needs >= 2 replica groups (one per pool); a winner
+        # that consumes the whole slice would double the chip demand
+        cands = [e for e in cands if e.replicas >= 2]
+        if not cands:
+            return skip("disagg_infeasible", model=model,
+                        reason="no config with >=2 replica groups fits")
+    meeting = [e for e in cands if e.meets(ttft, itl)]
+    est = (meeting or cands)[0]
     split = disagg_split(est, isl, osl) if has_disagg else None
 
     for name, svc in workers.items():

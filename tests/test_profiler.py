@@ -77,7 +77,49 @@ def test_disagg_split_sums_to_replicas():
     est = best_config(cfg, get_system("v5e-16"), 4000, 500)
     split = disagg_split(est, 4000, 500)
     assert split["prefill"] >= 1 and split["decode"] >= 1
-    assert split["prefill"] + split["decode"] == max(est.replicas, 2)
+    assert split["prefill"] + split["decode"] == est.replicas
+
+
+def test_disagg_split_none_for_single_replica_group():
+    import dataclasses
+
+    cfg = ModelConfig.from_model_name("meta-llama-3-8b-instruct")
+    est = best_config(cfg, get_system("v5e-8"), 4000, 500)
+    est1 = dataclasses.replace(est, replicas=1)
+    assert disagg_split(est1, 4000, 500) is None
+
+
+def test_apply_sla_overrides_no_model_flag_skips():
+    dgd = _disagg_dgd("x")
+    for svc in dgd["spec"]["services"].values():
+        pod = svc.get("extraPodSpec")
+        if pod:
+            pod["mainContainer"]["args"] = ["--port", "8000"]
+    before = json.dumps(dgd["spec"])
+    out = apply_sla_overrides(dgd, {"isl": 100, "osl": 10}, system="v5e-8")
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["result"] == "skipped"
+    assert json.dumps(out["spec"]) == before
+
+
+def test_apply_sla_overrides_unknown_model_skips():
+    dgd = _disagg_dgd("no-such-model-xyz")
+    before = json.dumps(dgd["spec"])
+    out = apply_sla_overrides(dgd, {"isl": 100, "osl": 10}, system="v5e-8")
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["result"] == "skipped"
+    assert json.dumps(out["spec"]) == before
+
+
+def test_apply_sla_overrides_disagg_needs_two_replica_groups():
+    # 70B on v5e-16: fits only at tp=16 (one replica group) -> disagg
+    # infeasible, template left unchanged rather than doubling the chip demand
+    dgd = _disagg_dgd("meta-llama-3-70b-instruct")
+    before = json.dumps(dgd["spec"])
+    out = apply_sla_overrides(dgd, {"isl": 4000, "osl": 500}, system="v5e-16")
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["result"] == "disagg_infeasible"
+    assert json.dumps(out["spec"]) == before
 
 
 def test_get_system_parses_arbitrary_shape():
@@ -139,9 +181,10 @@ def test_apply_sla_overrides_infeasible_annotates_only():
 def test_profiler_cli_json(capsys):
     from dynamo_tpu.profiler.__main__ import main
 
-    main(["--model", "meta-llama-3-8b-instruct", "--system", "v5e-8",
+    main(["--model", "meta-llama-3-8b-instruct", "--system", "v5e-16",
           "--isl", "4000", "--osl", "500", "--ttft", "600", "--itl", "25",
           "--json"])
     out = json.loads(capsys.readouterr().out)
     assert out["best"]["meets_sla"] is True
-    assert out["disagg_split"]["prefill"] >= 1
+    split = out["disagg_split"]
+    assert split is None or split["prefill"] >= 1

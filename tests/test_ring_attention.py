@@ -1,0 +1,148 @@
+"""Ring + Ulysses sequence-parallel attention vs the dense reference.
+
+Runs on the 8-virtual-CPU-device mesh from conftest (SURVEY.md §4 simulation
+strategy). The reference implementation is the engine's own
+prefill_attention_xla, so agreement here means the long-context path can be
+swapped into the prefill step without numerics drift.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.ops.attention import prefill_attention_xla
+from dynamo_tpu.ops.ring_attention import (
+    ring_prefill_attention,
+    ulysses_prefill_attention,
+)
+from dynamo_tpu.parallel.mesh import build_long_context_mesh
+
+
+def _qkv(s=64, h=4, kv=2, d=16, seed=0, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(k1, (s, h, d), dtype)
+    k = jax.random.normal(k2, (s, kv, d), dtype)
+    v = jax.random.normal(k3, (s, kv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense_reference(sp):
+    q, k, v = _qkv()
+    seq_len = 50  # padded tail beyond 50 must be masked
+    mesh = build_long_context_mesh(sp, 1)
+    ref = prefill_attention_xla(q, k, v, seq_len)
+    out = ring_prefill_attention(q, k, v, seq_len, mesh)
+    np.testing.assert_allclose(
+        np.asarray(out[:seq_len]), np.asarray(ref[:seq_len]), atol=2e-5
+    )
+
+
+def test_ring_with_tensor_parallel_heads():
+    q, k, v = _qkv(s=32, h=4, kv=2, d=8)
+    mesh = build_long_context_mesh(4, 2)  # sp=4 x tp=2 on 8 devices
+    ref = prefill_attention_xla(q, k, v, 32)
+    out = ring_prefill_attention(q, k, v, 32, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_full_length_no_padding():
+    q, k, v = _qkv(s=40, h=2, kv=2, d=8, seed=3)
+    mesh = build_long_context_mesh(4, 1)
+    ref = prefill_attention_xla(q, k, v, 40)
+    out = ring_prefill_attention(q, k, v, 40, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_non_causal():
+    q, k, v = _qkv(s=32, h=2, kv=1, d=8, seed=7)
+    mesh = build_long_context_mesh(4, 1)
+    out = ring_prefill_attention(q, k, v, 32, mesh, causal=False)
+    # dense non-causal reference
+    from dynamo_tpu.ops.attention import repeat_kv
+
+    kk, vv = repeat_kv(k, 2, axis=1), repeat_kv(v, 2, axis=1)
+    s = jnp.einsum("qhd,khd->hqk", q / jnp.sqrt(8.0), kk)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("hqk,khd->qhd", p, vv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ulysses_matches_dense_reference(sp):
+    # kv=4 so KV heads divide sp without replication
+    q, k, v = _qkv(s=64, h=8, kv=4, d=16, seed=1)
+    seq_len = 57
+    mesh = build_long_context_mesh(sp, 1)
+    ref = prefill_attention_xla(q, k, v, seq_len)
+    out = ulysses_prefill_attention(q, k, v, seq_len, mesh)
+    np.testing.assert_allclose(
+        np.asarray(out[:seq_len]), np.asarray(ref[:seq_len]), atol=2e-5
+    )
+
+
+def test_ulysses_gqa_replication_path():
+    # kv=1 < sp=4: forces the repeat_kv fallback inside the shard
+    q, k, v = _qkv(s=32, h=4, kv=1, d=8, seed=2)
+    mesh = build_long_context_mesh(4, 1)
+    ref = prefill_attention_xla(q, k, v, 32)
+    out = ulysses_prefill_attention(q, k, v, 32, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_jit_compiles_once_for_long_sequence():
+    """128k-token shapes trace/compile fine (tiny dims elsewhere)."""
+    q, k, v = _qkv(s=8 * 2048, h=2, kv=1, d=8, seed=4, dtype=jnp.bfloat16)
+    mesh = build_long_context_mesh(8, 1)
+    out = jax.jit(
+        lambda q, k, v: ring_prefill_attention(q, k, v, q.shape[0], mesh)
+    )(q, k, v)
+    assert out.shape == q.shape
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_llama_prefill_under_long_context_mesh_matches_single_device():
+    """attention_context with a seq mesh routes model prefill through the
+    ring without numerics drift (KV page writes included)."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import PRESETS
+    from dynamo_tpu.ops.attention import attention_context
+    import dataclasses
+
+    cfg = dataclasses.replace(PRESETS["tiny-debug"], dtype="float32")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    page_size, s = 4, 32
+    n_pages = s // page_size + 1
+    kv_shape = (cfg.num_layers, cfg.num_kv_heads, n_pages, page_size, cfg.head_dim)
+    kp = jnp.zeros(kv_shape, jnp.float32)
+    vp = jnp.zeros(kv_shape, jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (s,), 0, cfg.vocab_size)
+    pages = jnp.arange(1, s // page_size + 1, dtype=jnp.int32)
+    seq_len = jnp.asarray(s - 3, jnp.int32)
+
+    ref = llama.prefill(cfg, params, tokens, seq_len, kp, vp, pages,
+                        page_size=page_size)
+    mesh = build_long_context_mesh(8, 1)
+    with attention_context(None, mesh):
+        out = llama.prefill(cfg, params, tokens, seq_len, kp, vp, pages,
+                            page_size=page_size)
+    np.testing.assert_allclose(np.asarray(out.last_logits),
+                               np.asarray(ref.last_logits), atol=3e-5)
+    np.testing.assert_allclose(np.asarray(out.k_pages),
+                               np.asarray(ref.k_pages), atol=3e-5)
+
+
+def test_prefill_dispatch_pads_to_seq_axis_multiple():
+    """Engine pads prompts to page_size multiples only; the ring route must
+    handle S not divisible by the seq axis size."""
+    from dynamo_tpu.ops.attention import attention_context, prefill_attention
+
+    q, k, v = _qkv(s=20, h=2, kv=1, d=8, seed=5)  # 20 % 8 != 0
+    ref = prefill_attention_xla(q, k, v, 17)
+    mesh = build_long_context_mesh(8, 1)
+    with attention_context(None, mesh):
+        out = prefill_attention(q, k, v, 17)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out[:17]), np.asarray(ref[:17]),
+                               atol=2e-5)

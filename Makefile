@@ -1,0 +1,29 @@
+# Entry points for the TPU-native Dynamo stack.
+# Contract mirrors the reference Makefile (/root/reference/Makefile:13-24):
+#   make k8s            - bootstrap a single-node Kubernetes cluster (Cilium CNI)
+#   make dynamo         - install the Dynamo-TPU platform (CRDs, operator, TPU plugin)
+#   make install        - both of the above
+#   make benchmark-env  - set up the benchmark virtualenv
+.PHONY: k8s dynamo install benchmark-env help
+
+help:
+	@echo "Targets:"
+	@echo "  k8s            bootstrap single-node K8s cluster (kubeadm + Cilium)"
+	@echo "  dynamo         install Dynamo-TPU platform (CRDs, operator, etcd, NATS, TPU device plugin)"
+	@echo "  install        k8s + dynamo"
+	@echo "  benchmark-env  create benchmark virtualenv + deps"
+	@echo ""
+	@echo "Env overrides pass through, e.g.:"
+	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
+	@echo "  make dynamo NAMESPACE=dynamo-system TPU_REQUIRED=true"
+
+k8s:
+	sudo -E ./k8s-single-node-cilium.sh
+
+dynamo:
+	./install-dynamo-1node.sh
+
+install: k8s dynamo
+
+benchmark-env:
+	./setup-benchmark-env.sh

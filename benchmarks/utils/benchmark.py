@@ -1,0 +1,156 @@
+"""Endpoint benchmark CLI — `python3 -m benchmarks.utils.benchmark`.
+
+CLI contract mirrors the module the reference's run-benchmarks.sh invokes
+(`python3 -m benchmarks.utils.benchmark --benchmark-name … --endpoint-url …
+--model … --output-dir …`, /root/reference/run-benchmarks.sh:56-68), so the
+wrapper script runs unchanged. Sweeps concurrency levels against the
+OpenAI-compatible endpoint and writes per-level JSON + a summary with tok/s,
+tok/s/chip, and TTFT/ITL/latency percentiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import statistics
+import time
+from typing import Dict, List
+
+from benchmarks.utils.loadgen import LoadConfig, RequestResult, run_load
+
+
+def _pctl(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    values = sorted(values)
+    idx = min(len(values) - 1, max(0, int(round(q / 100.0 * (len(values) - 1)))))
+    return values[idx]
+
+
+def summarize(results: List[RequestResult], wall_s: float, num_chips: int) -> Dict:
+    ok = [r for r in results if r.ok]
+    out_toks = sum(r.output_tokens for r in ok)
+    in_toks = sum(r.input_tokens for r in ok)
+    # only requests that actually streamed text contribute latency samples
+    ttfts = [r.ttft_s for r in ok if r.ttft_s > 0]
+    lats = [r.latency_s for r in ok]
+    itls = [itl for r in ok for itl in r.itl_s]
+    return {
+        "requests": len(results),
+        "successful": len(ok),
+        "failed": len(results) - len(ok),
+        "wall_s": round(wall_s, 3),
+        "input_tokens": in_toks,
+        "output_tokens": out_toks,
+        "output_tok_per_s": round(out_toks / wall_s, 2) if wall_s else 0.0,
+        "output_tok_per_s_per_chip": (
+            round(out_toks / wall_s / num_chips, 2) if wall_s else 0.0
+        ),
+        "request_per_s": round(len(ok) / wall_s, 3) if wall_s else 0.0,
+        "ttft_ms": {
+            "p50": round(_pctl(ttfts, 50) * 1e3, 1),
+            "p90": round(_pctl(ttfts, 90) * 1e3, 1),
+            "p99": round(_pctl(ttfts, 99) * 1e3, 1),
+            "mean": round(statistics.fmean(ttfts) * 1e3, 1) if ttfts else 0.0,
+        },
+        "itl_ms": {
+            "p50": round(_pctl(itls, 50) * 1e3, 2),
+            "p90": round(_pctl(itls, 90) * 1e3, 2),
+            "p99": round(_pctl(itls, 99) * 1e3, 2),
+            "mean": round(statistics.fmean(itls) * 1e3, 2) if itls else 0.0,
+        },
+        "latency_ms": {
+            "p50": round(_pctl(lats, 50) * 1e3, 1),
+            "p90": round(_pctl(lats, 90) * 1e3, 1),
+            "p99": round(_pctl(lats, 99) * 1e3, 1),
+        },
+        "errors": sorted({r.error for r in results if r.error})[:5],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="benchmarks.utils.benchmark")
+    p.add_argument("--benchmark-name", required=True)
+    p.add_argument("--endpoint-url", required=True)
+    p.add_argument("--model", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--concurrency", default="1,2,4,8",
+                   help="comma-separated concurrency sweep")
+    p.add_argument("--requests-per-level", type=int, default=32)
+    p.add_argument("--isl", type=int, default=128,
+                   help="synthetic input length (words)")
+    p.add_argument("--osl", type=int, default=64, help="max output tokens")
+    p.add_argument("--num-chips", type=int,
+                   default=int(os.environ.get("NUM_CHIPS", "1")),
+                   help="chips behind the endpoint, for tok/s/chip")
+    p.add_argument("--timeout", type=float, default=300.0)
+    args = p.parse_args(argv)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    levels = [int(c) for c in args.concurrency.split(",") if c.strip()]
+    sweep = []
+    for conc in levels:
+        cfg = LoadConfig(
+            endpoint_url=args.endpoint_url,
+            model=args.model,
+            num_requests=args.requests_per_level,
+            concurrency=conc,
+            input_len=args.isl,
+            max_tokens=args.osl,
+            timeout_s=args.timeout,
+        )
+        print(f"[benchmark] {args.benchmark_name}: concurrency={conc} "
+              f"requests={cfg.num_requests} isl~{args.isl}w osl={args.osl}")
+        t0 = time.perf_counter()
+        results = run_load(cfg)
+        wall = time.perf_counter() - t0
+        summary = summarize(results, wall, args.num_chips)
+        summary["concurrency"] = conc
+        sweep.append(summary)
+        print(f"[benchmark]   -> {summary['output_tok_per_s']} tok/s, "
+              f"TTFT p50 {summary['ttft_ms']['p50']}ms, "
+              f"ITL p50 {summary['itl_ms']['p50']}ms, "
+              f"{summary['failed']} failed")
+        level_path = os.path.join(
+            args.output_dir, f"{args.benchmark_name}_c{conc}.json"
+        )
+        with open(level_path, "w") as f:
+            json.dump(
+                {
+                    "summary": summary,
+                    "results": [dataclasses.asdict(r) for r in results],
+                },
+                f, indent=2,
+            )
+
+    best = max(sweep, key=lambda s: s["output_tok_per_s"]) if sweep else {}
+    report = {
+        "benchmark_name": args.benchmark_name,
+        "endpoint_url": args.endpoint_url,
+        "model": args.model,
+        "num_chips": args.num_chips,
+        "isl_words": args.isl,
+        "osl_tokens": args.osl,
+        "sweep": sweep,
+        "best": best,
+    }
+    out_path = os.path.join(args.output_dir, f"{args.benchmark_name}_summary.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"[benchmark] wrote {out_path}")
+    if best:
+        print(json.dumps({
+            "metric": "output_tok_per_s_per_chip",
+            "value": best["output_tok_per_s_per_chip"],
+            "unit": "tok/s/chip",
+            "ttft_p50_ms": best["ttft_ms"]["p50"],
+            "itl_p50_ms": best["itl_ms"]["p50"],
+        }))
+    any_ok = any(s["successful"] for s in sweep)
+    return 0 if any_ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

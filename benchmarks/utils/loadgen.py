@@ -1,0 +1,137 @@
+"""Endpoint load generator — the aiperf analogue.
+
+Drives an OpenAI-compatible /v1/chat/completions endpoint with streaming
+requests from a thread pool, recording per-request TTFT, ITL, end-to-end
+latency, and token counts. Stdlib-only (urllib + threads) so it runs in any
+cluster image. Consumed by `benchmarks.utils.benchmark`
+(/root/reference/run-benchmarks.sh:56-68 invokes the reference's equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class RequestResult:
+    ok: bool
+    ttft_s: float = 0.0          # time to first streamed token
+    latency_s: float = 0.0       # end-to-end
+    itl_s: List[float] = dataclasses.field(default_factory=list)
+    input_tokens: int = 0
+    output_tokens: int = 0
+    error: str = ""
+
+
+@dataclasses.dataclass
+class LoadConfig:
+    endpoint_url: str
+    model: str
+    num_requests: int = 32
+    concurrency: int = 4
+    input_len: int = 128          # synthetic prompt length (words)
+    max_tokens: int = 64
+    timeout_s: float = 300.0
+    prompt: Optional[str] = None  # overrides the synthetic prompt
+
+
+def _synthetic_prompt(n_words: int, seed: int) -> str:
+    """Deterministic filler prompt ~n_words long; varies per request so
+    prefix-cache routing doesn't collapse every request onto one worker."""
+    words = ["alpha", "ocean", "matrix", "signal", "vector", "photon",
+             "kernel", "lattice", "tensor", "stream"]
+    body = " ".join(words[(seed + i) % len(words)] for i in range(n_words))
+    return f"[req {seed}] Repeat and continue this text: {body}"
+
+
+def run_one(cfg: LoadConfig, seed: int) -> RequestResult:
+    prompt = cfg.prompt or _synthetic_prompt(cfg.input_len, seed)
+    body = json.dumps({
+        "model": cfg.model,
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": cfg.max_tokens,
+        "temperature": 0,
+        "stream": True,
+        "stream_options": {"include_usage": True},
+    }).encode()
+    req = urllib.request.Request(
+        cfg.endpoint_url.rstrip("/") + "/v1/chat/completions",
+        data=body, headers={"Content-Type": "application/json"}, method="POST",
+    )
+    res = RequestResult(ok=False)
+    start = time.perf_counter()
+    last_tok: Optional[float] = None
+    n_deltas = 0
+    usage_tokens: Optional[int] = None
+    try:
+        with urllib.request.urlopen(req, timeout=cfg.timeout_s) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[len("data:"):].strip()
+                if payload == "[DONE]":
+                    break
+                try:
+                    chunk = json.loads(payload)
+                except json.JSONDecodeError:
+                    continue
+                usage = chunk.get("usage")
+                if usage:
+                    res.input_tokens = usage.get("prompt_tokens", 0)
+                    usage_tokens = usage.get("completion_tokens")
+                choices = chunk.get("choices") or []
+                if not choices:
+                    continue
+                delta = (choices[0].get("delta") or {}).get("content")
+                if delta:
+                    now = time.perf_counter()
+                    if last_tok is None:
+                        res.ttft_s = now - start
+                    else:
+                        res.itl_s.append(now - last_tok)
+                    last_tok = now
+                    n_deltas += 1
+        res.latency_s = time.perf_counter() - start
+        # exact server-side count when stream usage is on; delta count otherwise
+        # (deltas may under-count: servers can batch tokens per SSE event, and
+        # some token ids decode to empty text)
+        res.output_tokens = usage_tokens if usage_tokens is not None else n_deltas
+        res.ok = res.output_tokens > 0
+        if not res.ok:
+            res.error = "no tokens streamed"
+    except Exception as e:  # noqa: BLE001 — load gen records, never raises
+        res.latency_s = time.perf_counter() - start
+        res.error = f"{type(e).__name__}: {e}"
+    return res
+
+
+def run_load(cfg: LoadConfig) -> List[RequestResult]:
+    """Closed-loop load: `concurrency` workers pull request ids off a queue."""
+    results: List[Optional[RequestResult]] = [None] * cfg.num_requests
+    next_id = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                if next_id[0] >= cfg.num_requests:
+                    return
+                rid = next_id[0]
+                next_id[0] += 1
+            results[rid] = run_one(cfg, rid)
+
+    threads = [
+        threading.Thread(target=worker, daemon=True, name=f"loadgen-{i}")
+        for i in range(max(1, cfg.concurrency))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [r for r in results if r is not None]

@@ -1,0 +1,95 @@
+"""Plot benchmark sweeps — `python3 -m benchmarks.utils.plot --data-dir D`.
+
+Mirror of the reference's optional plotting step
+(/root/reference/run-benchmarks.sh:70-72). Reads the *_summary.json files
+written by benchmarks.utils.benchmark and renders throughput-vs-concurrency
+and latency-percentile charts. Falls back to a text summary when matplotlib
+is unavailable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+from typing import List
+
+
+def _load_summaries(data_dir: str) -> List[dict]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(data_dir, "*_summary.json"))):
+        with open(path) as f:
+            out.append(json.load(f))
+    return out
+
+
+def _text_report(reports: List[dict], data_dir: str) -> str:
+    lines = []
+    for rep in reports:
+        lines.append(f"benchmark: {rep['benchmark_name']}  model: {rep['model']}")
+        lines.append(f"{'conc':>6} {'tok/s':>10} {'tok/s/chip':>11} "
+                     f"{'ttft p50':>9} {'itl p50':>8} {'fail':>5}")
+        for s in rep["sweep"]:
+            lines.append(
+                f"{s['concurrency']:>6} {s['output_tok_per_s']:>10} "
+                f"{s['output_tok_per_s_per_chip']:>11} "
+                f"{s['ttft_ms']['p50']:>8}ms {s['itl_ms']['p50']:>7}ms "
+                f"{s['failed']:>5}"
+            )
+        lines.append("")
+    text = "\n".join(lines)
+    path = os.path.join(data_dir, "report.txt")
+    with open(path, "w") as f:
+        f.write(text)
+    return text
+
+
+def _charts(reports: List[dict], data_dir: str) -> None:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    for rep in reports:
+        sweep = rep["sweep"]
+        conc = [s["concurrency"] for s in sweep]
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4))
+        ax1.plot(conc, [s["output_tok_per_s"] for s in sweep], marker="o")
+        ax1.set_xlabel("concurrency")
+        ax1.set_ylabel("output tok/s")
+        ax1.set_title(f"{rep['benchmark_name']}: throughput")
+        ax2.plot(conc, [s["ttft_ms"]["p50"] for s in sweep], marker="o",
+                 label="TTFT p50 (ms)")
+        ax2.plot(conc, [s["itl_ms"]["p50"] for s in sweep], marker="s",
+                 label="ITL p50 (ms)")
+        ax2.set_xlabel("concurrency")
+        ax2.set_ylabel("latency (ms)")
+        ax2.set_title("latency")
+        ax2.legend()
+        fig.tight_layout()
+        out = os.path.join(data_dir, f"{rep['benchmark_name']}.png")
+        fig.savefig(out, dpi=120)
+        plt.close(fig)
+        print(f"[plot] wrote {out}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="benchmarks.utils.plot")
+    p.add_argument("--data-dir", required=True)
+    args = p.parse_args(argv)
+
+    reports = _load_summaries(args.data_dir)
+    if not reports:
+        print(f"[plot] no *_summary.json files in {args.data_dir}")
+        return 1
+    print(_text_report(reports, args.data_dir))
+    try:
+        _charts(reports, args.data_dir)
+    except Exception as e:  # matplotlib missing or headless failure
+        print(f"[plot] charts skipped ({type(e).__name__}: {e}); "
+              f"text report written")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

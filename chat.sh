@@ -1,0 +1,116 @@
+#!/usr/bin/env bash
+# Interactive chat client for the OpenAI-compatible frontend.
+#
+# Layer 5 of the stack (SURVEY.md §1 L5). Contract-compatible with the
+# reference's chat.sh: multi-turn history, reasoning-model output handling
+# (prefer a FINAL: marker, else take text after the last </think>, else ask
+# the model to repair its own raw output into a final answer), deterministic
+# requests (temperature 0, max_tokens 512).
+#
+# Usage: DYNAMO_BASE_URL=http://<node-ip>:<port> ./chat.sh [model]
+set -uo pipefail
+
+BASE_URL="${DYNAMO_BASE_URL:-http://127.0.0.1:8000}"
+MODEL="${1:-${MODEL:-}}"
+MAX_TOKENS="${MAX_TOKENS:-512}"
+TEMPERATURE="${TEMPERATURE:-0}"
+HISTORY_FILE="$(mktemp /tmp/dynamo-chat.XXXXXX.json)"
+trap 'rm -f "$HISTORY_FILE"' EXIT
+echo "[]" >"$HISTORY_FILE"
+
+die() { echo "chat: $*" >&2; exit 1; }
+
+command -v curl >/dev/null 2>&1 || die "curl required"
+command -v python3 >/dev/null 2>&1 || die "python3 required"
+
+# Default model: first entry of /v1/models.
+if [[ -z "$MODEL" ]]; then
+  MODEL="$(curl -fsS "${BASE_URL}/v1/models" 2>/dev/null \
+    | python3 -c 'import json,sys; d=json.load(sys.stdin); print(d["data"][0]["id"])' \
+    2>/dev/null)" || die "cannot list models at ${BASE_URL}/v1/models — set DYNAMO_BASE_URL"
+fi
+echo "chatting with ${MODEL} at ${BASE_URL} (Ctrl-D to exit)"
+
+# extract_final RAW -> the user-facing answer, stripped of reasoning.
+extract_final() {
+  python3 - "$@" <<'PY'
+import re, sys
+raw = sys.argv[1]
+# 1) explicit FINAL: marker wins
+m = re.search(r"FINAL:\s*(.*)", raw, re.S)
+if m and m.group(1).strip():
+    print(m.group(1).strip()); sys.exit()
+# 2) text after the last closed think block
+if "</think>" in raw:
+    tail = raw.rsplit("</think>", 1)[1].strip()
+    if tail:
+        print(tail); sys.exit()
+    sys.exit(1)  # think-only output: caller triggers repair
+# 3) plain output
+if raw.strip():
+    print(raw.strip()); sys.exit()
+sys.exit(1)
+PY
+}
+
+# call_chat MESSAGES_JSON -> raw assistant text (empty string on HTTP error)
+call_chat() {
+  local messages="$1"
+  local body
+  body="$(python3 - "$MODEL" "$TEMPERATURE" "$MAX_TOKENS" "$messages" <<'PY'
+import json, sys
+model, temp, max_toks, messages = sys.argv[1:5]
+print(json.dumps({
+    "model": model,
+    "messages": json.loads(messages),
+    "temperature": float(temp),
+    "max_tokens": int(max_toks),
+}))
+PY
+)"
+  curl -fsS "${BASE_URL}/v1/chat/completions" \
+    -H "Content-Type: application/json" -d "$body" 2>/dev/null \
+    | python3 -c 'import json,sys; print(json.load(sys.stdin)["choices"][0]["message"]["content"])' \
+    2>/dev/null || true
+}
+
+append_history() {  # role content
+  python3 - "$HISTORY_FILE" "$1" "$2" <<'PY'
+import json, sys
+path, role, content = sys.argv[1:4]
+h = json.load(open(path))
+h.append({"role": role, "content": content})
+json.dump(h, open(path, "w"))
+PY
+}
+
+while true; do
+  printf "you> "
+  IFS= read -r line || { echo; break; }
+  [[ -z "$line" ]] && continue
+  append_history user "$line"
+
+  raw="$(call_chat "$(cat "$HISTORY_FILE")")"
+  if [[ -z "$raw" ]]; then
+    echo "model> (request failed)"
+    continue
+  fi
+
+  if answer="$(extract_final "$raw")"; then
+    :
+  else
+    # Repair pass: ask the model to turn its own raw output into the answer.
+    repair='[{"role": "user", "content": "Rewrite the following model output as ONLY the final answer, no reasoning: '"$(python3 -c 'import json,sys; print(json.dumps(sys.argv[1])[1:-1])' "$raw")"'"}]'
+    raw2="$(call_chat "$repair")"
+    if [[ -n "$raw2" ]] && answer="$(extract_final "$raw2")"; then
+      :
+    else
+      # Last resort: strip the think blocks mechanically.
+      answer="$(printf '%s' "$raw" | python3 -c 'import re,sys; print(re.sub(r"<think>.*?(</think>|$)", "", sys.stdin.read(), flags=re.S).strip())')"
+      [[ -n "$answer" ]] || answer="(no final answer produced)"
+    fi
+  fi
+
+  echo "model> $answer"
+  append_history assistant "$answer"
+done

@@ -1,0 +1,208 @@
+#!/usr/bin/env bash
+# Deploy a DynamoGraphDeployment manifest and expose its frontend.
+#
+# Layer 4 of the stack (SURVEY.md §1 L4). Contract-compatible with the
+# reference's deploy-incluster.sh: same CLI (--manifest/--namespace/--model/
+# --hf-token/--nodeport/--no-wait), same behavior (manifest applied as-is and
+# never edited on disk; HF secret with three key aliases; operator-created
+# children discovered by label; frontend ClusterIP converted to NodePort;
+# readiness waits that warn rather than abort; copy-paste test snippet).
+# TPU differences: discovery label is tpu.dynamo.ai/dynamo-namespace (the
+# analogue of nvidia.com/dynamo-namespace, /root/reference/deploy-incluster.sh:252-256)
+# and preflight reports google.com/tpu allocatable instead of nvidia.com/gpu.
+set -uo pipefail
+
+# ---- defaults (env-overridable; flags win) ----------------------------------
+NAMESPACE="${NAMESPACE:-dynamo}"
+MANIFEST="${MANIFEST:-}"
+MODEL="${MODEL:-}"
+HF_TOKEN="${HF_TOKEN:-}"
+NODEPORT="${NODEPORT:-}"
+WAIT="${WAIT:-true}"
+SECRET_NAME="${SECRET_NAME:-hf-token-secret}"
+POLL_PERIOD="${POLL_PERIOD:-3}"
+DISCOVER_TIMEOUT="${DISCOVER_TIMEOUT:-180}"
+READY_TIMEOUT="${READY_TIMEOUT:-1200}"
+NS_LABEL="tpu.dynamo.ai/dynamo-namespace"
+
+log()  { echo "[deploy] $*"; }
+warn() { echo "[deploy] WARN: $*" >&2; }
+die()  { echo "[deploy] ERROR: $*" >&2; exit 1; }
+
+usage() {
+  cat <<EOF
+Usage: $0 --manifest FILE [options]
+
+Options:
+  --manifest FILE    DGD manifest to apply (required)
+  --namespace NS     target namespace            (default: ${NAMESPACE})
+  --model NAME       served model name for the printed test snippet
+  --hf-token TOKEN   HuggingFace token stored in ${SECRET_NAME}
+  --nodeport PORT    fixed NodePort for the frontend (30000-32767)
+  --no-wait          apply + patch, skip readiness waits
+  -h, --help         this text
+EOF
+  exit "${1:-0}"
+}
+
+# ---- argument parsing --------------------------------------------------------
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --manifest)  MANIFEST="$2"; shift 2 ;;
+    --namespace) NAMESPACE="$2"; shift 2 ;;
+    --model)     MODEL="$2"; shift 2 ;;
+    --hf-token)  HF_TOKEN="$2"; shift 2 ;;
+    --nodeport)  NODEPORT="$2"; shift 2 ;;
+    --no-wait)   WAIT=false; shift ;;
+    -h|--help)   usage 0 ;;
+    *) warn "unknown argument: $1"; usage 1 ;;
+  esac
+done
+
+[[ -n "$MANIFEST" ]] || usage 1
+[[ -f "$MANIFEST" ]] || die "manifest not found: ${MANIFEST}"
+if [[ -n "$NODEPORT" ]]; then
+  [[ "$NODEPORT" =~ ^[0-9]+$ && "$NODEPORT" -ge 30000 && "$NODEPORT" -le 32767 ]] \
+    || die "nodeport must be in 30000-32767, got: ${NODEPORT}"
+fi
+
+# ---- preflight ---------------------------------------------------------------
+command -v kubectl >/dev/null 2>&1 || die "kubectl not found"
+kubectl cluster-info >/dev/null 2>&1 || die "cluster unreachable"
+tpus="$(kubectl get nodes -o jsonpath='{range .items[*]}{.status.allocatable.google\.com/tpu}{"\n"}{end}' \
+  | awk 'BEGIN{s=0} /^[0-9]+$/{s+=$1} END{print s}')"
+log "google.com/tpu allocatable in cluster: ${tpus:-0}"
+
+# ---- namespace + HF secret ---------------------------------------------------
+kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -f - >/dev/null
+if [[ -n "$HF_TOKEN" ]]; then
+  log "creating secret ${SECRET_NAME}"
+else
+  # Manifests referencing envFromSecret must still mount; use a dummy value.
+  log "no --hf-token given; creating dummy ${SECRET_NAME}"
+  HF_TOKEN="dummy"
+fi
+# Three aliases so any engine image's expected key resolves.
+kubectl create secret generic "$SECRET_NAME" -n "$NAMESPACE" \
+  --from-literal=HF_TOKEN="$HF_TOKEN" \
+  --from-literal=HUGGING_FACE_HUB_TOKEN="$HF_TOKEN" \
+  --from-literal=token="$HF_TOKEN" \
+  --dry-run=client -o yaml | kubectl apply -f - >/dev/null
+
+# ---- apply the manifest (as-is, never edited) --------------------------------
+log "applying ${MANIFEST}"
+kubectl apply -n "$NAMESPACE" -f "$MANIFEST" || die "kubectl apply failed"
+
+# DGD name: first metadata.name in the manifest's DynamoGraphDeployment doc.
+DGD_NAME="$(awk '
+  /^kind:[[:space:]]*DynamoGraphDeployment[[:space:]]*$/ { indgd=1 }
+  indgd && /^[[:space:]]+name:/ { sub(/^[[:space:]]+name:[[:space:]]*/, ""); print; exit }
+' "$MANIFEST")"
+[[ -n "$DGD_NAME" ]] || DGD_NAME="$(basename "$MANIFEST" .yaml)"
+log "DynamoGraphDeployment: ${DGD_NAME}"
+
+# Patch envFromSecret into every service of the DGD (in-cluster only; the
+# manifest file itself is never modified). Top-level service names only —
+# nested spec keys must not be mistaken for services.
+services="$(kubectl get dgd -n "$NAMESPACE" "$DGD_NAME" -o json 2>/dev/null \
+  | python3 -c 'import json,sys; print("\n".join(json.load(sys.stdin).get("spec",{}).get("services",{})))' \
+  || true)"
+for svc in $services; do
+  kubectl patch dgd -n "$NAMESPACE" "$DGD_NAME" --type merge -p \
+    "{\"spec\":{\"services\":{\"${svc}\":{\"envFromSecret\":\"${SECRET_NAME}\"}}}}" \
+    >/dev/null 2>&1 || warn "could not patch envFromSecret into service ${svc}"
+done
+
+# ---- discover operator-created children --------------------------------------
+label="${NS_LABEL}=${NAMESPACE}-${DGD_NAME}"
+log "discovering Deployments with label ${label}"
+deploys=""
+deadline=$((SECONDS + DISCOVER_TIMEOUT))
+while [[ $SECONDS -lt $deadline ]]; do
+  deploys="$(kubectl get deploy -n "$NAMESPACE" -l "$label" \
+    -o jsonpath='{range .items[*]}{.metadata.name}{"\n"}{end}' 2>/dev/null)"
+  [[ -n "$deploys" ]] && break
+  sleep "$POLL_PERIOD"
+done
+[[ -n "$deploys" ]] || die "operator created no Deployments for ${DGD_NAME} within ${DISCOVER_TIMEOUT}s"
+log "found: $(echo "$deploys" | tr '\n' ' ')"
+
+svcs="$(kubectl get svc -n "$NAMESPACE" -l "$label" \
+  -o jsonpath='{range .items[*]}{.metadata.name}{"\n"}{end}' 2>/dev/null)"
+
+# ---- frontend NodePort exposure ----------------------------------------------
+# Frontend = non-headless child service of componentType frontend; fall back
+# to name heuristics excluding -p/-d (prefill/decode-internal) suffixes.
+frontend_svc="$(kubectl get svc -n "$NAMESPACE" -l "$label,tpu.dynamo.ai/component-type=frontend" \
+  -o jsonpath='{.items[0].metadata.name}' 2>/dev/null || true)"
+if [[ -z "$frontend_svc" ]]; then
+  for s in $svcs; do
+    cluster_ip="$(kubectl get svc -n "$NAMESPACE" "$s" -o jsonpath='{.spec.clusterIP}')"
+    [[ "$cluster_ip" == "None" ]] && continue   # headless: worker-internal
+    case "$s" in *-p|*-d|*prefill*|*decode*) continue ;; esac
+    frontend_svc="$s"; break
+  done
+fi
+
+node_port=""
+if [[ -n "$frontend_svc" ]]; then
+  log "exposing frontend service ${frontend_svc} via NodePort"
+  if [[ -n "$NODEPORT" ]]; then
+    port_json="{\"spec\":{\"type\":\"NodePort\",\"ports\":[{\"port\":8000,\"targetPort\":8000,\"nodePort\":${NODEPORT}}]}}"
+  else
+    port_json='{"spec":{"type":"NodePort"}}'
+  fi
+  kubectl patch svc -n "$NAMESPACE" "$frontend_svc" -p "$port_json" >/dev/null \
+    || warn "NodePort patch failed for ${frontend_svc}"
+  node_port="$(kubectl get svc -n "$NAMESPACE" "$frontend_svc" \
+    -o jsonpath='{.spec.ports[0].nodePort}' 2>/dev/null)"
+else
+  warn "no frontend service found to expose"
+fi
+
+# (No direct `kubectl set env` on the child Deployments: the operator's
+# reconcile loop would revert it within seconds. The DGD envFromSecret patch
+# above is the durable path — the operator propagates it on the next sync.)
+
+# ---- readiness waits (warn-and-continue) -------------------------------------
+if [[ "$WAIT" == "true" ]]; then
+  log "waiting for pods of ${DGD_NAME} (cap ${READY_TIMEOUT}s)"
+  deadline=$((SECONDS + READY_TIMEOUT))
+  for d in $deploys; do
+    remaining=$((deadline - SECONDS))
+    [[ $remaining -le 10 ]] && remaining=10
+    kubectl rollout status -n "$NAMESPACE" "deployment/${d}" \
+      --timeout="${remaining}s" >/dev/null 2>&1 \
+      || warn "deployment ${d} not ready in time — continuing"
+  done
+  if [[ -n "$frontend_svc" ]]; then
+    ep=""
+    while [[ $SECONDS -lt $deadline ]]; do
+      ep="$(kubectl get endpoints -n "$NAMESPACE" "$frontend_svc" \
+        -o jsonpath='{.subsets[0].addresses[0].ip}' 2>/dev/null)"
+      [[ -n "$ep" ]] && break
+      sleep "$POLL_PERIOD"
+    done
+    [[ -n "$ep" ]] || warn "frontend has no endpoints yet — it may still be starting"
+  fi
+fi
+
+# ---- test snippet ------------------------------------------------------------
+node_ip="$(kubectl get nodes -o jsonpath='{.items[0].status.addresses[?(@.type=="InternalIP")].address}')"
+model_hint="${MODEL:-<model>}"
+echo ""
+echo "================= quick test ================="
+if [[ -n "$node_port" ]]; then
+  echo "export DYNAMO_BASE_URL=http://${node_ip}:${node_port}"
+else
+  echo "# frontend not exposed; port-forward instead:"
+  echo "kubectl port-forward -n ${NAMESPACE} svc/${frontend_svc:-<frontend>} 8000:8000"
+  echo "export DYNAMO_BASE_URL=http://127.0.0.1:8000"
+fi
+cat <<EOF
+curl \$DYNAMO_BASE_URL/v1/models
+curl -s \$DYNAMO_BASE_URL/v1/chat/completions \\
+  -H 'Content-Type: application/json' \\
+  -d '{"model": "${model_hint}", "messages": [{"role": "user", "content": "Say hello."}], "max_tokens": 32}'
+==============================================
+EOF

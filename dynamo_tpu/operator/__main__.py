@@ -19,7 +19,9 @@ from dynamo_tpu.operator.k8s_client import K8sClient
 def main(argv=None) -> None:
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
     p = argparse.ArgumentParser(prog="dynamo_tpu.operator")
-    p.add_argument("--namespace", default=os.environ.get("NAMESPACE") or None,
+    p.add_argument("--namespace",
+                   default=os.environ.get("WATCH_NAMESPACE")
+                   or os.environ.get("NAMESPACE") or None,
                    help="restrict to one namespace (default: cluster-wide)")
     p.add_argument("--interval", type=float,
                    default=float(os.environ.get("RECONCILE_INTERVAL", "3")))

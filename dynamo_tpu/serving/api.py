@@ -268,23 +268,32 @@ class _Handler(JsonHTTPHandler):
         gen = self.ctx.start_generation(rid, prompt_ids, p)  # may raise -> 400
 
         if p["stream"]:
+            with_null = p.get("include_usage", False)
             self._start_sse()
             self._sse_chunk(
-                proto.chat_chunk(rid, p["model"], {"role": "assistant"}, None)
+                proto.chat_chunk(rid, p["model"], {"role": "assistant"}, None,
+                                 with_usage_null=with_null)
             )
 
             def emit(delta, finish) -> bool:
                 ok = True
                 if delta:
                     ok = self._sse_chunk(
-                        proto.chat_chunk(rid, p["model"], {"content": delta}, None)
+                        proto.chat_chunk(rid, p["model"], {"content": delta},
+                                         None, with_usage_null=with_null)
                     )
                 if finish is not None:
                     ok = self._sse_chunk(
-                        proto.chat_chunk(rid, p["model"], {}, finish)) and ok
+                        proto.chat_chunk(rid, p["model"], {}, finish,
+                                         with_usage_null=with_null)) and ok
                 return ok
 
-            gen.run(emit)
+            _, _, n_out = gen.run(emit)
+            if p.get("include_usage"):
+                self._sse_chunk(proto.usage_chunk(
+                    rid, p["model"], "chat.completion.chunk",
+                    len(prompt_ids), n_out,
+                ))
             self._sse_chunk("[DONE]")
             self._end_sse()
         else:
@@ -307,15 +316,22 @@ class _Handler(JsonHTTPHandler):
 
             def emit(delta, finish) -> bool:
                 if delta or finish is not None:
-                    return self._sse_chunk({
+                    chunk = {
                         "id": rid, "object": "text_completion",
                         "created": int(time.time()), "model": p["model"],
                         "choices": [{"index": 0, "text": delta,
                                      "finish_reason": finish}],
-                    })
+                    }
+                    if p.get("include_usage"):
+                        chunk["usage"] = None
+                    return self._sse_chunk(chunk)
                 return True
 
-            gen.run(emit)
+            _, _, n_out = gen.run(emit)
+            if p.get("include_usage"):
+                self._sse_chunk(proto.usage_chunk(
+                    rid, p["model"], "text_completion", len(prompt_ids), n_out,
+                ))
             self._sse_chunk("[DONE]")
             self._end_sse()
         else:

@@ -46,7 +46,23 @@ def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
         "top_p": _num(body, "top_p", 1.0),
         "top_k": int(_num(body, "top_k", 0)),
         "stream": bool(body.get("stream", False)),
+        "include_usage": _include_usage(body),
         "ignore_eos": bool(body.get("ignore_eos", False)),
+    }
+
+
+def _include_usage(body: Dict[str, Any]) -> bool:
+    so = body.get("stream_options") or {}
+    if not isinstance(so, dict):
+        raise BadRequest("'stream_options' must be an object")
+    return bool(so.get("include_usage", False))
+
+
+def _usage(prompt_tokens: int, completion_tokens: int) -> Dict[str, int]:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
     }
 
 
@@ -81,6 +97,7 @@ def parse_completion_request(body: Dict[str, Any]) -> Dict[str, Any]:
         "top_p": _num(body, "top_p", 1.0),
         "top_k": int(_num(body, "top_k", 0)),
         "stream": bool(body.get("stream", False)),
+        "include_usage": _include_usage(body),
         "ignore_eos": bool(body.get("ignore_eos", False)),
     }
 
@@ -112,24 +129,26 @@ def chat_completion_response(
                 "finish_reason": finish_reason,
             }
         ],
-        "usage": {
-            "prompt_tokens": prompt_tokens,
-            "completion_tokens": completion_tokens,
-            "total_tokens": prompt_tokens + completion_tokens,
-        },
+        "usage": _usage(prompt_tokens, completion_tokens),
     }
 
 
 def chat_chunk(
-    rid: str, model: str, delta: Dict[str, Any], finish_reason: Optional[str]
+    rid: str, model: str, delta: Dict[str, Any], finish_reason: Optional[str],
+    with_usage_null: bool = False,
 ) -> Dict[str, Any]:
-    return {
+    out = {
         "id": rid,
         "object": "chat.completion.chunk",
         "created": int(time.time()),
         "model": model,
         "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
     }
+    if with_usage_null:
+        # with stream_options.include_usage, every non-final chunk carries
+        # an explicit "usage": null per the OpenAI streaming contract
+        out["usage"] = None
+    return out
 
 
 def completion_response(
@@ -143,11 +162,21 @@ def completion_response(
         "model": model,
         "choices": [{"index": 0, "text": text, "finish_reason": finish_reason,
                      "logprobs": None}],
-        "usage": {
-            "prompt_tokens": prompt_tokens,
-            "completion_tokens": completion_tokens,
-            "total_tokens": prompt_tokens + completion_tokens,
-        },
+        "usage": _usage(prompt_tokens, completion_tokens),
+    }
+
+
+def usage_chunk(
+    rid: str, model: str, object_: str, prompt_tokens: int, completion_tokens: int
+) -> Dict[str, Any]:
+    """Final SSE chunk carrying usage, per stream_options.include_usage."""
+    return {
+        "id": rid,
+        "object": object_,
+        "created": int(time.time()),
+        "model": model,
+        "choices": [],
+        "usage": _usage(prompt_tokens, completion_tokens),
     }
 
 

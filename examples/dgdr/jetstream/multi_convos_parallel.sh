@@ -1,0 +1,121 @@
+#!/usr/bin/env bash
+# Parallel conversation load test: N conversations x 3 scripted turns, with
+# bounded concurrency via a FIFO-fd token semaphore.
+#
+# Layer 5 of the stack (SURVEY.md §1 L5); contract mirrors the reference's
+# examples/dgdr/trtllm/multi_convos_parallel.sh (NUM_CONVOS / CONCURRENCY env
+# knobs, per-conversation JSON history, transcript collection, failure
+# aggregation with nonzero exit when any conversation fails).
+#
+# Usage: DYNAMO_BASE_URL=http://<ip>:<port> ./multi_convos_parallel.sh
+set -uo pipefail
+
+BASE_URL="${DYNAMO_BASE_URL:-http://127.0.0.1:8000}"
+MODEL="${MODEL:-}"
+NUM_CONVOS="${NUM_CONVOS:-8}"
+CONCURRENCY="${CONCURRENCY:-4}"
+MAX_TOKENS="${MAX_TOKENS:-128}"
+OUT_DIR="${OUT_DIR:-$(mktemp -d /tmp/dynamo-convos.XXXXXX)}"
+
+die() { echo "multi_convos: $*" >&2; exit 1; }
+command -v curl >/dev/null || die "curl required"
+command -v python3 >/dev/null || die "python3 required"
+
+if [[ -z "$MODEL" ]]; then
+  MODEL="$(curl -fsS "${BASE_URL}/v1/models" \
+    | python3 -c 'import json,sys; print(json.load(sys.stdin)["data"][0]["id"])')" \
+    || die "cannot reach ${BASE_URL}/v1/models"
+fi
+mkdir -p "$OUT_DIR"
+echo "model=${MODEL} convos=${NUM_CONVOS} concurrency=${CONCURRENCY} out=${OUT_DIR}"
+
+# The three scripted turns every conversation walks through.
+TURNS=(
+  "Give me one sentence about the ocean."
+  "Now make it about mountains instead."
+  "Combine both sentences into one."
+)
+
+# chat_once HISTORY_FILE PROMPT -> appends to history, prints assistant text
+chat_once() {
+  local hist="$1" prompt="$2"
+  python3 - "$hist" user "$prompt" <<'PY'
+import json, sys
+p, role, content = sys.argv[1:4]
+h = json.load(open(p)); h.append({"role": role, "content": content})
+json.dump(h, open(p, "w"))
+PY
+  local body
+  body="$(python3 - "$MODEL" "$MAX_TOKENS" "$hist" <<'PY'
+import json, sys
+model, max_toks, hist = sys.argv[1:4]
+print(json.dumps({"model": model, "messages": json.load(open(hist)),
+                  "temperature": 0, "max_tokens": int(max_toks)}))
+PY
+)"
+  local reply
+  reply="$(curl -fsS --max-time 300 "${BASE_URL}/v1/chat/completions" \
+    -H 'Content-Type: application/json' -d "$body" \
+    | python3 -c 'import json,sys; print(json.load(sys.stdin)["choices"][0]["message"]["content"])')" \
+    || return 1
+  python3 - "$hist" assistant "$reply" <<'PY'
+import json, sys
+p, role, content = sys.argv[1:4]
+h = json.load(open(p)); h.append({"role": role, "content": content})
+json.dump(h, open(p, "w"))
+PY
+  printf '%s\n' "$reply"
+}
+
+run_convo() {
+  local id="$1"
+  local hist="${OUT_DIR}/convo-${id}.json"
+  local transcript="${OUT_DIR}/convo-${id}.txt"
+  echo "[]" >"$hist"
+  local turn reply
+  for turn in "${TURNS[@]}"; do
+    {
+      echo "user> ${turn}"
+      if ! reply="$(chat_once "$hist" "$turn")"; then
+        echo "FAILED at turn: ${turn}"
+        return 1
+      fi
+      echo "model> ${reply}"
+    } >>"$transcript"
+  done
+}
+
+# ---- FIFO-fd token semaphore -------------------------------------------------
+SEM="$(mktemp -u /tmp/dynamo-sem.XXXXXX)"
+mkfifo "$SEM"
+exec 3<>"$SEM"
+rm -f "$SEM"
+for ((i = 0; i < CONCURRENCY; i++)); do printf '.' >&3; done
+sem_acquire() { local _t; read -r -n1 -u3 _t; }
+sem_release() { printf '.' >&3; }
+
+pids=()
+for ((c = 1; c <= NUM_CONVOS; c++)); do
+  sem_acquire
+  {
+    if run_convo "$c"; then
+      touch "${OUT_DIR}/convo-${c}.ok"
+    fi
+    sem_release
+  } &
+  pids+=($!)
+done
+wait "${pids[@]}" 2>/dev/null
+
+# ---- aggregate ---------------------------------------------------------------
+ok=0 failed=0
+for ((c = 1; c <= NUM_CONVOS; c++)); do
+  if [[ -f "${OUT_DIR}/convo-${c}.ok" ]]; then
+    ok=$((ok + 1))
+  else
+    failed=$((failed + 1))
+    echo "FAILED: conversation ${c} (transcript: ${OUT_DIR}/convo-${c}.txt)"
+  fi
+done
+echo "done: ${ok}/${NUM_CONVOS} conversations succeeded (transcripts in ${OUT_DIR})"
+[[ $failed -eq 0 ]]

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Apply the SLA-driven DGDR workflow: template ConfigMap -> DGDR -> (operator
+# profiles + generates + applies the DGD) -> fixed NodePort + test snippet.
+# Mirror of /root/reference/examples/dgdr/trtllm/run-dgdr.sh.
+set -euo pipefail
+
+NAMESPACE="${NAMESPACE:-dynamo}"
+NODEPORT="${NODEPORT:-30081}"
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+log() { echo "[run-dgdr] $*"; }
+
+kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -f - >/dev/null
+
+# Template ConfigMap: key MUST be disagg.yaml to match the DGDR's
+# profilingConfig.config.configMapRef.key.
+log "creating/updating template ConfigMap qwen-config"
+kubectl create configmap qwen-config -n "$NAMESPACE" \
+  --from-file=disagg.yaml="${HERE}/disagg.yaml" \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+log "applying DGDR"
+kubectl apply -n "$NAMESPACE" -f "${HERE}/dgdr.yaml"
+
+# Pin the frontend service (created later by the generated DGD) to a fixed
+# NodePort once it exists.
+log "waiting for generated frontend service"
+frontend=""
+for _ in $(seq 1 120); do
+  frontend="$(kubectl get svc -n "$NAMESPACE" \
+    -l tpu.dynamo.ai/component-type=frontend \
+    -o jsonpath='{.items[0].metadata.name}' 2>/dev/null || true)"
+  [[ -n "$frontend" ]] && break
+  sleep 5
+done
+if [[ -n "$frontend" ]]; then
+  kubectl patch svc -n "$NAMESPACE" "$frontend" -p \
+    "{\"spec\":{\"type\":\"NodePort\",\"ports\":[{\"port\":8000,\"targetPort\":8000,\"nodePort\":${NODEPORT}}]}}"
+else
+  log "WARN: frontend service not created yet; patch it manually once the profile completes"
+fi
+
+node_ip="$(kubectl get nodes -o jsonpath='{.items[0].status.addresses[?(@.type=="InternalIP")].address}')"
+cat <<EOF
+
+DGDR applied. Once profiling finishes and the generated DGD is ready:
+  export DYNAMO_BASE_URL=http://${node_ip}:${NODEPORT}
+  curl \$DYNAMO_BASE_URL/v1/models
+  curl -s \$DYNAMO_BASE_URL/v1/chat/completions -H 'Content-Type: application/json' \\
+    -d '{"model": "Qwen/Qwen3-0.6B", "messages": [{"role": "user", "content": "hello"}], "max_tokens": 32}'
+EOF

@@ -1,0 +1,128 @@
+#!/usr/bin/env bash
+# Install the Dynamo-TPU platform onto an existing cluster.
+#
+# Layer 2 of the stack (SURVEY.md §1 L2). Contract-compatible with the
+# reference's install-dynamo-1node.sh: same ordering (StorageClass -> CRDs ->
+# platform -> accelerator plugin -> allocatable verification), same readiness
+# gates (etcd-0 / nats-0 / operator), same env-knob style — with the NVIDIA
+# GPU Operator swapped for the TPU device plugin and the allocatable poll
+# checking `google.com/tpu` instead of `nvidia.com/gpu`
+# (/root/reference/install-dynamo-1node.sh:282-321).
+#
+# Usage: ./install-dynamo-1node.sh    (or: make dynamo)
+set -euo pipefail
+
+# ---- configuration (env-overridable) ----------------------------------------
+NAMESPACE="${NAMESPACE:-dynamo-system}"
+RELEASE_VERSION="${RELEASE_VERSION:-local}"     # "local" applies deploy/ from this repo
+NAMESPACE_RESTRICTED_OPERATOR="${NAMESPACE_RESTRICTED_OPERATOR:-false}"
+ENABLE_GANG_SCHEDULING="${ENABLE_GANG_SCHEDULING:-false}"   # Grove/KAI analogue
+PROMETHEUS_ENDPOINT="${PROMETHEUS_ENDPOINT:-http://prometheus-kube-prometheus-prometheus.monitoring.svc.cluster.local:9090}"
+INSTALL_TPU_PLUGIN="${INSTALL_TPU_PLUGIN:-true}"
+INSTALL_TPU_EXPORTER="${INSTALL_TPU_EXPORTER:-true}"
+TPU_REQUIRED="${TPU_REQUIRED:-false}"           # hard-fail if no google.com/tpu allocatable
+TPU_POLL_RETRIES="${TPU_POLL_RETRIES:-120}"
+TPU_POLL_INTERVAL="${TPU_POLL_INTERVAL:-5}"
+WAIT_TIMEOUT="${WAIT_TIMEOUT:-600s}"
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+log() { echo "[$(date +%H:%M:%S)] $*"; }
+die() { echo "ERROR: $*" >&2; exit 1; }
+
+# ---- preflight --------------------------------------------------------------
+for cmd in kubectl; do
+  command -v "$cmd" >/dev/null 2>&1 || die "missing required command: $cmd"
+done
+kubectl cluster-info >/dev/null 2>&1 || die "cluster unreachable (is kubeconfig set?)"
+[[ -n "$RELEASE_VERSION" ]] || die "RELEASE_VERSION must be set"
+
+# ---- step 1: default StorageClass -------------------------------------------
+# etcd/NATS PVCs (and the model-cache PVC) need a default StorageClass on a
+# single node; install rancher local-path-provisioner if none is default.
+default_sc="$(kubectl get storageclass -o \
+  jsonpath='{range .items[*]}{.metadata.name}{"\t"}{.metadata.annotations.storageclass\.kubernetes\.io/is-default-class}{"\n"}{end}' \
+  | awk '$2=="true"{print $1; exit}')"
+if [[ -n "$default_sc" ]]; then
+  log "default StorageClass present: ${default_sc}"
+else
+  log "installing local-path-provisioner as default StorageClass"
+  kubectl apply -f https://raw.githubusercontent.com/rancher/local-path-provisioner/v0.0.30/deploy/local-path-storage.yaml
+  kubectl patch storageclass local-path -p \
+    '{"metadata":{"annotations":{"storageclass.kubernetes.io/is-default-class":"true"}}}'
+fi
+
+# ---- step 2: CRDs ------------------------------------------------------------
+log "installing Dynamo-TPU CRDs (release: ${RELEASE_VERSION})"
+kubectl apply -f "${REPO_ROOT}/deploy/crds/"
+
+# ---- step 3: platform (operator + etcd + NATS) -------------------------------
+log "installing platform into namespace ${NAMESPACE}"
+kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -f -
+
+# The operator Deployment lives in the namespace hardcoded by operator.yaml
+# (its RBAC + ServiceAccount are bound there), independent of $NAMESPACE.
+OPERATOR_NAMESPACE="dynamo-system"
+operator_env=("PROMETHEUS_ENDPOINT=${PROMETHEUS_ENDPOINT}")
+if [[ "$NAMESPACE_RESTRICTED_OPERATOR" == "true" ]]; then
+  operator_env+=("WATCH_NAMESPACE=${NAMESPACE}")
+fi
+if [[ "$ENABLE_GANG_SCHEDULING" == "true" ]]; then
+  operator_env+=("ENABLE_GANG_SCHEDULING=true")
+fi
+
+kubectl apply -n "$NAMESPACE" -f "${REPO_ROOT}/deploy/platform/"
+# operator.yaml carries its own namespace refs; apply then inject env config
+kubectl apply -f "${REPO_ROOT}/deploy/operator.yaml"
+kubectl set env -n "$OPERATOR_NAMESPACE" \
+  deployment/dynamo-tpu-operator-controller-manager "${operator_env[@]}" >/dev/null
+
+# ---- step 4: readiness gates -------------------------------------------------
+log "waiting for platform pods (timeout ${WAIT_TIMEOUT} each)"
+kubectl wait -n "$NAMESPACE" --for=condition=Ready pod/dynamo-platform-etcd-0 \
+  --timeout="$WAIT_TIMEOUT"
+kubectl wait -n "$NAMESPACE" --for=condition=Ready pod/dynamo-platform-nats-0 \
+  --timeout="$WAIT_TIMEOUT"
+kubectl wait -n "$OPERATOR_NAMESPACE" --for=condition=Available \
+  deployment/dynamo-tpu-operator-controller-manager --timeout="$WAIT_TIMEOUT"
+
+# ---- step 5: TPU device plugin + metrics exporter ----------------------------
+if [[ "$INSTALL_TPU_PLUGIN" == "true" ]]; then
+  log "installing TPU device plugin DaemonSet"
+  kubectl apply -f "${REPO_ROOT}/deploy/tpu-device-plugin.yaml"
+fi
+if [[ "$INSTALL_TPU_EXPORTER" == "true" && -f "${REPO_ROOT}/deploy/tpu-metrics-exporter.yaml" ]]; then
+  log "installing TPU metrics exporter DaemonSet"
+  kubectl apply -f "${REPO_ROOT}/deploy/tpu-metrics-exporter.yaml"
+fi
+
+# ---- step 6: verify google.com/tpu allocatable -------------------------------
+# Mirror of the reference's nvidia.com/gpu allocatable poll
+# (/root/reference/install-dynamo-1node.sh:305-321). On GKE TPU node pools the
+# built-in plugin advertises the resource; on CPU-only dev clusters the poll
+# is skipped unless TPU_REQUIRED=true.
+count_tpus() {
+  kubectl get nodes -o jsonpath='{range .items[*]}{.status.allocatable.google\.com/tpu}{"\n"}{end}' \
+    | awk 'BEGIN{s=0} /^[0-9]+$/{s+=$1} END{print s}'
+}
+
+if [[ "$TPU_REQUIRED" == "true" ]]; then
+  log "polling for google.com/tpu allocatable (${TPU_POLL_RETRIES}x${TPU_POLL_INTERVAL}s)"
+  tpus=0
+  for ((i = 1; i <= TPU_POLL_RETRIES; i++)); do
+    tpus="$(count_tpus)"
+    [[ "$tpus" -gt 0 ]] && break
+    sleep "$TPU_POLL_INTERVAL"
+  done
+  [[ "$tpus" -gt 0 ]] || die "no google.com/tpu allocatable after $((TPU_POLL_RETRIES * TPU_POLL_INTERVAL))s"
+  log "google.com/tpu allocatable: ${tpus}"
+else
+  tpus="$(count_tpus)"
+  if [[ "$tpus" -gt 0 ]]; then
+    log "google.com/tpu allocatable: ${tpus}"
+  else
+    log "no TPUs allocatable (CPU-only cluster?) — continuing; set TPU_REQUIRED=true to enforce"
+  fi
+fi
+
+log "Dynamo-TPU platform installed. Next:"
+echo "    ./deploy-incluster.sh --manifest examples/deploy/jetstream/agg.yaml"

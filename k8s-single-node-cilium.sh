@@ -1,0 +1,188 @@
+#!/usr/bin/env bash
+# Bootstrap a single-node Kubernetes cluster with the Cilium CNI on Ubuntu.
+#
+# Layer 1 of the stack (SURVEY.md §1 L1). Contract-compatible with the
+# reference's k8s-single-node-cilium.sh (same env knobs, same end state:
+# a schedulable one-node kubeadm cluster with Cilium, kubectl configured for
+# the invoking user, optional Hubble + kube-prometheus-stack). This layer is
+# accelerator-agnostic; everything TPU-specific lives in install-dynamo-1node.sh.
+#
+# Usage: sudo -E ./k8s-single-node-cilium.sh    (or: make k8s)
+set -euo pipefail
+
+# ---- configuration (env-overridable) ----------------------------------------
+K8S_REPO_MINOR="${K8S_REPO_MINOR:-v1.31}"      # pkgs.k8s.io minor release line
+CLUSTER_NAME="${CLUSTER_NAME:-dynamo-tpu}"
+POD_CIDR="${POD_CIDR:-10.244.0.0/16}"
+ENABLE_HUBBLE="${ENABLE_HUBBLE:-false}"        # Hubble relay + UI
+HELM_VERSION="${HELM_VERSION:-v3.16.2}"
+INSTALL_HELM="${INSTALL_HELM:-true}"
+INSTALL_PROMETHEUS_STACK="${INSTALL_PROMETHEUS_STACK:-false}"
+MONITORING_NS="${MONITORING_NS:-monitoring}"
+CILIUM_CLI_VERSION="${CILIUM_CLI_VERSION:-}"   # default: stable.txt
+
+log()  { echo "[$(date +%H:%M:%S)] $*"; }
+die()  { echo "ERROR: $*" >&2; exit 1; }
+
+# ---- preflight --------------------------------------------------------------
+[[ $EUID -eq 0 ]] || die "must run as root (use sudo -E)"
+grep -qi ubuntu /etc/os-release 2>/dev/null || die "this script targets Ubuntu"
+
+# The user who invoked sudo gets the kubeconfig.
+PRIMARY_USER="${SUDO_USER:-$(logname 2>/dev/null || echo root)}"
+PRIMARY_HOME="$(getent passwd "$PRIMARY_USER" | cut -d: -f6)"
+
+ARCH="$(uname -m)"
+case "$ARCH" in
+  x86_64)  ARCH=amd64 ;;
+  aarch64) ARCH=arm64 ;;
+  *) die "unsupported architecture: $ARCH" ;;
+esac
+
+# Idempotence: a cluster that already exists is left alone.
+if [[ -f /etc/kubernetes/admin.conf ]]; then
+  log "cluster already initialized (/etc/kubernetes/admin.conf exists) — skipping bootstrap"
+  exit 0
+fi
+
+# ---- OS preparation ---------------------------------------------------------
+log "disabling swap"
+swapoff -a
+sed -ri 's@^([^#].*\sswap\s.*)$@#\1@' /etc/fstab || true
+
+log "loading kernel modules (overlay, br_netfilter)"
+cat >/etc/modules-load.d/k8s.conf <<'EOF'
+overlay
+br_netfilter
+EOF
+modprobe overlay
+modprobe br_netfilter
+
+log "applying sysctl settings"
+cat >/etc/sysctl.d/99-kubernetes.conf <<'EOF'
+net.ipv4.ip_forward                 = 1
+net.bridge.bridge-nf-call-iptables  = 1
+net.bridge.bridge-nf-call-ip6tables = 1
+EOF
+sysctl --system >/dev/null
+
+# ---- containerd -------------------------------------------------------------
+log "installing containerd"
+apt-get update -q
+DEBIAN_FRONTEND=noninteractive apt-get install -qy containerd apt-transport-https ca-certificates curl gpg
+mkdir -p /etc/containerd
+containerd config default >/etc/containerd/config.toml
+# kubelet uses the systemd cgroup driver; containerd must match
+sed -ri 's/(SystemdCgroup\s*=\s*)false/\1true/' /etc/containerd/config.toml
+systemctl restart containerd
+systemctl enable containerd
+
+# ---- kubeadm / kubelet / kubectl --------------------------------------------
+log "installing kubeadm/kubelet/kubectl (${K8S_REPO_MINOR})"
+install -m 0755 -d /etc/apt/keyrings
+curl -fsSL "https://pkgs.k8s.io/core:/stable:/${K8S_REPO_MINOR}/deb/Release.key" \
+  | gpg --dearmor --yes -o /etc/apt/keyrings/kubernetes-apt-keyring.gpg
+echo "deb [signed-by=/etc/apt/keyrings/kubernetes-apt-keyring.gpg] https://pkgs.k8s.io/core:/stable:/${K8S_REPO_MINOR}/deb/ /" \
+  >/etc/apt/sources.list.d/kubernetes.list
+apt-get update -q
+DEBIAN_FRONTEND=noninteractive apt-get install -qy kubelet kubeadm kubectl
+apt-mark hold kubelet kubeadm kubectl
+systemctl enable kubelet
+
+# ---- helm (sha256-verified) -------------------------------------------------
+if [[ "$INSTALL_HELM" == "true" ]] && ! command -v helm >/dev/null 2>&1; then
+  log "installing helm ${HELM_VERSION}"
+  tmp="$(mktemp -d)"
+  tarball="helm-${HELM_VERSION}-linux-${ARCH}.tar.gz"
+  curl -fsSL -o "${tmp}/${tarball}" "https://get.helm.sh/${tarball}"
+  curl -fsSL -o "${tmp}/${tarball}.sha256sum" "https://get.helm.sh/${tarball}.sha256sum"
+  (cd "$tmp" && sha256sum -c "${tarball}.sha256sum" >/dev/null) \
+    || die "helm tarball checksum mismatch"
+  tar -xzf "${tmp}/${tarball}" -C "$tmp"
+  install -m 0755 "${tmp}/linux-${ARCH}/helm" /usr/local/bin/helm
+  rm -rf "$tmp"
+fi
+
+# ---- cluster init -----------------------------------------------------------
+log "kubeadm init (pod CIDR ${POD_CIDR})"
+kubeadm init \
+  --pod-network-cidr="$POD_CIDR" \
+  --node-name="$CLUSTER_NAME" \
+  --skip-phases=addon/kube-proxy   # Cilium replaces kube-proxy
+
+log "configuring kubectl for ${PRIMARY_USER}"
+mkdir -p "${PRIMARY_HOME}/.kube"
+cp /etc/kubernetes/admin.conf "${PRIMARY_HOME}/.kube/config"
+chown -R "$(id -u "$PRIMARY_USER"):$(id -g "$PRIMARY_USER")" "${PRIMARY_HOME}/.kube"
+export KUBECONFIG=/etc/kubernetes/admin.conf
+if ! grep -q 'kubectl completion' "${PRIMARY_HOME}/.bashrc" 2>/dev/null; then
+  echo 'source <(kubectl completion bash)' >>"${PRIMARY_HOME}/.bashrc"
+fi
+
+# ---- Cilium CNI -------------------------------------------------------------
+log "installing cilium CLI"
+if [[ -z "$CILIUM_CLI_VERSION" ]]; then
+  CILIUM_CLI_VERSION="$(curl -fsSL https://raw.githubusercontent.com/cilium/cilium-cli/main/stable.txt)"
+fi
+tmp="$(mktemp -d)"
+cli_tar="cilium-linux-${ARCH}.tar.gz"
+curl -fsSL -o "${tmp}/${cli_tar}" \
+  "https://github.com/cilium/cilium-cli/releases/download/${CILIUM_CLI_VERSION}/${cli_tar}"
+curl -fsSL -o "${tmp}/${cli_tar}.sha256sum" \
+  "https://github.com/cilium/cilium-cli/releases/download/${CILIUM_CLI_VERSION}/${cli_tar}.sha256sum"
+(cd "$tmp" && sha256sum -c "${cli_tar}.sha256sum" >/dev/null) \
+  || die "cilium CLI checksum mismatch"
+tar -xzf "${tmp}/${cli_tar}" -C /usr/local/bin
+rm -rf "$tmp"
+
+log "installing cilium CNI"
+cilium_args=(install --set kubeProxyReplacement=true)
+if [[ "$ENABLE_HUBBLE" == "true" ]]; then
+  cilium_args+=(--set hubble.relay.enabled=true --set hubble.ui.enabled=true)
+fi
+cilium "${cilium_args[@]}"
+
+# Single node: the control-plane taint must go before cilium status --wait,
+# or the cilium-operator pod never schedules and the wait deadlocks.
+log "removing control-plane taint (single-node scheduling)"
+kubectl taint nodes --all node-role.kubernetes.io/control-plane- 2>/dev/null || true
+kubectl taint nodes --all node-role.kubernetes.io/master- 2>/dev/null || true
+
+log "waiting for cilium to become ready"
+cilium status --wait
+
+# ---- monitoring stack (optional) --------------------------------------------
+if [[ "$INSTALL_PROMETHEUS_STACK" == "true" ]]; then
+  log "installing kube-prometheus-stack into ${MONITORING_NS}"
+  helm repo add prometheus-community https://prometheus-community.github.io/helm-charts >/dev/null
+  helm repo update >/dev/null
+  values="$(mktemp)"
+  # Open PodMonitor/Probe discovery across namespaces so the Dynamo-TPU
+  # PodMonitors (created in other namespaces) are scraped.
+  cat >"$values" <<'EOF'
+prometheus:
+  prometheusSpec:
+    podMonitorSelectorNilUsesHelmValues: false
+    podMonitorNamespaceSelector: {}
+    probeNamespaceSelector: {}
+    serviceMonitorSelectorNilUsesHelmValues: false
+    serviceMonitorNamespaceSelector: {}
+grafana:
+  sidecar:
+    dashboards:
+      enabled: true
+      searchNamespace: ALL
+EOF
+  helm upgrade --install prometheus prometheus-community/kube-prometheus-stack \
+    --namespace "$MONITORING_NS" --create-namespace -f "$values" --wait --timeout 10m
+  rm -f "$values"
+
+  log "grafana admin credentials:"
+  user="$(kubectl -n "$MONITORING_NS" get secret prometheus-grafana -o jsonpath='{.data.admin-user}' | base64 -d)"
+  pass="$(kubectl -n "$MONITORING_NS" get secret prometheus-grafana -o jsonpath='{.data.admin-password}' | base64 -d)"
+  echo "    user: ${user}"
+  echo "    pass: ${pass}"
+fi
+
+log "cluster ready:"
+kubectl get nodes -o wide

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Benchmark runner. Layer 6 of the stack (SURVEY.md §1 L6); contract mirrors
+# the reference's run-benchmarks.sh getopts CLI (-u/-m/-o/-b/-p) and its
+# invocation of `python3 -m benchmarks.utils.benchmark`
+# (/root/reference/run-benchmarks.sh:21-72).
+set -euo pipefail
+
+ENDPOINT_URL="${ENDPOINT_URL:-http://127.0.0.1:8000}"
+MODEL="${MODEL:-}"
+OUTPUT_DIR="${OUTPUT_DIR:-./benchmark-results}"
+BENCH_NAME="${BENCH_NAME:-dynamo-tpu}"
+PLOT=false
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+usage() {
+  cat <<EOF
+Usage: $0 -u ENDPOINT_URL -m MODEL [-o OUTPUT_DIR] [-b BENCH_NAME] [-p]
+  -u  endpoint base URL (default: ${ENDPOINT_URL})
+  -m  served model name (required)
+  -o  output directory  (default: ${OUTPUT_DIR})
+  -b  benchmark name    (default: ${BENCH_NAME})
+  -p  also render plots
+EOF
+  exit "${1:-0}"
+}
+
+while getopts "u:m:o:b:ph" opt; do
+  case "$opt" in
+    u) ENDPOINT_URL="$OPTARG" ;;
+    m) MODEL="$OPTARG" ;;
+    o) OUTPUT_DIR="$OPTARG" ;;
+    b) BENCH_NAME="$OPTARG" ;;
+    p) PLOT=true ;;
+    h) usage 0 ;;
+    *) usage 1 ;;
+  esac
+done
+[[ -n "$MODEL" ]] || { echo "ERROR: -m MODEL is required" >&2; usage 1; }
+
+# Prefer the benchmark venv when present (created by setup-benchmark-env.sh);
+# fall back to system python3 — the harness is stdlib-only.
+PY=python3
+if [[ -x "${HERE}/.venv/bin/python3" ]]; then
+  PY="${HERE}/.venv/bin/python3"
+fi
+
+# Sweep shape knobs pass through as env vars (the getopts surface stays the
+# reference's -u/-m/-o/-b/-p contract).
+extra_args=()
+[[ -n "${ISL:-}" ]] && extra_args+=(--isl "$ISL")
+[[ -n "${OSL:-}" ]] && extra_args+=(--osl "$OSL")
+[[ -n "${CONCURRENCY:-}" ]] && extra_args+=(--concurrency "$CONCURRENCY")
+[[ -n "${REQUESTS_PER_LEVEL:-}" ]] && extra_args+=(--requests-per-level "$REQUESTS_PER_LEVEL")
+[[ -n "${NUM_CHIPS:-}" ]] && extra_args+=(--num-chips "$NUM_CHIPS")
+
+mkdir -p "$OUTPUT_DIR"
+(cd "$HERE" && "$PY" -m benchmarks.utils.benchmark \
+  --benchmark-name "$BENCH_NAME" \
+  --endpoint-url "$ENDPOINT_URL" \
+  --model "$MODEL" \
+  --output-dir "$OUTPUT_DIR" \
+  "${extra_args[@]}")
+
+if [[ "$PLOT" == "true" ]]; then
+  (cd "$HERE" && "$PY" -m benchmarks.utils.plot --data-dir "$OUTPUT_DIR")
+fi

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# One-time benchmark environment bootstrap. Layer 6 of the stack (SURVEY.md
+# §1 L6); mirror of the reference's setup-benchmark-env.sh venv flow
+# (/root/reference/setup-benchmark-env.sh:6-42). The harness itself
+# (benchmarks/) ships in this repo and is stdlib-only, so the venv only needs
+# matplotlib for the optional plotting step.
+set -euo pipefail
+
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+VENV="${VENV:-${HERE}/.venv}"
+
+log() { echo "[benchmark-env] $*"; }
+
+if ! python3 -m venv --help >/dev/null 2>&1; then
+  log "installing python3-venv/pip via apt"
+  sudo apt-get update -q
+  sudo DEBIAN_FRONTEND=noninteractive apt-get install -qy python3-venv python3-pip
+fi
+
+if [[ ! -d "$VENV" ]]; then
+  log "creating venv at ${VENV}"
+  python3 -m venv "$VENV"
+fi
+
+# Stdlib-only core; plotting is the only extra. Failure to install it is
+# non-fatal (run-benchmarks.sh -p degrades to a text report).
+"${VENV}/bin/pip" install -q --upgrade pip || true
+"${VENV}/bin/pip" install -q matplotlib || log "WARN: matplotlib install failed; plots degrade to text"
+
+log "done. Run benchmarks with:"
+echo "    ./run-benchmarks.sh -u http://<node-ip>:<nodeport> -m <model> -o ./benchmark-results -b my-run -p"

@@ -80,6 +80,25 @@ def test_chat_completion_streaming(server_url):
     assert all(p["object"] == "chat.completion.chunk" for p in parsed)
 
 
+def test_chat_streaming_include_usage(server_url):
+    resp = post(server_url, "/v1/chat/completions", {
+        "model": MODEL,
+        "messages": [{"role": "user", "content": "usage please"}],
+        "max_tokens": 5, "temperature": 0, "stream": True, "ignore_eos": True,
+        "stream_options": {"include_usage": True},
+    }, raw=True)
+    chunks = []
+    for line in resp:
+        line = line.decode().strip()
+        if line.startswith("data: "):
+            chunks.append(line[6:])
+    assert chunks[-1] == "[DONE]"
+    usage_chunk = json.loads(chunks[-2])
+    assert usage_chunk["choices"] == []
+    assert usage_chunk["usage"]["completion_tokens"] == 5
+    assert usage_chunk["usage"]["prompt_tokens"] > 0
+
+
 def test_completions_endpoint(server_url):
     out = post(server_url, "/v1/completions", {
         "model": MODEL, "prompt": "Once upon", "max_tokens": 4,

@@ -1,0 +1,90 @@
+"""Benchmark harness (benchmarks.utils.benchmark / plot / loadgen) against an
+in-process engine server — the aiperf-analogue contract the reference's
+run-benchmarks.sh drives (/root/reference/run-benchmarks.sh:56-72)."""
+
+import json
+import os
+
+import pytest
+
+from benchmarks.utils import benchmark as bench_mod
+from benchmarks.utils import plot as plot_mod
+from benchmarks.utils.loadgen import LoadConfig, run_load
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.serving.api import ServingContext, make_server, serve_forever_in_thread
+
+MODEL = "tiny-debug"
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    engine = Engine(
+        EngineConfig(model=MODEL, page_size=4, num_pages=128, max_num_seqs=4,
+                     max_seq_len=128)
+    )
+    ctx = ServingContext(engine, MODEL)
+    srv = make_server(ctx, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    ctx.close()
+
+
+def test_loadgen_streaming_metrics(server_url):
+    results = run_load(LoadConfig(
+        endpoint_url=server_url, model=MODEL, num_requests=4, concurrency=2,
+        input_len=8, max_tokens=6,
+    ))
+    assert len(results) == 4
+    ok = [r for r in results if r.ok]
+    assert ok, [r.error for r in results]
+    for r in ok:
+        assert r.ttft_s > 0
+        assert r.latency_s >= r.ttft_s
+        assert r.output_tokens > 0
+
+
+def test_benchmark_cli_writes_summary(server_url, tmp_path):
+    rc = bench_mod.main([
+        "--benchmark-name", "smoke",
+        "--endpoint-url", server_url,
+        "--model", MODEL,
+        "--output-dir", str(tmp_path),
+        "--concurrency", "1,2",
+        "--requests-per-level", "3",
+        "--isl", "8",
+        "--osl", "5",
+    ])
+    assert rc == 0
+    summary_path = tmp_path / "smoke_summary.json"
+    assert summary_path.exists()
+    report = json.loads(summary_path.read_text())
+    assert report["model"] == MODEL
+    assert len(report["sweep"]) == 2
+    best = report["best"]
+    assert best["output_tok_per_s"] > 0
+    assert best["ttft_ms"]["p50"] > 0
+    # per-level files with raw results exist
+    assert (tmp_path / "smoke_c1.json").exists()
+    assert (tmp_path / "smoke_c2.json").exists()
+
+
+def test_plot_falls_back_to_text(server_url, tmp_path):
+    rc = bench_mod.main([
+        "--benchmark-name", "plotme",
+        "--endpoint-url", server_url,
+        "--model", MODEL,
+        "--output-dir", str(tmp_path),
+        "--concurrency", "1",
+        "--requests-per-level", "2",
+        "--isl", "6", "--osl", "4",
+    ])
+    assert rc == 0
+    rc = plot_mod.main(["--data-dir", str(tmp_path)])
+    assert rc == 0
+    assert (tmp_path / "report.txt").exists()
+
+
+def test_plot_empty_dir_errors(tmp_path):
+    assert plot_mod.main(["--data-dir", str(tmp_path)]) == 1

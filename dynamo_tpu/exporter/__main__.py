@@ -1,0 +1,76 @@
+"""Standalone exporter process: `python -m dynamo_tpu.exporter`.
+
+Serves GET /metrics with the tpu_* hardware series — the role DCGM exporter
+plays in the reference's GPU Operator install
+(/root/reference/install-dynamo-1node.sh:266-286). Deployed by
+deploy/tpu-metrics-exporter.yaml.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import threading
+
+from dynamo_tpu.exporter.tpu_exporter import TpuMetricsExporter
+from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
+
+
+class _Handler(JsonHTTPHandler):
+    exporter: TpuMetricsExporter  # bound by make_http_server
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            self._raw(200, self.exporter.registry.expose().encode(),
+                      "text/plain; version=0.0.4")
+        elif self.path in ("/health", "/live", "/ready"):
+            self._json(200, {"status": "ok"})
+        else:
+            self._error(404, f"no route {self.path}")
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    p = argparse.ArgumentParser(prog="dynamo_tpu.exporter")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 9400)))
+    p.add_argument("--interval", type=float,
+                   default=float(os.environ.get("SCRAPE_INTERVAL", "10")))
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.utils.platform import init_backend_with_fallback
+    backend = init_backend_with_fallback()
+    logging.info("tpu exporter on %s:%d (backend=%s)", args.host, args.port,
+                 backend)
+
+    stop = threading.Event()
+    # On TPU nodes the chips are held by the worker process, which exports
+    # in-process (serving/worker.py). A standalone pod falling back to CPU
+    # would export zero-valued tpu_* series that pollute the dashboard
+    # alongside the real ones — so keep the registry empty unless forced.
+    if backend == "cpu" and not os.environ.get("DYNAMO_EXPORTER_FORCE"):
+        logging.warning(
+            "cpu backend and DYNAMO_EXPORTER_FORCE unset: serving /health "
+            "and an empty /metrics, no tpu_* series"
+        )
+        from dynamo_tpu.serving.metrics import Registry
+
+        class _Empty:
+            registry = Registry()
+
+        exp = _Empty()
+    else:
+        exp = TpuMetricsExporter()
+        t = threading.Thread(target=exp.run_forever, args=(args.interval, stop),
+                             daemon=True)
+        t.start()
+    srv = make_http_server(_Handler, {"exporter": exp}, args.host, args.port)
+    try:
+        srv.serve_forever()
+    finally:
+        stop.set()
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,17 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
     )
     srv = make_server(ctx, args.host, args.port)
 
+    # hardware series (tpu_tensorcore_utilization etc.) ride the same
+    # /metrics endpoint — the in-process DCGM-analogue. In-process is the
+    # primary path on TPU: the worker holds the chips (libtpu is
+    # single-process), so only it can report real HBM/duty-cycle numbers.
+    from dynamo_tpu.exporter.tpu_exporter import (
+        attach_to_registry, engine_busy_sampler,
+    )
+    attach_to_registry(ctx.metrics.registry).set_sampler(
+        engine_busy_sampler(engine)
+    )
+
     stop = threading.Event()
     if args.frontend_url:
         self_url = _self_url(args.host, args.port)

@@ -19,7 +19,9 @@ NAMESPACE_RESTRICTED_OPERATOR="${NAMESPACE_RESTRICTED_OPERATOR:-false}"
 ENABLE_GANG_SCHEDULING="${ENABLE_GANG_SCHEDULING:-false}"   # Grove/KAI analogue
 PROMETHEUS_ENDPOINT="${PROMETHEUS_ENDPOINT:-http://prometheus-kube-prometheus-prometheus.monitoring.svc.cluster.local:9090}"
 INSTALL_TPU_PLUGIN="${INSTALL_TPU_PLUGIN:-true}"
-INSTALL_TPU_EXPORTER="${INSTALL_TPU_EXPORTER:-true}"
+# standalone exporter DaemonSet is a debug fallback only — the primary
+# hardware-metrics path is in-process in the engine workers
+INSTALL_TPU_EXPORTER="${INSTALL_TPU_EXPORTER:-false}"
 TPU_REQUIRED="${TPU_REQUIRED:-false}"           # hard-fail if no google.com/tpu allocatable
 TPU_POLL_RETRIES="${TPU_POLL_RETRIES:-120}"
 TPU_POLL_INTERVAL="${TPU_POLL_INTERVAL:-5}"

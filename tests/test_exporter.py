@@ -1,0 +1,90 @@
+"""TPU metrics exporter: gauge exposition, sampler override, HTTP surface."""
+
+import urllib.request
+
+from dynamo_tpu.exporter.tpu_exporter import TpuMetricsExporter, attach_to_registry
+from dynamo_tpu.serving.http_base import make_http_server, serve_forever_in_thread
+from dynamo_tpu.serving.metrics import Registry
+
+
+def test_collect_once_exports_all_devices():
+    exp = TpuMetricsExporter()
+    n = exp.collect_once()
+    assert n >= 1  # conftest forces 8 virtual CPU devices
+    text = exp.registry.expose()
+    assert "tpu_tensorcore_utilization" in text
+    assert "tpu_hbm_memory_usage_bytes" in text
+    assert "tpu_hbm_memory_total_bytes" in text
+    assert "tpu_power_usage_watts" in text
+    assert 'device="0"' in text
+
+
+def test_sampler_overrides_series():
+    exp = TpuMetricsExporter()
+    exp.set_sampler(lambda: {0: {"util_pct": 73.5, "hbm_used": 1024.0,
+                                 "hbm_total": 4096.0, "power_w": 150.0}})
+    exp.collect_once()
+    text = exp.registry.expose()
+    assert "73.5" in text
+    assert "150.0" in text
+
+
+def test_sampler_failure_is_nonfatal():
+    exp = TpuMetricsExporter()
+
+    def boom():
+        raise RuntimeError("sensor offline")
+
+    exp.set_sampler(boom)
+    assert exp.collect_once() >= 1
+
+
+def test_http_surface():
+    from dynamo_tpu.exporter.__main__ import _Handler
+
+    exp = TpuMetricsExporter()
+    exp.collect_once()
+    srv = make_http_server(_Handler, {"exporter": exp}, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        text = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+        assert "tpu_tensorcore_utilization" in text
+        health = urllib.request.urlopen(url + "/health", timeout=10).read().decode()
+        assert "ok" in health
+    finally:
+        srv.shutdown()
+
+
+def test_attach_to_shared_registry():
+    reg = Registry()
+    exp = attach_to_registry(reg, interval_s=3600)
+    exp.collect_once()
+    assert "tpu_hbm_memory_usage_bytes" in reg.expose()
+
+
+def test_engine_busy_sampler_reports_duty_cycle():
+    import time as _time
+
+    from dynamo_tpu.exporter.tpu_exporter import engine_busy_sampler
+
+    class FakeMetrics:
+        prefill_time_s = 0.0
+        decode_time_s = 0.0
+
+    class FakeEngine:
+        metrics = FakeMetrics()
+
+    sampler = engine_busy_sampler(FakeEngine())
+    sampler()  # establish the baseline window
+    _time.sleep(0.05)
+    FakeEngine.metrics.decode_time_s = 0.025  # ~half the window busy
+    out = sampler()
+    utils = {s["util_pct"] for s in out.values()}
+    assert len(utils) == 1  # SPMD: same value on every device
+    util = utils.pop()
+    assert 10.0 < util <= 100.0
+    # idle window after the burst reads ~0
+    _time.sleep(0.02)
+    out2 = sampler()
+    assert all(s["util_pct"] < 5.0 for s in out2.values())

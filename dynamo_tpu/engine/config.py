@@ -38,6 +38,9 @@ class EngineConfig:
     tensor_parallel: int = 1
     data_parallel: int = 1
     expert_parallel: int = 1
+    # MoE prefill dispatch: 0 = exact dense-masked; > 0 enables the
+    # capacity-gather path with this capacity factor (ops/moe.py)
+    moe_capacity_factor: float = 0.0
 
     # disaggregation (NIXL-contract mirror)
     disaggregation_mode: str = "agg"  # agg | prefill | decode
@@ -73,6 +76,7 @@ class EngineConfig:
         p.add_argument("--tp", "--tensor-parallel-size", type=int, default=1, dest="tp")
         p.add_argument("--dp", type=int, default=1)
         p.add_argument("--ep", type=int, default=1)
+        p.add_argument("--moe-capacity-factor", type=float, default=0.0)
         p.add_argument("--disaggregation-mode", default="agg",
                        choices=["agg", "prefill", "decode"])
         p.add_argument("--is-prefill-worker", action="store_true")
@@ -105,6 +109,7 @@ class EngineConfig:
             tensor_parallel=args.tp,
             data_parallel=args.dp,
             expert_parallel=args.ep,
+            moe_capacity_factor=args.moe_capacity_factor,
             disaggregation_mode=mode,
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,

@@ -88,9 +88,17 @@ class Engine:
         self.cfg = cfg
         backend = jax.default_backend()
         default_dtype = "float32" if backend == "cpu" else "bfloat16"
-        self.model_cfg = model_cfg or ModelConfig.from_model_name(
-            cfg.model_path or cfg.model, dtype=cfg.dtype or default_dtype
-        )
+        if model_cfg is None:
+            model_cfg = ModelConfig.from_model_name(
+                cfg.model_path or cfg.model, dtype=cfg.dtype or default_dtype
+            )
+        if cfg.moe_capacity_factor > 0:
+            import dataclasses as _dc
+
+            model_cfg = _dc.replace(
+                model_cfg, moe_capacity_factor=cfg.moe_capacity_factor
+            )
+        self.model_cfg = model_cfg
         self.mesh = build_mesh(
             MeshConfig(
                 tensor_parallel=cfg.tensor_parallel,

@@ -37,6 +37,12 @@ class ModelConfig:
     # MoE (mixtral/deepseek-style). num_experts == 0 -> dense MLP.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # capacity factor for the prefill dispatch path (ops/moe.py). 0 (default)
+    # = exact dense-masked dispatch everywhere; > 0 enables the capacity-based
+    # gather for prefill-sized batches (~X/k fewer expert-MLP FLOPs), where
+    # tokens past an expert's capacity drop that expert — a throughput/
+    # fidelity trade the operator opts into per deployment
+    moe_capacity_factor: float = 0.0
     # dtype for params/compute (bfloat16 on TPU; float32 for CPU tests)
     dtype: str = "bfloat16"
     eos_token_id: int = 2

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops import attention as att
+from dynamo_tpu.ops import moe as moe_ops
 from dynamo_tpu.ops.rope import apply_rope
 
 Params = Dict[str, jax.Array]
@@ -117,28 +118,42 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array):
     return q, k, v
 
 
-def _mlp(cfg: ModelConfig, lp: Params, x: jax.Array) -> jax.Array:
-    """SwiGLU MLP or MoE block. x: [T, E]."""
+def _mlp(cfg: ModelConfig, lp: Params, x: jax.Array,
+         token_mask: jax.Array | None = None,
+         allow_capacity: bool = False) -> jax.Array:
+    """SwiGLU MLP or MoE block. x: [T, E]; token_mask: [T] bool, False for
+    padding rows (prefill pads to a page multiple). The capacity-gather MoE
+    path is prefill-only (allow_capacity): decode batches contain inactive
+    slots with no mask to exclude them, and are small enough that dense
+    dispatch wins anyway."""
     if not cfg.is_moe:
         g = jnp.einsum("te,ef->tf", x, lp["w_gate"])
         u = jnp.einsum("te,ef->tf", x, lp["w_up"])
         return jnp.einsum("tf,fe->te", jax.nn.silu(g) * u, lp["w_down"])
-    # MoE: top-k routing, dense expert compute (every expert sees every token;
-    # the weighting zeroes non-selected experts). Correct and simple; the
-    # expert-parallel dispatch path optimises this under `shard_map` later.
+    # MoE: top-k routing into a dense [T, X] combine matrix, then one of two
+    # dispatch paths (dynamo_tpu.ops.moe): exact dense-masked by default;
+    # capacity-based gather (T*k*cf expert-MLP rows instead of T*X) when the
+    # deployment opts in via moe_capacity_factor > 0. Both partition over the
+    # `expert` mesh axis via the sharding rules on moe_w_*.
     logits = jnp.einsum("te,ex->tx", x, lp["router"]).astype(jnp.float32)
-    topv, topi = jax.lax.top_k(logits, cfg.num_experts_per_tok)
-    weights = jax.nn.softmax(topv, axis=-1).astype(x.dtype)  # [T, K]
-    # scatter the top-k weights back to a dense [T, X] combine matrix
-    combine = (
-        jnp.zeros(logits.shape, x.dtype)
-        .at[jnp.arange(x.shape[0])[:, None], topi]
-        .add(weights)
+    combine = moe_ops.topk_combine(logits, cfg.num_experts_per_tok, x.dtype)
+    if token_mask is not None:
+        # padding rows must not claim expert capacity (nor compute)
+        combine = combine * token_mask.astype(combine.dtype)[:, None]
+    t = x.shape[0]
+    if allow_capacity and cfg.moe_capacity_factor > 0:
+        cap = moe_ops.expert_capacity(
+            t, cfg.num_experts, cfg.num_experts_per_tok,
+            cfg.moe_capacity_factor,
+        )
+        if cap < t:  # gather only pays off when capacity actually cuts rows
+            return moe_ops.moe_mlp_dropping(
+                x, combine, lp["moe_w_gate"], lp["moe_w_up"],
+                lp["moe_w_down"], capacity=cap,
+            )
+    return moe_ops.moe_mlp_dense(
+        x, combine, lp["moe_w_gate"], lp["moe_w_up"], lp["moe_w_down"]
     )
-    g = jnp.einsum("te,xef->txf", x, lp["moe_w_gate"])
-    u = jnp.einsum("te,xef->txf", x, lp["moe_w_up"])
-    y = jnp.einsum("txf,xfe->txe", jax.nn.silu(g) * u, lp["moe_w_down"])
-    return jnp.einsum("txe,tx->te", y, combine)
 
 
 class PrefillOut(NamedTuple):
@@ -171,6 +186,7 @@ def prefill(
     """
     s = tokens.shape[0]
     positions = jnp.arange(s)
+    token_mask = positions < seq_len  # padding rows past the true length
     x = jnp.take(params["embed"], tokens, axis=0).astype(_dtype(cfg))
 
     def body(x, scanned):
@@ -181,7 +197,7 @@ def prefill(
         x = x + jnp.einsum("thd,hde->te", o, lp["wo"])
         kp, vp = att.write_kv_prefill(kp, vp, k, v, pages, page_size=page_size)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(cfg, lp, h)
+        x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
         return x, (kp, vp)
 
     x, (k_pages, v_pages) = jax.lax.scan(

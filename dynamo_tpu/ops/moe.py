@@ -1,0 +1,93 @@
+"""Mixture-of-experts dispatch paths.
+
+The reference serves MoE models through its consumed engines (BASELINE.json
+config #5: Mixtral/DeepSeek expert-parallel via the smart router); here the
+expert compute itself is TPU-native. Two paths, both jit-safe and
+GSPMD-partitionable over the `expert` mesh axis (sharding rules in
+dynamo_tpu.parallel.sharding map moe_w_* onto P('expert', ...)):
+
+- `moe_mlp_dense`: every expert processes every token, the top-k combine
+  matrix zeroes the rest. No gathers, no token drops; the right choice for
+  small decode batches where dispatch overhead dominates.
+- `moe_mlp_dropping`: capacity-based dispatch for prefill-sized token counts.
+  Each expert gathers its top-C tokens by router weight (C = T*k/X * cf),
+  computes only those, and scatter-adds the weighted outputs. FLOPs drop from
+  T*X expert-MLPs to C*X ≈ T*k*cf — a 4x cut for Mixtral (X=8, k=2) — and
+  under expert-parallel sharding XLA partitions the leading X axis so each
+  device touches only its local experts. Tokens past an expert's capacity are
+  dropped (standard capacity-factor semantics); cf defaults to 1.25.
+
+The dense combine matrix [T, X] is the single interface between routing and
+dispatch, so both paths share the router code in models/llama.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_combine(logits: jax.Array, k: int, dtype) -> jax.Array:
+    """Router logits [T, X] -> dense combine matrix [T, X]: softmaxed top-k
+    weights scattered back, zeros elsewhere."""
+    topv, topi = jax.lax.top_k(logits, k)
+    weights = jax.nn.softmax(topv, axis=-1).astype(dtype)  # [T, K]
+    t = logits.shape[0]
+    return (
+        jnp.zeros(logits.shape, dtype)
+        .at[jnp.arange(t)[:, None], topi]
+        .add(weights)
+    )
+
+
+def moe_mlp_dense(
+    x: jax.Array,        # [T, E]
+    combine: jax.Array,  # [T, X]
+    w_gate: jax.Array,   # [X, E, F]
+    w_up: jax.Array,
+    w_down: jax.Array,   # [X, F, E]
+) -> jax.Array:
+    """All experts see all tokens; combine zeroes non-selected outputs."""
+    g = jnp.einsum("te,xef->txf", x, w_gate)
+    u = jnp.einsum("te,xef->txf", x, w_up)
+    y = jnp.einsum("txf,xfe->txe", jax.nn.silu(g) * u, w_down)
+    return jnp.einsum("txe,tx->te", y, combine)
+
+
+def expert_capacity(num_tokens: int, num_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert token capacity (multiple of 8 for TPU lane tiling)."""
+    c = int(num_tokens * k / num_experts * capacity_factor)
+    c = max(8, -(-c // 8) * 8)  # round up to 8
+    return min(c, num_tokens)
+
+
+def moe_mlp_dropping(
+    x: jax.Array,        # [T, E]
+    combine: jax.Array,  # [T, X] dense combine matrix
+    w_gate: jax.Array,   # [X, E, F]
+    w_up: jax.Array,
+    w_down: jax.Array,   # [X, F, E]
+    *,
+    capacity: int,
+) -> jax.Array:
+    """Capacity-based dispatch: each expert computes only its top-C tokens.
+
+    Gather/scatter are batched on the leading X axis, so expert-parallel
+    sharding keeps every step local to the expert's device; the final
+    scatter-add contracts the X axis (XLA inserts the psum over `expert`).
+    """
+    t, e = x.shape
+    # per-expert token selection by routing weight: [X, C] indices into T
+    weights_xt = combine.T  # [X, T]
+    sel_w, sel_i = jax.lax.top_k(weights_xt, capacity)  # [X, C]
+    xg = jnp.take(x, sel_i, axis=0)  # [X, C, E]
+    g = jnp.einsum("xce,xef->xcf", xg, w_gate)
+    u = jnp.einsum("xce,xef->xcf", xg, w_up)
+    y = jnp.einsum("xcf,xfe->xce", jax.nn.silu(g) * u, w_down)  # [X, C, E]
+    # weight by routing prob; zero-weight slots (capacity padding for experts
+    # with fewer selected tokens) contribute nothing
+    y = y * sel_w[..., None].astype(y.dtype)
+    out = jnp.zeros((t, e), y.dtype)
+    out = out.at[sel_i.reshape(-1)].add(y.reshape(-1, e))
+    return out

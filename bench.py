@@ -7,7 +7,8 @@ on TPU v5e). On TPU this runs the flagship Llama-3.2-1B architecture
 reachable it falls back to a CPU-sized model and reports against the same
 baseline so the metric line is always produced.
 
-Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_STEPS, BENCH_PROMPT_LEN, BENCH_FORCE_CPU.
+Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_STEPS, BENCH_PROMPT_LEN,
+BENCH_MULTISTEP (fused decode steps per dispatch; 1 disables), BENCH_FORCE_CPU.
 """
 
 from __future__ import annotations
@@ -18,6 +19,13 @@ import time
 
 
 def _init_backend() -> str:
+    # persistent XLA compilation cache: repeat bench runs skip the multi-second
+    # jit compiles (the TRT-engine-build analogue, SURVEY.md §5)
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "dynamo_tpu",
+                     "jax-comp-cache"),
+    )
     from dynamo_tpu.utils.platform import force_cpu, init_backend_with_fallback
 
     if os.environ.get("BENCH_FORCE_CPU"):
@@ -38,9 +46,12 @@ def main() -> None:
     model = os.environ.get(
         "BENCH_MODEL", "llama-3.2-1b-instruct" if on_tpu else "tiny-debug"
     )
-    batch = int(os.environ.get("BENCH_BATCH", "32" if on_tpu else "4"))
+    batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "4"))
     steps = int(os.environ.get("BENCH_STEPS", "128" if on_tpu else "32"))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128" if on_tpu else "16"))
+    # multi-step decode amortises the per-dispatch host round-trip (large on
+    # tunneled TPU backends) across a window of fused steps
+    multistep = int(os.environ.get("BENCH_MULTISTEP", "16" if on_tpu else "4"))
     max_seq = prompt_len + steps + 8
 
     eng = Engine(
@@ -50,15 +61,20 @@ def main() -> None:
             num_pages=batch * ((max_seq + 15) // 16) + 8,
             max_num_seqs=batch,
             max_seq_len=max_seq,
+            num_scheduler_steps=multistep,
         )
     )
 
     prompts = [[(i * 7 + j) % 200 + 1 for j in range(prompt_len)] for i in range(batch)]
+    # warmup compiles prefill + BOTH decode paths (the fused multi-step window
+    # needs every sequence to have >= multistep tokens of headroom, so warm
+    # generations must be long enough to trigger it)
     for i, p in enumerate(prompts):
         eng.add_request(
-            GenRequest(f"warm{i}", p, max_tokens=4, temperature=0.0, ignore_eos=True)
+            GenRequest(f"warm{i}", p, max_tokens=max(4, 2 * multistep),
+                       temperature=0.0, ignore_eos=True)
         )
-    while eng.has_work:  # warmup: compiles prefill + decode
+    while eng.has_work:
         eng.step()
 
     for i, p in enumerate(prompts):

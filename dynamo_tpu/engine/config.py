@@ -49,6 +49,12 @@ class EngineConfig:
 
     seed: int = 0
 
+    # multi-step decode: fuse this many decode iterations into one jit
+    # dispatch (lax.scan with on-device sampling). Amortises per-step host
+    # round-trips — the dominant cost on networked TPU backends — at the cost
+    # of token-burst granularity in streams. 1 = classic per-token stepping.
+    num_scheduler_steps: int = 1
+
     # runtime
     enforce_eager: bool = False  # skip jit (debug only)
     # attention kernel backend: auto (Pallas on TPU, XLA elsewhere) | xla |
@@ -77,6 +83,7 @@ class EngineConfig:
         p.add_argument("--dp", type=int, default=1)
         p.add_argument("--ep", type=int, default=1)
         p.add_argument("--moe-capacity-factor", type=float, default=0.0)
+        p.add_argument("--num-scheduler-steps", type=int, default=1)
         p.add_argument("--disaggregation-mode", default="agg",
                        choices=["agg", "prefill", "decode"])
         p.add_argument("--is-prefill-worker", action="store_true")
@@ -110,6 +117,7 @@ class EngineConfig:
             data_parallel=args.dp,
             expert_parallel=args.ep,
             moe_capacity_factor=args.moe_capacity_factor,
+            num_scheduler_steps=args.num_scheduler_steps,
             disaggregation_mode=mode,
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,

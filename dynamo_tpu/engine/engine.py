@@ -147,7 +147,25 @@ class Engine:
         self._parked: Dict[str, tuple] = {}
 
         self.rng = jax.random.PRNGKey(cfg.seed)
+        # --- device-resident decode state ---
+        # The decode hot loop keeps (cur_tokens, positions, context_lens, rng)
+        # and the block-table / sampling arrays on device between windows, so
+        # a steady-state window costs ONE dispatch + ONE token download — on
+        # networked TPU backends the per-transfer round-trip, not compute, is
+        # the decode bottleneck. Host mirrors stay authoritative; any
+        # membership/page/sampling mutation invalidates the matching device
+        # copy and it is rebuilt from mirrors before the next window.
+        self._dev_state = None  # (cur_tokens, positions, context_lens)
+        self._dev_tables = None
+        self._dev_sampling = None  # (temperature, top_p, top_k)
+        self._dev_key = None
         self._build_jit()
+
+    def _invalidate_dev(self, tables_only: bool = False):
+        self._dev_tables = None
+        if not tables_only:
+            self._dev_state = None
+            self._dev_sampling = None
 
     # ------------------------------------------------------------------ jit --
 
@@ -162,17 +180,48 @@ class Engine:
             )
             return out.last_logits, out.k_pages, out.v_pages
 
-        def decode_fn(
-            params, tokens, positions, block_tables, context_lens,
-            k_pages, v_pages, temperature, top_p, top_k, key,
-        ):
-            out = llama.decode_step(
-                mcfg, params, tokens, positions, block_tables, context_lens,
-                k_pages, v_pages, page_size=page_size,
-            )
-            state = smp.SamplingState(temperature, top_p, top_k)
-            next_tokens = smp.sample(out.logits, state, key)
-            return next_tokens, out.k_pages, out.v_pages
+        def make_decode_window(n_steps: int):
+            """n_steps fused decode iterations in one dispatch: lax.scan over
+            the step body with on-device sampling AND the batch state carried
+            on device, so a steady-state window costs one dispatch + one
+            token download instead of ~9 host round-trips."""
+
+            def window_fn(
+                params, tokens, positions, context_lens, active, block_tables,
+                temperature, top_p, top_k, key, k_pages, v_pages,
+            ):
+                state = smp.SamplingState(temperature, top_p, top_k)
+                step = active.astype(positions.dtype)  # inactive slots frozen
+
+                def body(carry, subkey):
+                    toks, pos, ctx_lens, kp, vp = carry
+                    out = llama.decode_step(
+                        mcfg, params, toks, pos, block_tables, ctx_lens,
+                        kp, vp, page_size=page_size,
+                    )
+                    nxt = smp.sample(out.logits, state, subkey)
+                    # inactive slots stay pinned at position 0 / context 1 so
+                    # their trash-page work never grows between rebuilds
+                    return (
+                        nxt, pos + step, ctx_lens + step,
+                        out.k_pages, out.v_pages,
+                    ), nxt
+
+                key, sub = jax.random.split(key)
+                keys = jax.random.split(sub, n_steps)
+                carry, toks = jax.lax.scan(
+                    body, (tokens, positions, context_lens, k_pages, v_pages),
+                    keys,
+                )
+                tokens, positions, context_lens, k_pages, v_pages = carry
+                # toks: [n_steps, B]
+                return (toks, tokens, positions, context_lens, key,
+                        k_pages, v_pages)
+
+            return window_fn
+
+        decode_fn = make_decode_window(1)
+        decode_multi_fn = make_decode_window(max(1, cfg.num_scheduler_steps))
 
         def sample_one(logits, temperature, top_p, top_k, key):
             state = smp.SamplingState(temperature, top_p, top_k)
@@ -203,12 +252,17 @@ class Engine:
         if cfg.enforce_eager:
             self._prefill = ctx(prefill_fn)
             self._decode = ctx(decode_fn)
+            self._decode_multi = ctx(decode_multi_fn)
             self._sample_one = ctx(sample_one)
             self._import = ctx(import_fn)
         else:
-            # donate KV pools: XLA updates them in place in HBM
+            # donate KV pools + carried decode state: XLA updates in place
+            # (active mask and block tables are reused across windows)
+            window_donate = (1, 2, 3, 9, 10, 11)  # tokens/pos/ctx/key/k/v
             self._prefill = ctx(jax.jit(prefill_fn, donate_argnums=(3, 4)))
-            self._decode = ctx(jax.jit(decode_fn, donate_argnums=(5, 6)))
+            self._decode = ctx(jax.jit(decode_fn, donate_argnums=window_donate))
+            self._decode_multi = ctx(jax.jit(decode_multi_fn,
+                                             donate_argnums=window_donate))
             self._sample_one = ctx(jax.jit(sample_one))
             self._import = ctx(jax.jit(import_fn, donate_argnums=(0, 1)))
 
@@ -384,6 +438,7 @@ class Engine:
         self.top_p[slot] = req.top_p
         self.top_k[slot] = req.top_k
         self.metrics.output_tokens += 1
+        self._invalidate_dev()  # new membership -> rebuild device batch state
 
         finished, reason = self._check_stop(seq, first)
         ev = TokenEvent(req.request_id, first, 0, finished, reason)
@@ -391,73 +446,140 @@ class Engine:
             self._finish_slot(slot, reason)
         return ev
 
+    def _window_steps(self) -> int:
+        """How many decode steps the next dispatch may fuse (1 = classic).
+
+        The multi-step window requires every active sequence to have at least
+        K tokens of headroom (max_tokens, max_seq_len, block-table columns) so
+        no stop condition or table overflow can occur mid-window, and no
+        pending prefills waiting for a slot (admission latency beats batching
+        round-trips)."""
+        k = self.cfg.num_scheduler_steps
+        if k <= 1 or self.pending or not self.seqs:
+            return 1
+        pmax_tokens = self.cfg.max_pages_per_seq * self.cfg.page_size
+        for seq in self.seqs.values():
+            n_out = len(seq.output_tokens)
+            headroom = min(
+                seq.max_tokens - n_out,
+                self.cfg.max_seq_len - (seq.prompt_len + n_out),
+                pmax_tokens - seq.num_tokens,
+            )
+            if headroom < k:
+                return 1
+        return k
+
+    def _grow_pages(self, window: int, events: List[TokenEvent]) -> int:
+        """Ensure every active sequence has KV pages for the next `window`
+        tokens (positions num_tokens .. num_tokens+window-1). Falls back to a
+        1-token window if the pool can't cover the full window; sequences that
+        can't even get one page finish with kv_oom."""
+        cfg = self.cfg
+        if window > 1:
+            need_total = 0
+            for seq in self.seqs.values():
+                last_page = (seq.num_tokens + window - 1) // cfg.page_size
+                need_total += max(0, last_page + 1 - len(seq.pages))
+            if not self.allocator.can_alloc(need_total):
+                window = 1
+
+        for slot, seq in list(self.seqs.items()):
+            last_page = (seq.num_tokens + window - 1) // cfg.page_size
+            need = max(0, last_page + 1 - len(seq.pages))
+            if need == 0:
+                continue
+            if not self.allocator.can_alloc(need):
+                self.metrics.kv_oom += 1
+                events.append(
+                    TokenEvent(
+                        seq.request_id, -1, len(seq.output_tokens), True, "kv_oom"
+                    )
+                )
+                self._finish_slot(slot, "kv_oom")
+                continue
+            for page in self.allocator.alloc(need):
+                seq.pages.append(page)
+                self.block_tables[slot, len(seq.pages) - 1] = page
+            self._invalidate_dev(tables_only=True)
+        return window
+
     def _decode_once(self) -> List[TokenEvent]:
         t0 = time.monotonic()
         cfg = self.cfg
         events: List[TokenEvent] = []
 
-        # grow page lists for sequences whose next token starts a new page
-        for slot, seq in list(self.seqs.items()):
-            if seq.needs_page(cfg.page_size):
-                if not self.allocator.can_alloc(1):
-                    self.metrics.kv_oom += 1
-                    events.append(
-                        TokenEvent(
-                            seq.request_id, -1, len(seq.output_tokens), True, "kv_oom"
-                        )
-                    )
-                    self._finish_slot(slot, "kv_oom")
-                    continue
-                page = self.allocator.alloc(1)[0]
-                seq.pages.append(page)
-                self.block_tables[slot, len(seq.pages) - 1] = page
+        window = self._grow_pages(self._window_steps(), events)
 
         if not self.seqs:
             return events
 
-        for slot, seq in self.seqs.items():
-            self.positions[slot] = seq.num_tokens
-            self.context_lens[slot] = seq.num_tokens + 1
-        # inactive slots: position 0 / trash page / context 1 (masked by result drop)
-        active = set(self.seqs)
-        for slot in range(cfg.max_num_seqs):
-            if slot not in active:
-                self.positions[slot] = 0
-                self.context_lens[slot] = 1
-                self.block_tables[slot, :] = 0
+        # rebuild invalidated device state from the host mirrors
+        if self._dev_state is None:
+            active = set(self.seqs)
+            for slot in range(cfg.max_num_seqs):
+                seq = self.seqs.get(slot)
+                if seq is not None:
+                    self.cur_tokens[slot] = seq.output_tokens[-1]
+                    self.positions[slot] = seq.num_tokens
+                    self.context_lens[slot] = seq.num_tokens + 1
+                else:
+                    # inactive: position 0 / trash page / context 1
+                    self.positions[slot] = 0
+                    self.context_lens[slot] = 1
+                    self.block_tables[slot, :] = 0
+            active_mask = np.zeros((cfg.max_num_seqs,), np.bool_)
+            active_mask[list(active)] = True
+            self._dev_state = (
+                jnp.asarray(self.cur_tokens),
+                jnp.asarray(self.positions),
+                jnp.asarray(self.context_lens),
+                jnp.asarray(active_mask),
+            )
+            self._dev_tables = None  # block_tables zeroed above for inactive
+        if self._dev_tables is None:
+            self._dev_tables = jnp.asarray(self.block_tables)
+        if self._dev_sampling is None:
+            self._dev_sampling = (
+                jnp.asarray(self.temperature),
+                jnp.asarray(self.top_p),
+                jnp.asarray(self.top_k),
+            )
+        if self._dev_key is None:
+            self.rng, sub = jax.random.split(self.rng)
+            self._dev_key = sub
 
-        self.rng, key = jax.random.split(self.rng)
-        next_tokens, self.k_pages, self.v_pages = self._decode(
-            self.params,
-            jnp.asarray(self.cur_tokens),
-            jnp.asarray(self.positions),
-            jnp.asarray(self.block_tables),
-            jnp.asarray(self.context_lens),
-            self.k_pages,
-            self.v_pages,
-            jnp.asarray(self.temperature),
-            jnp.asarray(self.top_p),
-            jnp.asarray(self.top_k),
-            key,
+        cur, pos, ctx_lens, active_dev = self._dev_state
+        temp, top_p, top_k = self._dev_sampling
+        fn = self._decode_multi if window > 1 else self._decode
+        (toks, cur, pos, ctx_lens, self._dev_key, self.k_pages,
+         self.v_pages) = fn(
+            self.params, cur, pos, ctx_lens, active_dev, self._dev_tables,
+            temp, top_p, top_k, self._dev_key, self.k_pages, self.v_pages,
         )
-        next_np = np.asarray(next_tokens)
-        self.metrics.decode_steps += 1
+        self._dev_state = (cur, pos, ctx_lens, active_dev)
+        next_np = np.asarray(toks)  # [window, B] — the only download
+        self.metrics.decode_steps += window
         self.metrics.decode_time_s += time.monotonic() - t0
 
         for slot, seq in list(self.seqs.items()):
-            tok = int(next_np[slot])
-            seq.num_tokens += 1  # the token we just attended over is now cached
-            seq.output_tokens.append(tok)
-            self.cur_tokens[slot] = tok
-            self.metrics.output_tokens += 1
-            finished, reason = self._check_stop(seq, tok)
-            events.append(
-                TokenEvent(
-                    seq.request_id, tok, len(seq.output_tokens) - 1, finished, reason
+            for k in range(window):
+                tok = int(next_np[k, slot])
+                seq.num_tokens += 1  # the attended token is now cached
+                seq.output_tokens.append(tok)
+                self.cur_tokens[slot] = tok
+                self.metrics.output_tokens += 1
+                finished, reason = self._check_stop(seq, tok)
+                events.append(
+                    TokenEvent(
+                        seq.request_id, tok, len(seq.output_tokens) - 1,
+                        finished, reason,
+                    )
                 )
-            )
-            if finished:
-                self._finish_slot(slot, reason)
+                if finished:
+                    # mid-window stop: later window tokens for this slot are
+                    # discarded (their KV lives in pages freed right here)
+                    self._finish_slot(slot, reason)
+                    break
         return events
 
     def _check_stop(self, seq: SeqState, token: int):
@@ -478,6 +600,9 @@ class Engine:
         self.context_lens[slot] = 0
         self._free_slots.append(slot)
         self.metrics.num_finished += 1
+        # the freed slot's device-side block-table row must stop pointing at
+        # the released pages before the next decode window
+        self._invalidate_dev()
 
     # --------------------------------------------------- disaggregation API --
 
@@ -590,6 +715,7 @@ class Engine:
         self.top_k[slot] = req.top_k
         self.metrics.num_requests += 1
         self.metrics.output_tokens += 1
+        self._invalidate_dev()  # new membership -> rebuild device batch state
         return False, None
 
     # ------------------------------------------------------------ conveniences

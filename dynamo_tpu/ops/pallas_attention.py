@@ -68,10 +68,12 @@ def _decode_kernel(
     bt_ref,  # [B, Pmax] int32 block table
     cl_ref,  # [B] int32 context lens (incl. current token)
     # blocks
-    q_ref,  # [1, G, D]
+    q_ref,  # [1, 1, G, D] — 4D so the block equals the trailing array dims
+    #         exactly (TPU tiling requires last-two block dims divisible by
+    #         (8, 128) OR equal to the array dims; G can be small)
     k_ref,  # [1, 1, ps, D]
     v_ref,  # [1, 1, ps, D]
-    o_ref,  # [1, G, D]
+    o_ref,  # [1, 1, G, D]
     # scratch
     m_ref,  # [G, 128] f32 running max
     l_ref,  # [G, 128] f32 running denominator
@@ -95,7 +97,7 @@ def _decode_kernel(
     # (their DMA still runs; the grid is static).
     @pl.when(page_start < ctx)
     def _attend():
-        q = q_ref[0].astype(jnp.float32)  # [G, D]
+        q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
         k = k_ref[0, 0].astype(jnp.float32)  # [ps, D]
         v = v_ref[0, 0].astype(jnp.float32)
         s = (
@@ -110,7 +112,7 @@ def _decode_kernel(
 
     @pl.when(i == pages_per_seq - 1)
     def _finalize():
-        o_ref[0] = _flash_normalize(l_ref, acc_ref).astype(o_ref.dtype)
+        o_ref[0, 0] = _flash_normalize(l_ref, acc_ref).astype(o_ref.dtype)
 
 
 def paged_attention_decode(
@@ -129,11 +131,17 @@ def paged_attention_decode(
     pmax = block_table.shape[1]
     scale = 1.0 / (head_dim**0.5)
 
+    # [B, KV, G, D]: GQA query heads are contiguous per KV head, and the 4D
+    # layout lets the q/o blocks equal the trailing array dims exactly.
+    q4 = q.reshape(bsz, n_kv, group, head_dim)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(bsz, n_kv, pmax),
         in_specs=[
-            pl.BlockSpec((1, group, head_dim), lambda b, h, i, bt, cl: (b, h, 0)),
+            pl.BlockSpec(
+                (1, 1, group, head_dim), lambda b, h, i, bt, cl: (b, h, 0, 0)
+            ),
             pl.BlockSpec(
                 (1, 1, page_size, head_dim),
                 lambda b, h, i, bt, cl: (h, bt[b, i], 0, 0),
@@ -144,7 +152,7 @@ def paged_attention_decode(
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, group, head_dim), lambda b, h, i, bt, cl: (b, h, 0)
+            (1, 1, group, head_dim), lambda b, h, i, bt, cl: (b, h, 0, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((group, 128), jnp.float32),
@@ -155,15 +163,16 @@ def paged_attention_decode(
     kernel = functools.partial(
         _decode_kernel, page_size=page_size, pages_per_seq=pmax, scale=scale
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((bsz, n_heads, head_dim), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bsz, n_kv, group, head_dim), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(block_table.astype(jnp.int32), context_lens.astype(jnp.int32), q, k_pages, v_pages)
+    )(block_table.astype(jnp.int32), context_lens.astype(jnp.int32), q4, k_pages, v_pages)
+    return out.reshape(bsz, n_heads, head_dim)
 
 
 # ----------------------------------------------------------------- prefill --

@@ -127,3 +127,35 @@ def test_sampling_temperature_varies(engine):
         )
         outs.add(tuple(out))
     assert len(outs) > 1, "high-temperature sampling produced identical outputs"
+
+
+def test_multi_step_decode_matches_single_step():
+    """num_scheduler_steps>1 fuses decode iterations into one dispatch; greedy
+    outputs must be identical to per-token stepping, including heterogeneous
+    max_tokens (the window shrinks to 1 near any sequence's end)."""
+    base = dict(model="tiny-debug", page_size=4, num_pages=64, max_num_seqs=4,
+                max_seq_len=64)
+    single = Engine(EngineConfig(**base))
+    multi = Engine(EngineConfig(**base, num_scheduler_steps=4))
+
+    prompt = [3, 1, 4, 1, 5]
+    want = single.generate(GenRequest("s", prompt, max_tokens=11,
+                                      temperature=0.0, ignore_eos=True))
+    got = multi.generate(GenRequest("m", prompt, max_tokens=11,
+                                    temperature=0.0, ignore_eos=True))
+    assert want == got
+    assert len(got) == 11  # window fallback at the tail still stops exactly
+
+    # two concurrent requests with different lengths
+    multi.add_request(GenRequest("m1", prompt, max_tokens=9, temperature=0.0,
+                                 ignore_eos=True))
+    multi.add_request(GenRequest("m2", prompt[:3], max_tokens=5, temperature=0.0,
+                                 ignore_eos=True))
+    done = {}
+    while multi.has_work:
+        for ev in multi.step():
+            if ev.finished:
+                done[ev.request_id] = ev
+    assert set(done) == {"m1", "m2"}
+    # pages fully released after completion
+    assert multi.allocator.free_pages == multi.cfg.num_pages - 1

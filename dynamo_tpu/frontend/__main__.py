@@ -23,6 +23,9 @@ def main(argv=None):
     p.add_argument("--static-workers", default=os.environ.get("STATIC_WORKERS"),
                    help="comma-separated worker URLs (skip heartbeat discovery)")
     p.add_argument("--static-model", default=os.environ.get("STATIC_MODEL"))
+    p.add_argument("--etcd-endpoint", default=os.environ.get("ETCD_ENDPOINT"),
+                   help="etcd v3 gateway URL; enables cross-replica worker "
+                        "registry sync (e.g. http://dynamo-platform-etcd:2379)")
     args = p.parse_args(argv)
 
     from dynamo_tpu.serving.router import Router
@@ -33,6 +36,16 @@ def main(argv=None):
         router.ttl = float("inf")
         for url in args.static_workers.split(","):
             router.register(url.strip(), args.static_model or "?", "agg")
+    if args.etcd_endpoint and args.static_workers:
+        logging.getLogger("dynamo_tpu.frontend").warning(
+            "--static-workers skips discovery entirely; ignoring "
+            "--etcd-endpoint (the two modes are mutually exclusive)"
+        )
+    elif args.etcd_endpoint:
+        from dynamo_tpu.serving.registry import EtcdRegistry
+
+        EtcdRegistry(router, args.etcd_endpoint,
+                     ttl_s=int(args.heartbeat_ttl)).start()
     ctx = FrontendContext(router)
     srv = make_frontend_server(ctx, args.host, args.port)
 

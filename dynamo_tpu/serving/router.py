@@ -32,6 +32,9 @@ class WorkerInfo:
     mode: str = "agg"  # agg | prefill | decode
     last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
     stats: Dict = dataclasses.field(default_factory=dict)
+    # "direct" = heartbeated straight to this frontend; "etcd" = merged from a
+    # peer replica's registry record. Only direct workers are re-published.
+    source: str = "direct"
 
     @property
     def headroom(self) -> float:
@@ -59,17 +62,25 @@ class Router:
 
     # ---------------------------------------------------------- membership --
     def register(self, url: str, model: str, mode: str = "agg",
-                 stats: Optional[Dict] = None):
+                 stats: Optional[Dict] = None, source: str = "direct"):
         with self._lock:
             w = self._workers.get(url)
             if w is None:
                 self._workers[url] = WorkerInfo(url, model, mode,
-                                                stats=stats or {})
-            else:
-                w.model, w.mode = model, mode
-                w.last_heartbeat = time.monotonic()
-                if stats is not None:
-                    w.stats = stats
+                                                stats=stats or {},
+                                                source=source)
+                return
+            if (source == "etcd" and w.source == "direct"
+                    and w.last_heartbeat >= time.monotonic() - self.ttl):
+                # a live direct heartbeat is fresher than any peer's record;
+                # an expired one may be resurrected by a peer that still
+                # hears the worker (e.g. it re-registered elsewhere)
+                return
+            w.model, w.mode = model, mode
+            w.source = source
+            w.last_heartbeat = time.monotonic()
+            if stats is not None:
+                w.stats = stats
 
     def deregister(self, url: str):
         with self._lock:

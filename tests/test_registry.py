@@ -1,0 +1,182 @@
+"""etcd-backed registry sync: fake v3 gateway, lease expiry, two-router
+convergence (the multi-frontend-replica discovery story)."""
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dynamo_tpu.serving.registry import EtcdClient, EtcdRegistry
+from dynamo_tpu.serving.router import Router
+
+
+class FakeEtcd:
+    """In-process etcd v3 JSON gateway: lease grant/keepalive, kv put/range."""
+
+    def __init__(self):
+        self.kv = {}  # key -> (value, lease_id)
+        self.leases = {}  # id -> expiry monotonic
+        self._next_lease = [1000]
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"]))
+                )
+                out = fake.handle(self.path, body)
+                data = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.srv.daemon_threads = True
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+
+    def _expire(self):
+        now = time.monotonic()
+        dead = {lid for lid, exp in self.leases.items() if exp < now}
+        for lid in dead:
+            del self.leases[lid]
+        self.kv = {k: (v, l) for k, (v, l) in self.kv.items()
+                   if l is None or l not in dead}
+
+    def handle(self, path, body):
+        with self._lock:
+            self._expire()
+            if path == "/v3/lease/grant":
+                lid = self._next_lease[0]
+                self._next_lease[0] += 1
+                self.leases[lid] = time.monotonic() + body["TTL"]
+                return {"ID": str(lid), "TTL": str(body["TTL"])}
+            if path == "/v3/lease/keepalive":
+                lid = int(body["ID"])
+                if lid not in self.leases:
+                    return {"result": {}}
+                self.leases[lid] = time.monotonic() + 15
+                return {"result": {"ID": str(lid), "TTL": "15"}}
+            if path == "/v3/kv/put":
+                key = base64.b64decode(body["key"]).decode()
+                val = base64.b64decode(body["value"]).decode()
+                self.kv[key] = (val, body.get("lease"))
+                return {}
+            if path == "/v3/kv/deleterange":
+                key = base64.b64decode(body["key"]).decode()
+                self.kv.pop(key, None)
+                return {}
+            if path == "/v3/kv/range":
+                start = base64.b64decode(body["key"]).decode()
+                end = base64.b64decode(body["range_end"]).decode()
+                kvs = [
+                    {"key": base64.b64encode(k.encode()).decode(),
+                     "value": base64.b64encode(v.encode()).decode()}
+                    for k, (v, _) in sorted(self.kv.items())
+                    if start <= k < end
+                ]
+                return {"kvs": kvs}
+            raise AssertionError(f"unhandled {path}")
+
+    def close(self):
+        self.srv.shutdown()
+
+
+@pytest.fixture()
+def etcd():
+    f = FakeEtcd()
+    yield f
+    f.close()
+
+
+def test_client_roundtrip(etcd):
+    c = EtcdClient(etcd.url)
+    lease = c.grant_lease(10)
+    c.put("/t/a", "1", lease)
+    c.put("/t/b", "2")
+    assert c.range_prefix("/t/") == {"/t/a": "1", "/t/b": "2"}
+    assert c.keepalive(lease)
+
+
+def test_two_frontends_converge(etcd):
+    """Each frontend hears one worker directly; after sync both route to both."""
+    r1, r2 = Router(), Router()
+    r1.register("http://w1:8000", "m", "agg", stats={"max_num_seqs": 8})
+    r2.register("http://w2:8000", "m", "agg", stats={"max_num_seqs": 8})
+    reg1 = EtcdRegistry(r1, etcd.url)
+    reg2 = EtcdRegistry(r2, etcd.url)
+    reg1.sync_once()  # publishes w1
+    reg2.sync_once()  # publishes w2, merges w1
+    reg1.sync_once()  # merges w2
+    urls1 = {w.url for w in r1.alive()}
+    urls2 = {w.url for w in r2.alive()}
+    assert urls1 == urls2 == {"http://w1:8000", "http://w2:8000"}
+    # stats rode along
+    w1_at_r2 = next(w for w in r2.alive() if w.url == "http://w1:8000")
+    assert w1_at_r2.stats.get("max_num_seqs") == 8
+
+
+def test_lease_expiry_removes_dead_frontend_records(etcd):
+    r1 = Router()
+    r1.register("http://w1:8000", "m", "agg")
+    reg1 = EtcdRegistry(r1, etcd.url, ttl_s=1)
+    reg1.sync_once()
+    assert EtcdClient(etcd.url).range_prefix(EtcdRegistry.PREFIX)
+    # frontend dies (no keepalive); lease expires server-side
+    time.sleep(1.2)
+    assert EtcdClient(etcd.url).range_prefix(EtcdRegistry.PREFIX) == {}
+
+
+def test_dead_worker_is_not_resurrected(etcd):
+    """A merged (peer-origin) worker must never be re-published, and the
+    owner deletes its key once the worker stops heartbeating — so a dead
+    worker disappears from every replica instead of looping forever."""
+    r1 = Router(heartbeat_ttl=0.5)
+    r2 = Router(heartbeat_ttl=0.5)
+    reg1 = EtcdRegistry(r1, etcd.url, ttl_s=15)
+    reg2 = EtcdRegistry(r2, etcd.url, ttl_s=15)
+    r1.register("http://w1:8000", "m", "agg")
+    reg1.sync_once()
+    reg2.sync_once()  # r2 merges w1 (source=etcd)
+    w1_at_r2 = next(w for w in r2.alive() if w.url == "http://w1:8000")
+    assert w1_at_r2.source == "etcd"
+    reg2.sync_once()  # must NOT publish w1 under reg2's lease
+    # w1 dies: r1 stops hearing it
+    time.sleep(0.6)
+    reg1.sync_once()  # owner deletes the key
+    assert EtcdClient(etcd.url).range_prefix(EtcdRegistry.PREFIX) == {}
+    time.sleep(0.1)
+    reg2.sync_once()
+    assert all(w.url != "http://w1:8000" for w in r2.alive())
+
+
+def test_stale_record_under_live_lease_ignored(etcd):
+    """Records older than 2*ttl are skipped even if their key still exists."""
+    import json as _json
+
+    c = EtcdClient(etcd.url)
+    lease = c.grant_lease(3600)
+    c.put(EtcdRegistry.PREFIX + "http://old:1", _json.dumps({
+        "url": "http://old:1", "model": "m", "mode": "agg",
+        "ts": time.time() - 1000,
+    }), lease)
+    r = Router()
+    reg = EtcdRegistry(r, etcd.url, ttl_s=15)
+    assert reg.sync_once() == 0
+    assert r.alive() == []
+
+
+def test_sync_survives_unreachable_etcd():
+    r = Router()
+    r.register("http://w1:8000", "m", "agg")
+    reg = EtcdRegistry(r, "http://127.0.0.1:9")  # closed port
+    assert reg.sync_once() == 0  # no raise; local discovery keeps working
+    assert {w.url for w in r.alive()} == {"http://w1:8000"}

@@ -25,11 +25,23 @@ def main(argv=None) -> None:
                    help="restrict to one namespace (default: cluster-wide)")
     p.add_argument("--interval", type=float,
                    default=float(os.environ.get("RECONCILE_INTERVAL", "3")))
+    p.add_argument("--gang", action="store_true",
+                   default=os.environ.get("ENABLE_GANG_SCHEDULING", "").lower()
+                   in ("1", "true"),
+                   help="emit coscheduling PodGroups for multi-pod worker "
+                        "services (Grove/KAI analogue)")
+    p.add_argument("--gang-scheduler",
+                   default=os.environ.get("GANG_SCHEDULER_NAME") or None)
     p.add_argument("--once", action="store_true",
                    help="single reconcile pass (CI / debugging)")
     args = p.parse_args(argv)
 
-    ctrl = Controller(K8sClient.from_env(), namespace=args.namespace)
+    from dynamo_tpu.operator import materialize as mat
+
+    ctrl = Controller(
+        K8sClient.from_env(), namespace=args.namespace, gang=args.gang,
+        gang_scheduler=args.gang_scheduler or mat.DEFAULT_GANG_SCHEDULER,
+    )
     if args.once:
         n = ctrl.reconcile_once()
         scope = args.namespace or "all namespaces"

@@ -36,13 +36,19 @@ def _yaml_load(text: str) -> Dict[str, Any]:
 
 
 class Controller:
-    def __init__(self, client: K8sClient, namespace: Optional[str] = "default"):
+    def __init__(self, client: K8sClient, namespace: Optional[str] = "default",
+                 gang: bool = False,
+                 gang_scheduler: str = mat.DEFAULT_GANG_SCHEDULER):
         """namespace=None watches every namespace (cluster-wide list), the
         reference operator's default; a concrete namespace restricts it (the
         NAMESPACE_RESTRICTED_OPERATOR analogue,
-        /root/reference/install-dynamo-1node.sh:32,203-205)."""
+        /root/reference/install-dynamo-1node.sh:32,203-205). gang=True emits
+        coscheduling PodGroups for multi-pod worker services (the Grove/KAI
+        opt-in analogue, :35-36,207-212)."""
         self.k8s = client
         self.namespace = namespace
+        self.gang = gang
+        self.gang_scheduler = gang_scheduler
 
     @staticmethod
     def _ns(cr: Dict[str, Any]) -> str:
@@ -58,8 +64,13 @@ class Controller:
         name = cr["metadata"]["name"]
         ns = self._ns(cr)
         ns_label = mat.discovery_label_value(ns, name)
-        desired = mat.materialize(cr)
+        desired = mat.materialize(cr, gang=self.gang,
+                                  gang_scheduler=self.gang_scheduler)
 
+        # PodGroups first: the gang scheduler must see the group before the
+        # Deployment's pods arrive, or they schedule ungated
+        for pg in desired["podgroups"]:
+            self.k8s.upsert(mat.POD_GROUP_API, "podgroups", ns, pg)
         for dep in desired["deployments"]:
             self.k8s.upsert("apps/v1", "deployments", ns, dep)
         for svc in desired["services"]:
@@ -88,6 +99,20 @@ class Controller:
                 self.k8s.delete(
                     "v1", "services", ns, existing["metadata"]["name"]
                 )
+        if self.gang:
+            want_pgs = {p["metadata"]["name"] for p in desired["podgroups"]}
+            try:
+                for existing in self._owned(
+                    mat.POD_GROUP_API, "podgroups", ns, ns_label
+                ):
+                    if existing["metadata"]["name"] not in want_pgs:
+                        self.k8s.delete(
+                            mat.POD_GROUP_API, "podgroups", ns,
+                            existing["metadata"]["name"],
+                        )
+            except ApiError as e:
+                if not e.not_found:  # PodGroup CRD not installed
+                    raise
 
         self._update_dgd_status(cr, kept_deps)
 

@@ -37,6 +37,12 @@ COMPONENT_TYPE_LABEL = f"{GROUP}/component-type"
 MANAGED_BY_LABEL = "app.kubernetes.io/managed-by"
 OPERATOR_NAME = "dynamo-tpu-operator"
 POD_GROUP_LABEL = f"{GROUP}/pod-group"
+# coscheduling (scheduler-plugins) contract — the Grove/KAI-analogue gang
+# scheduler consumes these (/root/reference/install-dynamo-1node.sh:35-36,
+# 207-212 gates the reference's equivalents behind the same kind of opt-in)
+POD_GROUP_API = "scheduling.x-k8s.io/v1alpha1"
+POD_GROUP_ANNOTATION = "scheduling.x-k8s.io/pod-group"
+DEFAULT_GANG_SCHEDULER = "scheduler-plugins-scheduler"
 
 FRONTEND_PORT = 8000
 WORKER_PORT = 8000
@@ -204,7 +210,8 @@ def _pod_spec(
 
 
 def build_deployment(
-    cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any]
+    cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any],
+    gang: bool = False, gang_scheduler: str = DEFAULT_GANG_SCHEDULER,
 ) -> Dict[str, Any]:
     namespace = cr["metadata"].get("namespace", "default")
     dgd_name = cr["metadata"]["name"]
@@ -217,6 +224,13 @@ def build_deployment(
     pod_labels = dict(labels)
     # gang semantics for multi-host slices: one pod-group per service
     pod_labels[POD_GROUP_LABEL] = name
+    pod_meta: Dict[str, Any] = {"labels": pod_labels}
+    pod_spec = _pod_spec(namespace, dgd_name, svc_name, spec, ctype, frontend)
+    if gang and _gang_eligible(spec, ctype):
+        # all-or-nothing placement via the coscheduling plugin: pods carry
+        # the PodGroup annotation and are bound by the gang scheduler
+        pod_meta["annotations"] = {POD_GROUP_ANNOTATION: name}
+        pod_spec.setdefault("schedulerName", gang_scheduler)
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
@@ -231,10 +245,45 @@ def build_deployment(
             "selector": {"matchLabels": {COMPONENT_LABEL: svc_name.lower(),
                                          NS_LABEL: labels[NS_LABEL]}},
             "template": {
-                "metadata": {"labels": pod_labels},
-                "spec": _pod_spec(namespace, dgd_name, svc_name, spec, ctype,
-                                  frontend),
+                "metadata": pod_meta,
+                "spec": pod_spec,
             },
+        },
+    }
+
+
+def _gang_eligible(spec: Dict[str, Any], ctype: str) -> bool:
+    """Gang placement applies to accelerator worker groups with more than one
+    pod — a multi-host TPU slice is unusable until every host's pod lands, so
+    partial placement just wastes chips (the reason the reference offers
+    Grove/KAI at all)."""
+    if ctype == "frontend":
+        return False
+    replicas = int(spec.get("replicas", 1))
+    return replicas > 1
+
+
+def build_pod_group(
+    cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any]
+) -> Dict[str, Any]:
+    """scheduling.x-k8s.io PodGroup: minMember = the service's full replica
+    count, so the coscheduling plugin holds all pods until all fit."""
+    namespace = cr["metadata"].get("namespace", "default")
+    dgd_name = cr["metadata"]["name"]
+    name = child_name(dgd_name, svc_name)
+    ctype = spec.get("componentType", "worker")
+    return {
+        "apiVersion": POD_GROUP_API,
+        "kind": "PodGroup",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": _labels(namespace, dgd_name, svc_name, ctype),
+            "ownerReferences": [owner_reference(cr)],
+        },
+        "spec": {
+            "minMember": int(spec.get("replicas", 1)),
+            "scheduleTimeoutSeconds": 300,
         },
     }
 
@@ -306,16 +355,27 @@ def build_pvcs(cr: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
-def materialize(cr: Dict[str, Any]) -> Dict[str, List[Dict[str, Any]]]:
-    """CR -> {deployments, services, pvcs} (desired child state)."""
+def materialize(
+    cr: Dict[str, Any], gang: bool = False,
+    gang_scheduler: str = DEFAULT_GANG_SCHEDULER,
+) -> Dict[str, List[Dict[str, Any]]]:
+    """CR -> {deployments, services, pvcs, podgroups} (desired child state)."""
     services = cr.get("spec", {}).get("services") or {}
     deployments = []
     svcs = []
+    podgroups = []
     for svc_name, spec in services.items():
-        deployments.append(build_deployment(cr, svc_name, spec))
+        deployments.append(
+            build_deployment(cr, svc_name, spec, gang=gang,
+                             gang_scheduler=gang_scheduler)
+        )
         svcs.append(build_service(cr, svc_name, spec))
+        ctype = spec.get("componentType", "worker")
+        if gang and _gang_eligible(spec, ctype):
+            podgroups.append(build_pod_group(cr, svc_name, spec))
     return {
         "deployments": deployments,
         "services": svcs,
         "pvcs": build_pvcs(cr),
+        "podgroups": podgroups,
     }

@@ -311,3 +311,64 @@ def test_dgdr_missing_template_retries_after_fix():
         assert req["status"]["state"] == "successful"
         assert fake.get_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
                                "late")
+
+
+def test_gang_scheduling_emits_podgroups():
+    """With gang on, multi-pod worker services get a coscheduling PodGroup,
+    the pod-group annotation, and the gang schedulerName; frontends and
+    single-pod services stay untouched."""
+    cr = {
+        "apiVersion": mat.API_VERSION,
+        "kind": mat.DGD_KIND,
+        "metadata": {"name": "g", "namespace": "ns", "uid": "u1"},
+        "spec": {"services": {
+            "Frontend": {"componentType": "frontend", "replicas": 2},
+            "Worker": {"componentType": "worker", "replicas": 4,
+                       "resources": {"limits": {"tpu": "4"}}},
+            "Solo": {"componentType": "worker", "replicas": 1},
+        }},
+    }
+    out = mat.materialize(cr, gang=True)
+    pgs = {p["metadata"]["name"]: p for p in out["podgroups"]}
+    assert set(pgs) == {"g-worker"}
+    assert pgs["g-worker"]["spec"]["minMember"] == 4
+
+    deps = {d["metadata"]["name"]: d for d in out["deployments"]}
+    wtpl = deps["g-worker"]["spec"]["template"]
+    assert wtpl["metadata"]["annotations"][mat.POD_GROUP_ANNOTATION] == "g-worker"
+    assert wtpl["spec"]["schedulerName"] == mat.DEFAULT_GANG_SCHEDULER
+    for untouched in ("g-frontend", "g-solo"):
+        tpl = deps[untouched]["spec"]["template"]
+        assert "annotations" not in tpl["metadata"]
+        assert "schedulerName" not in tpl["spec"]
+
+    # gang off -> no podgroups, no annotations
+    out_off = mat.materialize(cr)
+    assert out_off["podgroups"] == []
+    tpl = out_off["deployments"][1]["spec"]["template"]
+    assert "annotations" not in tpl["metadata"]
+
+
+def test_gang_reconcile_upserts_and_prunes_podgroups():
+    with FakeK8s() as fake:
+        client = K8sClient(fake.url)
+        ctrl = Controller(client, namespace="ns", gang=True)
+        cr = {
+            "apiVersion": mat.API_VERSION,
+            "kind": mat.DGD_KIND,
+            "metadata": {"name": "g", "namespace": "ns", "uid": "u1"},
+            "spec": {"services": {
+                "Worker": {"componentType": "worker", "replicas": 3},
+            }},
+        }
+        fake.put_object(mat.API_VERSION, "ns", mat.DGD_PLURAL, cr)
+        ctrl.reconcile_once()
+        pgs = client.list(mat.POD_GROUP_API, "podgroups", "ns")
+        assert [p["metadata"]["name"] for p in pgs] == ["g-worker"]
+        assert pgs[0]["spec"]["minMember"] == 3
+
+        # scale to 1 replica -> pod group no longer eligible, pruned
+        cr["spec"]["services"]["Worker"]["replicas"] = 1
+        fake.put_object(mat.API_VERSION, "ns", mat.DGD_PLURAL, cr)
+        ctrl.reconcile_once()
+        assert client.list(mat.POD_GROUP_API, "podgroups", "ns") == []

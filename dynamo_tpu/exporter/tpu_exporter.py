@@ -9,7 +9,8 @@ same dashboard slots for TPUs:
     tpu_tensorcore_utilization   (gauge, %, per device)  <- duty-cycle proxy
     tpu_hbm_memory_usage_bytes   (gauge, bytes, per device)
     tpu_hbm_memory_total_bytes   (gauge, bytes, per device)
-    tpu_power_usage_watts        (gauge, W, per device; modeled)
+    tpu_power_usage_watts        (gauge, W, per device; label
+                                  source="modeled"|"measured")
 
 Sources, in order of preference:
 1. `jax.local_devices()[i].memory_stats()` — live HBM numbers on TPU
@@ -142,10 +143,21 @@ class TpuMetricsExporter:
             self.util.set(util, **labels)
             self.hbm_used.set(float(sample.get("hbm_used", used)), **labels)
             self.hbm_total.set(float(sample.get("hbm_total", total)), **labels)
-            # modeled power: idle floor + utilization-proportional dynamic power
+            # power: a real measurement when the sampler pushed one, else a
+            # model (idle floor + utilization-proportional dynamic power).
+            # The source label lets dashboards/alerts tell them apart rather
+            # than treating the model as hardware truth.
             tdp = _CHIP_TDP_W[kind]
-            power = sample.get("power_w", tdp * (0.25 + 0.75 * util / 100.0))
-            self.power.set(float(power), **labels)
+            if "power_w" in sample:
+                power, source = sample["power_w"], "measured"
+            else:
+                power = tdp * (0.25 + 0.75 * util / 100.0)
+                source = "modeled"
+            # drop the opposite-source series on flip, or sum() over the
+            # metric double-counts a frozen stale variant
+            other = "modeled" if source == "measured" else "measured"
+            self.power.remove(source=other, **labels)
+            self.power.set(float(power), source=source, **labels)
         return len(devices)
 
     def run_forever(self, interval_s: float = 10.0,

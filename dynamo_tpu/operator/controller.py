@@ -68,9 +68,20 @@ class Controller:
                                   gang_scheduler=self.gang_scheduler)
 
         # PodGroups first: the gang scheduler must see the group before the
-        # Deployment's pods arrive, or they schedule ungated
+        # Deployment's pods arrive, or they schedule ungated. A cluster with
+        # gang enabled but no PodGroup CRD must still get its Deployments —
+        # warn once and continue ungated rather than failing every reconcile.
         for pg in desired["podgroups"]:
-            self.k8s.upsert(mat.POD_GROUP_API, "podgroups", ns, pg)
+            try:
+                self.k8s.upsert(mat.POD_GROUP_API, "podgroups", ns, pg)
+            except ApiError as e:
+                if not e.not_found:
+                    raise
+                log.warning(
+                    "PodGroup CRD (%s) not installed; %s/%s schedules without "
+                    "gang gating", mat.POD_GROUP_API, ns,
+                    pg["metadata"]["name"],
+                )
         for dep in desired["deployments"]:
             self.k8s.upsert("apps/v1", "deployments", ns, dep)
         for svc in desired["services"]:

@@ -9,7 +9,7 @@ pod (every DGD manifest's `Frontend` service,
 - worker membership via HTTP heartbeats (POST /internal/register) — the
   lightweight stand-in for the reference's etcd registry + NATS request plane
   (SURVEY.md §2d); an etcd-backed registry can be swapped in via
-  dynamo_tpu.distributed.registry;
+  dynamo_tpu.serving.registry;
 - emit the dynamo_frontend_* metric contract at /metrics.
 """
 

@@ -81,6 +81,12 @@ class Gauge(_Metric):
         with self._lock:
             self._values[tuple(sorted(labels.items()))] = value
 
+    def remove(self, **labels):
+        """Drop one label-set's series (e.g. a device's stale variant after a
+        label value flips) so it doesn't stay frozen at its last value."""
+        with self._lock:
+            self._values.pop(tuple(sorted(labels.items())), None)
+
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:

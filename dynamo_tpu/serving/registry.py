@@ -129,10 +129,12 @@ class EtcdRegistry:
 
         Merged (peer-origin) workers are NEVER re-published — re-publishing
         would re-parent a dead worker's record under this frontend's live
-        lease and resurrect it forever. Records carry a wall-clock timestamp
-        so stale entries are ignored even while their owner's lease is alive,
-        and keys whose worker fell out of the local alive set are deleted.
-        Returns the merged count."""
+        lease and resurrect it forever. Liveness is etcd lease expiry alone:
+        the keepalive happens in this same loop that prunes dead workers, so
+        a live lease implies a running sync loop implies pruned records. (A
+        producer-wall-clock staleness check was dropped — cross-host clock
+        skew > 2*ttl silently degraded discovery to local-only.) Returns the
+        merged count."""
         lease = self._ensure_lease()
         if lease is None:
             return 0
@@ -176,8 +178,6 @@ class EtcdRegistry:
                 continue
             if rec.get("url") in known:
                 continue  # local heartbeats are fresher
-            if now - float(rec.get("ts", 0)) > 2 * self.ttl_s:
-                continue  # stale record still parked under a live lease
             self.router.register(
                 rec["url"], rec.get("model", "?"), rec.get("mode", "agg"),
                 stats=rec.get("stats"), source="etcd",

@@ -39,20 +39,132 @@ def maybe_force_cpu_from_env() -> None:
         force_cpu()
 
 
-def init_backend_with_fallback() -> str:
-    """Initialize the JAX backend, falling back to CPU when no accelerator is
-    reachable (e.g. TPU tunnel down). Returns the backend name in use."""
-    maybe_force_cpu_from_env()
-    import jax
+def _probe_accelerator(timeout_s: float) -> str | None:
+    """Probe accelerator availability in a SUBPROCESS with a hard timeout.
 
+    `jax.devices()` on a tunneled TPU backend can hang indefinitely inside
+    native code when the tunnel is flaky — a Python-level timeout cannot
+    interrupt it. Probing in a throwaway child process means a hang costs
+    only the timeout, never the caller. Returns the backend name the child
+    initialized ("tpu", "axon", ...), the sentinel "cpu" when the machine
+    cleanly has no accelerator plugin at all (callers should fall back
+    immediately, not retry), or None if unavailable/hung (retryable)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, sys\n"
+        "jax.devices()\n"
+        "sys.stdout.write(jax.default_backend())\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the plugin pick the accelerator
     try:
-        jax.devices()
-        return jax.default_backend()
-    except Exception as e:
-        import logging
-
-        logging.getLogger("dynamo_tpu.platform").warning(
-            "accelerator backend unavailable (%s); falling back to CPU", e
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, timeout=timeout_s, env=env, text=True,
         )
-        force_cpu()
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        return None
+    backend = out.stdout.strip()
+    return backend or None
+
+
+def _devices_with_timeout(jax_mod, timeout_s: float) -> bool:
+    """Run jax.devices() on a watchdog thread. True = initialized; False =
+    still hung at timeout (the daemon thread is abandoned). Exceptions from
+    the init propagate to the caller."""
+    import threading
+
+    result: list = []
+
+    def target():
+        try:
+            jax_mod.devices()
+            result.append(True)
+        except Exception as e:
+            result.append(e)
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(max(1.0, timeout_s))
+    if not result:
+        return False
+    if result[0] is True:
+        return True
+    raise result[0]
+
+
+def init_backend_with_fallback(
+    max_attempts: int = 5,
+    budget_s: float = 300.0,
+    probe_timeout_s: float = 75.0,
+) -> str:
+    """Initialize the JAX backend, retrying a flaky accelerator before falling
+    back to CPU. Returns the backend name in use.
+
+    The tunneled TPU backend fails in two modes: a fast UNAVAILABLE error and
+    an indefinite hang inside backend init. Each attempt probes in a
+    subprocess (bounded by probe_timeout_s); only after a successful probe do
+    we initialize in-process. Total retry budget is bounded by budget_s —
+    after that, CPU fallback, loudly logged."""
+    import logging
+    import time
+
+    log = logging.getLogger("dynamo_tpu.platform")
+    maybe_force_cpu_from_env()
+    if want_cpu_from_env():
         return "cpu"
+
+    deadline = time.monotonic() + budget_s
+    for attempt in range(1, max_attempts + 1):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            log.warning("accelerator init budget (%.0fs) exhausted", budget_s)
+            break
+        backend = _probe_accelerator(min(probe_timeout_s, remaining))
+        if backend == "cpu":
+            # clean CPU-only machine (no accelerator plugin registered):
+            # retrying can never find hardware — fall back immediately
+            force_cpu()
+            return "cpu"
+        if backend is not None:
+            import jax
+
+            try:
+                # the in-process init can hang the same way the probe can
+                # (tunnel dropped since the probe succeeded) — bound it with
+                # a watchdog thread; backend RPC waits release the GIL
+                if _devices_with_timeout(
+                    jax, min(probe_timeout_s, deadline - time.monotonic())
+                ):
+                    log.info(
+                        "accelerator backend %r up after %d attempt(s)",
+                        jax.default_backend(), attempt,
+                    )
+                    return jax.default_backend()
+                log.warning("in-process init hung after probe ok; retrying")
+            except Exception as e:  # probe raced a tunnel drop; retry
+                log.warning("in-process init failed after probe ok: %s", e)
+            # JAX caches backend-init failures for the life of the process;
+            # without clearing, every later attempt re-raises the cached
+            # error without re-contacting the hardware
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+        else:
+            log.warning(
+                "accelerator probe attempt %d/%d failed (timeout or error)",
+                attempt, max_attempts,
+            )
+        if attempt < max_attempts:
+            time.sleep(min(5.0 * attempt,
+                           max(0.0, deadline - time.monotonic())))
+
+    log.warning("accelerator unavailable after %d attempts; falling back to CPU",
+                max_attempts)
+    force_cpu()
+    return "cpu"

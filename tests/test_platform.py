@@ -1,0 +1,62 @@
+"""Backend-init retry/fallback behavior (dynamo_tpu.utils.platform).
+
+Round-1 failure mode: a single-shot `jax.devices()` probe met a transiently
+down TPU tunnel and the bench silently ran on CPU. The retry loop must (a)
+stay inside its time budget, (b) fall back to CPU loudly, (c) return the
+in-process backend after a successful probe.
+"""
+
+from __future__ import annotations
+
+import time
+
+from dynamo_tpu.utils import platform as plat
+
+
+def test_cpu_env_short_circuits(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    calls = []
+    monkeypatch.setattr(plat, "_probe_accelerator",
+                        lambda t: calls.append(t) or "tpu")
+    assert plat.init_backend_with_fallback() == "cpu"
+    assert calls == []  # never probes when CPU is explicitly requested
+
+
+def test_fallback_after_failed_probes(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    calls = []
+    monkeypatch.setattr(plat, "_probe_accelerator",
+                        lambda t: calls.append(t) or None)
+    t0 = time.monotonic()
+    backend = plat.init_backend_with_fallback(
+        max_attempts=3, budget_s=1.0, probe_timeout_s=0.2
+    )
+    assert backend == "cpu"
+    assert calls, "should have probed at least once"
+    # bounded: budget plus one probe-timeout of slack, not minutes
+    assert time.monotonic() - t0 < 5.0
+    # fallback must pin the env so child processes inherit CPU too
+    import os
+
+    assert os.environ.get("JAX_PLATFORMS") == "cpu"
+
+
+def test_probe_timeouts_respect_budget(monkeypatch):
+    """Each probe gets at most the remaining budget, never the full timeout."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    seen = []
+    monkeypatch.setattr(plat, "_probe_accelerator",
+                        lambda t: seen.append(t) or None)
+    plat.init_backend_with_fallback(
+        max_attempts=5, budget_s=0.5, probe_timeout_s=60.0
+    )
+    assert all(t <= 0.5 + 1e-6 for t in seen)
+
+
+def test_successful_probe_initializes_in_process(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(plat, "_probe_accelerator", lambda t: "tpu")
+    # in-process jax is already initialized as CPU under the test conftest,
+    # so the success path lands on default_backend() == "cpu"
+    backend = plat.init_backend_with_fallback(max_attempts=1, budget_s=5.0)
+    assert backend == "cpu"

@@ -158,20 +158,23 @@ def test_dead_worker_is_not_resurrected(etcd):
     assert all(w.url != "http://w1:8000" for w in r2.alive())
 
 
-def test_stale_record_under_live_lease_ignored(etcd):
-    """Records older than 2*ttl are skipped even if their key still exists."""
+def test_clock_skew_does_not_drop_live_records(etcd):
+    """Liveness is lease expiry alone: a record whose producer wall-clock ts
+    is far in the past (cross-host clock skew) is still merged while its
+    owner's lease is alive. The old producer-ts staleness check silently
+    degraded multi-replica discovery to local-only under >2*ttl skew."""
     import json as _json
 
     c = EtcdClient(etcd.url)
     lease = c.grant_lease(3600)
-    c.put(EtcdRegistry.PREFIX + "http://old:1", _json.dumps({
-        "url": "http://old:1", "model": "m", "mode": "agg",
+    c.put(EtcdRegistry.PREFIX + "http://skewed:1", _json.dumps({
+        "url": "http://skewed:1", "model": "m", "mode": "agg",
         "ts": time.time() - 1000,
     }), lease)
     r = Router()
     reg = EtcdRegistry(r, etcd.url, ttl_s=15)
-    assert reg.sync_once() == 0
-    assert r.alive() == []
+    assert reg.sync_once() == 1
+    assert [w.url for w in r.alive()] == ["http://skewed:1"]
 
 
 def test_sync_survives_unreachable_etcd():

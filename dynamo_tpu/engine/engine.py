@@ -139,6 +139,11 @@ class Engine:
         self.temperature = np.zeros((b,), dtype=np.float32)
         self.top_p = np.ones((b,), dtype=np.float32)
         self.top_k = np.zeros((b,), dtype=np.int32)
+        self.presence = np.zeros((b,), dtype=np.float32)
+        self.frequency = np.zeros((b,), dtype=np.float32)
+        # per-slot PRNG chain roots (seeded requests are deterministic
+        # regardless of batch composition; see engine/sampling.py)
+        self.slot_keys = np.zeros((b, 2), dtype=np.uint32)
         self.seqs: Dict[int, SeqState] = {}
         self._free_slots = list(range(b - 1, -1, -1))
         self.pending: collections.deque[GenRequest] = collections.deque()
@@ -148,17 +153,22 @@ class Engine:
 
         self.rng = jax.random.PRNGKey(cfg.seed)
         # --- device-resident decode state ---
-        # The decode hot loop keeps (cur_tokens, positions, context_lens, rng)
+        # The decode hot loop keeps (cur_tokens, positions, context_lens)
         # and the block-table / sampling arrays on device between windows, so
         # a steady-state window costs ONE dispatch + ONE token download — on
         # networked TPU backends the per-transfer round-trip, not compute, is
         # the decode bottleneck. Host mirrors stay authoritative; any
         # membership/page/sampling mutation invalidates the matching device
         # copy and it is rebuilt from mirrors before the next window.
-        self._dev_state = None  # (cur_tokens, positions, context_lens)
+        self._dev_state = None  # (cur_tokens, positions, context_lens, active)
         self._dev_tables = None
-        self._dev_sampling = None  # (temperature, top_p, top_k)
-        self._dev_key = None
+        self._dev_sampling = None  # (temp, top_p, top_k, pres, freq, keys)
+        # output-token counts for presence/frequency penalties: [B, V] int32,
+        # PERSISTENTLY device-resident (never re-uploaded on membership
+        # changes — rows are zeroed in-place by the tiny _reset_count jit)
+        self.token_counts = jnp.zeros(
+            (b, self.model_cfg.vocab_size), dtype=jnp.int32
+        )
         self._build_jit()
 
     def _invalidate_dev(self, tables_only: bool = False):
@@ -180,52 +190,88 @@ class Engine:
             )
             return out.last_logits, out.k_pages, out.v_pages
 
-        def make_decode_window(n_steps: int):
+        def make_decode_window(n_steps: int, with_logprobs: bool):
             """n_steps fused decode iterations in one dispatch: lax.scan over
             the step body with on-device sampling AND the batch state carried
             on device, so a steady-state window costs one dispatch + one
-            token download instead of ~9 host round-trips."""
+            token download instead of ~9 host round-trips. The logprobs
+            variant additionally streams back the chosen-token logprob and
+            top-5 alternatives per step (compiled lazily — costs nothing
+            unless a request asks for logprobs)."""
 
             def window_fn(
                 params, tokens, positions, context_lens, active, block_tables,
-                temperature, top_p, top_k, key, k_pages, v_pages,
+                temperature, top_p, top_k, presence, frequency, slot_keys,
+                counts, k_pages, v_pages,
             ):
-                state = smp.SamplingState(temperature, top_p, top_k)
+                state = smp.SamplingState(
+                    temperature, top_p, top_k, presence, frequency
+                )
                 step = active.astype(positions.dtype)  # inactive slots frozen
+                b = tokens.shape[0]
 
-                def body(carry, subkey):
-                    toks, pos, ctx_lens, kp, vp = carry
+                def body(carry, _):
+                    toks, pos, ctx_lens, cnts, kp, vp = carry
                     out = llama.decode_step(
                         mcfg, params, toks, pos, block_tables, ctx_lens,
                         kp, vp, page_size=page_size,
                     )
-                    nxt = smp.sample(out.logits, state, subkey)
+                    keys = smp.fold_positions(slot_keys, pos)
+                    if with_logprobs:
+                        nxt, chosen, tids, tvals = smp.sample_with_logprobs(
+                            out.logits, state, keys, cnts
+                        )
+                        y = (nxt, chosen, tids, tvals)
+                    else:
+                        nxt = smp.sample(out.logits, state, keys, cnts)
+                        y = (nxt,)
+                    # count only active slots' emissions; inactive rows are
+                    # zeroed at (re)admission anyway
+                    cnts = cnts.at[jnp.arange(b), nxt].add(
+                        step.astype(cnts.dtype)
+                    )
                     # inactive slots stay pinned at position 0 / context 1 so
                     # their trash-page work never grows between rebuilds
                     return (
-                        nxt, pos + step, ctx_lens + step,
+                        nxt, pos + step, ctx_lens + step, cnts,
                         out.k_pages, out.v_pages,
-                    ), nxt
+                    ), y
 
-                key, sub = jax.random.split(key)
-                keys = jax.random.split(sub, n_steps)
-                carry, toks = jax.lax.scan(
-                    body, (tokens, positions, context_lens, k_pages, v_pages),
-                    keys,
+                carry, ys = jax.lax.scan(
+                    body,
+                    (tokens, positions, context_lens, counts,
+                     k_pages, v_pages),
+                    None, length=n_steps,
                 )
-                tokens, positions, context_lens, k_pages, v_pages = carry
-                # toks: [n_steps, B]
-                return (toks, tokens, positions, context_lens, key,
+                tokens, positions, context_lens, counts, k_pages, v_pages = carry
+                # ys: (toks [n_steps, B], [logprob extras...])
+                return (ys, tokens, positions, context_lens, counts,
                         k_pages, v_pages)
 
             return window_fn
 
-        decode_fn = make_decode_window(1)
-        decode_multi_fn = make_decode_window(max(1, cfg.num_scheduler_steps))
+        n_multi = max(1, cfg.num_scheduler_steps)
+        window_fns = {
+            (False, False): make_decode_window(1, False),
+            (True, False): make_decode_window(n_multi, False),
+            (False, True): make_decode_window(1, True),
+            (True, True): make_decode_window(n_multi, True),
+        }
 
-        def sample_one(logits, temperature, top_p, top_k, key):
-            state = smp.SamplingState(temperature, top_p, top_k)
-            return smp.sample(logits[None], state, key)[0]
+        def sample_first(logits, temperature, top_p, top_k, req_key, pos):
+            """First-token sampling after prefill: logits [V] for one request.
+            Penalties don't apply (no output yet); logprobs always computed
+            (one [V] row — negligible)."""
+            state = smp.make_state(temperature, top_p, top_k)
+            key = jax.random.fold_in(req_key, pos)
+            toks, chosen, tids, tvals = smp.sample_with_logprobs(
+                logits[None], state, key[None]
+            )
+            return toks[0], chosen[0], tids[0], tvals[0]
+
+        def reset_count_fn(counts, slot, token):
+            """Zero a slot's penalty counts and count its first token."""
+            return counts.at[slot].set(0).at[slot, token].add(1)
 
         def import_fn(k_pages, v_pages, idx, k_new, v_new):
             # disagg KV install: in-place page scatter (pools donated)
@@ -251,19 +297,23 @@ class Engine:
 
         if cfg.enforce_eager:
             self._prefill = ctx(prefill_fn)
-            self._decode = ctx(decode_fn)
-            self._decode_multi = ctx(decode_multi_fn)
-            self._sample_one = ctx(sample_one)
+            self._windows = {k: ctx(f) for k, f in window_fns.items()}
+            self._sample_first = ctx(sample_first)
+            self._reset_count = ctx(reset_count_fn)
             self._import = ctx(import_fn)
         else:
             # donate KV pools + carried decode state: XLA updates in place
-            # (active mask and block tables are reused across windows)
-            window_donate = (1, 2, 3, 9, 10, 11)  # tokens/pos/ctx/key/k/v
+            # (active mask, block tables, sampling params and slot keys are
+            # reused across windows). tokens/pos/ctx/counts/k/v donated.
+            window_donate = (1, 2, 3, 12, 13, 14)
             self._prefill = ctx(jax.jit(prefill_fn, donate_argnums=(3, 4)))
-            self._decode = ctx(jax.jit(decode_fn, donate_argnums=window_donate))
-            self._decode_multi = ctx(jax.jit(decode_multi_fn,
-                                             donate_argnums=window_donate))
-            self._sample_one = ctx(jax.jit(sample_one))
+            self._windows = {
+                k: ctx(jax.jit(f, donate_argnums=window_donate))
+                for k, f in window_fns.items()
+            }
+            self._sample_first = ctx(jax.jit(sample_first))
+            self._reset_count = ctx(jax.jit(reset_count_fn,
+                                            donate_argnums=(0,)))
             self._import = ctx(jax.jit(import_fn, donate_argnums=(0, 1)))
 
     # ------------------------------------------------------- request intake --
@@ -372,10 +422,20 @@ class Engine:
             events.append(ev)
         return events
 
+    def _request_key(self, req: GenRequest):
+        """Per-request PRNG chain root: deterministic when seeded."""
+        if req.seed is not None:
+            return jax.random.PRNGKey(req.seed)
+        self.rng, key = jax.random.split(self.rng)
+        return key
+
     def _run_prefill(self, req: GenRequest):
         """Shared prefill: bucket, allocate pages, run the jitted prefill, and
         sample the first token. Used by both the aggregated admission path and
-        the disagg prefill role. Returns (first_token, pages, prompt_len)."""
+        the disagg prefill role.
+
+        Returns (first_token, pages, prompt_len, req_key, lp) where lp =
+        (chosen_logprob, top_ids, top_logprobs) numpy for the first token."""
         cfg = self.cfg
         t0 = time.monotonic()
         prompt = req.prompt_token_ids
@@ -398,23 +458,27 @@ class Engine:
             self.v_pages,
             jnp.asarray(pages_arr),
         )
-        self.rng, key = jax.random.split(self.rng)
-        first = int(
-            self._sample_one(
-                last_logits,
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_p], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                key,
-            )
+        req_key = self._request_key(req)
+        # the prediction made FROM position prompt_len-1; decode windows fold
+        # positions >= prompt_len, so the chains never collide
+        tok, chosen, tids, tvals = self._sample_first(
+            last_logits,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            req_key,
+            jnp.int32(prompt_len - 1),
         )
+        first = int(tok)
+        lp = (float(chosen), np.asarray(tids), np.asarray(tvals))
         self.metrics.prefill_time_s += time.monotonic() - t0
         self.metrics.prompt_tokens += prompt_len
-        return first, pages, prompt_len
+        return first, pages, prompt_len, req_key, lp
 
-    def _prefill_request(self, req: GenRequest) -> TokenEvent:
-        first, pages, prompt_len = self._run_prefill(req)
-        slot = self._free_slots.pop()
+    def _install_slot(self, req: GenRequest, slot: int, pages, prompt_len: int,
+                      first: int, req_key) -> SeqState:
+        """Shared slot installation for the agg-prefill and KV-import paths:
+        SeqState + every host mirror + the device-side penalty-count reset."""
         seq = SeqState(
             req.request_id,
             slot,
@@ -428,6 +492,7 @@ class Engine:
                 [] if req.ignore_eos
                 else (req.stop_token_ids or [self.model_cfg.eos_token_id])
             ),
+            logprobs=req.logprobs,
         )
         seq.output_tokens.append(first)
         self.seqs[slot] = seq
@@ -437,11 +502,33 @@ class Engine:
         self.temperature[slot] = req.temperature
         self.top_p[slot] = req.top_p
         self.top_k[slot] = req.top_k
+        self.presence[slot] = req.presence_penalty
+        self.frequency[slot] = req.frequency_penalty
+        self.slot_keys[slot] = np.asarray(req_key, dtype=np.uint32)
+        self.token_counts = self._reset_count(
+            self.token_counts, jnp.int32(slot), jnp.int32(first)
+        )
         self.metrics.output_tokens += 1
         self._invalidate_dev()  # new membership -> rebuild device batch state
+        return seq
+
+    @staticmethod
+    def _decorate_lp(ev: TokenEvent, seq: SeqState, chosen: float,
+                     tids, tvals) -> None:
+        """Attach logprob fields to an event for a logprobs-requesting seq."""
+        ev.logprob = float(chosen)
+        n = min(int(seq.logprobs or 0), len(tids))
+        ev.top_logprobs = [(int(tids[i]), float(tvals[i])) for i in range(n)]
+
+    def _prefill_request(self, req: GenRequest) -> TokenEvent:
+        first, pages, prompt_len, req_key, lp = self._run_prefill(req)
+        slot = self._free_slots.pop()
+        seq = self._install_slot(req, slot, pages, prompt_len, first, req_key)
 
         finished, reason = self._check_stop(seq, first)
         ev = TokenEvent(req.request_id, first, 0, finished, reason)
+        if req.logprobs is not None:
+            self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
         if finished:
             self._finish_slot(slot, reason)
         return ev
@@ -543,21 +630,27 @@ class Engine:
                 jnp.asarray(self.temperature),
                 jnp.asarray(self.top_p),
                 jnp.asarray(self.top_k),
+                jnp.asarray(self.presence),
+                jnp.asarray(self.frequency),
+                jnp.asarray(self.slot_keys),
             )
-        if self._dev_key is None:
-            self.rng, sub = jax.random.split(self.rng)
-            self._dev_key = sub
 
+        want_lp = any(s.logprobs is not None for s in self.seqs.values())
         cur, pos, ctx_lens, active_dev = self._dev_state
-        temp, top_p, top_k = self._dev_sampling
-        fn = self._decode_multi if window > 1 else self._decode
-        (toks, cur, pos, ctx_lens, self._dev_key, self.k_pages,
+        temp, top_p, top_k, pres, freq, keys = self._dev_sampling
+        fn = self._windows[(window > 1, want_lp)]
+        (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
          self.v_pages) = fn(
             self.params, cur, pos, ctx_lens, active_dev, self._dev_tables,
-            temp, top_p, top_k, self._dev_key, self.k_pages, self.v_pages,
+            temp, top_p, top_k, pres, freq, keys, self.token_counts,
+            self.k_pages, self.v_pages,
         )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
-        next_np = np.asarray(toks)  # [window, B] — the only download
+        next_np = np.asarray(ys[0])  # [window, B]
+        if want_lp:
+            chosen_np = np.asarray(ys[1])  # [window, B]
+            tids_np = np.asarray(ys[2])  # [window, B, K]
+            tvals_np = np.asarray(ys[3])
         self.metrics.decode_steps += window
         self.metrics.decode_time_s += time.monotonic() - t0
 
@@ -569,12 +662,14 @@ class Engine:
                 self.cur_tokens[slot] = tok
                 self.metrics.output_tokens += 1
                 finished, reason = self._check_stop(seq, tok)
-                events.append(
-                    TokenEvent(
-                        seq.request_id, tok, len(seq.output_tokens) - 1,
-                        finished, reason,
-                    )
+                ev = TokenEvent(
+                    seq.request_id, tok, len(seq.output_tokens) - 1,
+                    finished, reason,
                 )
+                if want_lp and seq.logprobs is not None:
+                    self._decorate_lp(ev, seq, chosen_np[k, slot],
+                                      tids_np[k, slot], tvals_np[k, slot])
+                events.append(ev)
                 if finished:
                     # mid-window stop: later window tokens for this slot are
                     # discarded (their KV lives in pages freed right here)
@@ -612,9 +707,11 @@ class Engine:
 
         Mirrors the reference's `--is-prefill-worker` / `--disaggregation-mode
         prefill` role (/root/reference/examples/deploy/vllm/disagg.yaml:37).
-        Returns (first_token, n_prompt_tokens). The KV stays resident until
-        export_kv()/release_parked() — the NIXL-style hold-until-pulled
-        contract (/root/reference/examples/deploy/sglang/disagg.yaml:47-52).
+        Returns (first_token, n_prompt_tokens, extras) where extras carries
+        the first token's logprob fields when requested. The KV stays
+        resident until export_kv()/release_parked() — the NIXL-style
+        hold-until-pulled contract
+        (/root/reference/examples/deploy/sglang/disagg.yaml:47-52).
         """
         if len(req.prompt_token_ids) >= self.cfg.max_seq_len:
             raise ValueError("prompt exceeds max_seq_len")
@@ -625,13 +722,22 @@ class Engine:
                 f"{self.cfg.num_pages - 1}"
             )
         with self._exec_lock:
-            first, pages, prompt_len = self._run_prefill(req)
+            first, pages, prompt_len, _, lp = self._run_prefill(req)
         with self._lock:
             stale = self._parked.pop(req.request_id, None)
             self._parked[req.request_id] = (pages, prompt_len, time.monotonic())
         if stale is not None:
             self.allocator.free(stale[0])
-        return first, prompt_len
+        extras = {}
+        if req.logprobs is not None:
+            n = min(int(req.logprobs), len(lp[1]))
+            extras = {
+                "logprob": lp[0],
+                "top_logprobs": [
+                    (int(lp[1][i]), float(lp[2][i])) for i in range(n)
+                ],
+            }
+        return first, prompt_len, extras
 
     def export_kv(self, request_id: str):
         """Gather a parked sequence's KV pages off the cache for transfer.
@@ -686,10 +792,9 @@ class Engine:
             return True, "length"
         with self._exec_lock:
             return self._import_kv_locked(req, first_token, k, v, n_prompt,
-                                          n_pages, stop_ids)
+                                          n_pages)
 
-    def _import_kv_locked(self, req, first_token, k, v, n_prompt, n_pages,
-                          stop_ids):
+    def _import_kv_locked(self, req, first_token, k, v, n_prompt, n_pages):
         if not self._free_slots:
             raise OutOfPages("no free decode slot for imported sequence")
         pages = self.allocator.alloc(n_pages)
@@ -700,22 +805,11 @@ class Engine:
             jnp.asarray(v).astype(self.v_pages.dtype),
         )
         slot = self._free_slots.pop()
-        seq = SeqState(
-            req.request_id, slot, pages, n_prompt,
-            max_tokens=req.max_tokens, temperature=req.temperature,
-            top_p=req.top_p, top_k=req.top_k, stop_token_ids=stop_ids,
-        )
-        seq.output_tokens.append(first_token)
-        self.seqs[slot] = seq
-        self.block_tables[slot, :] = 0
-        self.block_tables[slot, : len(pages)] = pages
-        self.cur_tokens[slot] = first_token
-        self.temperature[slot] = req.temperature
-        self.top_p[slot] = req.top_p
-        self.top_k[slot] = req.top_k
+        # seeded requests continue the same per-request key chain the prefill
+        # worker started, so disagg sampling == agg sampling for a given seed
+        self._install_slot(req, slot, pages, n_prompt, first_token,
+                           self._request_key(req))
         self.metrics.num_requests += 1
-        self.metrics.output_tokens += 1
-        self._invalidate_dev()  # new membership -> rebuild device batch state
         return False, None
 
     # ------------------------------------------------------------ conveniences

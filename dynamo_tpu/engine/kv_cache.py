@@ -125,7 +125,7 @@ class SeqState:
     __slots__ = (
         "request_id", "slot", "pages", "num_tokens", "output_tokens",
         "max_tokens", "temperature", "top_p", "top_k", "stop_token_ids",
-        "prompt_len",
+        "prompt_len", "logprobs",
     )
 
     def __init__(
@@ -139,6 +139,7 @@ class SeqState:
         top_p: float = 1.0,
         top_k: int = 0,
         stop_token_ids: Optional[List[int]] = None,
+        logprobs: Optional[int] = None,
     ):
         self.request_id = request_id
         self.slot = slot
@@ -151,6 +152,7 @@ class SeqState:
         self.top_p = top_p
         self.top_k = top_k
         self.stop_token_ids = stop_token_ids or []
+        self.logprobs = logprobs
 
     def needs_page(self, page_size: int) -> bool:
         """Will the next decoded token spill onto a new page?"""

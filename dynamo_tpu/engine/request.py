@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -17,6 +17,12 @@ class GenRequest:
     top_k: int = 0
     stop_token_ids: List[int] = dataclasses.field(default_factory=list)
     ignore_eos: bool = False
+    # OpenAI sampling extensions (/root/reference/README.md:277-292 serves the
+    # full OpenAI client surface; parity is fields, not just endpoint names)
+    seed: Optional[int] = None  # deterministic per-request sampling chain
+    presence_penalty: float = 0.0  # subtract if token appeared in output
+    frequency_penalty: float = 0.0  # subtract per occurrence in output
+    logprobs: Optional[int] = None  # None = off; N = return top-N alternatives
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
 
 
@@ -27,3 +33,6 @@ class TokenEvent:
     index: int  # 0-based output-token index
     finished: bool = False
     finish_reason: Optional[str] = None  # stop | length | abort | kv_oom
+    logprob: Optional[float] = None  # chosen-token logprob when requested
+    # [(token_id, logprob)] best-first alternatives when requested
+    top_logprobs: Optional[List[Tuple[int, float]]] = None

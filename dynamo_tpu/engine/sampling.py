@@ -1,14 +1,26 @@
-"""In-jit token sampling: greedy / temperature / top-k / top-p per batch slot.
+"""In-jit token sampling: greedy / temperature / top-k / top-p plus OpenAI
+presence/frequency penalties, per-slot PRNG chains, and optional logprobs.
 
 All parameters are per-slot arrays so one compiled sampler serves a
 heterogeneous continuous batch (requests arrive with their own OpenAI
 sampling params via /v1/chat/completions, mirroring the reference frontend's
 contract, /root/reference/README.md:284-292).
+
+Randomness is a per-slot key chain: each slot carries its own PRNGKey (seeded
+from the request's `seed` when given), and the key for the prediction made
+from position p is `fold_in(slot_key, p)`. Sampling is therefore
+deterministic per request — independent of batch composition, window size,
+or what other requests are in flight — which is what OpenAI's `seed` field
+promises ("best effort" determinism) and stronger than a shared batch key.
+
+Penalties follow vLLM semantics: presence/frequency count OUTPUT tokens only
+(a [B, V] count array maintained on device by the engine), applied to raw
+logits before temperature.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -18,19 +30,36 @@ class SamplingState(NamedTuple):
     temperature: jax.Array  # [B] float32; 0 -> greedy
     top_p: jax.Array  # [B] float32 in (0, 1]
     top_k: jax.Array  # [B] int32; 0 -> disabled
+    presence_penalty: jax.Array  # [B] float32; 0 -> off
+    frequency_penalty: jax.Array  # [B] float32; 0 -> off
 
 
-def sample(
-    logits: jax.Array,  # [B, V]
-    state: SamplingState,
-    key: jax.Array,
-) -> jax.Array:
-    """Return [B] sampled token ids."""
+def make_state(temperature, top_p, top_k, presence=None, frequency=None
+               ) -> SamplingState:
+    """Build a SamplingState, defaulting the penalty arrays to zeros."""
+    b = temperature.shape[0]
+    zeros = jnp.zeros((b,), jnp.float32)
+    return SamplingState(
+        temperature, top_p, top_k,
+        zeros if presence is None else presence,
+        zeros if frequency is None else frequency,
+    )
+
+
+def _masked_logits(logits: jax.Array, state: SamplingState,
+                   counts: jax.Array | None) -> Tuple[jax.Array, jax.Array]:
+    """Apply penalties + temperature + top-k + top-p masks.
+
+    Returns (scaled_masked_logits, greedy_token). logits: [B, V]."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
+    if counts is not None:
+        cf = counts.astype(jnp.float32)
+        logits = (logits
+                  - state.presence_penalty[:, None] * (cf > 0)
+                  - state.frequency_penalty[:, None] * cf)
     greedy = jnp.argmax(logits, axis=-1)
 
-    # temperature
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
     scaled = logits / temp
 
@@ -51,6 +80,41 @@ def sample(
     num_keep = jnp.maximum(keep_sorted.sum(axis=-1), 1)
     thresh = jnp.take_along_axis(sorted_desc2, (num_keep - 1)[:, None], axis=-1)
     scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
+    return scaled, greedy
 
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+
+def sample(
+    logits: jax.Array,  # [B, V]
+    state: SamplingState,
+    keys: jax.Array,  # [B, 2] uint32 — one PRNGKey per slot
+    counts: jax.Array | None = None,  # [B, V] output-token counts
+) -> jax.Array:
+    """Return [B] sampled token ids (gumbel-max with per-slot keys)."""
+    scaled, greedy = _masked_logits(logits, state, counts)
+    gumbel = jax.vmap(lambda k, row: jax.random.gumbel(k, row.shape))(
+        keys, scaled
+    )
+    sampled = jnp.argmax(scaled + gumbel, axis=-1)
     return jnp.where(state.temperature <= 0.0, greedy, sampled)
+
+
+def sample_with_logprobs(
+    logits: jax.Array,
+    state: SamplingState,
+    keys: jax.Array,
+    counts: jax.Array | None = None,
+    num_top: int = 5,
+):
+    """sample() plus logprobs of the chosen token and the top-`num_top`
+    alternatives, computed from the UNPENALIZED distribution at temperature 1
+    (the OpenAI contract: logprobs describe the model, not the sampler)."""
+    tokens = sample(logits, state, keys, counts)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # [B, V]
+    chosen = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]  # [B]
+    top_vals, top_ids = jax.lax.top_k(logp, num_top)  # [B, K]
+    return tokens, chosen, top_ids, top_vals
+
+
+def fold_positions(keys: jax.Array, positions: jax.Array) -> jax.Array:
+    """Per-slot step keys: fold_in(slot_key, position). keys [B,2], pos [B]."""
+    return jax.vmap(jax.random.fold_in)(keys, positions)

@@ -10,6 +10,7 @@ Endpoints: GET /v1/models, POST /v1/chat/completions, POST /v1/completions
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import List, Optional
 
@@ -54,15 +55,59 @@ class IncrementalDetokenizer:
         return delta
 
 
+class StopStringMatcher:
+    """Detokenizer-aware stop-string handling: holds back the longest
+    possible partial match so a stop string arriving across token boundaries
+    is never leaked to the client, and truncates the output at the match."""
+
+    def __init__(self, stops: List[str]):
+        self.stops = stops
+        self.hold = max((len(s) for s in stops), default=1) - 1
+        self.buf = ""
+        self.stopped = False
+
+    def push(self, delta: str) -> tuple:
+        """Returns (text_to_emit, stopped)."""
+        if self.stopped:
+            return "", True
+        self.buf += delta
+        best = -1
+        for s in self.stops:
+            i = self.buf.find(s)
+            if i >= 0 and (best < 0 or i < best):
+                best = i
+        if best >= 0:
+            self.stopped = True
+            emit, self.buf = self.buf[:best], ""
+            return emit, True
+        if self.hold <= 0 or len(self.buf) <= self.hold:
+            if self.hold <= 0:
+                emit, self.buf = self.buf, ""
+                return emit, False
+            return "", False
+        cut = len(self.buf) - self.hold
+        emit, self.buf = self.buf[:cut], self.buf[cut:]
+        return emit, False
+
+    def flush(self) -> str:
+        emit, self.buf = self.buf, ""
+        return emit
+
+
 class GenerationHandle:
     """A submitted request plus its event stream — submission (and its
     validation errors) happens strictly before any response bytes."""
 
     def __init__(self, ctx: "ServingContext", rid: str, prompt_ids: List[int],
-                 params: dict):
+                 params: dict, index: int = 0):
         self.ctx = ctx
         self.rid = rid
+        self.index = index
         self.prompt_ids = prompt_ids
+        self.stops: List[str] = params.get("stop") or []
+        self.want_logprobs = params.get("logprobs") is not None
+        # each choice of an n>1 request gets its own deterministic chain
+        seed = params.get("seed")
         self.req = GenRequest(
             rid,
             list(prompt_ids),
@@ -70,6 +115,10 @@ class GenerationHandle:
             temperature=params["temperature"],
             top_p=params["top_p"],
             top_k=params["top_k"],
+            presence_penalty=params.get("presence_penalty", 0.0),
+            frequency_penalty=params.get("frequency_penalty", 0.0),
+            seed=None if seed is None else seed + index,
+            logprobs=params.get("logprobs"),
             ignore_eos=params.get("ignore_eos", False),
         )
         if ctx.disagg_client is not None:
@@ -79,10 +128,23 @@ class GenerationHandle:
             self.queue = ctx.service.submit(self.req)  # raises ValueError early
         ctx.metrics.requests_total.inc(model=ctx.served_model)
         ctx.metrics.isl.observe(len(prompt_ids), model=ctx.served_model)
+        # collected when logprobs were requested: one protocol entry per token
+        self.lp_entries: List[dict] = []
+
+    def _lp_entry(self, ev) -> Optional[dict]:
+        """Build (but don't commit) the protocol logprob entry for a token."""
+        if not (self.want_logprobs and ev.logprob is not None):
+            return None
+        tok = self.ctx.tokenizer
+        return proto.chat_logprob_entry(
+            tok.decode([ev.token_id]), ev.logprob,
+            [(tok.decode([tid]), lp) for tid, lp in (ev.top_logprobs or [])],
+        )
 
     def run(self, emit) -> tuple:
-        """Drive the stream; emit(delta, finish|None) -> bool keeps going while
-        True. A False return (client gone) aborts the engine request.
+        """Drive the stream; emit(delta, finish|None, lp_entry|None) -> bool
+        keeps going while True. A False return (client gone) aborts the
+        engine request.
 
         Returns (text, finish_reason, completion_tokens)."""
         ctx, m = self.ctx, self.ctx.metrics
@@ -90,6 +152,8 @@ class GenerationHandle:
         t0 = time.monotonic()
         t_prev: Optional[float] = None
         detok = IncrementalDetokenizer(ctx.tokenizer)
+        matcher = StopStringMatcher(self.stops) if self.stops else None
+        text_parts: List[str] = []
         n_out = 0
         finish = "stop"
         for ev in ctx.service.drain(self.req, self.queue):
@@ -100,14 +164,36 @@ class GenerationHandle:
                 m.itl.observe(now - t_prev, model=model)
             t_prev = now
             delta = ""
+            lp_entry = None
             if ev.token_id >= 0:
                 n_out += 1
                 delta = detok.push(ev.token_id)
+                lp_entry = self._lp_entry(ev)
+            stopped = False
+            if matcher is not None and (delta or ev.finished):
+                delta, stopped = matcher.push(delta)
+                if not stopped and ev.finished:
+                    delta += matcher.flush()
+            if stopped:
+                # stop string seen: truncate the text, DISCARD the stop
+                # token's logprob entry (logprobs must match the returned
+                # content), abort the engine side, report finish "stop"
+                text_parts.append(delta)
+                emit(delta, "stop", None)
+                if not ev.finished:
+                    ctx.service.abort(self.rid)
+                finish = "stop"
+                break
+            if lp_entry is not None:
+                self.lp_entries.append(lp_entry)
             fr = proto.map_finish_reason(ev.finish_reason) if ev.finished else None
             if ev.finished:
                 finish = fr or "stop"
-            if delta or ev.finished:
-                if not emit(delta, fr) and not ev.finished:
+            text_parts.append(delta)
+            # emit on no-delta events too when they carry a logprob entry
+            # (UTF-8 holdback): streaming logprobs are one entry per token
+            if delta or ev.finished or lp_entry is not None:
+                if not emit(delta, fr, lp_entry) and not ev.finished:
                     log.info("client disconnected; aborting %s", self.rid)
                     ctx.service.abort(self.rid)
                     finish = "abort"
@@ -115,7 +201,7 @@ class GenerationHandle:
         m.duration.observe(time.monotonic() - t0, model=model)
         m.osl.observe(n_out, model=model)
         ctx.kv_gauge.set(ctx.engine.allocator.free_pages)
-        return detok.emitted, finish, n_out
+        return "".join(text_parts), finish, n_out
 
 
 class ServingContext:
@@ -157,8 +243,55 @@ class ServingContext:
             self.kv_source.close()
         self.service.close()
 
-    def start_generation(self, rid, prompt_ids, params) -> "GenerationHandle":
-        return GenerationHandle(self, rid, prompt_ids, params)
+    def start_generation(self, rid, prompt_ids, params,
+                         index: int = 0) -> "GenerationHandle":
+        return GenerationHandle(self, rid, prompt_ids, params, index=index)
+
+    def start_choices(self, rid, prompt_ids, params) -> List["GenerationHandle"]:
+        """Submit all n choices of a request (choice i streams under
+        request_id '<rid>-i'). Submission is all-or-nothing: a rejection on
+        choice k aborts choices 0..k-1 before re-raising."""
+        n = params.get("n", 1)
+        handles: List[GenerationHandle] = []
+        try:
+            for i in range(n):
+                handles.append(GenerationHandle(
+                    self, f"{rid}-{i}" if n > 1 else rid,
+                    prompt_ids, params, index=i,
+                ))
+        except Exception:
+            for h in handles:
+                self.service.abort(h.rid)
+            raise
+        return handles
+
+
+def run_choices(handles: List["GenerationHandle"], emit_for) -> List[tuple]:
+    """Drive n choice streams concurrently; emit_for(handle) returns that
+    choice's emit callback (already thread-safe). Returns the per-choice
+    (text, finish_reason, completion_tokens) in choice order; the first
+    choice failure propagates after all threads settle."""
+    if len(handles) == 1:
+        return [handles[0].run(emit_for(handles[0]))]
+    results: List[Optional[tuple]] = [None] * len(handles)
+    errors: List[Optional[BaseException]] = [None] * len(handles)
+
+    def drive(i: int):
+        try:
+            results[i] = handles[i].run(emit_for(handles[i]))
+        except BaseException as e:  # noqa: BLE001 — reported to the client
+            errors[i] = e
+
+    threads = [threading.Thread(target=drive, args=(i,), daemon=True)
+               for i in range(len(handles))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errors:
+        if e is not None:
+            raise e
+    return results  # type: ignore[return-value]
 
 
 class _Handler(JsonHTTPHandler):
@@ -234,14 +367,18 @@ class _Handler(JsonHTTPHandler):
         ids = body.get("prompt_token_ids")
         if not rid or not isinstance(ids, list) or not ids:
             raise proto.BadRequest("need request_id and prompt_token_ids")
+        lp = body.get("logprobs")
+        seed = body.get("seed")
         req = GenRequest(
             rid, [int(t) for t in ids],
             temperature=float(body.get("temperature", 0.0)),
             top_p=float(body.get("top_p", 1.0)),
             top_k=int(body.get("top_k", 0)),
+            seed=int(seed) if seed is not None else None,
+            logprobs=int(lp) if lp is not None else None,
         )
         t0 = time.monotonic()
-        first, n_tokens = ctx.engine.prefill_only(req)
+        first, n_tokens, extras = ctx.engine.prefill_only(req)
         ctx.metrics.ttft.observe(time.monotonic() - t0, model=ctx.served_model)
         ctx.metrics.requests_total.inc(model=ctx.served_model)
         ctx.metrics.isl.observe(n_tokens, model=ctx.served_model)
@@ -251,6 +388,7 @@ class _Handler(JsonHTTPHandler):
             "n_tokens": n_tokens,
             "bootstrap_port": ctx.kv_source.port,
             "transfer_backend": ctx.engine.cfg.disaggregation_transfer_backend,
+            **extras,
         })
 
     def _check_model(self, model: str):
@@ -265,43 +403,63 @@ class _Handler(JsonHTTPHandler):
         prompt_text = self.ctx.tokenizer.apply_chat_template(p["messages"])
         prompt_ids = self.ctx.tokenizer.encode(prompt_text)
         rid = proto.new_id("chatcmpl")
-        gen = self.ctx.start_generation(rid, prompt_ids, p)  # may raise -> 400
+        handles = self.ctx.start_choices(rid, prompt_ids, p)  # may raise -> 400
 
         if p["stream"]:
             with_null = p.get("include_usage", False)
             self._start_sse()
-            self._sse_chunk(
-                proto.chat_chunk(rid, p["model"], {"role": "assistant"}, None,
-                                 with_usage_null=with_null)
-            )
+            lock = threading.Lock()
+            for h in handles:
+                self._sse_chunk(
+                    proto.chat_chunk(rid, p["model"], {"role": "assistant"},
+                                     None, with_usage_null=with_null,
+                                     index=h.index)
+                )
 
-            def emit(delta, finish) -> bool:
-                ok = True
-                if delta:
-                    ok = self._sse_chunk(
-                        proto.chat_chunk(rid, p["model"], {"content": delta},
-                                         None, with_usage_null=with_null)
-                    )
-                if finish is not None:
-                    ok = self._sse_chunk(
-                        proto.chat_chunk(rid, p["model"], {}, finish,
-                                         with_usage_null=with_null)) and ok
-                return ok
+            def emit_for(h):
+                def emit(delta, finish, lp_entry) -> bool:
+                    with lock:
+                        ok = True
+                        if delta or lp_entry is not None:
+                            ok = self._sse_chunk(proto.chat_chunk(
+                                rid, p["model"], {"content": delta}, None,
+                                with_usage_null=with_null, index=h.index,
+                                logprob_entries=(
+                                    [lp_entry] if lp_entry is not None
+                                    else (None if not h.want_logprobs else [])
+                                ),
+                            ))
+                        if finish is not None:
+                            ok = self._sse_chunk(proto.chat_chunk(
+                                rid, p["model"], {}, finish,
+                                with_usage_null=with_null, index=h.index,
+                            )) and ok
+                        return ok
+                return emit
 
-            _, _, n_out = gen.run(emit)
+            results = run_choices(handles, emit_for)
             if p.get("include_usage"):
                 self._sse_chunk(proto.usage_chunk(
                     rid, p["model"], "chat.completion.chunk",
-                    len(prompt_ids), n_out,
+                    len(prompt_ids), sum(r[2] for r in results),
                 ))
             self._sse_chunk("[DONE]")
             self._end_sse()
         else:
-            text, finish, n_out = gen.run(lambda d, f: True)
+            results = run_choices(handles,
+                                  lambda h: (lambda d, f, lp: True))
+            choices = [
+                proto.chat_choice(
+                    h.index, text, finish,
+                    h.lp_entries if h.want_logprobs else None,
+                )
+                for h, (text, finish, _) in zip(handles, results)
+            ]
             self._json(
                 200,
                 proto.chat_completion_response(
-                    rid, p["model"], text, finish, len(prompt_ids), n_out
+                    rid, p["model"], choices, len(prompt_ids),
+                    sum(r[2] for r in results),
                 ),
             )
 
@@ -310,36 +468,66 @@ class _Handler(JsonHTTPHandler):
         self._check_model(p["model"])
         prompt_ids = self.ctx.tokenizer.encode(p["prompt"])
         rid = proto.new_id("cmpl")
-        gen = self.ctx.start_generation(rid, prompt_ids, p)
+        handles = self.ctx.start_choices(rid, prompt_ids, p)
+
+        def lp_block(h):
+            if not h.want_logprobs:
+                return None
+            return proto.completion_logprobs(
+                [e["token"] for e in h.lp_entries],
+                [e["logprob"] for e in h.lp_entries],
+                [[(a["token"], a["logprob"]) for a in e["top_logprobs"]]
+                 for e in h.lp_entries],
+            )
+
         if p["stream"]:
             self._start_sse()
+            lock = threading.Lock()
 
-            def emit(delta, finish) -> bool:
-                if delta or finish is not None:
-                    chunk = {
-                        "id": rid, "object": "text_completion",
-                        "created": int(time.time()), "model": p["model"],
-                        "choices": [{"index": 0, "text": delta,
-                                     "finish_reason": finish}],
-                    }
-                    if p.get("include_usage"):
-                        chunk["usage"] = None
-                    return self._sse_chunk(chunk)
-                return True
+            def emit_for(h):
+                def emit(delta, finish, lp_entry) -> bool:
+                    if not (delta or finish is not None
+                            or lp_entry is not None):
+                        return True
+                    with lock:
+                        choice = {"index": h.index, "text": delta,
+                                  "finish_reason": finish}
+                        if lp_entry is not None:
+                            choice["logprobs"] = proto.completion_logprobs(
+                                [lp_entry["token"]], [lp_entry["logprob"]],
+                                [[(a["token"], a["logprob"])
+                                  for a in lp_entry["top_logprobs"]]],
+                            )
+                        chunk = {
+                            "id": rid, "object": "text_completion",
+                            "created": int(time.time()), "model": p["model"],
+                            "choices": [choice],
+                        }
+                        if p.get("include_usage"):
+                            chunk["usage"] = None
+                        return self._sse_chunk(chunk)
+                return emit
 
-            _, _, n_out = gen.run(emit)
+            results = run_choices(handles, emit_for)
             if p.get("include_usage"):
                 self._sse_chunk(proto.usage_chunk(
-                    rid, p["model"], "text_completion", len(prompt_ids), n_out,
+                    rid, p["model"], "text_completion", len(prompt_ids),
+                    sum(r[2] for r in results),
                 ))
             self._sse_chunk("[DONE]")
             self._end_sse()
         else:
-            text, finish, n_out = gen.run(lambda d, f: True)
+            results = run_choices(handles,
+                                  lambda h: (lambda d, f, lp: True))
+            choices = [
+                proto.completion_choice(h.index, text, finish, lp_block(h))
+                for h, (text, finish, _) in zip(handles, results)
+            ]
             self._json(
                 200,
                 proto.completion_response(
-                    rid, p["model"], text, finish, len(prompt_ids), n_out
+                    rid, p["model"], choices, len(prompt_ids),
+                    sum(r[2] for r in results),
                 ),
             )
 

@@ -93,6 +93,10 @@ class DisaggDecodeClient:
             "temperature": req.temperature,
             "top_p": req.top_p,
             "top_k": req.top_k,
+            # seeded requests must sample the same first token the agg path
+            # would (the prefill worker continues the request's key chain)
+            "seed": req.seed,
+            "logprobs": req.logprobs,
         }).encode()
         t0 = time.monotonic()
         try:
@@ -136,6 +140,10 @@ class DisaggDecodeClient:
         except Exception:
             ctx.service.detach(req.request_id)
             raise
-        q.put(TokenEvent(req.request_id, first_token, 0, finished, reason))
+        ev = TokenEvent(req.request_id, first_token, 0, finished, reason)
+        if req.logprobs is not None and "logprob" in out:
+            ev.logprob = out["logprob"]
+            ev.top_logprobs = [tuple(t) for t in out.get("top_logprobs", [])]
+        q.put(ev)
         ctx.service.wake()
         return q

@@ -20,6 +20,55 @@ def new_id(prefix: str = "chatcmpl") -> str:
     return f"{prefix}-{uuid.uuid4().hex[:24]}"
 
 
+MAX_N = 8  # choices per request; bounded so one request can't hog the batch
+MAX_TOP_LOGPROBS = 5  # engine computes top-5 alternatives per step
+
+
+def _common_sampling(body: Dict[str, Any]) -> Dict[str, Any]:
+    """Fields shared by chat + completions: sampling, penalties, seed, stop,
+    n, stream/stream_options."""
+    temperature = _num(body, "temperature", 1.0)
+    if temperature < 0:
+        raise BadRequest("'temperature' must be >= 0")
+    for key in ("presence_penalty", "frequency_penalty"):
+        v = _num(body, key, 0.0)
+        if not -2.0 <= v <= 2.0:
+            raise BadRequest(f"'{key}' must be in [-2, 2]")
+    seed = body.get("seed")
+    if seed is not None and (isinstance(seed, bool) or not isinstance(seed, int)):
+        raise BadRequest("'seed' must be an integer")
+    n = body.get("n", 1)
+    if isinstance(n, bool) or not isinstance(n, int) or not 1 <= n <= MAX_N:
+        raise BadRequest(f"'n' must be an integer in [1, {MAX_N}]")
+    return {
+        "temperature": temperature,
+        "top_p": _num(body, "top_p", 1.0),
+        "top_k": int(_num(body, "top_k", 0)),
+        "presence_penalty": _num(body, "presence_penalty", 0.0),
+        "frequency_penalty": _num(body, "frequency_penalty", 0.0),
+        "seed": seed,
+        "n": n,
+        "stop": _parse_stop(body),
+        "stream": bool(body.get("stream", False)),
+        "include_usage": _include_usage(body),
+        "ignore_eos": bool(body.get("ignore_eos", False)),
+    }
+
+
+def _parse_stop(body: Dict[str, Any]) -> List[str]:
+    stop = body.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    if (not isinstance(stop, list) or len(stop) > 4
+            or not all(isinstance(s, str) and s for s in stop)):
+        raise BadRequest(
+            "'stop' must be a non-empty string or up to 4 non-empty strings"
+        )
+    return stop
+
+
 def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
     if not isinstance(body, dict):
         raise BadRequest("body must be a JSON object")
@@ -32,30 +81,46 @@ def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
     model = body.get("model")
     if not isinstance(model, str) or not model:
         raise BadRequest("'model' is required")
-    mt = body.get("max_tokens", body.get("max_completion_tokens", 512))
-    if not isinstance(mt, int) or mt < 1:
+    # max_completion_tokens is the current OpenAI name; max_tokens the legacy
+    # alias — accept both; explicit null means absent (OpenAI semantics)
+    mt = body.get("max_tokens")
+    if mt is None:
+        mt = body.get("max_completion_tokens")
+    if mt is None:
+        mt = 512
+    if isinstance(mt, bool) or not isinstance(mt, int) or mt < 1:
         raise BadRequest("'max_tokens' must be a positive integer")
-    temperature = _num(body, "temperature", 1.0)
-    if temperature < 0:
-        raise BadRequest("'temperature' must be >= 0")
+    lp = body.get("logprobs", False)
+    if not isinstance(lp, bool):
+        raise BadRequest("'logprobs' must be a boolean for chat completions")
+    top_lp = body.get("top_logprobs", 0)
+    if (isinstance(top_lp, bool) or not isinstance(top_lp, int)
+            or not 0 <= top_lp <= MAX_TOP_LOGPROBS):
+        raise BadRequest(
+            f"'top_logprobs' must be an integer in [0, {MAX_TOP_LOGPROBS}]"
+        )
+    if top_lp and not lp:
+        raise BadRequest("'top_logprobs' requires 'logprobs': true")
     return {
         "model": model,
         "messages": messages,
         "max_tokens": mt,
-        "temperature": temperature,
-        "top_p": _num(body, "top_p", 1.0),
-        "top_k": int(_num(body, "top_k", 0)),
-        "stream": bool(body.get("stream", False)),
-        "include_usage": _include_usage(body),
-        "ignore_eos": bool(body.get("ignore_eos", False)),
+        # engine logprobs: None = off; N = chosen + top-N alternatives
+        "logprobs": top_lp if lp else None,
+        **_common_sampling(body),
     }
 
 
 def _include_usage(body: Dict[str, Any]) -> bool:
-    so = body.get("stream_options") or {}
-    if not isinstance(so, dict):
+    so_raw = body.get("stream_options")
+    if so_raw is None:
+        return False
+    if not isinstance(so_raw, dict):
         raise BadRequest("'stream_options' must be an object")
-    return bool(so.get("include_usage", False))
+    if not body.get("stream", False):
+        # OpenAI returns 400 for stream_options without stream=true
+        raise BadRequest("'stream_options' requires 'stream': true")
+    return bool(so_raw.get("include_usage", False))
 
 
 def _usage(prompt_tokens: int, completion_tokens: int) -> Dict[str, int]:
@@ -87,18 +152,23 @@ def parse_completion_request(body: Dict[str, Any]) -> Dict[str, Any]:
     if not isinstance(model, str) or not model:
         raise BadRequest("'model' is required")
     mt = body.get("max_tokens", 16)
-    if not isinstance(mt, int) or mt < 1:
+    if isinstance(mt, bool) or not isinstance(mt, int) or mt < 1:
         raise BadRequest("'max_tokens' must be a positive integer")
+    # legacy completions logprobs: an integer count of alternatives
+    lp = body.get("logprobs")
+    if lp is not None and (
+        isinstance(lp, bool) or not isinstance(lp, int)
+        or not 0 <= lp <= MAX_TOP_LOGPROBS
+    ):
+        raise BadRequest(
+            f"'logprobs' must be an integer in [0, {MAX_TOP_LOGPROBS}]"
+        )
     return {
         "model": model,
         "prompt": prompt,
         "max_tokens": mt,
-        "temperature": _num(body, "temperature", 1.0),
-        "top_p": _num(body, "top_p", 1.0),
-        "top_k": int(_num(body, "top_k", 0)),
-        "stream": bool(body.get("stream", False)),
-        "include_usage": _include_usage(body),
-        "ignore_eos": bool(body.get("ignore_eos", False)),
+        "logprobs": lp,
+        **_common_sampling(body),
     }
 
 
@@ -113,8 +183,39 @@ def models_response(models: List[str]) -> Dict[str, Any]:
     }
 
 
+def _token_bytes(token_text: str) -> List[int]:
+    return list(token_text.encode("utf-8"))
+
+
+def chat_logprob_entry(token_text: str, logprob: float,
+                       top: List[tuple]) -> Dict[str, Any]:
+    """One content entry of a chat choice's logprobs; `top` is
+    [(token_text, logprob)] best-first."""
+    return {
+        "token": token_text,
+        "logprob": logprob,
+        "bytes": _token_bytes(token_text),
+        "top_logprobs": [
+            {"token": t, "logprob": lp, "bytes": _token_bytes(t)}
+            for t, lp in top
+        ],
+    }
+
+
+def chat_choice(index: int, text: str, finish_reason: str,
+                logprob_entries: Optional[List[Dict]] = None) -> Dict[str, Any]:
+    out = {
+        "index": index,
+        "message": {"role": "assistant", "content": text},
+        "finish_reason": finish_reason,
+    }
+    if logprob_entries is not None:
+        out["logprobs"] = {"content": logprob_entries}
+    return out
+
+
 def chat_completion_response(
-    rid: str, model: str, text: str, finish_reason: str,
+    rid: str, model: str, choices: List[Dict[str, Any]],
     prompt_tokens: int, completion_tokens: int,
 ) -> Dict[str, Any]:
     return {
@@ -122,27 +223,27 @@ def chat_completion_response(
         "object": "chat.completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [
-            {
-                "index": 0,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": finish_reason,
-            }
-        ],
+        "choices": choices,
         "usage": _usage(prompt_tokens, completion_tokens),
     }
 
 
 def chat_chunk(
     rid: str, model: str, delta: Dict[str, Any], finish_reason: Optional[str],
-    with_usage_null: bool = False,
+    with_usage_null: bool = False, index: int = 0,
+    logprob_entries: Optional[List[Dict]] = None,
 ) -> Dict[str, Any]:
+    choice: Dict[str, Any] = {
+        "index": index, "delta": delta, "finish_reason": finish_reason,
+    }
+    if logprob_entries is not None:
+        choice["logprobs"] = {"content": logprob_entries}
     out = {
         "id": rid,
         "object": "chat.completion.chunk",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+        "choices": [choice],
     }
     if with_usage_null:
         # with stream_options.include_usage, every non-final chunk carries
@@ -151,8 +252,29 @@ def chat_chunk(
     return out
 
 
+def completion_logprobs(tokens: List[str], token_logprobs: List[float],
+                        top: List[List[tuple]]) -> Dict[str, Any]:
+    """Legacy completions logprobs block; `top[i]` is [(text, lp)]."""
+    offsets, pos = [], 0
+    for t in tokens:
+        offsets.append(pos)
+        pos += len(t)
+    return {
+        "tokens": tokens,
+        "token_logprobs": token_logprobs,
+        "top_logprobs": [{t: lp for t, lp in alts} for alts in top],
+        "text_offset": offsets,
+    }
+
+
+def completion_choice(index: int, text: str, finish_reason: str,
+                      logprobs: Optional[Dict] = None) -> Dict[str, Any]:
+    return {"index": index, "text": text, "finish_reason": finish_reason,
+            "logprobs": logprobs}
+
+
 def completion_response(
-    rid: str, model: str, text: str, finish_reason: str,
+    rid: str, model: str, choices: List[Dict[str, Any]],
     prompt_tokens: int, completion_tokens: int,
 ) -> Dict[str, Any]:
     return {
@@ -160,8 +282,7 @@ def completion_response(
         "object": "text_completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason,
-                     "logprobs": None}],
+        "choices": choices,
         "usage": _usage(prompt_tokens, completion_tokens),
     }
 

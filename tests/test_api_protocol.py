@@ -1,0 +1,222 @@
+"""OpenAI protocol completeness through the HTTP layer: stop strings,
+logprobs, n>1, seed, penalties, max_completion_tokens, stream_options
+validation — one test per field (VERDICT r1 item 6; surface contract
+/root/reference/README.md:277-292)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.serving.api import (
+    ServingContext,
+    StopStringMatcher,
+    make_server,
+    serve_forever_in_thread,
+)
+
+MODEL = "tiny-debug"
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    engine = Engine(
+        EngineConfig(model=MODEL, page_size=4, num_pages=256, max_num_seqs=8,
+                     max_seq_len=128)
+    )
+    ctx = ServingContext(engine, MODEL)
+    srv = make_server(ctx, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield url
+    srv.shutdown()
+    ctx.close()
+
+
+def post(url, path, body, raw=False):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    resp = urllib.request.urlopen(req, timeout=120)
+    return resp if raw else json.loads(resp.read())
+
+
+def chat_body(**over):
+    body = {"model": MODEL, "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 8, "temperature": 0, "ignore_eos": True}
+    body.update(over)
+    return body
+
+
+def sse_chunks(resp):
+    out = []
+    for line in resp:
+        line = line.decode().strip()
+        if line.startswith("data: "):
+            out.append(line[6:])
+    assert out[-1] == "[DONE]"
+    return [json.loads(c) for c in out[:-1]]
+
+
+# ------------------------------------------------------------------ fields --
+
+
+def test_max_completion_tokens_alias(server_url):
+    out = post(server_url, "/v1/chat/completions",
+               chat_body(max_tokens=None) | {"max_completion_tokens": 5})
+    del out["choices"][0]["message"]  # shape checked elsewhere
+    assert out["usage"]["completion_tokens"] == 5
+
+
+def test_seed_reproducible_over_http(server_url):
+    body = chat_body(temperature=0.9, seed=1234, max_tokens=10)
+    a = post(server_url, "/v1/chat/completions", body)
+    b = post(server_url, "/v1/chat/completions", body)
+    assert a["choices"][0]["message"]["content"] == \
+        b["choices"][0]["message"]["content"]
+
+
+def test_penalties_accepted_and_validated(server_url):
+    out = post(server_url, "/v1/chat/completions",
+               chat_body(presence_penalty=1.0, frequency_penalty=0.5))
+    assert out["choices"][0]["finish_reason"] in ("stop", "length")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(server_url, "/v1/chat/completions",
+             chat_body(frequency_penalty=3.5))
+    assert ei.value.code == 400
+
+
+def test_n_choices_non_streaming(server_url):
+    out = post(server_url, "/v1/chat/completions",
+               chat_body(n=3, temperature=0.8, seed=7, max_tokens=6))
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    texts = {c["message"]["content"] for c in out["choices"]}
+    assert len(texts) > 1  # distinct seeds per choice
+    assert out["usage"]["completion_tokens"] == 18  # summed over choices
+
+
+def test_n_choices_streaming_indices(server_url):
+    resp = post(server_url, "/v1/chat/completions",
+                chat_body(n=2, temperature=0.8, seed=3, stream=True,
+                          max_tokens=5), raw=True)
+    parsed = sse_chunks(resp)
+    indices = {c["choices"][0]["index"] for c in parsed}
+    assert indices == {0, 1}
+    # every choice terminates with its own finish chunk
+    finishes = [c["choices"][0] for c in parsed
+                if c["choices"][0]["finish_reason"] is not None]
+    assert {f["index"] for f in finishes} == {0, 1}
+
+
+def test_n_out_of_range_rejected(server_url):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(server_url, "/v1/chat/completions", chat_body(n=100))
+    assert ei.value.code == 400
+
+
+def test_chat_logprobs(server_url):
+    out = post(server_url, "/v1/chat/completions",
+               chat_body(logprobs=True, top_logprobs=3, max_tokens=4))
+    content = out["choices"][0]["logprobs"]["content"]
+    assert len(content) == 4
+    for entry in content:
+        assert entry["logprob"] <= 0.0
+        assert isinstance(entry["bytes"], list)
+        assert len(entry["top_logprobs"]) == 3
+        # greedy: the chosen token is the argmax alternative
+        assert entry["top_logprobs"][0]["logprob"] == pytest.approx(
+            entry["logprob"], abs=1e-4
+        )
+
+
+def test_chat_logprobs_streaming(server_url):
+    resp = post(server_url, "/v1/chat/completions",
+                chat_body(logprobs=True, top_logprobs=2, stream=True,
+                          max_tokens=3), raw=True)
+    parsed = sse_chunks(resp)
+    entries = [e for c in parsed
+               for e in (c["choices"][0].get("logprobs") or {}).get(
+                   "content", [])]
+    assert len(entries) == 3
+    assert all(len(e["top_logprobs"]) == 2 for e in entries)
+
+
+def test_top_logprobs_requires_logprobs(server_url):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(server_url, "/v1/chat/completions", chat_body(top_logprobs=2))
+    assert ei.value.code == 400
+
+
+def test_completions_logprobs_legacy_block(server_url):
+    out = post(server_url, "/v1/completions", {
+        "model": MODEL, "prompt": "abc", "max_tokens": 3, "temperature": 0,
+        "ignore_eos": True, "logprobs": 2,
+    })
+    lp = out["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 3
+    assert len(lp["token_logprobs"]) == 3
+    assert all(len(t) <= 2 for t in lp["top_logprobs"])
+    assert lp["text_offset"][0] == 0
+
+
+def test_stop_string_truncates(server_url):
+    # byte tokenizer: the model emits deterministic bytes; pick the first
+    # greedy output char as the stop string -> content must be empty and
+    # finish_reason "stop"
+    ref = post(server_url, "/v1/chat/completions", chat_body(max_tokens=8))
+    full = ref["choices"][0]["message"]["content"]
+    assert full
+    stop_char = full[0]
+    out = post(server_url, "/v1/chat/completions",
+               chat_body(max_tokens=8) | {"stop": stop_char})
+    assert out["choices"][0]["message"]["content"] == ""
+    assert out["choices"][0]["finish_reason"] == "stop"
+
+
+def test_stop_string_multi_and_validation(server_url):
+    out = post(server_url, "/v1/chat/completions",
+               chat_body() | {"stop": ["zzzz-never", "qqqq-never"]})
+    assert out["choices"][0]["finish_reason"] in ("stop", "length")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(server_url, "/v1/chat/completions",
+             chat_body() | {"stop": ["a", "b", "c", "d", "e"]})
+    assert ei.value.code == 400
+
+
+def test_stream_options_requires_stream(server_url):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(server_url, "/v1/chat/completions",
+             chat_body(stream_options={"include_usage": True}))
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(server_url, "/v1/chat/completions",
+             chat_body(stream=True, stream_options=[]))
+    assert ei.value.code == 400
+
+
+# --------------------------------------------------------------- unit level --
+
+
+def test_stop_matcher_across_boundaries():
+    m = StopStringMatcher(["STOP"])
+    emitted = ""
+    for delta in ["hel", "lo S", "TO", "P tail"]:
+        out, stopped = m.push(delta)
+        emitted += out
+        if stopped:
+            break
+    assert stopped
+    assert emitted == "hello "
+
+
+def test_stop_matcher_holdback_flush():
+    m = StopStringMatcher(["XYZ"])
+    out1, s1 = m.push("abcXY")  # XY could start XYZ -> held back
+    assert not s1 and out1 == "abc"
+    out2, s2 = m.push("w")  # XYw is not a stop; safe to release up to holdback
+    assert not s2
+    assert out1 + out2 + m.flush() == "abcXYw"

@@ -42,7 +42,7 @@ def test_ici_handoff_matches_aggregated(engines):
 
     req = GenRequest("d1", prompt, max_tokens=8, temperature=0.0,
                      ignore_eos=True)
-    first, n = prefill.prefill_only(req)
+    first, n, _lp = prefill.prefill_only(req)
     assert n == len(prompt)
     assert first == ref[0], "prefill-side first token diverged"
     ICIHandoff(prefill, decode).transfer(req, first)
@@ -60,7 +60,7 @@ def test_dcn_transfer_matches_aggregated(engines):
 
     req = GenRequest("d2", prompt, max_tokens=6, temperature=0.0,
                      ignore_eos=True)
-    first, _ = prefill.prefill_only(req)
+    first, _, _lp = prefill.prefill_only(req)
     src = KVSource(prefill, port=0)
     try:
         k, v, n_tokens = fetch_kv("127.0.0.1", src.port, "d2")
@@ -108,7 +108,7 @@ def test_import_first_token_stop(engines):
     agg, prefill, decode = engines
     req = GenRequest("s1", [1, 2, 3], max_tokens=1, temperature=0.0,
                      ignore_eos=True)
-    first, _ = prefill.prefill_only(req)
+    first, _, _lp = prefill.prefill_only(req)
     k, v, _ = prefill.export_kv("s1")
     finished, reason = decode.import_kv(req, first, k, v)
     prefill.release_parked("s1")
@@ -184,3 +184,28 @@ def test_disagg_end_to_end_via_frontend(disagg_http_stack):
     ref = agg.generate(GenRequest("ref", prompt_ids, max_tokens=8,
                                   temperature=0.0, ignore_eos=True))
     assert out["choices"][0]["message"]["content"] == tok.decode(ref)
+
+
+def test_seeded_sampling_matches_agg_across_disagg(engines):
+    """seed=N must produce the same tokens whether the request runs
+    aggregated or split across prefill/decode workers (per-request key
+    chains survive the KV handoff)."""
+    agg, prefill, decode = engines
+    prompt = [2, 4, 6, 8, 10]
+    mk = lambda rid: GenRequest(rid, prompt, max_tokens=8, temperature=0.9,
+                                seed=77, ignore_eos=True, logprobs=2)
+    ref_events = []
+    agg.add_request(mk("sref"))
+    while agg.has_work:
+        ref_events.extend(e for e in agg.step() if e.token_id >= 0)
+    ref = [e.token_id for e in ref_events]
+
+    req = mk("sd")
+    first, _, extras = prefill.prefill_only(req)
+    assert first == ref[0], "seeded prefill first token diverged"
+    # first-token logprob extras flow back for the disagg RPC response
+    assert extras["logprob"] == pytest.approx(ref_events[0].logprob, abs=1e-5)
+    assert len(extras["top_logprobs"]) == 2
+    ICIHandoff(prefill, decode).transfer(req, first)
+    rest = drain(decode, "sd")
+    assert [first] + rest == ref

@@ -49,6 +49,10 @@ class EngineConfig:
 
     seed: int = 0
 
+    # weight-only quantization: none | int8 (per-channel symmetric; puts the
+    # 8B north-star model inside a v5e chip's 16 GiB — BASELINE.json #3)
+    quantization: str = "none"
+
     # multi-step decode: fuse this many decode iterations into one jit
     # dispatch (lax.scan with on-device sampling). Amortises per-step host
     # round-trips — the dominant cost on networked TPU backends — at the cost
@@ -93,6 +97,8 @@ class EngineConfig:
         p.add_argument("--trust-remote-code", action="store_true")  # accepted, unused
         p.add_argument("--skip-tokenizer-init", action="store_true")
         p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--quantization", default="none",
+                       choices=["none", "int8"])
         p.add_argument("--attention-backend", default="auto",
                        choices=["auto", "xla", "pallas", "pallas_interpret"])
         return p
@@ -122,5 +128,6 @@ class EngineConfig:
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,
             seed=args.seed,
+            quantization=getattr(args, "quantization", "none"),
             attention_backend=args.attention_backend,
         )

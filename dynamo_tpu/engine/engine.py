@@ -117,7 +117,10 @@ class Engine:
         if params is None:
             from dynamo_tpu.models.loader import load_or_init_params
 
-            params = load_or_init_params(self.model_cfg, cfg.model_path, seed=cfg.seed)
+            params = load_or_init_params(
+                self.model_cfg, cfg.model_path, seed=cfg.seed,
+                quantization=cfg.quantization,
+            )
         with self.mesh:
             self.params = shd.shard_params(params, self.mesh)
 
@@ -276,8 +279,8 @@ class Engine:
         def import_fn(k_pages, v_pages, idx, k_new, v_new):
             # disagg KV install: in-place page scatter (pools donated)
             return (
-                k_pages.at[:, :, idx].set(k_new),
-                v_pages.at[:, :, idx].set(v_new),
+                k_pages.at[:, idx].set(k_new),
+                v_pages.at[:, idx].set(v_new),
             )
 
         # Bind this engine's attention backend + mesh around every call
@@ -742,7 +745,7 @@ class Engine:
     def export_kv(self, request_id: str):
         """Gather a parked sequence's KV pages off the cache for transfer.
 
-        Returns (k, v, n_tokens): arrays [L, KV, n_pages, ps, D] (numpy).
+        Returns (k, v, n_tokens): arrays [L, n_pages, ps, KV*D] (numpy).
         TPU-native replacement for the NIXL KV pull: a single XLA gather per
         pool (device->host once), shipped over ICI/DCN by the transfer layer.
         """
@@ -750,8 +753,8 @@ class Engine:
             pages, n_tokens, _ = self._parked[request_id]
         with self._exec_lock:
             idx = jnp.asarray(pages, jnp.int32)
-            k = np.asarray(jnp.take(self.k_pages, idx, axis=2))
-            v = np.asarray(jnp.take(self.v_pages, idx, axis=2))
+            k = np.asarray(jnp.take(self.k_pages, idx, axis=1))
+            v = np.asarray(jnp.take(self.v_pages, idx, axis=1))
         return k, v, n_tokens
 
     def release_parked(self, request_id: str):
@@ -781,7 +784,7 @@ class Engine:
         is installed."""
         cfg = self.cfg
         n_prompt = len(req.prompt_token_ids)
-        n_pages = k.shape[2]
+        n_pages = k.shape[1]
         stop_ids = (
             [] if req.ignore_eos
             else (req.stop_token_ids or [self.model_cfg.eos_token_id])

@@ -1,9 +1,12 @@
 """Paged KV-cache: device-resident page pool + host-side page allocator.
 
-The device arrays are `[num_layers, num_kv_heads, num_pages, page_size,
-head_dim]` for K and V, sharded on the KV-head axis over the `model` mesh axis
-(dynamo_tpu.parallel.sharding.KV_SPEC) so each tensor-parallel shard owns its
-local heads' pages and the decode loop never crosses ICI for cache reads.
+The device arrays are `[num_layers, num_pages, page_size, num_kv_heads *
+head_dim]` for K and V — page-major with the KV heads fused into the trailing
+lane axis, so one page is one contiguous slab the Pallas decode kernel moves
+with a single DMA. The fused axis is sharded over the `model` mesh axis
+(dynamo_tpu.parallel.sharding.KV_SPEC): head h occupies lanes [h*D, (h+1)*D),
+each tensor-parallel shard owns its local heads' lanes of every page, and the
+decode loop never crosses ICI for cache reads.
 
 Page 0 is a reserved "trash" page: inactive batch slots point at it so the
 full-batch decode step stays shape-static without masking scatter writes.
@@ -54,10 +57,9 @@ class KVCacheSpec:
     def shape(self):
         return (
             self.num_layers,
-            self.num_kv_heads,
             self.num_pages,
             self.page_size,
-            self.head_dim,
+            self.num_kv_heads * self.head_dim,
         )
 
     def bytes_per_token(self) -> int:

@@ -26,9 +26,12 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models import quant
 from dynamo_tpu.ops import attention as att
 from dynamo_tpu.ops import moe as moe_ops
 from dynamo_tpu.ops.rope import apply_rope
+
+qeinsum = quant.einsum  # einsum that understands int8 QTensor weights
 
 Params = Dict[str, jax.Array]
 
@@ -103,9 +106,9 @@ def _layer_params(p: Params) -> Params:
 
 def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array):
     """x: [T, E] -> q [T, H, D], k/v [T, KV, D] with rope applied."""
-    q = jnp.einsum("te,ehd->thd", x, lp["wq"])
-    k = jnp.einsum("te,ekd->tkd", x, lp["wk"])
-    v = jnp.einsum("te,ekd->tkd", x, lp["wv"])
+    q = qeinsum("te,ehd->thd", x, lp["wq"])
+    k = qeinsum("te,ekd->tkd", x, lp["wk"])
+    v = qeinsum("te,ekd->tkd", x, lp["wv"])
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -127,9 +130,9 @@ def _mlp(cfg: ModelConfig, lp: Params, x: jax.Array,
     slots with no mask to exclude them, and are small enough that dense
     dispatch wins anyway."""
     if not cfg.is_moe:
-        g = jnp.einsum("te,ef->tf", x, lp["w_gate"])
-        u = jnp.einsum("te,ef->tf", x, lp["w_up"])
-        return jnp.einsum("tf,fe->te", jax.nn.silu(g) * u, lp["w_down"])
+        g = qeinsum("te,ef->tf", x, lp["w_gate"])
+        u = qeinsum("te,ef->tf", x, lp["w_up"])
+        return qeinsum("tf,fe->te", jax.nn.silu(g) * u, lp["w_down"])
     # MoE: top-k routing into a dense [T, X] combine matrix, then one of two
     # dispatch paths (dynamo_tpu.ops.moe): exact dense-masked by default;
     # capacity-based gather (T*k*cf expert-MLP rows instead of T*X) when the
@@ -164,8 +167,9 @@ class PrefillOut(NamedTuple):
 
 def _logits(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return jnp.einsum("te,ev->tv", x, head)
+    if cfg.tie_word_embeddings:
+        return quant.tied_head_einsum(x, params["embed"])
+    return qeinsum("te,ev->tv", x, params["lm_head"])
 
 
 def prefill(
@@ -173,7 +177,7 @@ def prefill(
     params: Params,
     tokens: jax.Array,  # [S] padded to a multiple of page_size
     seq_len: jax.Array,  # scalar int32: true length
-    k_pages: jax.Array,  # [L, KV, P, ps, D]
+    k_pages: jax.Array,  # [L, P, ps, KV*D] (page-major fused-head layout)
     v_pages: jax.Array,
     pages: jax.Array,  # [S // page_size] page ids for this sequence
     *,
@@ -187,14 +191,14 @@ def prefill(
     s = tokens.shape[0]
     positions = jnp.arange(s)
     token_mask = positions < seq_len  # padding rows past the true length
-    x = jnp.take(params["embed"], tokens, axis=0).astype(_dtype(cfg))
+    x = quant.take_rows(params["embed"], tokens, _dtype(cfg))
 
     def body(x, scanned):
         lp, kp, vp = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(cfg, lp, h, positions)
         o = att.prefill_attention(q, k, v, seq_len)
-        x = x + jnp.einsum("thd,hde->te", o, lp["wo"])
+        x = x + qeinsum("thd,hde->te", o, lp["wo"])
         kp, vp = att.write_kv_prefill(kp, vp, k, v, pages, page_size=page_size)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
@@ -221,13 +225,13 @@ def decode_step(
     positions: jax.Array,  # [B] position of that token
     block_tables: jax.Array,  # [B, Pmax]
     context_lens: jax.Array,  # [B] length INCLUDING current token
-    k_pages: jax.Array,  # [L, KV, P, ps, D]
+    k_pages: jax.Array,  # [L, P, ps, KV*D] (page-major fused-head layout)
     v_pages: jax.Array,
     *,
     page_size: int,
 ) -> DecodeOut:
     """One continuous-batching decode step over all batch slots."""
-    x = jnp.take(params["embed"], tokens, axis=0).astype(_dtype(cfg))  # [B, E]
+    x = quant.take_rows(params["embed"], tokens, _dtype(cfg))  # [B, E]
 
     def body(x, scanned):
         lp, kp, vp = scanned
@@ -239,7 +243,7 @@ def decode_step(
         o = att.paged_attention_decode(
             q, kp, vp, block_tables, context_lens, page_size=page_size
         )
-        x = x + jnp.einsum("bhd,hde->be", o, lp["wo"])
+        x = x + qeinsum("bhd,hde->be", o, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, lp, h)
         return x, (kp, vp)

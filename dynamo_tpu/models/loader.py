@@ -24,14 +24,37 @@ log = logging.getLogger("dynamo_tpu.loader")
 
 
 def load_or_init_params(
-    cfg: ModelConfig, model_path: Optional[str], seed: int = 0
+    cfg: ModelConfig,
+    model_path: Optional[str],
+    seed: int = 0,
+    quantization: str = "none",
 ) -> Dict[str, jax.Array]:
-    if model_path and os.path.isdir(model_path):
-        files = sorted(glob.glob(os.path.join(model_path, "*.safetensors")))
-        if files:
-            return load_hf_safetensors(cfg, files)
-        log.warning("no safetensors under %s; using random init", model_path)
-    return llama.init_params(cfg, jax.random.PRNGKey(seed))
+    """Load (or randomly init) params; optionally int8-quantize them.
+
+    Quantization runs pinned to the CPU backend so a model whose bf16 weights
+    exceed the accelerator's HBM (the whole point of quantizing — Llama-3-8B
+    on v5e) never materializes on-chip; the engine's shard_params moves the
+    int8 tree across afterwards.
+    """
+
+    def _load():
+        if model_path and os.path.isdir(model_path):
+            files = sorted(glob.glob(os.path.join(model_path, "*.safetensors")))
+            if files:
+                return load_hf_safetensors(cfg, files)
+            log.warning("no safetensors under %s; using random init", model_path)
+        return llama.init_params(cfg, jax.random.PRNGKey(seed))
+
+    if quantization in (None, "none", ""):
+        return _load()
+    if quantization != "int8":
+        raise ValueError(f"unknown quantization {quantization!r}")
+    from dynamo_tpu.models import quant
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        params = _load()
+        return quant.quantize_params(params)
 
 
 def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:

@@ -15,10 +15,13 @@ only set the process-global fallback for code outside an engine). Under
 tensor parallelism the Pallas path runs inside `shard_map` over the
 (`data`, `model`) axes — attention is head-parallel, so no collectives.
 
-Layout:
-  k_pages, v_pages: [num_kv_heads, num_pages, page_size, head_dim]
+Layout (page-major, fused heads — one page is one contiguous DMA-able slab):
+  k_pages, v_pages: [num_pages, page_size, num_kv_heads * head_dim]
   block_table:      [batch, max_pages_per_seq] int32 (page ids; 0 is the trash page)
   context_lens:     [batch] int32 — tokens in context INCLUDING the current one
+The fused trailing KV*D axis keeps every page's bytes contiguous (the Pallas
+decode kernel DMAs whole pages) and makes tensor-parallel sharding a plain
+lane split (head h occupies lanes [h*D, (h+1)*D)).
 """
 
 from __future__ import annotations
@@ -136,16 +139,17 @@ def write_kv_token(
     Inactive batch slots must carry block_table rows of zeros and position 0 so
     their writes land in the reserved trash page 0.
     """
+    b, kv, d = k_new.shape
     page_idx = jnp.take_along_axis(
         block_table, (positions // page_size)[:, None], axis=1
     ).squeeze(1)  # [B]
     slot_idx = positions % page_size  # [B]
-    # advanced indexing over (page, slot) pairs -> [KV, B, D]
-    k_pages = k_pages.at[:, page_idx, slot_idx, :].set(
-        k_new.transpose(1, 0, 2), mode="drop"
+    # advanced indexing over (page, slot) pairs -> rows of [KV*D]
+    k_pages = k_pages.at[page_idx, slot_idx, :].set(
+        k_new.reshape(b, kv * d), mode="drop"
     )
-    v_pages = v_pages.at[:, page_idx, slot_idx, :].set(
-        v_new.transpose(1, 0, 2), mode="drop"
+    v_pages = v_pages.at[page_idx, slot_idx, :].set(
+        v_new.reshape(b, kv * d), mode="drop"
     )
     return k_pages, v_pages
 
@@ -162,16 +166,16 @@ def write_kv_prefill(
     """Scatter a full (padded) prompt's K/V into its pages."""
     s, kv, d = k_new.shape
     n_pages = s // page_size
-    k_r = k_new.reshape(n_pages, page_size, kv, d).transpose(2, 0, 1, 3)
-    v_r = v_new.reshape(n_pages, page_size, kv, d).transpose(2, 0, 1, 3)
-    k_pages = k_pages.at[:, pages, :, :].set(k_r, mode="drop")
-    v_pages = v_pages.at[:, pages, :, :].set(v_r, mode="drop")
+    k_r = k_new.reshape(n_pages, page_size, kv * d)
+    v_r = v_new.reshape(n_pages, page_size, kv * d)
+    k_pages = k_pages.at[pages].set(k_r, mode="drop")
+    v_pages = v_pages.at[pages].set(v_r, mode="drop")
     return k_pages, v_pages
 
 
 def paged_attention_decode_xla(
     q: jax.Array,  # [B, H, D] — one query token per sequence
-    k_pages: jax.Array,  # [KV, P, ps, D]
+    k_pages: jax.Array,  # [P, ps, KV*D]
     v_pages: jax.Array,
     block_table: jax.Array,  # [B, Pmax]
     context_lens: jax.Array,  # [B]
@@ -184,15 +188,15 @@ def paged_attention_decode_xla(
     kernel avoids materialising the gathered KV in HBM entirely.
     """
     bsz, n_heads, head_dim = q.shape
-    n_kv = k_pages.shape[0]
+    n_kv = k_pages.shape[2] // head_dim
     pmax = block_table.shape[1]
-    # gather pages: [KV, B, Pmax, ps, D] -> [B, KV, S, D]
-    k = jnp.moveaxis(k_pages[:, block_table], 0, 1).reshape(
-        bsz, n_kv, pmax * page_size, head_dim
-    )
-    v = jnp.moveaxis(v_pages[:, block_table], 0, 1).reshape(
-        bsz, n_kv, pmax * page_size, head_dim
-    )
+    # gather pages: [B, Pmax, ps, KV*D] -> [B, KV, S, D]
+    k = k_pages[block_table].reshape(
+        bsz, pmax * page_size, n_kv, head_dim
+    ).transpose(0, 2, 1, 3)
+    v = v_pages[block_table].reshape(
+        bsz, pmax * page_size, n_kv, head_dim
+    ).transpose(0, 2, 1, 3)
     k = repeat_kv(k, n_heads // n_kv, axis=1)
     v = repeat_kv(v, n_heads // n_kv, axis=1)
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
@@ -230,7 +234,7 @@ def prefill_attention_xla(
 
 def paged_attention_decode(
     q: jax.Array,  # [B, H, D]
-    k_pages: jax.Array,  # [KV, P, ps, D]
+    k_pages: jax.Array,  # [P, ps, KV*D]
     v_pages: jax.Array,
     block_table: jax.Array,  # [B, Pmax]
     context_lens: jax.Array,  # [B]
@@ -238,31 +242,52 @@ def paged_attention_decode(
     page_size: int,
 ) -> jax.Array:
     backend = _resolve_backend()
-    if backend == "xla":
-        return paged_attention_decode_xla(
-            q, k_pages, v_pages, block_table, context_lens, page_size=page_size
-        )
-    from dynamo_tpu.ops import pallas_attention as pa
-
-    interpret = backend == "pallas_interpret"
-
-    def call(q, kp, vp, bt, cl):
-        return pa.paged_attention_decode(
-            q, kp, vp, bt, cl, page_size=page_size, interpret=interpret
-        )
-
     mesh = _mesh_for_shard_map()
+    if backend != "xla":
+        # TPU DMA needs the per-shard fused KV*D lane dim 128-aligned; with
+        # extreme TP on tiny heads (e.g. tp=8 over 8 KV heads of dim 64) the
+        # local span drops below a lane tile — use the XLA path there.
+        tp = 1
+        if mesh is not None:
+            tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
+        if (k_pages.shape[2] // tp) % 128 != 0:
+            import logging
+
+            logging.getLogger("dynamo_tpu.ops").warning(
+                "pallas decode needs the per-shard KV*D lane dim 128-aligned "
+                "(got %d/%d); falling back to the XLA gather path",
+                k_pages.shape[2], tp,
+            )
+            backend = "xla"
+    if backend == "xla":
+        def call(q, kp, vp, bt, cl):
+            return paged_attention_decode_xla(
+                q, kp, vp, bt, cl, page_size=page_size
+            )
+    else:
+        from dynamo_tpu.ops import pallas_attention as pa
+
+        interpret = backend == "pallas_interpret"
+
+        def call(q, kp, vp, bt, cl):
+            return pa.paged_attention_decode(
+                q, kp, vp, bt, cl,
+                page_size=page_size,
+                num_kv_heads=kp.shape[2] // q.shape[2],
+                interpret=interpret,
+            )
+
     if mesh is None:
         return call(q, k_pages, v_pages, block_table, context_lens)
-    # Heads (and KV pages) shard on `model`, batch on `data`: attention is
-    # embarrassingly parallel over both — no collectives inside the shard.
+    # Heads (the fused KV*D lane axis) shard on `model`, batch on `data`:
+    # attention is embarrassingly parallel over both — no collectives inside.
     return jax.shard_map(
         call,
         mesh=mesh,
         in_specs=(
             P("data", "model", None),
-            P("model", None, None, None),
-            P("model", None, None, None),
+            P(None, None, "model"),
+            P(None, None, "model"),
             P("data", None),
             P("data"),
         ),

@@ -26,6 +26,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.models.quant import einsum as qeinsum
+
 
 def topk_combine(logits: jax.Array, k: int, dtype) -> jax.Array:
     """Router logits [T, X] -> dense combine matrix [T, X]: softmaxed top-k
@@ -48,9 +50,9 @@ def moe_mlp_dense(
     w_down: jax.Array,   # [X, F, E]
 ) -> jax.Array:
     """All experts see all tokens; combine zeroes non-selected outputs."""
-    g = jnp.einsum("te,xef->txf", x, w_gate)
-    u = jnp.einsum("te,xef->txf", x, w_up)
-    y = jnp.einsum("txf,xfe->txe", jax.nn.silu(g) * u, w_down)
+    g = qeinsum("te,xef->txf", x, w_gate)
+    u = qeinsum("te,xef->txf", x, w_up)
+    y = qeinsum("txf,xfe->txe", jax.nn.silu(g) * u, w_down)
     return jnp.einsum("txe,tx->te", y, combine)
 
 
@@ -82,9 +84,9 @@ def moe_mlp_dropping(
     weights_xt = combine.T  # [X, T]
     sel_w, sel_i = jax.lax.top_k(weights_xt, capacity)  # [X, C]
     xg = jnp.take(x, sel_i, axis=0)  # [X, C, E]
-    g = jnp.einsum("xce,xef->xcf", xg, w_gate)
-    u = jnp.einsum("xce,xef->xcf", xg, w_up)
-    y = jnp.einsum("xcf,xfe->xce", jax.nn.silu(g) * u, w_down)  # [X, C, E]
+    g = qeinsum("xce,xef->xcf", xg, w_gate)
+    u = qeinsum("xce,xef->xcf", xg, w_up)
+    y = qeinsum("xcf,xfe->xce", jax.nn.silu(g) * u, w_down)  # [X, C, E]
     # weight by routing prob; zero-weight slots (capacity padding for experts
     # with fewer selected tokens) contribute nothing
     y = y * sel_w[..., None].astype(y.dtype)

@@ -2,15 +2,41 @@
 
 Same contracts as the XLA reference ops in `dynamo_tpu.ops.attention` (the KV
 layout parity point is the reference's SGLang `--page-size 16` flag,
-/root/reference/examples/deploy/sglang/agg.yaml:38-39). The kernels avoid
-materialising the gathered KV in HBM: pages are DMA'd page-by-page into VMEM
-via scalar-prefetched block tables, with flash (online-softmax) accumulation
-in VMEM scratch.
+/root/reference/examples/deploy/sglang/agg.yaml:38-39).
 
-Both kernels grid over KV heads (queries blocked `group` per KV head), so
-each K/V block is fetched from HBM exactly once, and both are head-parallel —
-under tensor parallelism they run inside `shard_map` over the `model` mesh
-axis with zero collectives: each TP shard attends over its local KV heads.
+Decode kernel design (bandwidth-first — this is the hot op of the serving
+loop, and decode attention is HBM-bandwidth-bound by definition):
+
+- **Page-major fused-head KV layout** `[num_pages, page_size, KV*D]`: one
+  page is a single contiguous `[ps, KV*D]` slab (16KB at ps=16/KV=8/D=64),
+  so each page moves HBM->VMEM in ONE big DMA instead of one tiny DMA per
+  KV head. TPU DMA requires the trailing dim be a multiple of 128 lanes;
+  KV*D satisfies that for every model this repo serves (8*64, 8*128, ...).
+- **Multi-page superblocks**: each grid step consumes `block_pages` pages
+  (default 8 => 128 tokens) fetched by parallel async copies.
+- **Cross-grid-step double buffering**: the copies for block i+1 (or for the
+  next sequence's first block) are issued before computing on block i, with
+  the pipeline threaded through a persistent SMEM block counter — so in
+  steady state the kernel is never waiting on HBM latency, only throughput.
+  Grid dims are `arbitrary` (sequential) on purpose: the software pipeline
+  carries state across steps.
+- **Block-diagonal GQA matmuls**: all H query heads are packed into one
+  `[H, KV*D]` block-diagonal matrix (row r nonzero only in its KV head's
+  D-lane span), so scores for every head come from ONE `[H,KV*D]x[KV*D,T]`
+  MXU op with zero cross-head score waste in the VPU, and the PV product
+  accumulates `[H, KV*D]` whose off-head lanes are sliced away once at
+  finalize. No reshapes or transposes of KV data anywhere.
+- Pages whose tokens lie past the context length are masked in-compute;
+  blocks wholly past it are never fetched (the per-sequence block count is a
+  dynamic `fori_loop` bound derived from the scalar-prefetched context lens).
+
+The prefill kernel is a standard flash (online-softmax) kernel over the
+`[S, KV, D]` pre-paging tensors, gridded over KV heads with queries blocked
+`group` per KV head so each K/V block is fetched exactly once.
+
+Both kernels are head-parallel: under tensor parallelism they run inside
+`shard_map` over the `model` mesh axis with zero collectives — each TP shard
+attends over its local KV-head lane span.
 """
 
 from __future__ import annotations
@@ -23,6 +49,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
+
+# pages per decode superblock (tokens per block = this * page_size)
+DEFAULT_BLOCK_PAGES = 8
+# KV block buffers in the DMA ring: num_bufs - 1 blocks are in flight ahead
+# of the one being consumed (pipeline depth)
+DEFAULT_NUM_BUFS = 4
 
 
 # ------------------------------------------------------ flash accumulation --
@@ -67,112 +99,216 @@ def _decode_kernel(
     # scalar prefetch
     bt_ref,  # [B, Pmax] int32 block table
     cl_ref,  # [B] int32 context lens (incl. current token)
-    # blocks
-    q_ref,  # [1, 1, G, D] — 4D so the block equals the trailing array dims
-    #         exactly (TPU tiling requires last-two block dims divisible by
-    #         (8, 128) OR equal to the array dims; G can be small)
-    k_ref,  # [1, 1, ps, D]
-    v_ref,  # [1, 1, ps, D]
-    o_ref,  # [1, 1, G, D]
-    # scratch
-    m_ref,  # [G, 128] f32 running max
-    l_ref,  # [G, 128] f32 running denominator
-    acc_ref,  # [G, D] f32 running numerator
+    # inputs
+    q_ref,  # [1, H, D] VMEM block (this sequence's query)
+    k_hbm,  # [P, ps, KVD] in ANY/HBM — manually DMA'd
+    v_hbm,  # [P, ps, KVD]
+    o_ref,  # [1, H, D]
+    # scratch (persistent across the sequential grid)
+    kbuf,  # [NBUF, SB, ps, KVD] KV-dtype ring of block buffers
+    vbuf,  # [NBUF, SB, ps, KVD]
+    m_ref,  # [H, 128] f32 running max
+    l_ref,  # [H, 128] f32 running denominator
+    acc_ref,  # [H, KVD] f32 running numerator (off-head lanes carry garbage
+    #           that the finalize slice discards)
+    ptr_ref,  # SMEM [4] int32: consumed count, issue cursor (b, i), issued count
+    sem,  # DMA semaphores [NBUF, 2, SB]
     *,
     page_size: int,
     pages_per_seq: int,
+    block_pages: int,
+    num_bufs: int,
+    n_kv: int,
     scale: float,
 ):
     b = pl.program_id(0)
-    i = pl.program_id(2)
+    i = pl.program_id(1)
+    bsz = pl.num_programs(0)
+    tokens_per_block = block_pages * page_size
+    h, d = q_ref.shape[1], q_ref.shape[2]
+    group = h // n_kv
 
-    @pl.when(i == 0)
-    def _reset():
-        _flash_reset(m_ref, l_ref, acc_ref)
-
-    ctx = cl_ref[b]
-    page_start = i * page_size
-
-    # Pages at/past the context length contribute nothing — skip their compute
-    # (their DMA still runs; the grid is static).
-    @pl.when(page_start < ctx)
-    def _attend():
-        q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [ps, D]
-        v = v_ref[0, 0].astype(jnp.float32)
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    def block_copies(bb, ii, slot):
+        """The 2*SB async page copies that fetch block ii of sequence bb."""
+        out = []
+        for j in range(block_pages):
+            pg = bt_ref[bb, jnp.minimum(ii * block_pages + j, pages_per_seq - 1)]
+            out.append(
+                pltpu.make_async_copy(
+                    k_hbm.at[pg], kbuf.at[slot, j], sem.at[slot, 0, j]
+                )
             )
-            * scale
-        )  # [G, ps]
-        span = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(span < ctx, s, NEG_INF)
-        _flash_update(m_ref, l_ref, acc_ref, s, v)
+            out.append(
+                pltpu.make_async_copy(
+                    v_hbm.at[pg], vbuf.at[slot, j], sem.at[slot, 1, j]
+                )
+            )
+        return out
 
-    @pl.when(i == pages_per_seq - 1)
-    def _finalize():
-        o_ref[0, 0] = _flash_normalize(l_ref, acc_ref).astype(o_ref.dtype)
+    def n_blocks(bb):
+        # clamp to >= 1 so every sequence owns at least one pipeline block
+        # (ctx 0 rows emit zeros via the all-masked normalize path; breaking
+        # the issue/consume pairing would corrupt the DMA slot parity)
+        ctx_b = jnp.maximum(cl_ref[bb], 1)
+        return (ctx_b + tokens_per_block - 1) // tokens_per_block
+
+    def issue_one():
+        """Issue the block at the issue cursor (if any remain) into ring
+        slot `issued % num_bufs`, then advance the cursor one active block
+        (every sequence has >= 1 active block, so advancing never skips).
+        The consume side reproduces the slot as `consumed % num_bufs` —
+        issue order == consume order, so the ring stays in lockstep."""
+        ib, ii = ptr_ref[1], ptr_ref[2]
+
+        @pl.when(ib < bsz)
+        def _():
+            slot = jax.lax.rem(ptr_ref[3], num_bufs)
+            for c in block_copies(ib, ii, slot):
+                c.start()
+            ptr_ref[3] = ptr_ref[3] + 1
+            nxt = ii + 1
+            done = nxt >= n_blocks(ib)
+            ptr_ref[1] = jnp.where(done, ib + 1, ib)
+            ptr_ref[2] = jnp.where(done, 0, nxt)
+
+    nb_b = n_blocks(b)
+
+    # Pipeline warm-up: the very first grid step primes `num_bufs - 1`
+    # blocks (the full ring minus the slot consumed+reissued each step).
+    @pl.when((b == 0) & (i == 0))
+    def _init():
+        ptr_ref[0] = 0  # consumed-block count
+        ptr_ref[1] = 0  # issue cursor: sequence
+        ptr_ref[2] = 0  # issue cursor: block within sequence
+        ptr_ref[3] = 0  # issued-block count
+        for _ in range(num_bufs - 1):
+            issue_one()
+
+    @pl.when(i < nb_b)
+    def _active():
+        cnt = ptr_ref[0]
+        cur = jax.lax.rem(cnt, num_bufs)
+
+        # keep the ring full: issue one block `num_bufs - 1` ahead of the
+        # one being consumed (slot `cur` frees after this step's wait — the
+        # new issue targets the slot consumed `num_bufs - 1` steps ago,
+        # which is complete and idle)
+        issue_one()
+
+        for c in block_copies(b, i, cur):
+            c.wait()
+        ptr_ref[0] = cnt + 1
+
+        @pl.when(i == 0)
+        def _reset():
+            _flash_reset(m_ref, l_ref, acc_ref)
+
+        # Block-diagonal lane mask over the fused KV*D axis: row r's own KV
+        # head (r // group) occupies lanes [(r//group)*D, (r//group+1)*D).
+        # Built with iota + lane tiling — no lane-splitting reshapes, which
+        # Mosaic cannot lower.
+        kvd = n_kv * d
+        row_kv = jax.lax.broadcasted_iota(jnp.int32, (h, kvd), 0) // group
+        lane_kv = jax.lax.broadcasted_iota(jnp.int32, (h, kvd), 1) // d
+        bd_mask = row_kv == lane_kv  # [H, KVD]
+        ctx = cl_ref[b]
+
+        # Skip compute for a fully-masked block (only possible at ctx == 0,
+        # the inactive-slot case — an all -inf row would NaN the online max).
+        @pl.when(i * tokens_per_block < ctx)
+        def _compute():
+            q = q_ref[0].astype(jnp.float32) * scale  # [H, D]
+            q_bd = jnp.where(bd_mask, jnp.tile(q, (1, n_kv)), 0.0)  # [H, KVD]
+            k = kbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+            v = vbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q_bd, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [H, T] — block-diagonal q => per-head scores, no cross-talk
+            tok = i * tokens_per_block + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(tok < ctx, s, NEG_INF)
+            _flash_update(m_ref, l_ref, acc_ref, s, v)
+
+        @pl.when(i == nb_b - 1)
+        def _finalize():
+            out = _flash_normalize(l_ref, acc_ref)  # [H, KVD]
+            # keep each row's own KV-head lane span (off-head lanes carry
+            # accumulated garbage), then fold the KV spans down to [H, D]
+            # with static lane slices — again avoiding lane-split reshapes.
+            out = jnp.where(bd_mask, out, 0.0)
+            folded = out[:, 0:d]
+            for kv in range(1, n_kv):
+                folded = folded + out[:, kv * d:(kv + 1) * d]
+            o_ref[0] = folded.astype(o_ref.dtype)
 
 
 def paged_attention_decode(
     q: jax.Array,  # [B, H, D]
-    k_pages: jax.Array,  # [KV, P, ps, D]
+    k_pages: jax.Array,  # [P, ps, KV*D]
     v_pages: jax.Array,
     block_table: jax.Array,  # [B, Pmax] int32
     context_lens: jax.Array,  # [B] int32
     *,
     page_size: int,
+    num_kv_heads: int,
+    block_pages: int = DEFAULT_BLOCK_PAGES,
+    num_bufs: int = DEFAULT_NUM_BUFS,
     interpret: bool = False,
 ) -> jax.Array:
     bsz, n_heads, head_dim = q.shape
-    n_kv = k_pages.shape[0]
-    group = n_heads // n_kv
+    kvd = k_pages.shape[2]
+    assert kvd == num_kv_heads * head_dim, (kvd, num_kv_heads, head_dim)
     pmax = block_table.shape[1]
+    block_pages = min(block_pages, pmax)
+    num_bufs = max(2, num_bufs)
+    nb_max = -(-pmax // block_pages)
     scale = 1.0 / (head_dim**0.5)
-
-    # [B, KV, G, D]: GQA query heads are contiguous per KV head, and the 4D
-    # layout lets the q/o blocks equal the trailing array dims exactly.
-    q4 = q.reshape(bsz, n_kv, group, head_dim)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(bsz, n_kv, pmax),
+        grid=(bsz, nb_max),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, group, head_dim), lambda b, h, i, bt, cl: (b, h, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, page_size, head_dim),
-                lambda b, h, i, bt, cl: (h, bt[b, i], 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, page_size, head_dim),
-                lambda b, h, i, bt, cl: (h, bt[b, i], 0, 0),
-            ),
+            pl.BlockSpec((1, n_heads, head_dim), lambda b, i, bt, cl: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, group, head_dim), lambda b, h, i, bt, cl: (b, h, 0, 0)
+            (1, n_heads, head_dim), lambda b, i, bt, cl: (b, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, head_dim), jnp.float32),
+            pltpu.VMEM((num_bufs, block_pages, page_size, kvd), k_pages.dtype),
+            pltpu.VMEM((num_bufs, block_pages, page_size, kvd), v_pages.dtype),
+            pltpu.VMEM((n_heads, 128), jnp.float32),
+            pltpu.VMEM((n_heads, 128), jnp.float32),
+            pltpu.VMEM((n_heads, kvd), jnp.float32),
+            pltpu.SMEM((4,), jnp.int32),
+            pltpu.SemaphoreType.DMA((num_bufs, 2, block_pages)),
         ],
     )
     kernel = functools.partial(
-        _decode_kernel, page_size=page_size, pages_per_seq=pmax, scale=scale
+        _decode_kernel,
+        page_size=page_size,
+        pages_per_seq=pmax,
+        block_pages=block_pages,
+        num_bufs=num_bufs,
+        n_kv=num_kv_heads,
+        scale=scale,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((bsz, n_kv, group, head_dim), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bsz, n_heads, head_dim), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            # sequential on purpose: the DMA pipeline carries state across
+            # grid steps (see module docstring)
+            dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(block_table.astype(jnp.int32), context_lens.astype(jnp.int32), q4, k_pages, v_pages)
-    return out.reshape(bsz, n_heads, head_dim)
+    )(block_table.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+    return out
 
 
 # ----------------------------------------------------------------- prefill --

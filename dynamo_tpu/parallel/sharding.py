@@ -44,18 +44,31 @@ PARAM_RULES: Dict[str, P] = {
     "moe_w_down": P(None, "expert", "model", None),  # [L, X, F, E]
 }
 
-# KV cache: [L, KV_heads, pages, page_size, head_dim] — heads on `model` so
-# each TP shard appends/reads only its local heads; pages stay local to the
-# shard (no cross-device traffic in the decode inner loop).
-KV_SPEC = P(None, "model", None, None, None)
+# KV cache: [L, pages, page_size, KV_heads*head_dim] — the fused head-major
+# lane axis shards on `model` so each TP shard appends/reads only its local
+# heads' lanes; pages stay local to the shard (no cross-device traffic in the
+# decode inner loop).
+KV_SPEC = P(None, None, None, "model")
 # decode activations: batch on data, hidden replicated across model
 ACT_SPEC = P("data", None)
 
 
 def param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
-    """Map a param tree to PartitionSpecs by leaf name (dict key)."""
+    """Map a param tree to PartitionSpecs by leaf name (dict key).
 
-    def spec_for(name: str, x) -> P:
+    Quantized weights (models.quant.QTensor) get a spec PER FIELD: the int8
+    `q` follows the weight rule; the keepdims `scale` follows the same rule
+    with size-1 (contracted) axes unsharded."""
+    from dynamo_tpu.models.quant import QTensor
+
+    def spec_for(name: str, x):
+        if isinstance(x, QTensor):
+            rule = PARAM_RULES.get(name, P(*([None] * x.q.ndim)))
+            scale_rule = P(*(
+                None if x.scale.shape[i] == 1 else rule[i]
+                for i in range(x.scale.ndim)
+            ))
+            return QTensor(rule, scale_rule)
         if name in PARAM_RULES:
             return PARAM_RULES[name]
         return P(*([None] * x.ndim))
@@ -63,10 +76,28 @@ def param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
     return {k: spec_for(k, v) for k, v in params.items()}
 
 
+def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
+    """Drop (replicate) spec axes whose mesh extent doesn't divide the dim —
+    e.g. KV-head projections when tp > num_kv_heads (GQA over-sharding):
+    the weights replicate, and attention still lane-shards the fused KV*D
+    axis downstream."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    fixed = []
+    for i, axis in enumerate(spec):
+        n = sizes.get(axis, 1) if isinstance(axis, str) else 1
+        fixed.append(axis if (axis is None or shape[i] % n == 0) else None)
+    return P(*fixed)
+
+
 def shard_params(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
     specs = param_specs(params)
+    shardings = jax.tree.map(
+        lambda s, x: NamedSharding(mesh, _fit_spec(s, x.shape, mesh)),
+        specs, dict(params),
+        is_leaf=lambda s: isinstance(s, P),
+    )
     return {
-        k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in params.items()
+        k: jax.device_put(v, shardings[k]) for k, v in params.items()
     }
 
 

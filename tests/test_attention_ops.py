@@ -27,8 +27,8 @@ def test_paged_decode_matches_dense():
     rng = np.random.default_rng(0)
     b, h, kvh, d, n_pages, pmax = 3, 4, 2, 8, 16, 3
     q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
-    k_pages = jnp.asarray(rng.normal(size=(kvh, n_pages, PS, d)), jnp.float32)
-    v_pages = jnp.asarray(rng.normal(size=(kvh, n_pages, PS, d)), jnp.float32)
+    k_pages = jnp.asarray(rng.normal(size=(n_pages, PS, kvh * d)), jnp.float32)
+    v_pages = jnp.asarray(rng.normal(size=(n_pages, PS, kvh * d)), jnp.float32)
     block = jnp.asarray([[1, 2, 0], [3, 0, 0], [4, 5, 6]], jnp.int32)
     lens = jnp.asarray([7, 3, 12], jnp.int32)
 
@@ -37,16 +37,16 @@ def test_paged_decode_matches_dense():
     )
 
     # dense reference: gather pages manually
-    k_g = jnp.moveaxis(k_pages[:, block], 0, 1).reshape(b, kvh, pmax * PS, d)
-    v_g = jnp.moveaxis(v_pages[:, block], 0, 1).reshape(b, kvh, pmax * PS, d)
+    k_g = k_pages[block].reshape(b, pmax * PS, kvh, d).transpose(0, 2, 1, 3)
+    v_g = v_pages[block].reshape(b, pmax * PS, kvh, d).transpose(0, 2, 1, 3)
     ref = dense_attention(q, k_g, v_g, lens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
 def test_write_then_read_roundtrip():
     kvh, d, n_pages = 2, 4, 8
-    k_pages = jnp.zeros((kvh, n_pages, PS, d))
-    v_pages = jnp.zeros((kvh, n_pages, PS, d))
+    k_pages = jnp.zeros((n_pages, PS, kvh * d))
+    v_pages = jnp.zeros((n_pages, PS, kvh * d))
     # sequence on pages [2, 5], write tokens at positions 0..5
     block = jnp.asarray([[2, 5]], jnp.int32)
     for pos in range(6):
@@ -57,9 +57,9 @@ def test_write_then_read_roundtrip():
         )
     k_np = np.asarray(k_pages)
     # positions 0-3 -> page 2 slots 0-3; positions 4-5 -> page 5 slots 0-1
-    assert (k_np[0, 2, :, 0] == [1, 2, 3, 4]).all()
-    assert (k_np[0, 5, :2, 0] == [5, 6]).all()
-    assert (k_np[0, 5, 2:, 0] == 0).all()
+    assert (k_np[2, :, 0] == [1, 2, 3, 4]).all()
+    assert (k_np[5, :2, 0] == [5, 6]).all()
+    assert (k_np[5, 2:, 0] == 0).all()
 
 
 def test_prefill_write_matches_token_writes():
@@ -69,12 +69,12 @@ def test_prefill_write_matches_token_writes():
     v_new = jnp.asarray(rng.normal(size=(s, kvh, d)), jnp.float32)
     pages = jnp.asarray([3, 6], jnp.int32)
 
-    kp1 = jnp.zeros((kvh, n_pages, PS, d))
-    vp1 = jnp.zeros((kvh, n_pages, PS, d))
+    kp1 = jnp.zeros((n_pages, PS, kvh * d))
+    vp1 = jnp.zeros((n_pages, PS, kvh * d))
     kp1, vp1 = att.write_kv_prefill(kp1, vp1, k_new, v_new, pages, page_size=PS)
 
-    kp2 = jnp.zeros((kvh, n_pages, PS, d))
-    vp2 = jnp.zeros((kvh, n_pages, PS, d))
+    kp2 = jnp.zeros((n_pages, PS, kvh * d))
+    vp2 = jnp.zeros((n_pages, PS, kvh * d))
     block = jnp.asarray([[3, 6]], jnp.int32)
     for pos in range(s):
         kp2, vp2 = att.write_kv_token(

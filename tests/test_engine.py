@@ -46,7 +46,8 @@ def test_greedy_matches_teacher_forcing(engine):
         pad = -(-len(seq) // ps) * ps
         toks = np.zeros(pad, np.int32)
         toks[: len(seq)] = seq
-        k = jnp.zeros((cfg.num_layers, cfg.num_kv_heads, 32, ps, cfg.head_dim))
+        k = jnp.zeros((cfg.num_layers, 32, ps,
+                       cfg.num_kv_heads * cfg.head_dim))
         v = jnp.zeros_like(k)
         pages = jnp.arange(1, pad // ps + 1, dtype=jnp.int32)
         res = llama.prefill(

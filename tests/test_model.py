@@ -18,7 +18,7 @@ def make(cfg_kwargs=None):
 
 
 def fresh_cache(cfg, num_pages=32):
-    shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, PS, cfg.head_dim)
+    shape = (cfg.num_layers, num_pages, PS, cfg.num_kv_heads * cfg.head_dim)
     return jnp.zeros(shape), jnp.zeros(shape)
 
 

@@ -18,10 +18,10 @@ def _decode_inputs(key, bsz=4, n_heads=8, n_kv=2, head_dim=128, page_size=16,
                    num_pages=64, pmax=8):
     ks = jax.random.split(key, 5)
     q = jax.random.normal(ks[0], (bsz, n_heads, head_dim), jnp.float32)
-    k_pages = jax.random.normal(ks[1], (n_kv, num_pages, page_size, head_dim),
-                                jnp.float32)
-    v_pages = jax.random.normal(ks[2], (n_kv, num_pages, page_size, head_dim),
-                                jnp.float32)
+    k_pages = jax.random.normal(
+        ks[1], (num_pages, page_size, n_kv * head_dim), jnp.float32)
+    v_pages = jax.random.normal(
+        ks[2], (num_pages, page_size, n_kv * head_dim), jnp.float32)
     # distinct non-zero pages per sequence
     bt = (
         jnp.arange(bsz * pmax, dtype=jnp.int32).reshape(bsz, pmax) % (num_pages - 1)
@@ -35,7 +35,7 @@ def test_decode_matches_xla():
     q, kp, vp, bt, cl = _decode_inputs(jax.random.PRNGKey(0))
     ref = att.paged_attention_decode_xla(q, kp, vp, bt, cl, page_size=16)
     out = pa.paged_attention_decode(q, kp, vp, bt, cl, page_size=16,
-                                    interpret=True)
+                                    num_kv_heads=2, interpret=True)
     # slot 3 is inactive (ctx 0): pallas emits zeros, XLA emits uniform junk —
     # compare active slots only.
     np.testing.assert_allclose(np.asarray(out[:3]), np.asarray(ref[:3]),
@@ -47,7 +47,7 @@ def test_decode_single_kv_head_mha():
     q, kp, vp, bt, cl = _decode_inputs(jax.random.PRNGKey(1), n_heads=4, n_kv=4)
     ref = att.paged_attention_decode_xla(q, kp, vp, bt, cl, page_size=16)
     out = pa.paged_attention_decode(q, kp, vp, bt, cl, page_size=16,
-                                    interpret=True)
+                                    num_kv_heads=4, interpret=True)
     np.testing.assert_allclose(np.asarray(out[:3]), np.asarray(ref[:3]),
                                rtol=2e-5, atol=2e-5)
 

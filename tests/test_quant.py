@@ -1,0 +1,118 @@
+"""int8 weight-only quantization: math, model parity, sharding, engine e2e."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama, quant
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import QTensor
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    qt = quant.quantize(w, (0,))
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 32)
+    deq = qt.q.astype(jnp.float32) * qt.scale
+    # symmetric int8: error bounded by scale/2 per element
+    err = np.abs(np.asarray(deq - w))
+    bound = np.asarray(qt.scale)[0] / 2 + 1e-7
+    assert (err <= bound[None, :]).all()
+
+
+@pytest.mark.parametrize("spec,xs,ws,axes", [
+    ("te,ehd->thd", (5, 8), (8, 4, 16), (0,)),
+    ("thd,hde->te", (5, 4, 16), (4, 16, 8), (0, 1)),
+    ("te,ef->tf", (5, 8), (8, 12), (0,)),
+    ("tf,fe->te", (5, 12), (12, 8), (0,)),
+    ("te,xef->txf", (5, 8), (3, 8, 12), (1,)),
+    ("xce,xef->xcf", (3, 4, 8), (3, 8, 12), (1,)),
+    ("txf,xfe->txe", (5, 3, 12), (3, 12, 8), (1,)),
+    ("te,ev->tv", (5, 8), (8, 30), (0,)),
+])
+def test_qeinsum_matches_dequantized_reference(spec, xs, ws, axes):
+    """quant.einsum == plain einsum against the dequantized weight, for every
+    call-site spec in llama.py / ops/moe.py."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=xs), jnp.float32)
+    w = jnp.asarray(rng.normal(size=ws), jnp.float32)
+    qt = quant.quantize(w, axes)
+    deq = qt.q.astype(jnp.float32) * qt.scale
+    ref = jnp.einsum(spec, x, deq)
+    out = quant.einsum(spec, x, qt)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_take_rows_and_tied_head():
+    rng = np.random.default_rng(2)
+    emb = jnp.asarray(rng.normal(size=(30, 8)), jnp.float32)
+    qt = quant.quantize(emb, quant.QUANT_AXES["embed"])
+    deq = qt.q.astype(jnp.float32) * qt.scale
+    ids = jnp.asarray([0, 3, 29], jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(quant.take_rows(qt, ids, jnp.float32)),
+        np.asarray(deq[ids]), rtol=1e-6)
+    x = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(quant.tied_head_einsum(x, qt)),
+        np.asarray(x @ deq.T), rtol=1e-5, atol=1e-5)
+
+
+def _tiny_params(cfg, quantize=False):
+    p = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return quant.quantize_params(p) if quantize else p
+
+
+@pytest.mark.parametrize("model", ["tiny-debug", "tiny-moe-debug"])
+def test_prefill_logits_close_to_fp(model):
+    cfg = ModelConfig.from_model_name(model, dtype="float32")
+    pf = _tiny_params(cfg)
+    pq = quant.quantize_params(pf)
+    assert quant.is_quantized(pq) and not quant.is_quantized(pf)
+    assert quant.param_bytes(pq) < 0.5 * quant.param_bytes(pf)
+    toks = jnp.asarray([1, 2, 3, 4, 5, 6, 7, 8], jnp.int32)
+    shape = (cfg.num_layers, 8, 4, cfg.num_kv_heads * cfg.head_dim)
+    pages = jnp.asarray([1, 2], jnp.int32)
+    out_f = llama.prefill(cfg, pf, toks, jnp.int32(8), jnp.zeros(shape),
+                          jnp.zeros(shape), pages, page_size=4)
+    out_q = llama.prefill(cfg, pq, toks, jnp.int32(8), jnp.zeros(shape),
+                          jnp.zeros(shape), pages, page_size=4)
+    lf, lq = np.asarray(out_f.last_logits), np.asarray(out_q.last_logits)
+    # int8 is approximate; top-1 and coarse logit agreement is the contract
+    assert np.argmax(lf) == np.argmax(lq)
+    assert np.abs(lf - lq).max() < 0.15 * np.abs(lf).max() + 0.1
+
+
+def test_sharded_quantized_params_tp(eight_devices):
+    from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dynamo_tpu.parallel import sharding as shd
+
+    cfg = ModelConfig.from_model_name("tiny-debug", dtype="float32")
+    pq = _tiny_params(cfg, quantize=True)
+    mesh = build_mesh(MeshConfig(tensor_parallel=4, data_parallel=2))
+    sharded = shd.shard_params(pq, mesh)
+    wq = sharded["wq"]
+    assert isinstance(wq, QTensor)
+    # q shards heads on `model`; the keepdims scale must shard identically
+    # on its non-contracted axes and stay replicated on size-1 axes
+    assert wq.q.sharding.spec == shd.PARAM_RULES["wq"]
+    assert wq.scale.shape[1] == 1  # contracted axis kept at size 1
+
+
+def test_engine_int8_matches_fp_greedy():
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    def run(q):
+        eng = Engine(EngineConfig(
+            model="tiny-debug", quantization=q, page_size=4, num_pages=64,
+            max_num_seqs=2, max_seq_len=64))
+        return eng.generate(GenRequest(
+            "r", [1, 2, 3, 4, 5], max_tokens=8, temperature=0.0,
+            ignore_eos=True))
+    assert run("int8") == run("none")

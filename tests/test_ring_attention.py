@@ -114,7 +114,8 @@ def test_llama_prefill_under_long_context_mesh_matches_single_device():
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     page_size, s = 4, 32
     n_pages = s // page_size + 1
-    kv_shape = (cfg.num_layers, cfg.num_kv_heads, n_pages, page_size, cfg.head_dim)
+    kv_shape = (cfg.num_layers, n_pages, page_size,
+                cfg.num_kv_heads * cfg.head_dim)
     kp = jnp.zeros(kv_shape, jnp.float32)
     vp = jnp.zeros(kv_shape, jnp.float32)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (s,), 0, cfg.vocab_size)

@@ -7,9 +7,9 @@ backend, chip, model, mfu, mbu, itl_ms, and a `secondary` dict with a
 smaller-model run for cross-round comparability.
 
 Model choice is HBM-aware: the 8B-class north-star model needs ~16 GiB of
-bf16 weights, which does not fit a v5e chip (16 GiB HBM); when the detected
-chip can't hold it, the flagship Llama-3.2-1B runs as headline and the 8B
-stays aspirational. Weights are random — throughput doesn't depend on values.
+bf16 weights, which does not fit a v5e chip (16 GiB HBM); there the 8B runs
+as headline via int8 weight-only quantization (~8 GiB + KV room). Weights
+are random — throughput doesn't depend on values.
 
 Backend init retries a flaky tunneled TPU with a bounded budget
 (dynamo_tpu.utils.platform.init_backend_with_fallback) instead of giving up
@@ -18,6 +18,7 @@ transiently-down tunnel.
 
 Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_STEPS, BENCH_PROMPT_LEN,
 BENCH_MULTISTEP (fused decode steps per dispatch; 1 disables),
+BENCH_QUANT (with BENCH_MODEL: none|int8),
 BENCH_FORCE_CPU, BENCH_SECONDARY=0 to skip the secondary run,
 BENCH_INIT_BUDGET_S (accelerator retry budget, default 300).
 """
@@ -76,17 +77,29 @@ def _hbm_bytes(device) -> float | None:
 
 
 def _pick_models(on_tpu: bool, hbm: float | None):
-    """(headline, secondary) by HBM headroom. Weights(bf16) + KV must fit."""
+    """((headline, quant), (secondary, quant)) by HBM headroom.
+
+    The north-star model is Llama-3-8B (BASELINE.json #3). bf16 weights
+    (~16.1 GiB) only fit chips with >20 GiB HBM; on a 16 GiB v5e the 8B
+    STILL runs as headline via int8 weight-only quantization (~8 GiB +
+    KV room) instead of silently demoting to the 1B model."""
     if os.environ.get("BENCH_MODEL"):
         headline = os.environ["BENCH_MODEL"]
+        quant = os.environ.get("BENCH_QUANT", "none")
         sec = "llama-3.2-1b-instruct" if on_tpu else None
-        return headline, (sec if sec != headline else None)
+        if sec is None or sec == headline:
+            return (headline, quant), None
+        return (headline, quant), (sec, "none")
     if not on_tpu:
-        return "tiny-debug", None
-    # 8B bf16 weights ~16.1 GiB; require ~20 GiB so KV + workspace fit.
-    if hbm is not None and hbm > 20 * (1024 ** 3):
-        return "meta-llama-3-8b-instruct", "llama-3.2-1b-instruct"
-    return "llama-3.2-1b-instruct", None
+        return ("tiny-debug", "none"), None
+    gib = 1024 ** 3
+    if hbm is not None and hbm > 20 * gib:
+        return ("meta-llama-3-8b-instruct", "none"), \
+            ("llama-3.2-1b-instruct", "none")
+    if hbm is not None and hbm > 12 * gib:
+        return ("meta-llama-3-8b-instruct", "int8"), \
+            ("llama-3.2-1b-instruct", "none")
+    return ("llama-3.2-1b-instruct", "none"), None
 
 
 def _effective_hbm(dev, chip) -> float | None:
@@ -98,7 +111,7 @@ def _effective_hbm(dev, chip) -> float | None:
     return hbm
 
 
-def bench_model(model: str, on_tpu: bool, chip) -> dict:
+def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     """Run steady-state decode on `model`; return metrics incl. MFU/MBU."""
     import jax
 
@@ -119,10 +132,11 @@ def bench_model(model: str, on_tpu: bool, chip) -> dict:
     mcfg = ModelConfig.from_model_name(
         model, dtype=None if on_tpu else "float32"
     )
+    wbytes = 1 if quant == "int8" else 2
     # shrink batch when weights + KV would overflow the chip
     if on_tpu and chip is not None:
         kv_seq = roofline.kv_bytes_per_token(mcfg) * max_seq
-        budget = chip.hbm_bytes * 0.9 - roofline.param_count(mcfg) * 2
+        budget = chip.hbm_bytes * 0.9 - roofline.param_count(mcfg) * wbytes
         while batch > 4 and batch * kv_seq > budget * 0.8:
             batch //= 2
 
@@ -134,6 +148,7 @@ def bench_model(model: str, on_tpu: bool, chip) -> dict:
             max_num_seqs=batch,
             max_seq_len=max_seq,
             num_scheduler_steps=multistep,
+            quantization=quant,
         ),
         model_cfg=mcfg,
     )
@@ -179,12 +194,14 @@ def bench_model(model: str, on_tpu: bool, chip) -> dict:
         "itl_ms": round(1e3 * dt * batch / max(tokens, 1), 3),
         "decode_steps_timed": decode_steps,
     }
+    if quant != "none":
+        out["quantization"] = quant
     if chip is not None:
         # decode-phase utilization against datasheet peaks: MFU from the
         # roofline's active-param FLOP model, MBU from weight+KV stream bytes
         active = roofline.active_param_count(mcfg)
         avg_ctx = prompt_len + steps / 2.0
-        stream = (roofline.param_count(mcfg) * 2
+        stream = (roofline.param_count(mcfg) * wbytes
                   + batch * roofline.kv_bytes_per_token(mcfg) * avg_ctx)
         out["mfu"] = round(tok_s * 2.0 * active / chip.bf16_flops, 4)
         out["mbu"] = round((tok_s / batch) * stream / chip.hbm_bw, 4)
@@ -201,11 +218,11 @@ def main() -> None:
     hbm = _effective_hbm(dev, chip) if on_tpu else None
 
     headline, secondary = _pick_models(on_tpu, hbm)
-    res = bench_model(headline, on_tpu, chip)
+    res = bench_model(headline[0], on_tpu, chip, quant=headline[1])
     sec = None
     if secondary and os.environ.get("BENCH_SECONDARY", "1") != "0":
         try:
-            sec = bench_model(secondary, on_tpu, chip)
+            sec = bench_model(secondary[0], on_tpu, chip, quant=secondary[1])
         except Exception as e:  # secondary is best-effort; never lose headline
             print(f"secondary bench failed: {e}", file=sys.stderr)
 
@@ -220,7 +237,7 @@ def main() -> None:
         "batch": res["batch"],
         "itl_ms": res["itl_ms"],
     }
-    for k in ("mfu", "mbu"):
+    for k in ("mfu", "mbu", "quantization"):
         if k in res:
             line[k] = res[k]
     if sec is not None:

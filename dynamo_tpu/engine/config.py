@@ -53,6 +53,14 @@ class EngineConfig:
     # 8B north-star model inside a v5e chip's 16 GiB — BASELINE.json #3)
     quantization: str = "none"
 
+    # chunked prefill: prompts longer than this many tokens are prefetched
+    # in fixed-size chunks interleaved with decode windows, bounding the
+    # decode stall a long admission causes (the reference's engines chunk
+    # prefill for the same reason — the 25ms ITL SLA of
+    # /root/reference/examples/dgdr/trtllm/dgdr.yaml:26 demands it).
+    # 0 disables. Rounded up to a page multiple at engine init.
+    prefill_chunk_tokens: int = 256
+
     # multi-step decode: fuse this many decode iterations into one jit
     # dispatch (lax.scan with on-device sampling). Amortises per-step host
     # round-trips — the dominant cost on networked TPU backends — at the cost
@@ -60,6 +68,11 @@ class EngineConfig:
     num_scheduler_steps: int = 1
 
     # runtime
+    # AOT warmup: precompile every prefill bucket + decode window before the
+    # worker flips /ready — the XLA analogue of the reference's TRT engine
+    # build (first traffic never eats a multi-second compile). Workers
+    # default it on via --warmup/--no-warmup; library users opt in.
+    warmup: bool = False
     enforce_eager: bool = False  # skip jit (debug only)
     # attention kernel backend: auto (Pallas on TPU, XLA elsewhere) | xla |
     # pallas | pallas_interpret (CPU debugging)
@@ -88,6 +101,7 @@ class EngineConfig:
         p.add_argument("--ep", type=int, default=1)
         p.add_argument("--moe-capacity-factor", type=float, default=0.0)
         p.add_argument("--num-scheduler-steps", type=int, default=1)
+        p.add_argument("--prefill-chunk-tokens", type=int, default=256)
         p.add_argument("--disaggregation-mode", default="agg",
                        choices=["agg", "prefill", "decode"])
         p.add_argument("--is-prefill-worker", action="store_true")
@@ -101,6 +115,14 @@ class EngineConfig:
                        choices=["none", "int8"])
         p.add_argument("--attention-backend", default="auto",
                        choices=["auto", "xla", "pallas", "pallas_interpret"])
+        p.add_argument("--warmup", action=argparse.BooleanOptionalAction,
+                       default=True,
+                       help="precompile all programs before /ready flips")
+        p.add_argument("--engine-config", default=None, metavar="FILE",
+                       help="per-role YAML/JSON file of EngineConfig field "
+                            "overrides (the TRT --extra-engine-args analogue, "
+                            "/root/reference/examples/dgdr/trtllm/"
+                            "disagg.yaml:39-40,64-65)")
         return p
 
     @staticmethod
@@ -110,7 +132,7 @@ class EngineConfig:
             mode = "prefill"
         if getattr(args, "is_decode_worker", False):
             mode = "decode"
-        return EngineConfig(
+        cfg = EngineConfig(
             model=args.model,
             model_path=args.model_path,
             served_model_name=args.served_model_name,
@@ -124,10 +146,35 @@ class EngineConfig:
             expert_parallel=args.ep,
             moe_capacity_factor=args.moe_capacity_factor,
             num_scheduler_steps=args.num_scheduler_steps,
+            prefill_chunk_tokens=getattr(args, "prefill_chunk_tokens", 256),
             disaggregation_mode=mode,
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,
             seed=args.seed,
             quantization=getattr(args, "quantization", "none"),
             attention_backend=args.attention_backend,
+            warmup=getattr(args, "warmup", False),
         )
+        path = getattr(args, "engine_config", None)
+        if path:
+            cfg = cfg.apply_file(path)
+        return cfg
+
+    def apply_file(self, path: str) -> "EngineConfig":
+        """Overlay EngineConfig fields from a YAML/JSON file (per-role engine
+        configs — prefill and decode roles ship different tuning files in the
+        disagg manifests). File values override CLI values; unknown keys are
+        an error so typos fail loudly."""
+        import yaml
+
+        with open(path) as f:
+            overrides = yaml.safe_load(f) or {}
+        if not isinstance(overrides, dict):
+            raise ValueError(f"engine config {path!r} must be a mapping")
+        valid = {f.name for f in dataclasses.fields(EngineConfig)}
+        unknown = set(overrides) - valid
+        if unknown:
+            raise ValueError(
+                f"unknown engine-config keys in {path!r}: {sorted(unknown)}"
+            )
+        return dataclasses.replace(self, **overrides)

@@ -59,8 +59,61 @@ def _next_bucket(n: int, page_size: int, max_len: int) -> int:
     return min(b, cap)
 
 
+class PhaseTimer:
+    """Bucketed per-phase latency histogram (log2 buckets, 0.25ms..8s).
+
+    The in-engine observability VERDICT/SURVEY §5 call for: per-phase
+    step-time distributions (not just cumulative sums), cheap enough to run
+    always-on in the hot loop."""
+
+    _EDGES_MS = [0.25 * 2 ** i for i in range(16)]  # 0.25ms .. ~8.2s
+
+    def __init__(self):
+        self.count = 0
+        self.sum_s = 0.0
+        self.max_s = 0.0
+        self.buckets = [0] * (len(self._EDGES_MS) + 1)
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.sum_s += seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+        ms = seconds * 1e3
+        for i, edge in enumerate(self._EDGES_MS):
+            if ms <= edge:
+                self.buckets[i] += 1
+                return
+        self.buckets[-1] += 1
+
+    def quantile_ms(self, q: float) -> float:
+        """Upper-edge estimate of the q-quantile from the buckets."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                return self._EDGES_MS[min(i, len(self._EDGES_MS) - 1)]
+        return self._EDGES_MS[-1]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "sum_s": round(self.sum_s, 6),
+            "mean_ms": round(1e3 * self.sum_s / self.count, 3)
+            if self.count else 0.0,
+            "p50_ms": round(self.quantile_ms(0.5), 3),
+            "p95_ms": round(self.quantile_ms(0.95), 3),
+            "max_ms": round(self.max_s * 1e3, 3),
+        }
+
+
 class EngineMetrics:
-    """Counters surfaced via the worker's /metrics endpoint."""
+    """Counters + per-phase timing histograms surfaced via /worker/stats."""
+
+    _PHASES = ("prefill", "prefill_chunk", "decode_window", "decode_step")
 
     def __init__(self):
         self.num_requests = 0
@@ -71,9 +124,32 @@ class EngineMetrics:
         self.prefill_time_s = 0.0
         self.decode_time_s = 0.0
         self.kv_oom = 0
+        self.phases: Dict[str, PhaseTimer] = {p: PhaseTimer()
+                                              for p in self._PHASES}
+
+    def observe_phase(self, phase: str, seconds: float) -> None:
+        self.phases[phase].observe(seconds)
 
     def snapshot(self) -> Dict[str, float]:
-        return dict(self.__dict__)
+        out = {k: v for k, v in self.__dict__.items() if k != "phases"}
+        out["phases"] = {p: t.snapshot() for p, t in self.phases.items()}
+        return out
+
+
+class InflightPrefill:
+    """A long prompt being prefilled chunk-by-chunk between decode windows."""
+
+    __slots__ = ("req", "pages", "pages_arr", "prompt_len", "done", "slot")
+
+    def __init__(self, req: GenRequest, pages, pages_arr, prompt_len: int,
+                 slot: int):
+        self.req = req
+        self.pages = pages  # real page ids (host list, allocator-owned)
+        self.pages_arr = pages_arr  # bucket-padded np.int32 for the jit
+        self.prompt_len = prompt_len
+        self.done = 0  # tokens whose KV is cached so far
+        self.slot = slot  # decode slot RESERVED at admission (a concurrent
+        # import_kv taking the last slot mid-prefill would strand the finish)
 
 
 class Engine:
@@ -150,6 +226,17 @@ class Engine:
         self.seqs: Dict[int, SeqState] = {}
         self._free_slots = list(range(b - 1, -1, -1))
         self.pending: collections.deque[GenRequest] = collections.deque()
+        self._inflight: Optional[InflightPrefill] = None
+        if cfg.prefill_chunk_tokens > 0:
+            # chunks must be page-aligned (chunk KV scatters whole pages);
+            # replace rather than mutate the caller's config object
+            import dataclasses as _dc
+
+            rounded = -(-cfg.prefill_chunk_tokens
+                        // cfg.page_size) * cfg.page_size
+            if rounded != cfg.prefill_chunk_tokens:
+                cfg = _dc.replace(cfg, prefill_chunk_tokens=rounded)
+                self.cfg = cfg
         self._aborted: set = set()
         # disagg prefill role: request_id -> (pages, n_tokens) held for export
         self._parked: Dict[str, tuple] = {}
@@ -173,6 +260,10 @@ class Engine:
             (b, self.model_cfg.vocab_size), dtype=jnp.int32
         )
         self._build_jit()
+        if not cfg.enforce_eager:
+            # normalize provenance so the first decode window keys the same
+            # compilation as steady state (see _upload)
+            (self.token_counts,) = self._upload(self.token_counts)
 
     def _invalidate_dev(self, tables_only: bool = False):
         self._dev_tables = None
@@ -185,13 +276,31 @@ class Engine:
     def _build_jit(self):
         cfg, mcfg = self.cfg, self.model_cfg
         page_size = cfg.page_size
+        rep_sharding = jax.NamedSharding(self.mesh, jax.P())
+
+        def rep(x):
+            """Pin host-readback outputs to fully-replicated: every process
+            of a multi-host gang can np.asarray() them locally (a
+            GSPMD-chosen batch/vocab sharding would make them
+            non-addressable on followers). No-op cost single-process."""
+            return jax.tree.map(
+                lambda a: jax.lax.with_sharding_constraint(a, rep_sharding), x
+            )
 
         def prefill_fn(params, tokens, seq_len, k_pages, v_pages, pages):
             out = llama.prefill(
                 mcfg, params, tokens, seq_len, k_pages, v_pages, pages,
                 page_size=page_size,
             )
-            return out.last_logits, out.k_pages, out.v_pages
+            return rep(out.last_logits), out.k_pages, out.v_pages
+
+        def chunk_fn(params, tokens, start, chunk_len, k_pages, v_pages,
+                     pages):
+            out = llama.prefill_chunk(
+                mcfg, params, tokens, start, chunk_len, k_pages, v_pages,
+                pages, page_size=page_size,
+            )
+            return rep(out.last_logits), out.k_pages, out.v_pages
 
         def make_decode_window(n_steps: int, with_logprobs: bool):
             """n_steps fused decode iterations in one dispatch: lax.scan over
@@ -248,7 +357,7 @@ class Engine:
                 )
                 tokens, positions, context_lens, counts, k_pages, v_pages = carry
                 # ys: (toks [n_steps, B], [logprob extras...])
-                return (ys, tokens, positions, context_lens, counts,
+                return (rep(ys), tokens, positions, context_lens, counts,
                         k_pages, v_pages)
 
             return window_fn
@@ -270,7 +379,7 @@ class Engine:
             toks, chosen, tids, tvals = smp.sample_with_logprobs(
                 logits[None], state, key[None]
             )
-            return toks[0], chosen[0], tids[0], tvals[0]
+            return rep((toks[0], chosen[0], tids[0], tvals[0]))
 
         def reset_count_fn(counts, slot, token):
             """Zero a slot's penalty counts and count its first token."""
@@ -300,30 +409,129 @@ class Engine:
 
         if cfg.enforce_eager:
             self._prefill = ctx(prefill_fn)
+            self._prefill_chunk = ctx(chunk_fn)
             self._windows = {k: ctx(f) for k, f in window_fns.items()}
             self._sample_first = ctx(sample_first)
             self._reset_count = ctx(reset_count_fn)
             self._import = ctx(import_fn)
+            self._upload = lambda *xs: tuple(jnp.asarray(x) for x in xs)
+            self._jit_handles = {}
         else:
             # donate KV pools + carried decode state: XLA updates in place
             # (active mask, block tables, sampling params and slot keys are
             # reused across windows). tokens/pos/ctx/counts/k/v donated.
             window_donate = (1, 2, 3, 12, 13, 14)
-            self._prefill = ctx(jax.jit(prefill_fn, donate_argnums=(3, 4)))
-            self._windows = {
-                k: ctx(jax.jit(f, donate_argnums=window_donate))
-                for k, f in window_fns.items()
-            }
-            self._sample_first = ctx(jax.jit(sample_first))
-            self._reset_count = ctx(jax.jit(reset_count_fn,
-                                            donate_argnums=(0,)))
-            self._import = ctx(jax.jit(import_fn, donate_argnums=(0, 1)))
+            jp = jax.jit(prefill_fn, donate_argnums=(3, 4))
+            jc = jax.jit(chunk_fn, donate_argnums=(4, 5))
+            jw = {k: jax.jit(f, donate_argnums=window_donate)
+                  for k, f in window_fns.items()}
+            js = jax.jit(sample_first)
+            jr = jax.jit(reset_count_fn, donate_argnums=(0,))
+            ji = jax.jit(import_fn, donate_argnums=(0, 1))
+            self._prefill = ctx(jp)
+            self._prefill_chunk = ctx(jc)
+            self._windows = {k: ctx(f) for k, f in jw.items()}
+            self._sample_first = ctx(js)
+            self._reset_count = ctx(jr)
+            self._import = ctx(ji)
+            # jitted upload whose outputs share the sharding provenance of
+            # other jit outputs over the engine mesh (see _decode_once).
+            # optimization_barrier defeats jit's pass-through fast path for
+            # identity functions; the explicit replicated out_shardings over
+            # self.mesh matches what the decode windows produce.
+            self._upload = jax.jit(
+                lambda *xs: jax.lax.optimization_barrier(xs),
+                out_shardings=rep_sharding)
+            # raw jitted fns, for warmup verification (compile-cache sizes)
+            self._jit_handles = {"prefill": jp, "prefill_chunk": jc,
+                                 "sample_first": js,
+                                 "reset_count": jr, "import": ji,
+                                 **{f"window_{m}_{l}": f
+                                    for (m, l), f in jw.items()}}
+
+    def compiled_program_count(self) -> int:
+        """Total executables across the engine's jit caches (warmup check)."""
+        return sum(f._cache_size() for f in self._jit_handles.values())
+
+    def warmup(self) -> Dict[str, int]:
+        """Precompile every program the serving loop can hit — all prefill
+        buckets, every decode-window variant, the first-token sampler, and
+        the disagg KV import — so /ready never flips before the engine is
+        compile-complete (the XLA analogue of the reference's TRT engine
+        build; with JAX_COMPILATION_CACHE_DIR set, a restart re-warms from
+        the persistent cache in seconds).
+
+        All warm traffic targets the reserved trash page 0 with inactive
+        batch state, so no live KV or slot bookkeeping is disturbed."""
+        if self.cfg.enforce_eager:
+            return {"programs": 0, "seconds": 0}
+        if self.has_work:
+            raise RuntimeError("warmup() requires an idle engine")
+        cfg = self.cfg
+        t0 = time.monotonic()
+        k = max(1, cfg.num_scheduler_steps)
+
+        # Warm with REAL requests through the live code path — hand-crafted
+        # jit calls can't reproduce the exact (sharding, layout, donation)
+        # cache keys the serving loop produces, and a near-miss means a
+        # compile on first traffic anyway.
+        reqs: List[GenRequest] = []
+        cap = -(-cfg.max_seq_len // cfg.page_size) * cfg.page_size
+        b = cfg.page_size
+        buckets = set()
+        while b < cap:
+            buckets.add(b)
+            b *= 2
+        buckets.add(cap)
+        for bucket in sorted(buckets):
+            p = min(bucket, cfg.max_seq_len - 1)
+            reqs.append(GenRequest(f"__warm_b{bucket}", [1] * p, max_tokens=1,
+                                   temperature=0.0, ignore_eos=True))
+        # decode windows: max_tokens = 2k+2 runs two consecutive fused-k
+        # windows (first with rebuilt state, second with carried state — the
+        # two distinct steady-state signatures) and then a single-step
+        # window; the logprobs twin compiles both lp variants
+        reqs.append(GenRequest("__warm_win", [1, 2, 3], max_tokens=2 * k + 2,
+                               temperature=0.0, ignore_eos=True))
+        reqs.append(GenRequest("__warm_lp", [1, 2, 3], max_tokens=2 * k + 2,
+                               temperature=0.0, ignore_eos=True, logprobs=1))
+        if cfg.disaggregation_mode == "prefill":
+            # the prefill role serves prompts via prefill_only -> FULL
+            # prefill at every bucket; routing warm traffic through
+            # add_request would divert long prompts to the chunked path
+            # and leave the large full-prefill programs uncompiled
+            for r in reqs:
+                self.prefill_only(r)
+                self.release_parked(r.request_id)
+        else:
+            for r in reqs:
+                self.add_request(r)
+                while self.has_work:  # one at a time: fused window needs
+                    self.step()       # an empty pending queue to engage
+        if cfg.disaggregation_mode == "decode":
+            with self._exec_lock:
+                idx = jnp.asarray([0], jnp.int32)
+                one = jnp.zeros(
+                    (self.kv_spec.num_layers, 1, cfg.page_size,
+                     self.kv_spec.num_kv_heads * self.kv_spec.head_dim),
+                    self.k_pages.dtype,
+                )
+                self.k_pages, self.v_pages = self._import(
+                    self.k_pages, self.v_pages, idx, one, one
+                )
+        self.metrics = EngineMetrics()  # don't surface warm traffic as load
+        out = {
+            "programs": self.compiled_program_count(),
+            "seconds": round(time.monotonic() - t0, 2),
+        }
+        log.info("warmup complete: %s", out)
+        return out
 
     # ------------------------------------------------------- request intake --
 
-    def add_request(self, req: GenRequest) -> None:
-        """Enqueue a request. Raises ValueError if it can never be served
-        (over-length prompt or a KV footprint larger than the whole pool)."""
+    def validate_request(self, req: GenRequest) -> None:
+        """Raise ValueError if the request can never be served (over-length
+        prompt or a KV footprint larger than the whole pool)."""
         if len(req.prompt_token_ids) >= self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_token_ids)} tokens exceeds "
@@ -335,6 +543,10 @@ class Engine:
                 f"prompt needs {n_pages} KV pages; pool only has "
                 f"{self.cfg.num_pages - 1}"
             )
+
+    def add_request(self, req: GenRequest) -> None:
+        """Enqueue a request (raises like validate_request)."""
+        self.validate_request(req)
         with self._lock:
             self.pending.append(req)
             self.metrics.num_requests += 1
@@ -362,7 +574,8 @@ class Engine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.seqs) or bool(self.pending)
+        return (bool(self.seqs) or bool(self.pending)
+                or self._inflight is not None)
 
     # ------------------------------------------------------------ scheduling --
 
@@ -374,7 +587,12 @@ class Engine:
         with self._exec_lock:
             events: List[TokenEvent] = []
             events.extend(self._apply_aborts())
-            events.extend(self._admit())
+            if self._inflight is not None:
+                # one chunk per step: decode windows run between chunks, so
+                # a long admission never monopolizes the chip
+                events.extend(self._advance_chunk())
+            else:
+                events.extend(self._admit())
             if self.seqs:
                 events.extend(self._decode_once())
             return events
@@ -392,6 +610,12 @@ class Engine:
                 else:
                     kept.append(r)
             self.pending = kept
+        inf = self._inflight
+        if inf is not None and inf.req.request_id in aborted:
+            self.allocator.free(inf.pages)
+            self._free_slots.append(inf.slot)
+            self._inflight = None
+            events.append(TokenEvent(inf.req.request_id, -1, 0, True, "abort"))
         for slot, seq in list(self.seqs.items()):
             if seq.request_id in aborted:
                 events.append(
@@ -403,6 +627,7 @@ class Engine:
 
     def _admit(self) -> List[TokenEvent]:
         events: List[TokenEvent] = []
+        chunk = self.cfg.prefill_chunk_tokens
         while self._free_slots:
             with self._lock:
                 if not self.pending:
@@ -414,6 +639,12 @@ class Engine:
                 if not self.allocator.can_alloc(n_pages):
                     break  # wait for running sequences to release pages
                 self.pending.popleft()
+            if chunk > 0 and len(req.prompt_token_ids) > chunk:
+                # long prompt: prefill in chunks across subsequent step()s
+                # instead of stalling every active stream for the whole
+                # prompt (FIFO holds: later admissions wait behind it)
+                self._start_inflight(req)
+                break
             try:
                 ev = self._prefill_request(req)
             except OutOfPages:
@@ -461,6 +692,16 @@ class Engine:
             self.v_pages,
             jnp.asarray(pages_arr),
         )
+        first, req_key, lp = self._first_token(req, last_logits, prompt_len)
+        dt = time.monotonic() - t0
+        self.metrics.prefill_time_s += dt
+        self.metrics.observe_phase("prefill", dt)
+        self.metrics.prompt_tokens += prompt_len
+        return first, pages, prompt_len, req_key, lp
+
+    def _first_token(self, req: GenRequest, last_logits, prompt_len: int):
+        """Sample the first token from prefill logits (shared by the full and
+        chunked prefill paths). Returns (first, req_key, lp)."""
         req_key = self._request_key(req)
         # the prediction made FROM position prompt_len-1; decode windows fold
         # positions >= prompt_len, so the chains never collide
@@ -472,11 +713,8 @@ class Engine:
             req_key,
             jnp.int32(prompt_len - 1),
         )
-        first = int(tok)
-        lp = (float(chosen), np.asarray(tids), np.asarray(tvals))
-        self.metrics.prefill_time_s += time.monotonic() - t0
-        self.metrics.prompt_tokens += prompt_len
-        return first, pages, prompt_len, req_key, lp
+        return int(tok), req_key, (float(chosen), np.asarray(tids),
+                                   np.asarray(tvals))
 
     def _install_slot(self, req: GenRequest, slot: int, pages, prompt_len: int,
                       first: int, req_key) -> SeqState:
@@ -535,6 +773,70 @@ class Engine:
         if finished:
             self._finish_slot(slot, reason)
         return ev
+
+    def _start_inflight(self, req: GenRequest) -> None:
+        cfg = self.cfg
+        chunk = cfg.prefill_chunk_tokens
+        prompt_len = len(req.prompt_token_ids)
+        bucket = _next_bucket(prompt_len, cfg.page_size, cfg.max_seq_len)
+        # the padded FINAL chunk must fit the page table: round the bucket
+        # up to a chunk multiple (dynamic_slice would silently clamp an
+        # overrunning slice and scatter the tail chunk's KV into the wrong
+        # pages)
+        bucket = -(-bucket // chunk) * chunk
+        pages = self.allocator.alloc(max(1, -(-prompt_len // cfg.page_size)))
+        pages_arr = np.zeros((bucket // cfg.page_size,), dtype=np.int32)
+        pages_arr[: len(pages)] = pages
+        slot = self._free_slots.pop()
+        self._inflight = InflightPrefill(req, pages, pages_arr, prompt_len,
+                                         slot)
+
+    def _advance_chunk(self) -> List[TokenEvent]:
+        """Run ONE chunk of the inflight prefill; on the last chunk, sample
+        the first token and install the sequence into a decode slot."""
+        inf = self._inflight
+        assert inf is not None
+        cfg = self.cfg
+        t0 = time.monotonic()
+        c = cfg.prefill_chunk_tokens
+        start = inf.done
+        take = min(c, inf.prompt_len - start)
+        tokens = np.zeros((c,), dtype=np.int32)
+        tokens[:take] = inf.req.prompt_token_ids[start:start + take]
+
+        last_logits, self.k_pages, self.v_pages = self._prefill_chunk(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.int32(start),
+            jnp.int32(take),
+            self.k_pages,
+            self.v_pages,
+            jnp.asarray(inf.pages_arr),
+        )
+        inf.done += take
+        dt = time.monotonic() - t0
+        self.metrics.prefill_time_s += dt
+        self.metrics.observe_phase("prefill_chunk", dt)
+        if inf.done < inf.prompt_len:
+            return []
+
+        # final chunk: first token + slot installation (same tail as the
+        # full-prefill path)
+        self._inflight = None
+        self.metrics.prompt_tokens += inf.prompt_len
+        req = inf.req
+        first, req_key, lp = self._first_token(req, last_logits,
+                                               inf.prompt_len)
+        slot = inf.slot  # reserved at _start_inflight
+        seq = self._install_slot(req, slot, inf.pages, inf.prompt_len, first,
+                                 req_key)
+        finished, reason = self._check_stop(seq, first)
+        ev = TokenEvent(req.request_id, first, 0, finished, reason)
+        if req.logprobs is not None:
+            self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
+        if finished:
+            self._finish_slot(slot, reason)
+        return [ev]
 
     def _window_steps(self) -> int:
         """How many decode steps the next dispatch may fuse (1 = classic).
@@ -603,7 +905,11 @@ class Engine:
         if not self.seqs:
             return events
 
-        # rebuild invalidated device state from the host mirrors
+        # rebuild invalidated device state from the host mirrors. Uploads go
+        # through the jitted identity `_upload` so the arrays carry the SAME
+        # sharding provenance as decode-window outputs — a plain jnp.asarray
+        # (uncommitted) input would key a second compilation of every window
+        # variant for the rebuild-following call.
         if self._dev_state is None:
             active = set(self.seqs)
             for slot in range(cfg.max_num_seqs):
@@ -619,23 +925,17 @@ class Engine:
                     self.block_tables[slot, :] = 0
             active_mask = np.zeros((cfg.max_num_seqs,), np.bool_)
             active_mask[list(active)] = True
-            self._dev_state = (
-                jnp.asarray(self.cur_tokens),
-                jnp.asarray(self.positions),
-                jnp.asarray(self.context_lens),
-                jnp.asarray(active_mask),
+            self._dev_state = self._upload(
+                self.cur_tokens, self.positions, self.context_lens,
+                active_mask,
             )
             self._dev_tables = None  # block_tables zeroed above for inactive
         if self._dev_tables is None:
-            self._dev_tables = jnp.asarray(self.block_tables)
+            (self._dev_tables,) = self._upload(self.block_tables)
         if self._dev_sampling is None:
-            self._dev_sampling = (
-                jnp.asarray(self.temperature),
-                jnp.asarray(self.top_p),
-                jnp.asarray(self.top_k),
-                jnp.asarray(self.presence),
-                jnp.asarray(self.frequency),
-                jnp.asarray(self.slot_keys),
+            self._dev_sampling = self._upload(
+                self.temperature, self.top_p, self.top_k,
+                self.presence, self.frequency, self.slot_keys,
             )
 
         want_lp = any(s.logprobs is not None for s in self.seqs.values())
@@ -654,8 +954,11 @@ class Engine:
             chosen_np = np.asarray(ys[1])  # [window, B]
             tids_np = np.asarray(ys[2])  # [window, B, K]
             tvals_np = np.asarray(ys[3])
+        dt = time.monotonic() - t0
         self.metrics.decode_steps += window
-        self.metrics.decode_time_s += time.monotonic() - t0
+        self.metrics.decode_time_s += dt
+        self.metrics.observe_phase("decode_window", dt)
+        self.metrics.observe_phase("decode_step", dt / window)
 
         for slot, seq in list(self.seqs.items()):
             for k in range(window):
@@ -755,6 +1058,21 @@ class Engine:
             idx = jnp.asarray(pages, jnp.int32)
             k = np.asarray(jnp.take(self.k_pages, idx, axis=1))
             v = np.asarray(jnp.take(self.v_pages, idx, axis=1))
+        return k, v, n_tokens
+
+    def export_kv_device(self, request_id: str):
+        """Device-resident twin of export_kv: the gathered pages stay
+        jax.Arrays, so a same-process decode engine can install them with a
+        device-to-device copy (the ICI plane) — no host bounce.
+
+        Returns (k, v, n_tokens) with k/v [L, n_pages, ps, KV*D] on device.
+        """
+        with self._lock:
+            pages, n_tokens, _ = self._parked[request_id]
+        with self._exec_lock:
+            idx = jnp.asarray(pages, jnp.int32)
+            k = jnp.take(self.k_pages, idx, axis=1)
+            v = jnp.take(self.v_pages, idx, axis=1)
         return k, v, n_tokens
 
     def release_parked(self, request_id: str):

@@ -26,6 +26,10 @@ def main(argv=None):
     p.add_argument("--etcd-endpoint", default=os.environ.get("ETCD_ENDPOINT"),
                    help="etcd v3 gateway URL; enables cross-replica worker "
                         "registry sync (e.g. http://dynamo-platform-etcd:2379)")
+    p.add_argument("--nats-url", default=os.environ.get("NATS_URL"),
+                   help="NATS server URL; routes requests to workers over "
+                        "the NATS plane (e.g. nats://dynamo-platform-nats:"
+                        "4222), with HTTP fallback")
     args = p.parse_args(argv)
 
     from dynamo_tpu.serving.router import Router
@@ -46,7 +50,7 @@ def main(argv=None):
 
         EtcdRegistry(router, args.etcd_endpoint,
                      ttl_s=int(args.heartbeat_ttl)).start()
-    ctx = FrontendContext(router)
+    ctx = FrontendContext(router, nats_url=args.nats_url)
     srv = make_frontend_server(ctx, args.host, args.port)
 
     def shutdown(*_):

@@ -84,6 +84,8 @@ class Controller:
                 )
         for dep in desired["deployments"]:
             self.k8s.upsert("apps/v1", "deployments", ns, dep)
+        for sts in desired["statefulsets"]:
+            self.k8s.upsert("apps/v1", "statefulsets", ns, sts)
         for svc in desired["services"]:
             self.k8s.upsert("v1", "services", ns, svc)
         for pvc in desired["pvcs"]:
@@ -104,6 +106,16 @@ class Controller:
                 )
             else:
                 kept_deps.append(existing)
+        want_sts = {s["metadata"]["name"] for s in desired["statefulsets"]}
+        for existing in self._owned("apps/v1", "statefulsets", ns, ns_label):
+            if existing["metadata"]["name"] not in want_sts:
+                log.info("pruning stale statefulset %s",
+                         existing["metadata"]["name"])
+                self.k8s.delete(
+                    "apps/v1", "statefulsets", ns, existing["metadata"]["name"]
+                )
+            else:
+                kept_deps.append(existing)  # joins the DGD status rollup
         want_svcs = {s["metadata"]["name"] for s in desired["services"]}
         for existing in self._owned("v1", "services", ns, ns_label):
             if existing["metadata"]["name"] not in want_svcs:

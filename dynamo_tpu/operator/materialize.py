@@ -193,6 +193,10 @@ def _pod_spec(
                 "persistentVolumeClaim": {"claimName": pvc["name"]},
             }
         )
+    for cm in spec.get("configMapVolumes") or []:
+        # ConfigMap-backed volumes (per-role engine-config files,
+        # examples/deploy/jetstream/engine-configs.yaml)
+        volumes.append({"name": cm, "configMap": {"name": cm}})
     if volumes:
         pod["volumes"] = volumes
     node_sel: Dict[str, str] = {}
@@ -252,15 +256,20 @@ def build_deployment(
     }
 
 
+def hosts_per_replica(spec: Dict[str, Any]) -> int:
+    """Pods per logical worker: > 1 = a multi-host TPU slice, where one
+    jax.distributed gang spans `hostsPerReplica` pods."""
+    return int(spec.get("hostsPerReplica", 1) or 1)
+
+
 def _gang_eligible(spec: Dict[str, Any], ctype: str) -> bool:
-    """Gang placement applies to accelerator worker groups with more than one
-    pod — a multi-host TPU slice is unusable until every host's pod lands, so
-    partial placement just wastes chips (the reason the reference offers
-    Grove/KAI at all)."""
+    """Gang placement applies when a service needs >1 pod to be useful at
+    all: a multi-host slice (hostsPerReplica > 1 — the canonical case: a
+    SINGLE replica spanning several hosts) or a multi-replica worker group.
+    Keyed on topology, not just replica count."""
     if ctype == "frontend":
         return False
-    replicas = int(spec.get("replicas", 1))
-    return replicas > 1
+    return int(spec.get("replicas", 1)) > 1 or hosts_per_replica(spec) > 1
 
 
 def build_pod_group(
@@ -282,8 +291,106 @@ def build_pod_group(
             "ownerReferences": [owner_reference(cr)],
         },
         "spec": {
-            "minMember": int(spec.get("replicas", 1)),
+            # a multi-host slice needs EVERY host pod placed to be usable
+            "minMember": int(spec.get("replicas", 1)) * hosts_per_replica(spec),
             "scheduleTimeoutSeconds": 300,
+        },
+    }
+
+
+def build_gang_statefulset(
+    cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any],
+    gang: bool = False, gang_scheduler: str = DEFAULT_GANG_SCHEDULER,
+) -> Dict[str, Any]:
+    """Multi-host worker group: one StatefulSet whose `hostsPerReplica` pods
+    form a single jax.distributed gang (the Grove multinode analogue,
+    /root/reference/install-dynamo-1node.sh:207-212).
+
+    StatefulSet (not Deployment) because gang membership needs STABLE pod
+    identities: the ordinal is the jax process id, and pod -0's stable DNS
+    name (via the headless gang Service) is the coordinator address that
+    every member dials.
+    """
+    from dynamo_tpu.parallel.distributed import COORDINATOR_PORT
+
+    hosts = hosts_per_replica(spec)
+    if int(spec.get("replicas", 1)) != 1:
+        raise ValueError(
+            "hostsPerReplica > 1 requires replicas == 1 (one gang per "
+            "service; scale multi-host workers with more DGD services)"
+        )
+    namespace = cr["metadata"].get("namespace", "default")
+    dgd_name = cr["metadata"]["name"]
+    ctype = spec.get("componentType", "worker")
+    frontend = frontend_host(cr)
+    name = child_name(dgd_name, svc_name)
+    labels = _labels(namespace, dgd_name, svc_name, ctype)
+    if spec.get("subComponentType"):
+        labels[f"{GROUP}/sub-component"] = spec["subComponentType"]
+    pod_labels = dict(labels)
+    pod_labels[POD_GROUP_LABEL] = name
+    pod_meta: Dict[str, Any] = {"labels": pod_labels}
+    pod_spec = _pod_spec(namespace, dgd_name, svc_name, spec, ctype, frontend)
+    gang_svc = f"{name}-gang"
+    main = pod_spec["containers"][0]
+    main["env"] = (main.get("env") or []) + [
+        {"name": "POD_NAME",
+         "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}}},
+        {"name": "DYNAMO_TPU_NUM_PROCESSES", "value": str(hosts)},
+        {"name": "DYNAMO_TPU_COORDINATOR",
+         "value": f"{name}-0.{gang_svc}.{namespace}.svc:{COORDINATOR_PORT}"},
+    ]
+    if gang:
+        pod_meta["annotations"] = {POD_GROUP_ANNOTATION: name}
+        pod_spec.setdefault("schedulerName", gang_scheduler)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels,
+            "ownerReferences": [owner_reference(cr)],
+        },
+        "spec": {
+            "replicas": hosts,
+            "serviceName": gang_svc,
+            "podManagementPolicy": "Parallel",  # the gang starts as a unit
+            "selector": {"matchLabels": {COMPONENT_LABEL: svc_name.lower(),
+                                         NS_LABEL: labels[NS_LABEL]}},
+            "template": {"metadata": pod_meta, "spec": pod_spec},
+        },
+    }
+
+
+def build_gang_service(
+    cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Headless Service giving gang pods stable DNS (coordinator discovery)."""
+    namespace = cr["metadata"].get("namespace", "default")
+    dgd_name = cr["metadata"]["name"]
+    ctype = spec.get("componentType", "worker")
+    name = child_name(dgd_name, svc_name)
+    labels = _labels(namespace, dgd_name, svc_name, ctype)
+    from dynamo_tpu.parallel.distributed import COORDINATOR_PORT
+
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{name}-gang",
+            "namespace": namespace,
+            "labels": labels,
+            "ownerReferences": [owner_reference(cr)],
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {COMPONENT_LABEL: svc_name.lower(),
+                         NS_LABEL: labels[NS_LABEL]},
+            "ports": [
+                {"name": "coordinator", "port": COORDINATOR_PORT},
+                {"name": "http", "port": FRONTEND_PORT},
+            ],
         },
     }
 
@@ -297,6 +404,11 @@ def build_service(
     NodePort (/root/reference/deploy-incluster.sh:409-413) and excludes
     `-d`/`-p` suffixed names from frontend selection (:459-464) — worker
     services here are headless, so both filters behave identically.
+
+    Multi-host gangs: only pod -0 (the jax.distributed leader) serves
+    HTTP — followers run the replication loop with no server — so the
+    selector additionally pins the StatefulSet leader pod via its stable
+    statefulset.kubernetes.io/pod-name label.
     """
     namespace = cr["metadata"].get("namespace", "default")
     dgd_name = cr["metadata"]["name"]
@@ -321,6 +433,9 @@ def build_service(
     }
     if ctype != "frontend":
         svc["spec"]["clusterIP"] = "None"
+    if hosts_per_replica(spec) > 1:
+        svc["spec"]["selector"][
+            "statefulset.kubernetes.io/pod-name"] = f"{name}-0"
     return svc
 
 
@@ -359,22 +474,32 @@ def materialize(
     cr: Dict[str, Any], gang: bool = False,
     gang_scheduler: str = DEFAULT_GANG_SCHEDULER,
 ) -> Dict[str, List[Dict[str, Any]]]:
-    """CR -> {deployments, services, pvcs, podgroups} (desired child state)."""
+    """CR -> {deployments, statefulsets, services, pvcs, podgroups}."""
     services = cr.get("spec", {}).get("services") or {}
     deployments = []
+    statefulsets = []
     svcs = []
     podgroups = []
     for svc_name, spec in services.items():
-        deployments.append(
-            build_deployment(cr, svc_name, spec, gang=gang,
-                             gang_scheduler=gang_scheduler)
-        )
+        if hosts_per_replica(spec) > 1:
+            # multi-host slice: StatefulSet gang + headless coordinator svc
+            statefulsets.append(
+                build_gang_statefulset(cr, svc_name, spec, gang=gang,
+                                       gang_scheduler=gang_scheduler)
+            )
+            svcs.append(build_gang_service(cr, svc_name, spec))
+        else:
+            deployments.append(
+                build_deployment(cr, svc_name, spec, gang=gang,
+                                 gang_scheduler=gang_scheduler)
+            )
         svcs.append(build_service(cr, svc_name, spec))
         ctype = spec.get("componentType", "worker")
         if gang and _gang_eligible(spec, ctype):
             podgroups.append(build_pod_group(cr, svc_name, spec))
     return {
         "deployments": deployments,
+        "statefulsets": statefulsets,
         "services": svcs,
         "pvcs": build_pvcs(cr),
         "podgroups": podgroups,

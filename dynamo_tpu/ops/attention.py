@@ -229,7 +229,48 @@ def prefill_attention_xla(
     return jnp.einsum("hqk,khd->qhd", probs, v)
 
 
+def chunk_attention(
+    q: jax.Array,  # [C, H, D] — one prefill chunk's queries
+    k_pages: jax.Array,  # [P, ps, KV*D]
+    v_pages: jax.Array,
+    pages: jax.Array,  # [Pbucket] page ids of THIS sequence (0-padded tail)
+    start,  # scalar int32: absolute position of q[0]
+    *,
+    page_size: int,
+) -> jax.Array:
+    """Chunked-prefill attention: C chunk queries over the sequence's cached
+    pages (prefix + the chunk itself, already written) with a causal mask in
+    absolute positions.
+
+    One gather of the sequence's pages serves ALL chunk rows (unlike the
+    decode op, whose per-row tables would duplicate the prefix C times).
+    XLA implementation: the gather feeds a masked-softmax attention that XLA
+    fuses; chunk attention is compute-bound (C queries amortize each KV
+    byte), so the flash-style Pallas treatment decode needs buys little here.
+    """
+    c, n_heads, head_dim = q.shape
+    n_kv = k_pages.shape[2] // head_dim
+    s_ctx = pages.shape[0] * page_size
+    k = k_pages[pages].reshape(s_ctx, n_kv, head_dim)
+    v = v_pages[pages].reshape(s_ctx, n_kv, head_dim)
+    k = repeat_kv(k, n_heads // n_kv, axis=1)
+    v = repeat_kv(v, n_heads // n_kv, axis=1)
+    scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+    scores = jnp.einsum("chd,shd->hcs", q * scale, k)
+    qpos = start + jnp.arange(c)[None, :, None]
+    kpos = jnp.arange(s_ctx)[None, None, :]
+    scores = jnp.where(kpos <= qpos, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("hcs,shd->chd", probs, v)
+
+
 # --------------------------------------------------------------- dispatch --
+
+
+def _mesh_tp(mesh) -> int:
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
 
 
 def paged_attention_decode(
@@ -243,14 +284,18 @@ def paged_attention_decode(
 ) -> jax.Array:
     backend = _resolve_backend()
     mesh = _mesh_for_shard_map()
+    n_kv = k_pages.shape[2] // q.shape[2]
+    tp = _mesh_tp(mesh)
+    if tp > 1 and (n_kv % tp != 0 or q.shape[1] % tp != 0):
+        # tp exceeds (or doesn't divide) the KV heads: the explicit
+        # head-parallel shard_map can't split a head — let GSPMD place the
+        # XLA path instead (weights are replicated by sharding._fit_spec)
+        mesh = None
     if backend != "xla":
         # TPU DMA needs the per-shard fused KV*D lane dim 128-aligned; with
         # extreme TP on tiny heads (e.g. tp=8 over 8 KV heads of dim 64) the
         # local span drops below a lane tile — use the XLA path there.
-        tp = 1
-        if mesh is not None:
-            tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
-        if (k_pages.shape[2] // tp) % 128 != 0:
+        if (k_pages.shape[2] // _mesh_tp(mesh)) % 128 != 0:
             import logging
 
             logging.getLogger("dynamo_tpu.ops").warning(
@@ -331,6 +376,9 @@ def prefill_attention(
         return pa.prefill_attention(q, k, v, sl, interpret=interpret)
 
     mesh = _mesh_for_shard_map()
+    tp = _mesh_tp(mesh)
+    if tp > 1 and (q.shape[1] % tp != 0 or k.shape[1] % tp != 0):
+        mesh = None  # heads not divisible: GSPMD auto-shards instead
     if mesh is None:
         return call(q, k, v, jnp.asarray(seq_len, jnp.int32))
     # Prefill is single-sequence: replicated over `data`, heads on `model`.

@@ -1,0 +1,244 @@
+"""Multi-host (multi-process) serving runtime.
+
+The reference platform serves multinode via Grove pod gangs
+(/root/reference/install-dynamo-1node.sh:35-36,207-212): one logical worker
+spans several pods, each owning a share of the accelerators. The TPU-native
+equivalent is a `jax.distributed` job: every process in the gang initializes
+against one coordinator, sees the GLOBAL device set, and executes the SAME
+jit programs over a global mesh (SPMD) — XLA places the collectives on
+ICI within a slice and DCN across slices.
+
+Serving on top of SPMD needs one extra invariant: every process must observe
+an IDENTICAL request stream and step sequence, because each step executes
+collectives that all processes must join. The leader (process 0) owns the
+HTTP frontend and broadcasts its intake ops (add/abort) plus a step/idle
+marker before every engine step; followers replay the ops into their local
+engine replica and step in lockstep.
+
+Config resolution order: explicit CLI flags > DYNAMO_TPU_* env > the GKE TPU
+pod env (TPU_WORKER_HOSTNAMES / TPU_WORKER_ID) that the operator's gang pod
+specs inject.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import pickle
+import threading
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger("dynamo_tpu.distributed")
+
+COORDINATOR_PORT = 8476  # jax.distributed coordinator (leader pod)
+
+# op kinds on the replication plane
+OP_ADD = "add"
+OP_ABORT = "abort"
+OP_STEP = "step"  # marker: run one engine.step() after applying ops
+OP_IDLE = "idle"  # heartbeat: keep followers' collective from timing out
+OP_SHUTDOWN = "shutdown"
+
+
+@dataclasses.dataclass(frozen=True)
+class DistConfig:
+    coordinator: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+
+def resolve(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> DistConfig:
+    """CLI > DYNAMO_TPU_* env > GKE TPU gang env."""
+    coord = coordinator or os.environ.get("DYNAMO_TPU_COORDINATOR") or None
+    n = num_processes or int(os.environ.get("DYNAMO_TPU_NUM_PROCESSES") or 0)
+    pid: Optional[str] = (
+        str(process_id) if process_id is not None
+        else os.environ.get("DYNAMO_TPU_PROCESS_ID")
+    )
+    if coord is None:
+        hosts = [
+            h.strip()
+            for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+            if h.strip()
+        ]
+        if len(hosts) > 1:
+            coord = f"{hosts[0]}:{COORDINATOR_PORT}"
+            n = n or len(hosts)
+            if pid is None:
+                pid = os.environ.get("TPU_WORKER_ID")
+    if coord is None or n <= 1:
+        return DistConfig()
+    if pid is None:
+        # StatefulSet gang pods: the ordinal suffix of the stable pod name
+        # IS the process id (operator/materialize.build_gang_statefulset)
+        pod_name = os.environ.get("POD_NAME", "")
+        tail = pod_name.rsplit("-", 1)[-1]
+        if tail.isdigit():
+            pid = tail
+    if pid is None:
+        raise ValueError(
+            "multi-process config needs a process id "
+            "(--process-id / DYNAMO_TPU_PROCESS_ID / TPU_WORKER_ID)"
+        )
+    return DistConfig(coordinator=coord, num_processes=n,
+                      process_id=int(pid))
+
+
+def initialize(cfg: DistConfig) -> None:
+    """jax.distributed.initialize for a gang member (no-op single-process).
+
+    Must run before the first JAX backend touch; afterwards jax.devices()
+    returns the gang's GLOBAL device set.
+    """
+    if not cfg.enabled:
+        return
+    import jax
+
+    log.info(
+        "jax.distributed.initialize: coordinator=%s process %d/%d",
+        cfg.coordinator, cfg.process_id, cfg.num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+
+
+# ------------------------------------------------------ replication plane --
+
+
+def _broadcast_bytes(payload: bytes, is_source: bool) -> bytes:
+    """Broadcast a variable-length byte string from process 0 to all.
+
+    Two fixed-shape collectives: the length, then the (length,) payload —
+    broadcast_one_to_all needs identical shapes on every process.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    n = mhu.broadcast_one_to_all(np.int32(len(payload)))
+    buf = np.frombuffer(payload, dtype=np.uint8) if is_source else np.zeros(
+        (int(n),), np.uint8
+    )
+    out = mhu.broadcast_one_to_all(buf)
+    return out.tobytes()
+
+
+class ReplicationPlane:
+    """Leader/follower op stream riding the jax.distributed collectives."""
+
+    def __init__(self, cfg: DistConfig):
+        self.cfg = cfg
+        # serialize the (length, payload) collective PAIR: interleaved
+        # publishes from two threads would pair lengths with foreign payloads
+        self._pub_lock = threading.Lock()
+
+    def publish(self, ops: List[Tuple[str, object]]) -> None:
+        assert self.cfg.is_leader
+        with self._pub_lock:
+            _broadcast_bytes(pickle.dumps(ops), is_source=True)
+
+    def receive(self) -> List[Tuple[str, object]]:
+        assert not self.cfg.is_leader
+        return pickle.loads(_broadcast_bytes(b"", is_source=False))
+
+
+class ReplicatedEngine:
+    """Leader-side engine wrapper: same surface EngineService drives, but
+    every intake op and step is published to the followers first, so all
+    gang processes execute identical SPMD programs in identical order."""
+
+    IDLE_EVERY_S = 2.0  # heartbeat cadence while no work is queued
+
+    def __init__(self, engine, plane: ReplicationPlane):
+        self.engine = engine
+        self.plane = plane
+        self._pending_ops: List[Tuple[str, object]] = []
+        self._ops_lock = threading.Lock()
+        self._last_idle = time.monotonic()
+
+    # ---- intake (HTTP threads). The op stream is the ONLY intake path on
+    # the leader too: ops apply to the local engine inside step(), after the
+    # snapshot — applying at intake time would let the leader's step admit a
+    # request whose OP_ADD wasn't in that step's broadcast, desynchronizing
+    # the followers' collectives. ----
+    def add_request(self, req) -> None:
+        # surface validation errors synchronously, BEFORE replication
+        self.engine.validate_request(req)
+        with self._ops_lock:
+            self._pending_ops.append((OP_ADD, req))
+
+    def abort_request(self, request_id: str) -> None:
+        with self._ops_lock:
+            self._pending_ops.append((OP_ABORT, request_id))
+
+    @property
+    def has_work(self) -> bool:
+        with self._ops_lock:
+            if self._pending_ops:
+                return True
+        return self.engine.has_work
+
+    def step(self):
+        with self._ops_lock:
+            ops, self._pending_ops = self._pending_ops, []
+        for op, arg in ops:
+            if op == OP_ADD:
+                self.engine.add_request(arg)
+            elif op == OP_ABORT:
+                self.engine.abort_request(arg)
+        self.plane.publish(ops + [(OP_STEP, None)])
+        return self.engine.step()
+
+    def idle_tick(self) -> None:
+        """Keep followers' pending collective fed while the leader idles
+        (a starved broadcast would hit the distributed-runtime timeout)."""
+        now = time.monotonic()
+        if now - self._last_idle >= self.IDLE_EVERY_S:
+            self._last_idle = now
+            self.plane.publish([(OP_IDLE, None)])
+
+    def shutdown(self) -> None:
+        self.plane.publish([(OP_SHUTDOWN, None)])
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+
+def follower_loop(engine, plane: ReplicationPlane) -> None:
+    """Follower process body: replay the leader's op stream forever.
+
+    The follower's engine is a full replica (same config, same seed, same
+    weights); collectives inside its jit programs pair up with the leader's
+    because both execute the same step sequence over the same global mesh.
+    """
+    log.info("follower %d/%d entering replication loop",
+             plane.cfg.process_id, plane.cfg.num_processes)
+    while True:
+        for op, arg in plane.receive():
+            if op == OP_ADD:
+                engine.add_request(arg)
+            elif op == OP_ABORT:
+                engine.abort_request(arg)
+            elif op == OP_STEP:
+                engine.step()
+            elif op == OP_IDLE:
+                pass
+            elif op == OP_SHUTDOWN:
+                log.info("follower shutting down")
+                return

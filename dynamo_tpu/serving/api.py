@@ -10,6 +10,7 @@ Endpoints: GET /v1/models, POST /v1/chat/completions, POST /v1/completions
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import List, Optional
@@ -218,6 +219,7 @@ class ServingContext:
             "dynamo_worker_kv_free_pages", "Free KV pages", self.metrics.registry
         )
         self.start_time = time.time()
+        self._trace_lock = threading.Lock()  # one profiler capture at a time
 
         # --- disaggregation wiring (mirrors the reference's role flags,
         # /root/reference/examples/deploy/sglang/disagg.yaml:45-52) ---
@@ -237,6 +239,35 @@ class ServingContext:
             self.disagg_client = DisaggDecodeClient(
                 self, PrefillPool(prefill_urls, frontend_url)
             )
+
+    def capture_trace(self, duration_s: float) -> bytes:
+        """Capture a jax.profiler trace for `duration_s` and return it as a
+        zip of the trace directory (XProf/TensorBoard-loadable). The
+        in-engine tracing story from SURVEY §5 — the deployment-level SLA
+        profiler (dynamo_tpu.profiler) covers pre-deploy planning; this
+        covers live per-step behavior."""
+        import io
+        import shutil
+        import tempfile
+        import zipfile
+
+        import jax
+
+        with self._trace_lock:
+            d = tempfile.mkdtemp(prefix="dynamo-trace-")
+            try:
+                jax.profiler.start_trace(d)
+                time.sleep(min(max(duration_s, 0.05), 30.0))
+                jax.profiler.stop_trace()
+                buf = io.BytesIO()
+                with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                    for root, _, files in os.walk(d):
+                        for f in files:
+                            full = os.path.join(root, f)
+                            z.write(full, os.path.relpath(full, d))
+                return buf.getvalue()
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
 
     def close(self):
         if self.kv_source is not None:
@@ -308,6 +339,23 @@ class _Handler(JsonHTTPHandler):
         elif path in ("/health", "/live", "/ready"):
             self._json(200, {"status": "ok", "uptime_s": round(
                 time.time() - self.ctx.start_time, 1)})
+        elif path == "/debug/trace":
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            try:
+                dur = float((qs.get("duration_s") or ["1.0"])[0])
+            except ValueError:
+                self._error(400, "duration_s must be a number")
+                return
+            try:
+                data = self.ctx.capture_trace(dur)
+            except Exception as e:
+                log.exception("trace capture failed")
+                self._error(503, f"trace capture failed: {e}",
+                            "service_unavailable")
+                return
+            self._raw(200, data, "application/zip")
         elif path == "/worker/stats":
             eng = self.ctx.engine
             self._json(200, {

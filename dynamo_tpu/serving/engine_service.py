@@ -103,8 +103,13 @@ class EngineService:
 
     # ------------------------------------------------------------ scheduler
     def _run(self):
+        idle_tick = getattr(self.engine, "idle_tick", None)
         while not self._stop:
             if not self.engine.has_work:
+                if idle_tick is not None:
+                    # multi-host leader: heartbeat the replication plane so
+                    # idle followers' pending collective never times out
+                    idle_tick()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue

@@ -32,7 +32,8 @@ log = logging.getLogger("dynamo_tpu.frontend")
 
 
 class FrontendContext:
-    def __init__(self, router: Optional[Router] = None):
+    def __init__(self, router: Optional[Router] = None,
+                 nats_url: Optional[str] = None):
         self.router = router or Router()
         self.metrics = FrontendMetrics()
         self.worker_gauge = Gauge(
@@ -40,6 +41,14 @@ class FrontendContext:
             self.metrics.registry,
         )
         self.start_time = time.time()
+        # NATS request plane (the reference's frontend<->worker transport,
+        # /root/reference/install-dynamo-1node.sh:241-242); HTTP remains the
+        # fallback when the plane is down or unset
+        self.nats = None
+        if nats_url:
+            from dynamo_tpu.serving.nats import NatsClient
+
+            self.nats = NatsClient(nats_url, name="frontend")
 
 
 class _FrontendHandler(JsonHTTPHandler):
@@ -91,6 +100,48 @@ class _FrontendHandler(JsonHTTPHandler):
             log.exception("frontend request failed")
             self._error(500, "internal error", "internal_error")
 
+    def _send_nats_response(self, parts, model: str, t0: float):
+        """Write a NATS-plane response out. The response has STARTED once we
+        are here — mid-stream failures truncate (never re-dispatch to the
+        HTTP plane, which would re-run inference and corrupt the stream)."""
+        ctx = self.ctx
+        m = ctx.metrics
+        status, ctype, chunks = parts
+        if "text/event-stream" in ctype:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            first = True
+            try:
+                for chunk in chunks:
+                    if first:
+                        m.ttft.observe(time.monotonic() - t0, model=model)
+                        first = False
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, socket.error):
+                pass
+            except Exception:
+                log.exception("NATS stream truncated mid-response")
+        else:
+            payload = b"".join(chunks)
+            m.ttft.observe(time.monotonic() - t0, model=model)
+            try:
+                usage = json.loads(payload).get("usage", {})
+                m.isl.observe(usage.get("prompt_tokens", 0), model=model)
+                m.osl.observe(usage.get("completion_tokens", 0), model=model)
+            except Exception:
+                pass
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        m.duration.observe(time.monotonic() - t0, model=model)
+
     # ----------------------------------------------------------------- proxy
     def _proxy(self, path: str):
         ctx = self.ctx
@@ -117,6 +168,17 @@ class _FrontendHandler(JsonHTTPHandler):
         m = ctx.metrics
         m.requests_total.inc(model=model)
         t0 = time.monotonic()
+        if ctx.nats is not None:
+            try:
+                # resolving the head frame proves a responder exists; only
+                # failures BEFORE it (no responder / timeout) may fall back
+                parts = _nats_proxy_parts(ctx, worker, path, body)
+            except Exception as e:
+                log.warning("NATS plane failed (%s); HTTP fallback to %s",
+                            e, worker.url)
+            else:
+                self._send_nats_response(parts, model, t0)
+                return
         req = urllib.request.Request(
             worker.url.rstrip("/") + path,
             data=raw,
@@ -179,5 +241,14 @@ class _FrontendHandler(JsonHTTPHandler):
         m.duration.observe(time.monotonic() - t0, model=model)
 
 
+def _nats_proxy_parts(ctx, worker, path, body):
+    from dynamo_tpu.serving import nats_plane
+
+    return nats_plane.nats_request(
+        ctx.nats, nats_plane.worker_subject(worker.url), path, body
+    )
+
+
+# split out so _proxy's HTTP path stays exactly as-is
 def make_frontend_server(ctx: FrontendContext, host="0.0.0.0", port=8000):
     return make_http_server(_FrontendHandler, {"ctx": ctx}, host, port)

@@ -1,0 +1,385 @@
+"""Minimal NATS client + embedded broker (core protocol, dependency-free).
+
+The reference platform runs NATS as its frontend<->worker request plane
+(/root/reference/install-dynamo-1node.sh:241-242, README.md:334). This module
+makes that plane REAL here rather than ornamental: the frontend publishes
+requests to per-worker / queue-group subjects and workers stream response
+chunks back over reply inboxes (dynamo_tpu.serving.nats_plane).
+
+Two pieces:
+- `NatsClient`: a synchronous client speaking the standard NATS text protocol
+  (INFO/CONNECT/PING/PONG/SUB/PUB/MSG, queue groups, reply inboxes) — works
+  against the official `nats-server` the platform manifests deploy
+  (deploy/platform/nats.yaml).
+- `MiniNatsBroker`: an in-process broker implementing the same core subset,
+  used by the test suite and for single-node dev (`python -m
+  dynamo_tpu.serving.nats` serves one on :4222). Subject matching supports
+  the `*` token and `>` tail wildcards.
+
+No JetStream/auth/TLS — core pub/sub is exactly what the request plane needs
+(at-most-once; HTTP remains the fallback path on timeout).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket
+import threading
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("dynamo_tpu.nats")
+
+DEFAULT_PORT = 4222
+
+
+def parse_url(url: str) -> Tuple[str, int]:
+    """nats://host:port (scheme optional)."""
+    u = url.strip()
+    if "://" in u:
+        u = u.split("://", 1)[1]
+    if "/" in u:
+        u = u.split("/", 1)[0]
+    if ":" in u:
+        host, port = u.rsplit(":", 1)
+        return host, int(port)
+    return u, DEFAULT_PORT
+
+
+def subject_token(raw: str) -> str:
+    """Sanitize an arbitrary string (model name, worker URL) into a single
+    NATS subject token (no dots/spaces/wildcards)."""
+    return "".join(c if c.isalnum() or c in "-_" else "-" for c in raw)
+
+
+def _subject_matches(pattern: str, subject: str) -> bool:
+    pt, st = pattern.split("."), subject.split(".")
+    for i, p in enumerate(pt):
+        if p == ">":
+            return True
+        if i >= len(st):
+            return False
+        if p != "*" and p != st[i]:
+            return False
+    return len(pt) == len(st)
+
+
+class _LineReader:
+    """Buffered protocol reader over a socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = b""
+
+    def read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("nats connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("nats connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+
+class Msg:
+    __slots__ = ("subject", "reply", "data")
+
+    def __init__(self, subject: str, reply: Optional[str], data: bytes):
+        self.subject = subject
+        self.reply = reply
+        self.data = data
+
+
+class NatsClient:
+    """Synchronous NATS client; a reader thread dispatches MSG callbacks."""
+
+    def __init__(self, url: str, name: str = "dynamo-tpu",
+                 connect_timeout: float = 5.0):
+        host, port = parse_url(url)
+        self.sock = socket.create_connection((host, port),
+                                             timeout=connect_timeout)
+        self.sock.settimeout(None)
+        self._reader = _LineReader(self.sock)
+        self._wlock = threading.Lock()
+        self._subs: Dict[int, Callable[[Msg], None]] = {}
+        self._next_sid = 1
+        self._closed = False
+
+        info = self._reader.read_line()
+        if not info.startswith(b"INFO "):
+            raise ConnectionError(f"unexpected NATS greeting: {info[:64]!r}")
+        self._send(
+            b"CONNECT "
+            + json.dumps({"verbose": False, "pedantic": False, "name": name,
+                          "lang": "python", "version": "0"}).encode()
+            + b"\r\n"
+        )
+        self._thread = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="nats-reader")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ io --
+    def _send(self, data: bytes) -> None:
+        with self._wlock:
+            self.sock.sendall(data)
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed:
+                line = self._reader.read_line()
+                if line == b"PING":
+                    self._send(b"PONG\r\n")
+                elif line.startswith(b"MSG "):
+                    parts = line.decode().split(" ")
+                    # MSG <subject> <sid> [reply-to] <#bytes>
+                    if len(parts) == 5:
+                        _, subject, sid, reply, nbytes = parts
+                    else:
+                        _, subject, sid, nbytes = parts
+                        reply = None
+                    data = self._reader.read_exact(int(nbytes))
+                    self._reader.read_exact(2)  # trailing CRLF
+                    cb = self._subs.get(int(sid))
+                    if cb is not None:
+                        try:
+                            cb(Msg(subject, reply, data))
+                        except Exception:
+                            log.exception("nats subscription callback failed")
+                elif line.startswith(b"-ERR"):
+                    log.warning("nats error: %s", line.decode(errors="replace"))
+                # +OK / PONG / INFO updates: ignore
+        except (ConnectionError, OSError):
+            if not self._closed:
+                log.warning("nats reader disconnected")
+
+    # ------------------------------------------------------------- surface --
+    def publish(self, subject: str, data: bytes,
+                reply: Optional[str] = None) -> None:
+        head = f"PUB {subject} {reply + ' ' if reply else ''}{len(data)}\r\n"
+        self._send(head.encode() + data + b"\r\n")
+
+    def subscribe(self, subject: str, cb: Callable[[Msg], None],
+                  queue_group: Optional[str] = None) -> int:
+        with self._wlock:  # sid allocation races across handler threads
+            sid = self._next_sid
+            self._next_sid += 1
+        self._subs[sid] = cb
+        q = f" {queue_group}" if queue_group else ""
+        self._send(f"SUB {subject}{q} {sid}\r\n".encode())
+        return sid
+
+    def unsubscribe(self, sid: int) -> None:
+        self._subs.pop(sid, None)
+        try:
+            self._send(f"UNSUB {sid}\r\n".encode())
+        except OSError:
+            pass
+
+    def new_inbox(self) -> str:
+        return f"_INBOX.{uuid.uuid4().hex}"
+
+    def request_stream(self, subject: str, data: bytes,
+                       timeout: float = 600.0,
+                       first_timeout: Optional[float] = None):
+        """Publish with a reply inbox; yield reply Msgs until the responder
+        sends a message whose JSON body has "done": true.
+
+        `first_timeout` bounds the wait for the FIRST reply separately —
+        core NATS silently drops publishes with no subscriber, so a missing
+        responder should fail fast instead of eating the full stream
+        timeout. Raises TimeoutError on either bound."""
+        inbox = self.new_inbox()
+        q: "queue.Queue[Msg]" = queue.Queue()
+        sid = self.subscribe(inbox, q.put)
+        try:
+            self.publish(subject, data, reply=inbox)
+            wait = first_timeout if first_timeout is not None else timeout
+            while True:
+                try:
+                    msg = q.get(timeout=wait)
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"no reply on {subject} within {wait}s"
+                    ) from None
+                wait = timeout
+                yield msg
+                try:
+                    if json.loads(msg.data).get("done"):
+                        return
+                except (json.JSONDecodeError, AttributeError):
+                    pass
+        finally:
+            self.unsubscribe(sid)
+
+    def request(self, subject: str, data: bytes,
+                timeout: float = 30.0) -> bytes:
+        for msg in self.request_stream(subject, data, timeout=timeout):
+            return msg.data
+        raise TimeoutError(f"no responder on {subject}")
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------------ broker --
+
+
+class _BrokerConn:
+    def __init__(self, sock: socket.socket, broker: "MiniNatsBroker"):
+        self.sock = sock
+        self.broker = broker
+        self.reader = _LineReader(sock)
+        self.wlock = threading.Lock()
+        # sid -> (subject_pattern, queue_group)
+        self.subs: Dict[int, Tuple[str, Optional[str]]] = {}
+        self.alive = True
+
+    def send(self, data: bytes) -> None:
+        try:
+            with self.wlock:
+                self.sock.sendall(data)
+        except OSError:
+            self.alive = False
+
+    def serve(self) -> None:
+        self.send(b'INFO {"server_name":"dynamo-tpu-mini-nats","version":"0"}\r\n')
+        try:
+            while True:
+                line = self.reader.read_line()
+                verb = line.split(b" ", 1)[0].upper()
+                if verb == b"CONNECT":
+                    pass
+                elif verb == b"PING":
+                    self.send(b"PONG\r\n")
+                elif verb == b"PONG":
+                    pass
+                elif verb == b"SUB":
+                    parts = line.decode().split(" ")
+                    if len(parts) == 4:
+                        _, subject, group, sid = parts
+                    else:
+                        _, subject, sid = parts
+                        group = None
+                    self.subs[int(sid)] = (subject, group)
+                elif verb == b"UNSUB":
+                    sid = int(line.decode().split(" ")[1])
+                    self.subs.pop(sid, None)
+                elif verb == b"PUB":
+                    parts = line.decode().split(" ")
+                    if len(parts) == 4:
+                        _, subject, reply, nbytes = parts
+                    else:
+                        _, subject, nbytes = parts
+                        reply = None
+                    data = self.reader.read_exact(int(nbytes))
+                    self.reader.read_exact(2)
+                    self.broker.route(subject, reply, data)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.alive = False
+            self.broker.drop(self)
+
+
+class MiniNatsBroker:
+    """In-process NATS-core broker: pub/sub, queue groups, wildcards."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._conns: List[_BrokerConn] = []
+        self._lock = threading.Lock()
+        self._rr = 0  # queue-group round-robin cursor
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="mini-nats-accept"
+        )
+        self._closed = False
+        self._accept_thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"nats://{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            conn = _BrokerConn(sock, self)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(target=conn.serve, daemon=True,
+                             name="mini-nats-conn").start()
+
+    def drop(self, conn: _BrokerConn) -> None:
+        with self._lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    def route(self, subject: str, reply: Optional[str], data: bytes) -> None:
+        """Deliver to every plain match; ONE member per queue group."""
+        plain: List[Tuple[_BrokerConn, int]] = []
+        groups: Dict[str, List[Tuple[_BrokerConn, int]]] = {}
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            for sid, (pattern, group) in list(conn.subs.items()):
+                if not _subject_matches(pattern, subject):
+                    continue
+                if group:
+                    groups.setdefault(group, []).append((conn, sid))
+                else:
+                    plain.append((conn, sid))
+        for group_members in groups.values():
+            self._rr += 1
+            plain.append(group_members[self._rr % len(group_members)])
+        head_reply = f" {reply}" if reply else ""
+        for conn, sid in plain:
+            conn.send(
+                f"MSG {subject} {sid}{head_reply} {len(data)}\r\n".encode()
+                + data + b"\r\n"
+            )
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+
+
+def main() -> None:  # pragma: no cover - dev convenience
+    import time
+
+    logging.basicConfig(level="INFO")
+    broker = MiniNatsBroker(host="0.0.0.0", port=DEFAULT_PORT)
+    log.info("mini NATS broker on %s", broker.url)
+    while True:
+        time.sleep(60)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

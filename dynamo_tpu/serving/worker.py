@@ -79,22 +79,61 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
     p.add_argument("--prefill-url", default=os.environ.get("PREFILL_URL"),
                    help="comma-separated prefill worker URLs (decode role)")
     p.add_argument("--heartbeat-interval", type=float, default=3.0)
+    p.add_argument("--nats-url", default=os.environ.get("NATS_URL"),
+                   help="NATS server URL: serve requests over the NATS "
+                        "request plane in addition to HTTP")
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator host:port (multi-host "
+                        "gang; the Grove-multinode analogue)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     args = p.parse_args(argv)
 
     cfg = EngineConfig.from_cli_args(args)
+    from dynamo_tpu.parallel import distributed as dist
+
+    dist_cfg = dist.resolve(args.coordinator, args.num_processes,
+                            args.process_id)
+    dist.initialize(dist_cfg)  # must precede the first backend touch
     from dynamo_tpu.utils.platform import init_backend_with_fallback
 
     backend = init_backend_with_fallback()
-    log.info("starting %s worker: model=%s mode=%s tp=%d backend=%s",
+    log.info("starting %s worker: model=%s mode=%s tp=%d backend=%s "
+             "process=%d/%d",
              backend_name, cfg.model, cfg.disaggregation_mode,
-             cfg.tensor_parallel, backend)
+             cfg.tensor_parallel, backend, dist_cfg.process_id,
+             dist_cfg.num_processes)
     engine = Engine(cfg)
+    if cfg.warmup:
+        # compile-complete before the socket opens: /ready can never observe
+        # a worker that would stall first traffic on a multi-second XLA
+        # compile (the reference's TRT engine-build happens pre-serve too)
+        log.info("warming up: precompiling prefill buckets + decode windows")
+        engine.warmup()
+    if dist_cfg.enabled:
+        plane = dist.ReplicationPlane(dist_cfg)
+        if not dist_cfg.is_leader:
+            # followers replay the leader's op stream; no HTTP surface
+            dist.follower_loop(engine, plane)
+            return
+        engine = dist.ReplicatedEngine(engine, plane)
     ctx = ServingContext(
         engine, cfg.served_name,
         prefill_urls=(args.prefill_url.split(",") if args.prefill_url else None),
         frontend_url=args.frontend_url,
     )
     srv = make_server(ctx, args.host, args.port)
+
+    if cfg.disaggregation_mode == "prefill":
+        # colocated decode engines resolve this engine for the on-device
+        # ici KV handoff (transfer.ici_registry); harmless cross-process
+        from dynamo_tpu.transfer import ici_registry
+
+        raw_engine = getattr(engine, "engine", engine)
+        ici_registry.register(_self_url(args.host, srv.server_address[1]),
+                              raw_engine)
+        ici_registry.register(f"http://127.0.0.1:{srv.server_address[1]}",
+                              raw_engine)
 
     # hardware series (tpu_tensorcore_utilization etc.) ride the same
     # /metrics endpoint — the in-process DCGM-analogue. In-process is the
@@ -106,6 +145,20 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
     attach_to_registry(ctx.metrics.registry).set_sampler(
         engine_busy_sampler(engine)
     )
+
+    nats_plane = None
+    if args.nats_url:
+        from dynamo_tpu.serving.nats_plane import WorkerNatsPlane
+
+        try:
+            nats_plane = WorkerNatsPlane(
+                args.nats_url,
+                f"http://127.0.0.1:{srv.server_address[1]}",
+                cfg.served_name,
+                advertised_url=_self_url(args.host, srv.server_address[1]),
+            )
+        except OSError as e:
+            log.warning("NATS plane unavailable (%s); HTTP only", e)
 
     stop = threading.Event()
     if args.frontend_url:
@@ -127,7 +180,12 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
     try:
         srv.serve_forever()
     finally:
-        ctx.close()
+        if nats_plane is not None:
+            nats_plane.close()
+        ctx.close()  # stops the scheduler thread (and its idle_tick
+        # broadcasts) BEFORE the shutdown broadcast below
+        if dist_cfg.enabled and dist_cfg.is_leader:
+            engine.shutdown()  # release followers from their collective
 
 
 if __name__ == "__main__":

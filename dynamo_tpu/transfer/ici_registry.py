@@ -1,0 +1,41 @@
+"""Process-local engine registry for the ICI disagg data plane.
+
+`--disaggregation-transfer-backend ici` (the reference's nixl slot,
+/root/reference/examples/deploy/sglang/disagg.yaml:47-48) means the KV
+handoff stays on-device: when the prefill engine a decode request was routed
+to lives in THIS process (colocated roles on one slice — one pod hosting
+both engines), the decode client skips the HTTP RPC + TCP byte pump entirely
+and moves pages engine-to-engine as jax.Arrays, which XLA lowers to
+device-to-device copies (ICI for cross-chip shards, no host bounce).
+
+Workers register their engine under every URL they advertise; the decode
+client consults the registry before falling back to the dcn (TCP) plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_engines: Dict[str, object] = {}
+
+
+def register(url: str, engine) -> None:
+    with _lock:
+        _engines[url.rstrip("/")] = engine
+
+
+def unregister(url: str) -> None:
+    with _lock:
+        _engines.pop(url.rstrip("/"), None)
+
+
+def lookup(url: str) -> Optional[object]:
+    with _lock:
+        return _engines.get(url.rstrip("/"))
+
+
+def clear() -> None:
+    with _lock:
+        _engines.clear()

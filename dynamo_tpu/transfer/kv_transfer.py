@@ -139,15 +139,17 @@ def fetch_kv(host: str, port: int, request_id: str
 class ICIHandoff:
     """Colocated prefill/decode engines on one slice: device-to-device copy.
 
-    export_kv/import_kv operate on jax.Arrays; when both engines share devices
-    XLA turns the gather+scatter into on-device copies (ICI for cross-chip
-    shards) with no host bounce."""
+    export_kv_device/import_kv operate on jax.Arrays; when both engines share
+    devices XLA turns the gather+scatter into on-device copies (ICI for
+    cross-chip shards) with no host bounce. The serving path reaches this
+    via transfer.ici_registry when `--disaggregation-transfer-backend ici`
+    finds the routed prefill engine in-process."""
 
     def __init__(self, prefill_engine, decode_engine):
         self.src = prefill_engine
         self.dst = decode_engine
 
     def transfer(self, req, first_token: int) -> None:
-        k, v, _ = self.src.export_kv(req.request_id)
+        k, v, _ = self.src.export_kv_device(req.request_id)
         self.dst.import_kv(req, first_token, k, v)
         self.src.release_parked(req.request_id)

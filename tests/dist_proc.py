@@ -1,0 +1,53 @@
+"""Subprocess body for the 2-process gang test (run, not imported by pytest).
+
+Usage: python tests/dist_proc.py <process_id> <coordinator> <out_json>
+Builds a dp=2 x tp=4 engine over the 2x4-device global CPU mesh; process 0 drives
+requests through ReplicatedEngine, process 1 replays via follower_loop.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pid, coordinator, out_path = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+
+from dynamo_tpu.parallel import distributed as dist
+
+dcfg = dist.DistConfig(coordinator=coordinator, num_processes=2,
+                       process_id=pid)
+dist.initialize(dcfg)
+assert len(jax.devices()) == 8, jax.devices()
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+
+engine = Engine(EngineConfig(
+    model="tiny-debug", page_size=4, num_pages=64, max_num_seqs=2,
+    max_seq_len=64, tensor_parallel=4, data_parallel=2,
+    num_scheduler_steps=4))
+plane = dist.ReplicationPlane(dcfg)
+
+if pid != 0:
+    dist.follower_loop(engine, plane)
+    sys.exit(0)
+
+rep = dist.ReplicatedEngine(engine, plane)
+toks = {}
+for rid, prompt in (("a", [1, 2, 3]), ("b", [4, 5, 6, 7, 8])):
+    rep.add_request(GenRequest(rid, prompt, max_tokens=10, temperature=0.0,
+                               ignore_eos=True))
+out = {"a": [], "b": []}
+while rep.has_work:
+    for ev in rep.step():
+        if ev.token_id >= 0:
+            out[ev.request_id].append(ev.token_id)
+rep.shutdown()
+with open(out_path, "w") as f:
+    json.dump(out, f)

@@ -209,3 +209,67 @@ def test_seeded_sampling_matches_agg_across_disagg(engines):
     ICIHandoff(prefill, decode).transfer(req, first)
     rest = drain(decode, "sd")
     assert [first] + rest == ref
+
+
+def test_ici_backend_serves_without_host_bounce(monkeypatch):
+    """Serving-path test for `--disaggregation-transfer-backend ici` with
+    colocated engines: the decode HTTP request completes with tokens
+    byte-identical to the dcn path, while the TCP pull (fetch_kv) and the
+    host-copy export (export_kv) are both forbidden."""
+    import json
+    import threading
+    import urllib.request
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.serving.api import ServingContext, make_server
+    from dynamo_tpu.transfer import ici_registry
+
+    kw = dict(model="tiny-debug", page_size=4, num_pages=64, max_num_seqs=2,
+              max_seq_len=64, seed=7, disaggregation_bootstrap_port=0)
+
+    def run(backend, forbid_host_paths):
+        ici_registry.clear()
+        pre = Engine(EngineConfig(disaggregation_mode="prefill", **kw))
+        pre_ctx = ServingContext(pre, served_model="tiny-debug")
+        pre_srv = make_server(pre_ctx, host="127.0.0.1", port=0)
+        pre_url = f"http://127.0.0.1:{pre_srv.server_address[1]}"
+        threading.Thread(target=pre_srv.serve_forever, daemon=True).start()
+        ici_registry.register(pre_url, pre)
+
+        dec = Engine(EngineConfig(
+            disaggregation_mode="decode",
+            disaggregation_transfer_backend=backend, **kw))
+        from dynamo_tpu.serving.api import ServingContext as SC
+
+        dec_ctx = SC(dec, served_model="tiny-debug",
+                     prefill_urls=[pre_url])
+        dec_srv = make_server(dec_ctx, host="127.0.0.1", port=0)
+        threading.Thread(target=dec_srv.serve_forever, daemon=True).start()
+
+        if forbid_host_paths:
+            def boom(*a, **k):
+                raise AssertionError("host-bounce path used under ici")
+            monkeypatch.setattr(
+                "dynamo_tpu.serving.disagg.fetch_kv", boom)
+            monkeypatch.setattr(pre, "export_kv", boom)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dec_srv.server_address[1]}"
+                "/v1/chat/completions",
+                data=json.dumps({
+                    "model": "tiny-debug",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 6, "temperature": 0, "seed": 11,
+                }).encode(),
+                headers={"Content-Type": "application/json"})
+            out = json.load(urllib.request.urlopen(req, timeout=120))
+            return out["choices"][0]["message"]["content"]
+        finally:
+            dec_srv.shutdown(); dec_ctx.close()
+            pre_srv.shutdown(); pre_ctx.close()
+            ici_registry.clear()
+
+    text_dcn = run("dcn", forbid_host_paths=False)
+    text_ici = run("ici", forbid_host_paths=True)
+    assert text_ici == text_dcn

@@ -1,0 +1,79 @@
+"""Multi-host runtime: config resolution + 2-process CPU gang lockstep."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.parallel import distributed as dist
+
+
+def test_resolve_precedence(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    cfg = dist.resolve()
+    assert cfg.coordinator == f"h0:{dist.COORDINATOR_PORT}"
+    assert cfg.num_processes == 4 and cfg.process_id == 2
+    assert cfg.enabled and not cfg.is_leader
+    # explicit args beat the gang env
+    cfg = dist.resolve("c:1", 2, 0)
+    assert cfg.coordinator == "c:1" and cfg.is_leader
+
+
+def test_resolve_single_process_default(monkeypatch):
+    for k in ("DYNAMO_TPU_COORDINATOR", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(k, raising=False)
+    cfg = dist.resolve()
+    assert not cfg.enabled and cfg.is_leader
+
+
+@pytest.mark.slow
+def test_two_process_gang_matches_single_process():
+    """Leader + follower over a 2x4-device global mesh produce the same
+    greedy tokens as a single-process dp=2xtp=4 run (VERDICT round-2 task #3)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    here = os.path.dirname(__file__)
+    script = os.path.join(here, "dist_proc.py")
+    out_path = os.path.join(here, "..", ".pytest_dist_out.json")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen([sys.executable, script, str(i), coord, out_path],
+                         env=env, cwd=os.path.join(here, ".."))
+        for i in (0, 1)
+    ]
+    try:
+        for p in procs:
+            assert p.wait(timeout=600) == 0
+        with open(out_path) as f:
+            gang = json.load(f)
+    finally:
+        for p in procs:
+            p.kill()
+        if os.path.exists(out_path):
+            os.unlink(out_path)
+
+    # single-process dp=2 x tp=4 reference over the test session's 8 virtual devices
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    eng = Engine(EngineConfig(
+        model="tiny-debug", page_size=4, num_pages=64, max_num_seqs=2,
+        max_seq_len=64, tensor_parallel=4, data_parallel=2,
+        num_scheduler_steps=4))
+    ref = {"a": [], "b": []}
+    for rid, prompt in (("a", [1, 2, 3]), ("b", [4, 5, 6, 7, 8])):
+        eng.add_request(GenRequest(rid, prompt, max_tokens=10,
+                                   temperature=0.0, ignore_eos=True))
+    while eng.has_work:
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                ref[ev.request_id].append(ev.token_id)
+    assert gang == ref

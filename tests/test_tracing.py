@@ -1,0 +1,65 @@
+"""In-engine observability: /debug/trace capture + per-phase histograms."""
+
+import io
+import json
+import threading
+import urllib.request
+import zipfile
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine, PhaseTimer
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.serving.api import ServingContext, make_server
+
+
+def test_phase_timer_quantiles():
+    t = PhaseTimer()
+    for ms in (1, 1, 2, 4, 100):
+        t.observe(ms / 1e3)
+    snap = t.snapshot()
+    assert snap["count"] == 5
+    assert snap["p50_ms"] <= 4
+    assert snap["max_ms"] == pytest.approx(100, rel=0.01)
+    assert snap["p95_ms"] >= 50
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = EngineConfig(model="tiny-debug", page_size=4, num_pages=64,
+                       max_num_seqs=2, max_seq_len=64)
+    ctx = ServingContext(Engine(cfg), served_model="tiny-debug")
+    srv = make_server(ctx, host="127.0.0.1", port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield ctx, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    ctx.close()
+
+
+def test_debug_trace_returns_nonempty_zip(server):
+    ctx, base = server
+    # generate under the trace so device work lands in the capture window
+    def work():
+        ctx.engine.generate(GenRequest("tr", [1, 2, 3], max_tokens=6,
+                                       temperature=0.0, ignore_eos=True))
+    w = threading.Thread(target=work)
+    w.start()
+    data = urllib.request.urlopen(f"{base}/debug/trace?duration_s=0.5",
+                                  timeout=120).read()
+    w.join()
+    z = zipfile.ZipFile(io.BytesIO(data))
+    assert z.namelist(), "trace zip is empty"
+
+
+def test_worker_stats_include_phase_histograms(server):
+    ctx, base = server
+    ctx.engine.generate(GenRequest("ph", [1, 2, 3], max_tokens=4,
+                                   temperature=0.0, ignore_eos=True))
+    stats = json.load(urllib.request.urlopen(f"{base}/worker/stats",
+                                             timeout=30))
+    phases = stats["metrics"]["phases"]
+    assert phases["prefill"]["count"] >= 1
+    assert phases["decode_window"]["count"] >= 1
+    assert phases["decode_step"]["p50_ms"] > 0

@@ -67,6 +67,19 @@ class EngineConfig:
     # of token-burst granularity in streams. 1 = classic per-token stepping.
     num_scheduler_steps: int = 1
 
+    # automatic prefix caching: full prompt pages are shared (ref-counted)
+    # across requests keyed by a block-hash chain; repeated prefixes skip
+    # straight to suffix prefill. Needs prefill_chunk_tokens > 0 (the suffix
+    # runs through the chunked-prefill path).
+    enable_prefix_caching: bool = True
+
+    # async scheduling: dispatch decode window k+1 BEFORE reading window k's
+    # tokens back, overlapping the host sync with device compute (vLLM's
+    # async scheduler analogue). Stop detection lags one window; membership
+    # changes (admission/abort/finish) flush the pipeline first, so outputs
+    # are identical to synchronous stepping.
+    async_scheduling: bool = True
+
     # runtime
     # AOT warmup: precompile every prefill bucket + decode window before the
     # worker flips /ready — the XLA analogue of the reference's TRT engine
@@ -101,6 +114,10 @@ class EngineConfig:
         p.add_argument("--ep", type=int, default=1)
         p.add_argument("--moe-capacity-factor", type=float, default=0.0)
         p.add_argument("--num-scheduler-steps", type=int, default=1)
+        p.add_argument("--async-scheduling",
+                       action=argparse.BooleanOptionalAction, default=True)
+        p.add_argument("--enable-prefix-caching",
+                       action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("--prefill-chunk-tokens", type=int, default=256)
         p.add_argument("--disaggregation-mode", default="agg",
                        choices=["agg", "prefill", "decode"])
@@ -146,6 +163,9 @@ class EngineConfig:
             expert_parallel=args.ep,
             moe_capacity_factor=args.moe_capacity_factor,
             num_scheduler_steps=args.num_scheduler_steps,
+            async_scheduling=getattr(args, "async_scheduling", True),
+            enable_prefix_caching=getattr(args, "enable_prefix_caching",
+                                          True),
             prefill_chunk_tokens=getattr(args, "prefill_chunk_tokens", 256),
             disaggregation_mode=mode,
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,

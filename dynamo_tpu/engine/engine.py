@@ -36,6 +36,7 @@ from dynamo_tpu.engine.kv_cache import (
     KVCacheSpec,
     OutOfPages,
     PageAllocator,
+    PrefixCache,
     SeqState,
     alloc_kv_pages,
 )
@@ -208,6 +209,9 @@ class Engine:
             self.kv_spec, shd.kv_sharding(self.mesh)
         )
         self.allocator = PageAllocator(cfg.num_pages)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if cfg.enable_prefix_caching and cfg.prefill_chunk_tokens > 0:
+            self.prefix_cache = PrefixCache(self.allocator, cfg.page_size)
 
         # --- batch slots (host-side mirrors of device batch state) ---
         b, pmax = cfg.max_num_seqs, cfg.max_pages_per_seq
@@ -253,6 +257,9 @@ class Engine:
         self._dev_state = None  # (cur_tokens, positions, context_lens, active)
         self._dev_tables = None
         self._dev_sampling = None  # (temp, top_p, top_k, pres, freq, keys)
+        # async scheduling: the decode window whose tokens have been
+        # dispatched but not read back yet — (window, ys, want_lp, t0)
+        self._pending_win = None
         # output-token counts for presence/frequency penalties: [B, V] int32,
         # PERSISTENTLY device-resident (never re-uploaded on membership
         # changes — rows are zeroed in-place by the tiny _reset_count jit)
@@ -485,8 +492,25 @@ class Engine:
         buckets.add(cap)
         for bucket in sorted(buckets):
             p = min(bucket, cfg.max_seq_len - 1)
-            reqs.append(GenRequest(f"__warm_b{bucket}", [1] * p, max_tokens=1,
+            # distinct tokens per bucket: identical prompts would hit the
+            # prefix cache and skip the full-prefill compilation this
+            # request exists to trigger
+            toks = [(bucket * 7 + j) % 97 + 1 for j in range(p)]
+            reqs.append(GenRequest(f"__warm_b{bucket}", toks, max_tokens=1,
                                    temperature=0.0, ignore_eos=True))
+        if (self.prefix_cache is not None
+                and cfg.disaggregation_mode != "prefill"):
+            # second pass: now-cached prefixes route through the
+            # chunked-suffix path, compiling its per-bucket page-table
+            # widths too (the prefill role serves via prefill_only, which
+            # never consults the cache — a second pass there would just
+            # re-run every bucket and delay /ready)
+            for bucket in sorted(buckets):
+                p = min(bucket, cfg.max_seq_len - 1)
+                toks = [(bucket * 7 + j) % 97 + 1 for j in range(p)]
+                reqs.append(GenRequest(f"__warm_c{bucket}", toks,
+                                       max_tokens=1, temperature=0.0,
+                                       ignore_eos=True))
         # decode windows: max_tokens = 2k+2 runs two consecutive fused-k
         # windows (first with rebuilt state, second with carried state — the
         # two distinct steady-state signatures) and then a single-step
@@ -563,6 +587,12 @@ class Engine:
             ids = [r.request_id for r in self.pending]
             self.pending.clear()
             self._aborted.clear()
+        self._pending_win = None  # unread tokens die with their sequences
+        inf, self._inflight = self._inflight, None
+        if inf is not None:
+            ids.append(inf.req.request_id)
+            self.allocator.free(inf.pages)
+            self._free_slots.append(inf.slot)
         for slot, seq in list(self.seqs.items()):
             ids.append(seq.request_id)
             self._finish_slot(slot, "abort")
@@ -594,15 +624,24 @@ class Engine:
             else:
                 events.extend(self._admit())
             if self.seqs:
-                events.extend(self._decode_once())
+                if self.cfg.async_scheduling:
+                    events.extend(self._decode_async())
+                else:
+                    events.extend(self._decode_once())
             return events
 
     def _apply_aborts(self) -> List[TokenEvent]:
         with self._lock:
             aborted, self._aborted = self._aborted, set()
-            if not aborted:
-                return []
-            events = []
+        if not aborted:
+            return []
+        # finishing slots frees pages an in-flight async window still
+        # touches and invalidates device state the rebuild needs current
+        # mirrors for — drain the pipeline before any teardown. (Checked
+        # AFTER the snapshot: an abort landing after it is simply next
+        # step's work, where the drain re-runs.)
+        events = self._materialize_pending()
+        with self._lock:
             kept = collections.deque()
             for r in self.pending:
                 if r.request_id in aborted:
@@ -633,17 +672,33 @@ class Engine:
                 if not self.pending:
                     break
                 req = self.pending[0]
-                n_pages = max(
-                    1, -(-len(req.prompt_token_ids) // self.cfg.page_size)
+            # prefix lookup BEFORE the page gate: only the suffix needs
+            # fresh pages, and gating on the full prompt would let the
+            # eviction pressure valve evict this very request's cached
+            # prefix to satisfy an allocation it never makes
+            cached_pages, n_cached = [], 0
+            if self.prefix_cache is not None:
+                cached_pages, n_cached = self.prefix_cache.lookup(
+                    req.prompt_token_ids
                 )
-                if not self.allocator.can_alloc(n_pages):
-                    break  # wait for running sequences to release pages
+            n_pages = max(
+                1, -(-len(req.prompt_token_ids) // self.cfg.page_size)
+            )
+            if not self._ensure_pages(n_pages - len(cached_pages)):
+                if cached_pages:
+                    self.allocator.free(cached_pages)  # drop our refs
+                break  # wait for running sequences to release pages
+            with self._lock:
                 self.pending.popleft()
-            if chunk > 0 and len(req.prompt_token_ids) > chunk:
-                # long prompt: prefill in chunks across subsequent step()s
-                # instead of stalling every active stream for the whole
-                # prompt (FIFO holds: later admissions wait behind it)
-                self._start_inflight(req)
+            # installing a slot invalidates the device carry: drain the
+            # in-flight async window before membership changes
+            events.extend(self._materialize_pending())
+            if chunk > 0 and (n_cached > 0
+                              or len(req.prompt_token_ids) > chunk):
+                # long (or partially cached) prompt: prefill the remainder
+                # in chunks across subsequent step()s instead of stalling
+                # every active stream (FIFO holds: later admissions wait)
+                self._start_inflight(req, cached_pages, n_cached)
                 break
             try:
                 ev = self._prefill_request(req)
@@ -763,6 +818,8 @@ class Engine:
 
     def _prefill_request(self, req: GenRequest) -> TokenEvent:
         first, pages, prompt_len, req_key, lp = self._run_prefill(req)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt_token_ids, pages)
         slot = self._free_slots.pop()
         seq = self._install_slot(req, slot, pages, prompt_len, first, req_key)
 
@@ -774,22 +831,36 @@ class Engine:
             self._finish_slot(slot, reason)
         return ev
 
-    def _start_inflight(self, req: GenRequest) -> None:
+    def _ensure_pages(self, n: int) -> bool:
+        """can_alloc with prefix-cache eviction as the pressure valve."""
+        if self.allocator.can_alloc(n):
+            return True
+        if self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.allocator.free_pages)
+            return self.allocator.can_alloc(n)
+        return False
+
+    def _start_inflight(self, req: GenRequest, cached_pages=None,
+                        n_cached: int = 0) -> None:
         cfg = self.cfg
         chunk = cfg.prefill_chunk_tokens
         prompt_len = len(req.prompt_token_ids)
         bucket = _next_bucket(prompt_len, cfg.page_size, cfg.max_seq_len)
-        # the padded FINAL chunk must fit the page table: round the bucket
-        # up to a chunk multiple (dynamic_slice would silently clamp an
-        # overrunning slice and scatter the tail chunk's KV into the wrong
-        # pages)
-        bucket = -(-bucket // chunk) * chunk
-        pages = self.allocator.alloc(max(1, -(-prompt_len // cfg.page_size)))
-        pages_arr = np.zeros((bucket // cfg.page_size,), dtype=np.int32)
+        total = max(1, -(-prompt_len // cfg.page_size))
+        pages = list(cached_pages or [])
+        pages += self.allocator.alloc(total - len(pages))
+        # The page table carries (chunk_pages - 1) trailing TRASH slots: a
+        # chunk may start at any page boundary (cached prefixes are page-,
+        # not chunk-, aligned), so the final padded chunk window can extend
+        # past the bucket — its page slice must land on trash page 0, never
+        # clamp back onto real (possibly SHARED) pages.
+        width = bucket // cfg.page_size + (chunk // cfg.page_size - 1)
+        pages_arr = np.zeros((width,), dtype=np.int32)
         pages_arr[: len(pages)] = pages
         slot = self._free_slots.pop()
-        self._inflight = InflightPrefill(req, pages, pages_arr, prompt_len,
-                                         slot)
+        inf = InflightPrefill(req, pages, pages_arr, prompt_len, slot)
+        inf.done = n_cached  # cached prefix blocks skip straight to suffix
+        self._inflight = inf
 
     def _advance_chunk(self) -> List[TokenEvent]:
         """Run ONE chunk of the inflight prefill; on the last chunk, sample
@@ -821,10 +892,13 @@ class Engine:
             return []
 
         # final chunk: first token + slot installation (same tail as the
-        # full-prefill path)
+        # full-prefill path); drain any in-flight async window first
+        events = self._materialize_pending()
         self._inflight = None
         self.metrics.prompt_tokens += inf.prompt_len
         req = inf.req
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt_token_ids, inf.pages)
         first, req_key, lp = self._first_token(req, last_logits,
                                                inf.prompt_len)
         slot = inf.slot  # reserved at _start_inflight
@@ -836,51 +910,66 @@ class Engine:
             self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
         if finished:
             self._finish_slot(slot, reason)
-        return [ev]
+        events.append(ev)
+        return events
 
-    def _window_steps(self) -> int:
+    def _window_steps(self, extra: int = 0) -> int:
         """How many decode steps the next dispatch may fuse (1 = classic).
 
         The multi-step window requires every active sequence to have at least
         K tokens of headroom (max_tokens, max_seq_len, block-table columns) so
         no stop condition or table overflow can occur mid-window, and no
         pending prefills waiting for a slot (admission latency beats batching
-        round-trips)."""
+        round-trips).
+
+        `extra` = tokens already committed to an in-flight (unread) window
+        under async scheduling: headroom must cover BOTH windows. Returns 0
+        when not even a 1-step window fits on top of the in-flight one (the
+        caller drains the pipeline and retries synchronously)."""
         k = self.cfg.num_scheduler_steps
-        if k <= 1 or self.pending or not self.seqs:
-            return 1
+        small = k <= 1 or self.pending or not self.seqs
         pmax_tokens = self.cfg.max_pages_per_seq * self.cfg.page_size
+        want = 1 if small else k
         for seq in self.seqs.values():
             n_out = len(seq.output_tokens)
             headroom = min(
                 seq.max_tokens - n_out,
                 self.cfg.max_seq_len - (seq.prompt_len + n_out),
                 pmax_tokens - seq.num_tokens,
-            )
-            if headroom < k:
-                return 1
-        return k
+            ) - extra
+            if headroom < want:
+                want = 1 if headroom >= 1 else 0
+                if want == 0:
+                    return 0
+        return want
 
-    def _grow_pages(self, window: int, events: List[TokenEvent]) -> int:
+    def _grow_pages(self, window: int, events: List[TokenEvent],
+                    offset: int = 0, allow_kill: bool = True) -> int:
         """Ensure every active sequence has KV pages for the next `window`
-        tokens (positions num_tokens .. num_tokens+window-1). Falls back to a
-        1-token window if the pool can't cover the full window; sequences that
-        can't even get one page finish with kv_oom."""
+        tokens (positions num_tokens+offset .. +offset+window-1; `offset` =
+        tokens of an in-flight async window). Falls back to a 1-token window
+        if the pool can't cover the full window; sequences that can't even
+        get one page finish with kv_oom — unless allow_kill is False (an
+        async window is in flight over those pages), where 0 is returned so
+        the caller drains the pipeline first."""
         cfg = self.cfg
         if window > 1:
             need_total = 0
             for seq in self.seqs.values():
-                last_page = (seq.num_tokens + window - 1) // cfg.page_size
+                last_page = (seq.num_tokens + offset + window - 1) \
+                    // cfg.page_size
                 need_total += max(0, last_page + 1 - len(seq.pages))
-            if not self.allocator.can_alloc(need_total):
+            if not self._ensure_pages(need_total):
                 window = 1
 
         for slot, seq in list(self.seqs.items()):
-            last_page = (seq.num_tokens + window - 1) // cfg.page_size
+            last_page = (seq.num_tokens + offset + window - 1) // cfg.page_size
             need = max(0, last_page + 1 - len(seq.pages))
             if need == 0:
                 continue
-            if not self.allocator.can_alloc(need):
+            if not self._ensure_pages(need):
+                if not allow_kill:
+                    return 0
                 self.metrics.kv_oom += 1
                 events.append(
                     TokenEvent(
@@ -896,14 +985,56 @@ class Engine:
         return window
 
     def _decode_once(self) -> List[TokenEvent]:
-        t0 = time.monotonic()
-        cfg = self.cfg
+        """Synchronous decode: dispatch one window and read it back."""
         events: List[TokenEvent] = []
-
         window = self._grow_pages(self._window_steps(), events)
-
         if not self.seqs:
             return events
+        self._dispatch_window(window)
+        events.extend(self._materialize_pending())
+        return events
+
+    def _decode_async(self) -> List[TokenEvent]:
+        """Pipelined decode: dispatch window k+1, THEN read window k back —
+        the host sync overlaps the new window's device compute. Any finish
+        discovered in window k drains the pipeline (window k+1's tokens for
+        surviving sequences are processed in the same step; the finished
+        slot's are discarded by the normal membership iteration)."""
+        events: List[TokenEvent] = []
+        if self._pending_win is not None and self._dev_state is None:
+            # a side-door membership change (disagg import_kv) invalidated
+            # the device carry since dispatch: materialize before rebuilding
+            events.extend(self._materialize_pending())
+        prev = self._pending_win
+        lag = prev[0] if prev is not None else 0
+        window = self._window_steps(extra=lag)
+        if window > 0:
+            window = self._grow_pages(window, events, offset=lag,
+                                      allow_kill=prev is None)
+        if not self.seqs:
+            if self._pending_win is not None:
+                events.extend(self._materialize_pending())
+            return events
+        if window <= 0:
+            # not enough headroom/pages to run ahead of the in-flight
+            # window: drain it and fall back to a synchronous step
+            events.extend(self._materialize_pending())
+            if self.seqs:
+                events.extend(self._decode_once())
+            return events
+        self._dispatch_window(window)
+        if prev is not None:
+            events.extend(self._materialize_window(prev))
+            if any(ev.finished for ev in events):
+                # a finish frees pages the NEW in-flight window still
+                # touches; drain it now so next step's admissions can't
+                # reuse them mid-flight
+                events.extend(self._materialize_pending())
+        return events
+
+    def _dispatch_window(self, window: int) -> None:
+        t0 = time.monotonic()
+        cfg = self.cfg
 
         # rebuild invalidated device state from the host mirrors. Uploads go
         # through the jitted identity `_upload` so the arrays carry the SAME
@@ -949,18 +1080,40 @@ class Engine:
             self.k_pages, self.v_pages,
         )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
+        # capture membership AT DISPATCH: a slot installed later (disagg
+        # import) must not consume this window's rows. The stored duration
+        # is the HOST dispatch cost; the materialize side adds its own wait
+        # so interleaved work (chunk prefills, scheduling) between dispatch
+        # and readback isn't double-counted into decode_window.
+        self._pending_win = (window, ys, want_lp,
+                             time.monotonic() - t0, list(self.seqs))
+
+    def _materialize_pending(self) -> List[TokenEvent]:
+        if self._pending_win is None:
+            return []
+        return self._materialize_window(self._pending_win)
+
+    def _materialize_window(self, pw) -> List[TokenEvent]:
+        if self._pending_win is pw:
+            self._pending_win = None
+        window, ys, want_lp, dispatch_s, slots = pw
+        events: List[TokenEvent] = []
+        t_wait = time.monotonic()
         next_np = np.asarray(ys[0])  # [window, B]
         if want_lp:
             chosen_np = np.asarray(ys[1])  # [window, B]
             tids_np = np.asarray(ys[2])  # [window, B, K]
             tvals_np = np.asarray(ys[3])
-        dt = time.monotonic() - t0
+        dt = dispatch_s + (time.monotonic() - t_wait)
         self.metrics.decode_steps += window
         self.metrics.decode_time_s += dt
         self.metrics.observe_phase("decode_window", dt)
         self.metrics.observe_phase("decode_step", dt / window)
 
-        for slot, seq in list(self.seqs.items()):
+        for slot in slots:
+            seq = self.seqs.get(slot)
+            if seq is None:  # finished/aborted since dispatch
+                continue
             for k in range(window):
                 tok = int(next_np[k, slot])
                 seq.num_tokens += 1  # the attended token is now cached
@@ -1118,6 +1271,7 @@ class Engine:
     def _import_kv_locked(self, req, first_token, k, v, n_prompt, n_pages):
         if not self._free_slots:
             raise OutOfPages("no free decode slot for imported sequence")
+        self._ensure_pages(n_pages)  # evict cached pages under pressure
         pages = self.allocator.alloc(n_pages)
         idx = jnp.asarray(pages, jnp.int32)
         self.k_pages, self.v_pages = self._import(

@@ -121,6 +121,109 @@ class PageAllocator:
         return n <= len(self._free)
 
 
+class PrefixCache:
+    """Automatic prefix caching over the paged KV pool (vLLM-style).
+
+    Full prompt pages are published under a rolling block-hash chain; a new
+    request reuses the longest cached prefix (ref-counted pages shared
+    across sequences — cached pages are immutable: only FULL pages are
+    inserted, and decode/suffix writes always target later pages) and
+    prefills only the suffix via the chunked-prefill path.
+
+    The cache holds one reference per published page; eviction (LRU) only
+    touches pages nothing else references, so live sequences are never
+    disturbed. The reference stack gets this from its consumed engines
+    (vLLM automatic prefix caching / SGLang radix cache); here it is a
+    first-class allocator feature.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        # block-hash -> page id, in LRU order (oldest first)
+        self._map: "dict[bytes, int]" = {}
+        self.hits = 0
+        self.misses = 0
+        self.cached_tokens_served = 0
+
+    @staticmethod
+    def _chain(prev: bytes, block) -> bytes:
+        import hashlib
+
+        h = hashlib.sha256(prev)
+        h.update(np.asarray(block, dtype=np.int64).tobytes())
+        return h.digest()
+
+    def _hashes(self, tokens, n_blocks: int):
+        out, h = [], b"root"
+        for i in range(n_blocks):
+            h = self._chain(h, tokens[i * self.page_size:
+                                       (i + 1) * self.page_size])
+            out.append(h)
+        return out
+
+    def lookup(self, prompt_tokens) -> "tuple[list[int], int]":
+        """Longest cached prefix: returns (page_ids, n_tokens). The pages
+        come back ref'd for the caller (the sequence now co-owns them).
+        Always leaves >= 1 token uncached so the final-token logits are
+        recomputed."""
+        limit = (len(prompt_tokens) - 1) // self.page_size
+        pages: "list[int]" = []
+        for h in self._hashes(prompt_tokens, limit):
+            page = self._map.get(h)
+            if page is None:
+                break
+            # LRU bump
+            self._map[h] = self._map.pop(h)
+            pages.append(page)
+        if pages:
+            self.allocator.ref(pages)
+            self.hits += 1
+            self.cached_tokens_served += len(pages) * self.page_size
+        else:
+            self.misses += 1
+        return pages, len(pages) * self.page_size
+
+    def insert(self, prompt_tokens, pages) -> None:
+        """Publish a fully-prefilled prompt's FULL pages. Each newly
+        published page gains a cache-owned reference."""
+        n_full = len(prompt_tokens) // self.page_size
+        for h, page in zip(self._hashes(prompt_tokens, n_full),
+                           pages[:n_full]):
+            if h in self._map:
+                continue
+            self.allocator.ref([page])
+            self._map[h] = page
+
+    def evictable(self) -> int:
+        """Pages reclaimable right now (cache is the sole owner)."""
+        return sum(1 for p in self._map.values()
+                   if self.allocator._refs[p] == 1)
+
+    def evict(self, n: int) -> int:
+        """Free up to n sole-owned pages, oldest first. Returns # evicted."""
+        if n <= 0:
+            return 0
+        victims = []
+        for h, page in self._map.items():  # insertion order == LRU
+            if self.allocator._refs[page] == 1:
+                victims.append((h, page))
+                if len(victims) >= n:
+                    break
+        for h, page in victims:
+            del self._map[h]
+            self.allocator.free([page])
+        return len(victims)
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._map),
+            "hits": self.hits,
+            "misses": self.misses,
+            "cached_tokens_served": self.cached_tokens_served,
+        }
+
+
 class SeqState:
     """Host-side state for one in-flight sequence (one decode slot)."""
 

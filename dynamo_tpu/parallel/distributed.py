@@ -38,6 +38,7 @@ COORDINATOR_PORT = 8476  # jax.distributed coordinator (leader pod)
 OP_ADD = "add"
 OP_ABORT = "abort"
 OP_STEP = "step"  # marker: run one engine.step() after applying ops
+OP_ABORT_ALL = "abort_all"  # fatal-step recovery: tear down the whole batch
 OP_IDLE = "idle"  # heartbeat: keep followers' collective from timing out
 OP_SHUTDOWN = "shutdown"
 
@@ -205,6 +206,15 @@ class ReplicatedEngine:
         self.plane.publish(ops + [(OP_STEP, None)])
         return self.engine.step()
 
+    def abort_all(self):
+        """Fatal-step recovery (EngineService): tear the batch down on the
+        WHOLE gang — an unreplicated teardown would desync the followers'
+        next collective."""
+        with self._ops_lock:
+            self._pending_ops.clear()
+        self.plane.publish([(OP_ABORT_ALL, None)])
+        return self.engine.abort_all()
+
     def idle_tick(self) -> None:
         """Keep followers' pending collective fed while the leader idles
         (a starved broadcast would hit the distributed-runtime timeout)."""
@@ -231,14 +241,25 @@ def follower_loop(engine, plane: ReplicationPlane) -> None:
              plane.cfg.process_id, plane.cfg.num_processes)
     while True:
         for op, arg in plane.receive():
-            if op == OP_ADD:
-                engine.add_request(arg)
-            elif op == OP_ABORT:
-                engine.abort_request(arg)
-            elif op == OP_STEP:
-                engine.step()
-            elif op == OP_IDLE:
-                pass
-            elif op == OP_SHUTDOWN:
-                log.info("follower shutting down")
-                return
+            try:
+                if op == OP_ADD:
+                    engine.add_request(arg)
+                elif op == OP_ABORT:
+                    engine.abort_request(arg)
+                elif op == OP_STEP:
+                    engine.step()
+                elif op == OP_ABORT_ALL:
+                    engine.abort_all()
+                elif op == OP_IDLE:
+                    pass
+                elif op == OP_SHUTDOWN:
+                    log.info("follower shutting down")
+                    return
+            except Exception:
+                # mirror the leader's fatal-step recovery
+                # (EngineService._run): tear down local state and keep
+                # replaying — the leader broadcasts OP_ABORT_ALL for its
+                # own failure, keeping both sides' batches empty/aligned
+                log.exception("follower op %s failed; aborting local batch",
+                              op)
+                engine.abort_all()

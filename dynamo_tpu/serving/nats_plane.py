@@ -17,9 +17,14 @@ Wire format: the request payload is the raw OpenAI-API JSON body plus
 message into its local HTTP handler (one loopback hop keeps a single code
 path for parsing/streaming/metrics) and streams the response back on the
 reply inbox as JSON frames:
-    {"head": true, "status": N, "ctype": ...}   (exactly once, first)
+    {"ack": true}                               (immediately on receipt)
+    {"head": true, "status": N, "ctype": ...}   (once, before any body)
     {"c": <b64 chunk>}                          (0..n body chunks)
     {"done": true}                              (exactly once, last)
+The ack decouples responder detection (fast, head_timeout) from head
+arrival (a slow NON-streaming generation only sends its head once the
+body is complete — that must not trip the no-responder fallback and
+re-run inference over HTTP).
 SSE bodies stream frame-by-frame, so frontend TTFT passthrough works the
 same as the HTTP plane.
 """
@@ -77,6 +82,7 @@ class WorkerNatsPlane:
     def _serve(self, msg: Msg) -> None:
         reply = msg.reply
         try:
+            self.nc.publish(reply, b'{"ack": true}')
             body = json.loads(msg.data)
             path = body.pop("_path", "/v1/chat/completions")
             req = urllib.request.Request(
@@ -136,6 +142,8 @@ def nats_request(
     frames = nc.request_stream(subject, json.dumps(payload).encode(),
                                timeout=timeout, first_timeout=head_timeout)
     head = json.loads(next(frames).data)
+    if head.get("ack"):  # responder exists; the head may take a while
+        head = json.loads(next(frames).data)
     if not head.get("head"):
         raise ConnectionError(f"nats plane protocol error: {head}")
     status = int(head.get("status", 200))

@@ -165,6 +165,9 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
         )
     while eng.has_work:
         eng.step()
+    # drop compile-time outliers from the phase histograms: the timed run's
+    # TTFT/ITL percentiles must reflect steady-state serving only
+    eng.reset_metrics()
 
     for i, p in enumerate(prompts):
         eng.add_request(
@@ -175,6 +178,12 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     while eng.pending:
         eng.step()
     jax.block_until_ready(eng.k_pages)
+    # TTFT (prefill phase) was measured during the drain; re-zero only the
+    # decode phases so ITL percentiles exclude the batch ramp-up steps
+    from dynamo_tpu.engine.engine import PhaseTimer
+
+    for ph in ("decode_window", "decode_step"):
+        eng.metrics.phases[ph] = PhaseTimer()
 
     t0 = time.perf_counter()
     tokens = 0
@@ -187,11 +196,17 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     decode_steps = eng.metrics.decode_steps - steps_before
 
     tok_s = tokens / dt
+    phases = eng.metrics.phases
     out = {
         "model": model,
         "tok_s_per_chip": round(tok_s, 2),  # single-chip engine
         "batch": batch,
         "itl_ms": round(1e3 * dt * batch / max(tokens, 1), 3),
+        # BASELINE.json headline: tok/s/chip + p50 TTFT/ITL. TTFT ~= prefill
+        # latency (admission-to-first-token); ITL from per-step timings.
+        "ttft_p50_ms": phases["prefill"].quantile_ms(0.5),
+        "itl_p50_ms": phases["decode_step"].quantile_ms(0.5),
+        "itl_p95_ms": phases["decode_step"].quantile_ms(0.95),
         "decode_steps_timed": decode_steps,
     }
     if quant != "none":
@@ -237,7 +252,8 @@ def main() -> None:
         "batch": res["batch"],
         "itl_ms": res["itl_ms"],
     }
-    for k in ("mfu", "mbu", "quantization"):
+    for k in ("mfu", "mbu", "quantization", "ttft_p50_ms", "itl_p50_ms",
+              "itl_p95_ms"):
         if k in res:
             line[k] = res[k]
     if sec is not None:

@@ -140,7 +140,8 @@ class EngineMetrics:
 class InflightPrefill:
     """A long prompt being prefilled chunk-by-chunk between decode windows."""
 
-    __slots__ = ("req", "pages", "pages_arr", "prompt_len", "done", "slot")
+    __slots__ = ("req", "pages", "pages_arr", "prompt_len", "done", "slot",
+                 "t_start")
 
     def __init__(self, req: GenRequest, pages, pages_arr, prompt_len: int,
                  slot: int):
@@ -149,6 +150,7 @@ class InflightPrefill:
         self.pages_arr = pages_arr  # bucket-padded np.int32 for the jit
         self.prompt_len = prompt_len
         self.done = 0  # tokens whose KV is cached so far
+        self.t_start = time.monotonic()  # admission time (TTFT accounting)
         self.slot = slot  # decode slot RESERVED at admission (a concurrent
         # import_kv taking the last slot mid-prefill would strand the finish)
 
@@ -456,6 +458,10 @@ class Engine:
                                  **{f"window_{m}_{l}": f
                                     for (m, l), f in jw.items()}}
 
+    def reset_metrics(self) -> None:
+        """Fresh metrics (post-warmup, bench phase boundaries)."""
+        self.metrics = EngineMetrics()
+
     def compiled_program_count(self) -> int:
         """Total executables across the engine's jit caches (warmup check)."""
         return sum(f._cache_size() for f in self._jit_handles.values())
@@ -543,7 +549,7 @@ class Engine:
                 self.k_pages, self.v_pages = self._import(
                     self.k_pages, self.v_pages, idx, one, one
                 )
-        self.metrics = EngineMetrics()  # don't surface warm traffic as load
+        self.reset_metrics()  # don't surface warm traffic as load
         out = {
             "programs": self.compiled_program_count(),
             "seconds": round(time.monotonic() - t0, 2),
@@ -905,6 +911,10 @@ class Engine:
         seq = self._install_slot(req, slot, inf.pages, inf.prompt_len, first,
                                  req_key)
         finished, reason = self._check_stop(seq, first)
+        # "prefill" records admission-to-first-token for BOTH paths (the
+        # TTFT phase); per-chunk timings live in "prefill_chunk"
+        self.metrics.observe_phase("prefill",
+                                   time.monotonic() - inf.t_start)
         ev = TokenEvent(req.request_id, first, 0, finished, reason)
         if req.logprobs is not None:
             self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])

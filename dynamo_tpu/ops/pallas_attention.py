@@ -50,11 +50,30 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
 
-# pages per decode superblock (tokens per block = this * page_size)
-DEFAULT_BLOCK_PAGES = 8
+import os
+
+
+def _env_int(name: str, default: int, lo: int) -> int:
+    """Defensive env knob parse: bad values warn and fall back."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return max(lo, int(raw))
+    except ValueError:
+        import logging
+
+        logging.getLogger("dynamo_tpu.ops").warning(
+            "ignoring %s=%r (not an integer)", name, raw)
+        return default
+
+
+# pages per decode superblock (tokens per block = this * page_size);
+# DYNAMO_TPU_DECODE_BLOCK_PAGES / _NUM_BUFS override for hardware tuning
+DEFAULT_BLOCK_PAGES = _env_int("DYNAMO_TPU_DECODE_BLOCK_PAGES", 8, 1)
 # KV block buffers in the DMA ring: num_bufs - 1 blocks are in flight ahead
 # of the one being consumed (pipeline depth)
-DEFAULT_NUM_BUFS = 4
+DEFAULT_NUM_BUFS = _env_int("DYNAMO_TPU_DECODE_NUM_BUFS", 4, 2)
 
 
 # ------------------------------------------------------ flash accumulation --
@@ -261,7 +280,7 @@ def paged_attention_decode(
     kvd = k_pages.shape[2]
     assert kvd == num_kv_heads * head_dim, (kvd, num_kv_heads, head_dim)
     pmax = block_table.shape[1]
-    block_pages = min(block_pages, pmax)
+    block_pages = max(1, min(block_pages, pmax))
     num_bufs = max(2, num_bufs)
     nb_max = -(-pmax // block_pages)
     scale = 1.0 / (head_dim**0.5)

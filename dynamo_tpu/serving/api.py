@@ -358,7 +358,7 @@ class _Handler(JsonHTTPHandler):
             self._raw(200, data, "application/zip")
         elif path == "/worker/stats":
             eng = self.ctx.engine
-            self._json(200, {
+            out = {
                 "model": self.ctx.served_model,
                 "active_seqs": eng.num_active,
                 "pending": len(eng.pending),
@@ -367,7 +367,11 @@ class _Handler(JsonHTTPHandler):
                 "max_num_seqs": eng.cfg.max_num_seqs,
                 "disaggregation_mode": eng.cfg.disaggregation_mode,
                 "metrics": eng.metrics.snapshot(),
-            })
+            }
+            pc = getattr(eng, "prefix_cache", None)
+            if pc is not None:
+                out["prefix_cache"] = pc.stats()
+            self._json(200, out)
         else:
             self._error(404, f"no route {path}")
 

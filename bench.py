@@ -169,7 +169,11 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     # TTFT/ITL percentiles must reflect steady-state serving only
     eng.reset_metrics()
 
-    for i, p in enumerate(prompts):
+    # FRESH prompts for the timed run: reusing the warmup prompts would let
+    # the prefix cache absorb every prefill and report cache-hit TTFT
+    timed_prompts = [[(i * 11 + j * 3) % 197 + 2 for j in range(prompt_len)]
+                     for i in range(batch)]
+    for i, p in enumerate(timed_prompts):
         eng.add_request(
             GenRequest(f"b{i}", p, max_tokens=steps, temperature=0.0,
                        ignore_eos=True)
@@ -180,10 +184,7 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     jax.block_until_ready(eng.k_pages)
     # TTFT (prefill phase) was measured during the drain; re-zero only the
     # decode phases so ITL percentiles exclude the batch ramp-up steps
-    from dynamo_tpu.engine.engine import PhaseTimer
-
-    for ph in ("decode_window", "decode_step"):
-        eng.metrics.phases[ph] = PhaseTimer()
+    eng.metrics.reset_phases("decode_window", "decode_step")
 
     t0 = time.perf_counter()
     tokens = 0

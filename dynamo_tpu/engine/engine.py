@@ -131,6 +131,11 @@ class EngineMetrics:
     def observe_phase(self, phase: str, seconds: float) -> None:
         self.phases[phase].observe(seconds)
 
+    def reset_phases(self, *names: str) -> None:
+        """Re-zero selected phase histograms (bench section boundaries)."""
+        for n in names:
+            self.phases[n] = PhaseTimer()
+
     def snapshot(self) -> Dict[str, float]:
         out = {k: v for k, v in self.__dict__.items() if k != "phases"}
         out["phases"] = {p: t.snapshot() for p, t in self.phases.items()}

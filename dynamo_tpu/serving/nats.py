@@ -27,6 +27,7 @@ import logging
 import queue
 import socket
 import threading
+import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -102,32 +103,64 @@ class Msg:
 
 
 class NatsClient:
-    """Synchronous NATS client; a reader thread dispatches MSG callbacks."""
+    """Synchronous NATS client; a reader thread dispatches MSG callbacks.
+
+    Resilient to broker restarts: on disconnect the reader thread redials
+    with exponential backoff and re-issues every active subscription, so
+    long-lived planes (worker responders, frontend routers) survive a
+    nats-server pod bounce. Publishes during the outage raise
+    ConnectionError/OSError — callers (the frontend) already treat plane
+    failures as fall-back-to-HTTP."""
+
+    RECONNECT_MAX_BACKOFF_S = 15.0
 
     def __init__(self, url: str, name: str = "dynamo-tpu",
                  connect_timeout: float = 5.0):
-        host, port = parse_url(url)
-        self.sock = socket.create_connection((host, port),
-                                             timeout=connect_timeout)
-        self.sock.settimeout(None)
-        self._reader = _LineReader(self.sock)
+        self._url = url
+        self._name = name
+        self._connect_timeout = connect_timeout
         self._wlock = threading.Lock()
         self._subs: Dict[int, Callable[[Msg], None]] = {}
+        # sid -> (subject, queue_group), for re-subscription after redial
+        self._sub_specs: Dict[int, Tuple[str, Optional[str]]] = {}
         self._next_sid = 1
         self._closed = False
-
-        info = self._reader.read_line()
-        if not info.startswith(b"INFO "):
-            raise ConnectionError(f"unexpected NATS greeting: {info[:64]!r}")
-        self._send(
-            b"CONNECT "
-            + json.dumps({"verbose": False, "pedantic": False, "name": name,
-                          "lang": "python", "version": "0"}).encode()
-            + b"\r\n"
-        )
+        self._connect()
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="nats-reader")
         self._thread.start()
+
+    def _connect(self) -> None:
+        host, port = parse_url(self._url)
+        sock = socket.create_connection((host, port),
+                                        timeout=self._connect_timeout)
+        # keep the timeout through the greeting: a peer that accepts TCP but
+        # never sends INFO must not hang the (sole) reconnect thread
+        reader = _LineReader(sock)
+        try:
+            info = reader.read_line()
+        except socket.timeout:
+            sock.close()
+            raise ConnectionError("timed out waiting for NATS INFO") from None
+        if not info.startswith(b"INFO "):
+            sock.close()
+            raise ConnectionError(f"unexpected NATS greeting: {info[:64]!r}")
+        sock.settimeout(None)
+        connect = (
+            b"CONNECT "
+            + json.dumps({"verbose": False, "pedantic": False,
+                          "name": self._name, "lang": "python",
+                          "version": "0"}).encode()
+            + b"\r\n"
+        )
+        # re-issue active subscriptions (no-op on first connect)
+        for sid, (subject, group) in list(self._sub_specs.items()):
+            q = f" {group}" if group else ""
+            connect += f"SUB {subject}{q} {sid}\r\n".encode()
+        sock.sendall(connect)
+        with self._wlock:
+            self.sock = sock
+            self._reader = reader
 
     # ------------------------------------------------------------------ io --
     def _send(self, data: bytes) -> None:
@@ -135,33 +168,55 @@ class NatsClient:
             self.sock.sendall(data)
 
     def _read_loop(self) -> None:
-        try:
+        backoff = 0.2
+        while not self._closed:
+            try:
+                while not self._closed:
+                    line = self._reader.read_line()
+                    backoff = 0.2  # healthy traffic resets the redial clock
+                    if line == b"PING":
+                        self._send(b"PONG\r\n")
+                    elif line.startswith(b"MSG "):
+                        parts = line.decode().split(" ")
+                        # MSG <subject> <sid> [reply-to] <#bytes>
+                        if len(parts) == 5:
+                            _, subject, sid, reply, nbytes = parts
+                        else:
+                            _, subject, sid, nbytes = parts
+                            reply = None
+                        data = self._reader.read_exact(int(nbytes))
+                        self._reader.read_exact(2)  # trailing CRLF
+                        cb = self._subs.get(int(sid))
+                        if cb is not None:
+                            try:
+                                cb(Msg(subject, reply, data))
+                            except Exception:
+                                log.exception(
+                                    "nats subscription callback failed")
+                    elif line.startswith(b"-ERR"):
+                        log.warning("nats error: %s",
+                                    line.decode(errors="replace"))
+                    # +OK / PONG / INFO updates: ignore
+            except (ConnectionError, OSError):
+                if self._closed:
+                    return
+                log.warning("nats disconnected; redialing %s", self._url)
+            try:
+                # release the dead connection: a half-open socket pins the
+                # broker-side port and leaks an fd per redial
+                self.sock.close()
+            except OSError:
+                pass
             while not self._closed:
-                line = self._reader.read_line()
-                if line == b"PING":
-                    self._send(b"PONG\r\n")
-                elif line.startswith(b"MSG "):
-                    parts = line.decode().split(" ")
-                    # MSG <subject> <sid> [reply-to] <#bytes>
-                    if len(parts) == 5:
-                        _, subject, sid, reply, nbytes = parts
-                    else:
-                        _, subject, sid, nbytes = parts
-                        reply = None
-                    data = self._reader.read_exact(int(nbytes))
-                    self._reader.read_exact(2)  # trailing CRLF
-                    cb = self._subs.get(int(sid))
-                    if cb is not None:
-                        try:
-                            cb(Msg(subject, reply, data))
-                        except Exception:
-                            log.exception("nats subscription callback failed")
-                elif line.startswith(b"-ERR"):
-                    log.warning("nats error: %s", line.decode(errors="replace"))
-                # +OK / PONG / INFO updates: ignore
-        except (ConnectionError, OSError):
-            if not self._closed:
-                log.warning("nats reader disconnected")
+                try:
+                    self._connect()
+                    log.info("nats reconnected to %s (%d subscriptions)",
+                             self._url, len(self._sub_specs))
+                    break
+                except OSError:
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2,
+                                  self.RECONNECT_MAX_BACKOFF_S)
 
     # ------------------------------------------------------------- surface --
     def publish(self, subject: str, data: bytes,
@@ -175,12 +230,14 @@ class NatsClient:
             sid = self._next_sid
             self._next_sid += 1
         self._subs[sid] = cb
+        self._sub_specs[sid] = (subject, queue_group)
         q = f" {queue_group}" if queue_group else ""
         self._send(f"SUB {subject}{q} {sid}\r\n".encode())
         return sid
 
     def unsubscribe(self, sid: int) -> None:
         self._subs.pop(sid, None)
+        self._sub_specs.pop(sid, None)
         try:
             self._send(f"UNSUB {sid}\r\n".encode())
         except OSError:
@@ -359,12 +416,26 @@ class MiniNatsBroker:
     def close(self) -> None:
         self._closed = True
         try:
+            # shutdown() wakes the accept() thread; a bare close() while a
+            # thread blocks in accept leaves the listener fd (and the port)
+            # alive indefinitely
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._srv.close()
         except OSError:
             pass
+        self._accept_thread.join(timeout=2)
         with self._lock:
             conns, self._conns = self._conns, []
         for c in conns:
+            try:
+                # same blocked-thread quirk as the listener: shutdown()
+                # wakes the conn's recv loop so close actually releases it
+                c.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.sock.close()
             except OSError:

@@ -181,3 +181,50 @@ def test_queue_group_subject_serves_without_router(serving_stack):
         assert out["usage"]["completion_tokens"] == 4
     finally:
         nc.close()
+
+
+def test_client_survives_broker_restart():
+    """Reconnect: after the broker bounces (same port), existing
+    subscriptions keep delivering without caller intervention."""
+    # a fixed port OUTSIDE the ephemeral range (32768+): the client's own
+    # redial sockets would otherwise grab the freed port as their local
+    # ephemeral port and block the rebind
+    import random
+
+    b1 = None
+    for _ in range(20):
+        try:
+            b1 = MiniNatsBroker(port=random.randint(21000, 29999))
+            break
+        except OSError:
+            continue
+    assert b1 is not None
+    port = b1.port
+    nc_sub = NatsClient(b1.url)
+    got = []
+    nc_sub.subscribe("up.again", lambda m: got.append(m.data))
+    b1.close()
+    b2 = None
+    for _ in range(40):  # rebinding the same port can hit TIME_WAIT briefly
+        time.sleep(0.25)
+        try:
+            b2 = MiniNatsBroker(port=port)
+            break
+        except OSError:
+            continue
+    assert b2 is not None, "could not rebind broker port"
+    try:
+        # wait for the subscriber's redial + resub
+        deadline = time.time() + 10
+        delivered = False
+        while time.time() < deadline and not delivered:
+            pub = NatsClient(b2.url)
+            pub.publish("up.again", b"hello-again")
+            pub.close()
+            time.sleep(0.25)
+            delivered = bool(got)
+        assert delivered, "subscription did not survive broker restart"
+        assert got[0] == b"hello-again"
+    finally:
+        nc_sub.close()
+        b2.close()

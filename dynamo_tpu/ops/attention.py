@@ -244,10 +244,58 @@ def chunk_attention(
 
     One gather of the sequence's pages serves ALL chunk rows (unlike the
     decode op, whose per-row tables would duplicate the prefix C times).
-    XLA implementation: the gather feeds a masked-softmax attention that XLA
-    fuses; chunk attention is compute-bound (C queries amortize each KV
-    byte), so the flash-style Pallas treatment decode needs buys little here.
+
+    Two implementations:
+    - XLA (default): the gather feeds a masked-softmax attention; simple,
+      correct everywhere, but materializes [H, C, S] scores per layer.
+    - Pallas flash (env DYNAMO_TPU_CHUNK_ATTENTION=pallas, TPU only): the
+      decode kernel's superblock DMA ring with a query BLOCK per grid row —
+      no score materialization, each KV byte fetched once per query block.
+      Gated off by default until validated on hardware (interpret-mode
+      tests cover semantics; Mosaic lowering needs a real chip).
     """
+    # NOTE: a process-wide env gate (not the per-engine attention_context)
+    # on purpose, and only while the Pallas chunk kernel awaits hardware
+    # validation — once it defaults on, selection folds into
+    # _resolve_backend() like the decode/prefill ops.
+    backend = os.environ.get("DYNAMO_TPU_CHUNK_ATTENTION", "xla")
+    if backend in ("pallas", "pallas_interpret"):
+        n_kv = k_pages.shape[2] // q.shape[2]
+        mesh = _mesh_for_shard_map()
+        tp = _mesh_tp(mesh)
+        aligned = (k_pages.shape[2] // max(tp, 1)) % 128 == 0 \
+            and (tp <= 1 or (n_kv % tp == 0 and q.shape[1] % tp == 0))
+        if not aligned:
+            import logging
+
+            logging.getLogger("dynamo_tpu.ops").warning(
+                "pallas chunk attention needs 128-aligned per-shard KV*D "
+                "(got %d/%d); using the XLA gather path",
+                k_pages.shape[2], max(tp, 1),
+            )
+        if aligned:
+            from dynamo_tpu.ops import pallas_attention as pa
+
+            interp = backend == "pallas_interpret"
+
+            def call(q, kp, vp, pg, st):
+                return pa.chunk_prefill_attention(
+                    q, kp, vp, pg, st, page_size=page_size,
+                    num_kv_heads=kp.shape[2] // q.shape[2],
+                    interpret=interp,
+                )
+
+            st = jnp.asarray(start, jnp.int32)
+            if mesh is None:
+                return call(q, k_pages, v_pages, pages, st)
+            return jax.shard_map(
+                call,
+                mesh=mesh,
+                in_specs=(P(None, "model", None), P(None, None, "model"),
+                          P(None, None, "model"), P(None), P()),
+                out_specs=P(None, "model", None),
+                check_vma=False,
+            )(q, k_pages, v_pages, pages, st)
     c, n_heads, head_dim = q.shape
     n_kv = k_pages.shape[2] // head_dim
     s_ctx = pages.shape[0] * page_size

@@ -461,3 +461,219 @@ def prefill_attention(
     )(sl, qt, kt, vt)
     out = out.reshape(n_heads, s_pad, head_dim)
     return jnp.moveaxis(out[:, :s], 0, 1)  # [S, H, D]
+
+
+# ------------------------------------------------------------ chunk prefill --
+
+
+def _chunk_kernel(
+    # scalar prefetch
+    pages_ref,  # [W] int32 page ids of the sequence (trash-padded tail)
+    start_ref,  # [1] int32 absolute position of the chunk's first token
+    # inputs
+    q_ref,  # [1, Cq, H, D] VMEM block (one query block of the chunk)
+    k_hbm,  # [P, ps, KVD] in ANY/HBM — manually DMA'd
+    v_hbm,  # [P, ps, KVD]
+    o_ref,  # [1, Cq, H, D]
+    # scratch (persistent across the sequential grid)
+    kbuf,  # [NBUF, SB, ps, KVD]
+    vbuf,  # [NBUF, SB, ps, KVD]
+    qbd_ref,  # [Cq*H, KVD] f32 — block-diagonal queries, built once per qb
+    m_ref,  # [Cq*H, 128] f32
+    l_ref,  # [Cq*H, 128] f32
+    acc_ref,  # [Cq*H, KVD] f32
+    ptr_ref,  # SMEM [4]: consumed count, issue cursor (qb, kb), issued count
+    sem,  # DMA semaphores [NBUF, 2, SB]
+    *,
+    page_size: int,
+    table_width: int,
+    block_pages: int,
+    block_q: int,
+    num_bufs: int,
+    n_kv: int,
+    scale: float,
+):
+    """Chunked-prefill flash attention over the paged KV cache.
+
+    Identical bones to `_decode_kernel` — the same page-major superblock DMA
+    ring pipelined across a sequential grid, the same block-diagonal GQA
+    matmuls — but the query side carries a BLOCK of chunk tokens (rows =
+    block_q * H, row r = query (r // H) of head (r % H)) and the mask is
+    causal in absolute positions instead of a per-sequence context length.
+    Each query block attends over every KV block up to its own causal
+    horizon, so one kernel invocation covers prefix + in-chunk attention
+    with each KV byte fetched once per query block.
+    """
+    qb = pl.program_id(0)
+    kb = pl.program_id(1)
+    nq = pl.num_programs(0)
+    tokens_per_block = block_pages * page_size
+    h, d = q_ref.shape[2], q_ref.shape[3]
+    group = h // n_kv
+    rows = block_q * h
+    kvd = n_kv * d
+    start = start_ref[0]
+
+    def block_copies(qq, kk, slot):
+        out = []
+        for j in range(block_pages):
+            pg = pages_ref[jnp.minimum(kk * block_pages + j, table_width - 1)]
+            out.append(pltpu.make_async_copy(
+                k_hbm.at[pg], kbuf.at[slot, j], sem.at[slot, 0, j]))
+            out.append(pltpu.make_async_copy(
+                v_hbm.at[pg], vbuf.at[slot, j], sem.at[slot, 1, j]))
+        return out
+
+    def n_blocks(qq):
+        # causal horizon of query block qq: tokens 0 .. start + (qq+1)*Cq - 1
+        horizon = start + (qq + 1) * block_q
+        return (horizon + tokens_per_block - 1) // tokens_per_block
+
+    def issue_one():
+        iq, ik = ptr_ref[1], ptr_ref[2]
+
+        @pl.when(iq < nq)
+        def _():
+            slot = jax.lax.rem(ptr_ref[3], num_bufs)
+            for c in block_copies(iq, ik, slot):
+                c.start()
+            ptr_ref[3] = ptr_ref[3] + 1
+            nxt = ik + 1
+            done = nxt >= n_blocks(iq)
+            ptr_ref[1] = jnp.where(done, iq + 1, iq)
+            ptr_ref[2] = jnp.where(done, 0, nxt)
+
+    nb_q = n_blocks(qb)
+
+    @pl.when((qb == 0) & (kb == 0))
+    def _init():
+        ptr_ref[0] = 0
+        ptr_ref[1] = 0
+        ptr_ref[2] = 0
+        ptr_ref[3] = 0
+        for _ in range(num_bufs - 1):
+            issue_one()
+
+    @pl.when(kb < nb_q)
+    def _active():
+        cnt = ptr_ref[0]
+        cur = jax.lax.rem(cnt, num_bufs)
+        issue_one()
+        for c in block_copies(qb, kb, cur):
+            c.wait()
+        ptr_ref[0] = cnt + 1
+
+        row_kv = (jax.lax.broadcasted_iota(jnp.int32, (rows, kvd), 0)
+                  % h) // group
+        lane_kv = jax.lax.broadcasted_iota(jnp.int32, (rows, kvd), 1) // d
+        bd_mask = row_kv == lane_kv
+
+        @pl.when(kb == 0)
+        def _reset():
+            _flash_reset(m_ref, l_ref, acc_ref)
+            q = q_ref[0].astype(jnp.float32).reshape(rows, d) * scale
+            qbd_ref[...] = jnp.where(bd_mask, jnp.tile(q, (1, n_kv)), 0.0)
+
+        k = kbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+        v = vbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qbd_ref[...], k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rows, T]
+        tok = kb * tokens_per_block + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        qpos = start + qb * block_q + (
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // h
+        )
+        s = jnp.where(tok <= qpos, s, NEG_INF)
+        _flash_update(m_ref, l_ref, acc_ref, s, v)
+
+        @pl.when(kb == nb_q - 1)
+        def _finalize():
+            out = _flash_normalize(l_ref, acc_ref)  # [rows, KVD]
+            out = jnp.where(bd_mask, out, 0.0)
+            folded = out[:, 0:d]
+            for kv in range(1, n_kv):
+                folded = folded + out[:, kv * d:(kv + 1) * d]
+            o_ref[0] = folded.reshape(block_q, h, d).astype(o_ref.dtype)
+
+
+def chunk_prefill_attention(
+    q: jax.Array,  # [C, H, D] — one prefill chunk's queries
+    k_pages: jax.Array,  # [P, ps, KV*D]
+    v_pages: jax.Array,
+    pages: jax.Array,  # [W] page ids (trash-padded tail)
+    start,  # scalar int32
+    *,
+    page_size: int,
+    num_kv_heads: int,
+    block_q: int = 8,
+    block_pages: int = DEFAULT_BLOCK_PAGES,
+    num_bufs: int = DEFAULT_NUM_BUFS,
+    interpret: bool = False,
+) -> jax.Array:
+    c, n_heads, head_dim = q.shape
+    kvd = k_pages.shape[2]
+    assert kvd == num_kv_heads * head_dim, (kvd, num_kv_heads, head_dim)
+    width = pages.shape[0]
+    block_pages = max(1, min(block_pages, width))
+    num_bufs = max(2, num_bufs)
+    # largest power-of-two divisor of c not exceeding the requested block
+    # (chunks are page multiples, not necessarily block_q multiples)
+    block_q = max(1, min(block_q, c))
+    while c % block_q != 0:
+        block_q //= 2
+    nq = c // block_q
+    # worst-case kv blocks: the final query block's causal horizon
+    nk_max = -(-(width * page_size) // (block_pages * page_size))
+    scale = 1.0 / (head_dim**0.5)
+    rows = block_q * n_heads
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nq, nk_max),
+        in_specs=[
+            pl.BlockSpec((1, block_q, n_heads, head_dim),
+                         lambda qb, kb, pg, st: (qb, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, n_heads, head_dim),
+            lambda qb, kb, pg, st: (qb, 0, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_bufs, block_pages, page_size, kvd), k_pages.dtype),
+            pltpu.VMEM((num_bufs, block_pages, page_size, kvd), v_pages.dtype),
+            pltpu.VMEM((rows, kvd), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, kvd), jnp.float32),
+            pltpu.SMEM((4,), jnp.int32),
+            pltpu.SemaphoreType.DMA((num_bufs, 2, block_pages)),
+        ],
+    )
+    kernel = functools.partial(
+        _chunk_kernel,
+        page_size=page_size,
+        table_width=width,
+        block_pages=block_pages,
+        block_q=block_q,
+        num_bufs=num_bufs,
+        n_kv=num_kv_heads,
+        scale=scale,
+    )
+    q4 = q.reshape(nq, block_q, n_heads, head_dim)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nq, block_q, n_heads, head_dim),
+                                       q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(pages.astype(jnp.int32), jnp.asarray(start, jnp.int32).reshape(1),
+      q4, k_pages, v_pages)
+    return out.reshape(c, n_heads, head_dim)

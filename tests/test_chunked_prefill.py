@@ -88,3 +88,29 @@ def test_chunked_final_chunk_past_bucket_cap():
         GenRequest("c", prompt, max_tokens=2, temperature=0.0,
                    ignore_eos=True))
     assert chunked == full
+
+
+def test_chunked_engine_with_pallas_chunk_kernel(monkeypatch):
+    """End-to-end: engine chunked prefill through the Pallas flash kernel
+    (interpret mode) produces the same tokens as the XLA chunk path.
+
+    Uses a model whose KV*D = 128 so the alignment gate actually admits the
+    kernel (tiny-debug's 64 lanes would silently fall back to XLA and the
+    test would compare the XLA path to itself)."""
+    from dynamo_tpu.models.config import ModelConfig
+
+    mcfg = ModelConfig(name="chunk-kernel-test", vocab_size=256,
+                       hidden_size=64, intermediate_size=128, num_layers=2,
+                       num_heads=4, num_kv_heads=2, head_dim=64,
+                       dtype="float32")
+    prompt = [(i * 11) % 200 + 1 for i in range(50)]
+    kw = dict(model="tiny-debug", page_size=4, num_pages=256, max_num_seqs=4,
+              max_seq_len=256, prefill_chunk_tokens=8)
+    ref = Engine(EngineConfig(**kw), model_cfg=mcfg).generate(
+        GenRequest("x", prompt, max_tokens=8, temperature=0.0,
+                   ignore_eos=True))
+    monkeypatch.setenv("DYNAMO_TPU_CHUNK_ATTENTION", "pallas_interpret")
+    out = Engine(EngineConfig(**kw), model_cfg=mcfg).generate(
+        GenRequest("x", prompt, max_tokens=8, temperature=0.0,
+                   ignore_eos=True))
+    assert out == ref

@@ -263,16 +263,10 @@ def chunk_attention(
         n_kv = k_pages.shape[2] // q.shape[2]
         mesh = _mesh_for_shard_map()
         tp = _mesh_tp(mesh)
-        aligned = (k_pages.shape[2] // max(tp, 1)) % 128 == 0 \
-            and (tp <= 1 or (n_kv % tp == 0 and q.shape[1] % tp == 0))
-        if not aligned:
-            import logging
-
-            logging.getLogger("dynamo_tpu.ops").warning(
-                "pallas chunk attention needs 128-aligned per-shard KV*D "
-                "(got %d/%d); using the XLA gather path",
-                k_pages.shape[2], max(tp, 1),
-            )
+        aligned = (
+            _pallas_head_gate(q.shape[1], n_kv, tp, "chunk attention")
+            and _pallas_lane_gate(k_pages.shape[2], tp, "chunk attention")
+        )
         if aligned:
             from dynamo_tpu.ops import pallas_attention as pa
 
@@ -321,6 +315,35 @@ def _mesh_tp(mesh) -> int:
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
 
 
+def _pallas_head_gate(n_heads: int, n_kv: int, tp: int, op: str) -> bool:
+    """True when tp divides both query and KV heads, i.e. the explicit
+    head-parallel shard_map can split the kernel. Logs the violated
+    constraint so fallbacks name their actual cause (trace-time only)."""
+    if tp <= 1 or (n_kv % tp == 0 and n_heads % tp == 0):
+        return True
+    import logging
+
+    logging.getLogger("dynamo_tpu.ops").warning(
+        "pallas %s: tp=%d does not divide query heads (%d) / KV heads (%d); "
+        "using the XLA path", op, tp, n_heads, n_kv,
+    )
+    return False
+
+
+def _pallas_lane_gate(kvd: int, tp: int, op: str) -> bool:
+    """True when the per-shard fused KV*D lane dim is 128-aligned — the TPU
+    DMA constraint both paged Pallas kernels share."""
+    if (kvd // max(tp, 1)) % 128 == 0:
+        return True
+    import logging
+
+    logging.getLogger("dynamo_tpu.ops").warning(
+        "pallas %s needs the per-shard KV*D lane dim 128-aligned (got %d "
+        "over tp=%d); falling back to the XLA gather path", op, kvd, tp,
+    )
+    return False
+
+
 def paged_attention_decode(
     q: jax.Array,  # [B, H, D]
     k_pages: jax.Array,  # [P, ps, KV*D]
@@ -334,23 +357,15 @@ def paged_attention_decode(
     mesh = _mesh_for_shard_map()
     n_kv = k_pages.shape[2] // q.shape[2]
     tp = _mesh_tp(mesh)
-    if tp > 1 and (n_kv % tp != 0 or q.shape[1] % tp != 0):
-        # tp exceeds (or doesn't divide) the KV heads: the explicit
-        # head-parallel shard_map can't split a head — let GSPMD place the
-        # XLA path instead (weights are replicated by sharding._fit_spec)
+    if not _pallas_head_gate(q.shape[1], n_kv, tp, "decode"):
+        # the explicit head-parallel shard_map can't split a head — let
+        # GSPMD place the op instead (weights replicated by
+        # sharding._fit_spec)
         mesh = None
     if backend != "xla":
-        # TPU DMA needs the per-shard fused KV*D lane dim 128-aligned; with
-        # extreme TP on tiny heads (e.g. tp=8 over 8 KV heads of dim 64) the
-        # local span drops below a lane tile — use the XLA path there.
-        if (k_pages.shape[2] // _mesh_tp(mesh)) % 128 != 0:
-            import logging
-
-            logging.getLogger("dynamo_tpu.ops").warning(
-                "pallas decode needs the per-shard KV*D lane dim 128-aligned "
-                "(got %d/%d); falling back to the XLA gather path",
-                k_pages.shape[2], tp,
-            )
+        # e.g. tp=8 over 8 KV heads of dim 64 drops the local fused-KV span
+        # below a lane tile
+        if not _pallas_lane_gate(k_pages.shape[2], _mesh_tp(mesh), "decode"):
             backend = "xla"
     if backend == "xla":
         def call(q, kp, vp, bt, cl):

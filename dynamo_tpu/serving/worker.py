@@ -1,5 +1,22 @@
-"""Engine-worker process entrypoint (shared by the jetstream / vllm_tpu
-backend aliases).
+"""Engine-worker process entrypoint, specialised per backend profile.
+
+The reference deploys three engine backends (vLLM / SGLang / TRT-LLM) that
+share one serving contract but differ in scheduling philosophy. This repo
+mirrors that as one TPU engine core specialised by three **backend
+profiles** — each `python -m dynamo_tpu.<backend>` entrypoint selects a
+distinct set of scheduling defaults (explicit CLI flags always win):
+
+- ``jetstream``  — orchestrated serving: fixed fused decode windows driven
+  synchronously (JetStream's orchestrator model); no chunked prefill —
+  admission happens between windows.
+- ``vllm_tpu``   — continuous batching: chunked prefill interleaved with
+  decode, automatic prefix caching, async (overlapped) scheduling —
+  vLLM's scheduler model.
+- ``trtllm_tpu`` — the compiled-engine model: an explicit per-role
+  ``--engine-config`` file is REQUIRED (TRT-LLM's engine_configs analogue,
+  /root/reference/examples/dgdr/trtllm/disagg.yaml:39-40,64-65), AOT
+  warmup always runs before /ready, and compiled programs persist in an
+  engine cache directory (the TRT engine-build analogue).
 
 CLI contract mirrors the reference's worker invocations
 (`python3 -m dynamo.vllm --model ...`,
@@ -26,6 +43,29 @@ from dynamo_tpu.engine.engine import Engine
 from dynamo_tpu.serving.api import ServingContext, make_server
 
 log = logging.getLogger("dynamo_tpu.worker")
+
+# Per-backend scheduling defaults (see module docstring). Applied as argparse
+# defaults, so an explicit CLI flag always overrides its profile value.
+BACKEND_PROFILES = {
+    "jetstream": dict(
+        num_scheduler_steps=8,
+        async_scheduling=False,
+        prefill_chunk_tokens=0,
+        enable_prefix_caching=False,
+    ),
+    "vllm_tpu": dict(
+        num_scheduler_steps=1,
+        async_scheduling=True,
+        prefill_chunk_tokens=256,
+        enable_prefix_caching=True,
+    ),
+    "trtllm_tpu": dict(
+        num_scheduler_steps=4,
+        async_scheduling=True,
+        prefill_chunk_tokens=256,
+        enable_prefix_caching=True,
+    ),
+}
 
 
 def _self_url(host: str, port: int) -> str:
@@ -69,10 +109,10 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
             log.warning("heartbeat to %s failed: %s", payload_url, e)
 
 
-def main(argv=None, backend_name: str = "jetstream") -> None:
-    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+def build_parser(backend_name: str) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog=f"dynamo_tpu.{backend_name}")
     EngineConfig.add_cli_args(p)
+    p.set_defaults(**BACKEND_PROFILES.get(backend_name, {}))
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
     p.add_argument("--frontend-url", default=os.environ.get("FRONTEND_URL"))
@@ -87,7 +127,33 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
                         "gang; the Grove-multinode analogue)")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    return p
+
+
+def main(argv=None, backend_name: str = "jetstream") -> None:
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    p = build_parser(backend_name)
     args = p.parse_args(argv)
+
+    if backend_name == "trtllm_tpu":
+        # the compiled-engine contract: refuse to serve without an explicit
+        # engine-build config, and persist compiled programs so a restart
+        # "loads the engine" instead of rebuilding it
+        if not getattr(args, "engine_config", None):
+            p.error("--engine-config FILE is required for the trtllm_tpu "
+                    "backend (the TRT engine-build config analogue)")
+        # jax is already imported by this point, so the env var would be a
+        # no-op — set the config knob directly (env var still wins if the
+        # operator configured one)
+        import jax
+
+        if not (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                or jax.config.jax_compilation_cache_dir):
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(os.path.expanduser("~"), ".cache", "dynamo_tpu",
+                             "engine-cache"),
+            )
 
     cfg = EngineConfig.from_cli_args(args)
     from dynamo_tpu.parallel import distributed as dist

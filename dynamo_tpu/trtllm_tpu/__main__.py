@@ -1,0 +1,3 @@
+from dynamo_tpu.serving.worker import main
+
+main(backend_name="trtllm_tpu")

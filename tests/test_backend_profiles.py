@@ -1,0 +1,64 @@
+"""Backend profiles: the three entrypoints select genuinely distinct
+scheduling defaults (docs/backends.md), explicit flags override, and the
+trtllm_tpu compiled-engine profile refuses to run without an engine config.
+"""
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.serving.worker import BACKEND_PROFILES, build_parser
+
+
+def _cfg(backend, argv):
+    args = build_parser(backend).parse_args(argv)
+    return EngineConfig.from_cli_args(args)
+
+
+def test_jetstream_profile_is_orchestrated():
+    cfg = _cfg("jetstream", ["--model", "tiny-debug"])
+    assert cfg.num_scheduler_steps == 8
+    assert cfg.async_scheduling is False
+    assert cfg.prefill_chunk_tokens == 0
+    assert cfg.enable_prefix_caching is False
+
+
+def test_vllm_profile_is_continuous_batching():
+    cfg = _cfg("vllm_tpu", ["--model", "tiny-debug"])
+    assert cfg.num_scheduler_steps == 1
+    assert cfg.async_scheduling is True
+    assert cfg.prefill_chunk_tokens == 256
+    assert cfg.enable_prefix_caching is True
+
+
+def test_profiles_differ_pairwise():
+    cfgs = {b: _cfg(b, ["--model", "tiny-debug"]) for b in BACKEND_PROFILES}
+    sched = {(c.num_scheduler_steps, c.async_scheduling,
+              c.prefill_chunk_tokens, c.enable_prefix_caching)
+             for c in cfgs.values()}
+    assert len(sched) == len(cfgs)  # no two backends share a profile
+
+
+def test_explicit_flag_overrides_profile():
+    cfg = _cfg("jetstream", ["--model", "tiny-debug",
+                             "--num-scheduler-steps", "2",
+                             "--prefill-chunk-tokens", "128",
+                             "--async-scheduling"])
+    assert cfg.num_scheduler_steps == 2
+    assert cfg.prefill_chunk_tokens == 128
+    assert cfg.async_scheduling is True
+
+
+def test_engine_config_overrides_profile(tmp_path):
+    f = tmp_path / "role.yaml"
+    f.write_text("num_scheduler_steps: 3\nmax_num_seqs: 5\n")
+    cfg = _cfg("vllm_tpu", ["--model", "tiny-debug",
+                            "--engine-config", str(f)])
+    assert cfg.num_scheduler_steps == 3
+    assert cfg.max_num_seqs == 5
+
+
+def test_trtllm_requires_engine_config():
+    from dynamo_tpu.serving import worker
+
+    with pytest.raises(SystemExit):
+        worker.main(["--model", "tiny-debug"], backend_name="trtllm_tpu")

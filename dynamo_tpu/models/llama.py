@@ -46,9 +46,12 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
-    """Random init with the exact shapes/names the loader and sharder expect."""
-    dt = _dtype(cfg)
+def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float]]:
+    """Shape/init spec for every parameter: name -> (shape, kind, sigma).
+
+    kind: "normal" (random weight with stddev sigma), "ones", "zeros".
+    Single source of truth for param shapes — `init_params` and the loader's
+    fast random-int8 path both build from it, so they cannot drift."""
     e, h, kv, d, f, l = (
         cfg.hidden_size,
         cfg.num_heads,
@@ -57,41 +60,58 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         cfg.intermediate_size,
         cfg.num_layers,
     )
-    ks = jax.random.split(key, 16)
 
-    def rnd(k, shape, scale=None):
-        scale = scale if scale is not None else 1.0 / jnp.sqrt(shape[-1])
-        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dt)
+    def w(shape, sigma=None):
+        return (shape, "normal",
+                sigma if sigma is not None else 1.0 / shape[-1] ** 0.5)
 
-    p: Params = {
-        "embed": rnd(ks[0], (cfg.vocab_size, e), scale=0.02),
-        "final_norm": jnp.ones((e,), dt),
-        "attn_norm": jnp.ones((l, e), dt),
-        "wq": rnd(ks[1], (l, e, h, d)),
-        "wk": rnd(ks[2], (l, e, kv, d)),
-        "wv": rnd(ks[3], (l, e, kv, d)),
-        "wo": rnd(ks[4], (l, h, d, e)),
-        "mlp_norm": jnp.ones((l, e), dt),
+    p = {
+        "embed": w((cfg.vocab_size, e), 0.02),
+        "final_norm": ((e,), "ones", 0.0),
+        "attn_norm": ((l, e), "ones", 0.0),
+        "wq": w((l, e, h, d)),
+        "wk": w((l, e, kv, d)),
+        "wv": w((l, e, kv, d)),
+        "wo": w((l, h, d, e)),
+        "mlp_norm": ((l, e), "ones", 0.0),
     }
     if not cfg.tie_word_embeddings:
-        p["lm_head"] = rnd(ks[5], (e, cfg.vocab_size), scale=0.02)
+        p["lm_head"] = w((e, cfg.vocab_size), 0.02)
     if cfg.attention_bias:
-        p["bq"] = jnp.zeros((l, h, d), dt)
-        p["bk"] = jnp.zeros((l, kv, d), dt)
-        p["bv"] = jnp.zeros((l, kv, d), dt)
+        p["bq"] = ((l, h, d), "zeros", 0.0)
+        p["bk"] = ((l, kv, d), "zeros", 0.0)
+        p["bv"] = ((l, kv, d), "zeros", 0.0)
     if cfg.qk_norm:
-        p["q_norm"] = jnp.ones((l, d), dt)
-        p["k_norm"] = jnp.ones((l, d), dt)
+        p["q_norm"] = ((l, d), "ones", 0.0)
+        p["k_norm"] = ((l, d), "ones", 0.0)
     if cfg.is_moe:
         x = cfg.num_experts
-        p["router"] = rnd(ks[6], (l, e, x), scale=0.02)
-        p["moe_w_gate"] = rnd(ks[7], (l, x, e, f))
-        p["moe_w_up"] = rnd(ks[8], (l, x, e, f))
-        p["moe_w_down"] = rnd(ks[9], (l, x, f, e))
+        p["router"] = w((l, e, x), 0.02)
+        p["moe_w_gate"] = w((l, x, e, f))
+        p["moe_w_up"] = w((l, x, e, f))
+        p["moe_w_down"] = w((l, x, f, e))
     else:
-        p["w_gate"] = rnd(ks[6], (l, e, f))
-        p["w_up"] = rnd(ks[7], (l, e, f))
-        p["w_down"] = rnd(ks[8], (l, f, e))
+        p["w_gate"] = w((l, e, f))
+        p["w_up"] = w((l, e, f))
+        p["w_down"] = w((l, f, e))
+    return p
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random init with the exact shapes/names the loader and sharder expect."""
+    dt = _dtype(cfg)
+    specs = param_specs(cfg)
+    ks = jax.random.split(key, len(specs))
+    p: Params = {}
+    for k, (name, (shape, kind, sigma)) in zip(ks, specs.items()):
+        if kind == "ones":
+            p[name] = jnp.ones(shape, dt)
+        elif kind == "zeros":
+            p[name] = jnp.zeros(shape, dt)
+        else:
+            p[name] = (
+                jax.random.normal(k, shape, dtype=jnp.float32) * sigma
+            ).astype(dt)
     return p
 
 

@@ -17,10 +17,14 @@ import threading
 
 log = logging.getLogger("dynamo_tpu.native")
 
-_SRC = os.path.join(os.path.dirname(__file__), "csrc", "dynamo_transport.cpp")
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+_SRC = os.path.join(_CSRC, "dynamo_transport.cpp")
+_ROUTER_SRC = os.path.join(_CSRC, "dynamo_router.cpp")
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_router_lib = None
+_router_tried = False
 
 
 def _build_dir() -> str:
@@ -32,20 +36,33 @@ def _build_dir() -> str:
     return d
 
 
-def build_library() -> str:
-    """Compile (if needed) and return the .so path. Raises on failure."""
-    with open(_SRC, "rb") as f:
+def _build(src: str, stem: str) -> str:
+    """Compile `src` (if needed) into the cache dir; return the .so path."""
+    with open(src, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    so_path = os.path.join(_build_dir(), f"libdynamo_transport_{digest}.so")
+    so_path = os.path.join(_build_dir(), f"lib{stem}_{digest}.so")
     if os.path.exists(so_path):
         return so_path
+    # per-process tmp name: concurrent first-start compiles (colocated
+    # workers) must not interleave writes into one .tmp — whoever's
+    # os.replace lands last wins, both outputs are identical
+    tmp = f"{so_path}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-Wall",
-        _SRC, "-o", so_path + ".tmp",
+        src, "-o", tmp,
     ]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    os.replace(so_path + ".tmp", so_path)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return so_path
+
+
+def build_library() -> str:
+    """Compile (if needed) and return the transport .so path."""
+    return _build(_SRC, "dynamo_transport")
 
 
 def get_lib():
@@ -82,3 +99,29 @@ def get_lib():
             log.warning("native transport unavailable (%s); python fallback", e)
             _lib = None
         return _lib
+
+
+def get_router_lib():
+    """The native router-core library, or None if unavailable."""
+    global _router_lib, _router_tried
+    with _lock:
+        if _router_tried:
+            return _router_lib
+        _router_tried = True
+        try:
+            lib = ctypes.CDLL(_build(_ROUTER_SRC, "dynamo_router"))
+            lib.dr_pick.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_int,
+            ]
+            lib.dr_pick.restype = ctypes.c_int
+            lib.dr_hash64.argtypes = [ctypes.c_char_p]
+            lib.dr_hash64.restype = ctypes.c_uint64
+            _router_lib = lib
+            log.info("native router core loaded")
+        except Exception as e:
+            log.warning("native router unavailable (%s); python fallback", e)
+            _router_lib = None
+        return _router_lib

@@ -48,6 +48,36 @@ class WorkerInfo:
         return 0.5 * slot_room + 0.5 * page_room
 
 
+def _pick_native(affinity_key: str, cands: List["WorkerInfo"]
+                 ) -> Optional["WorkerInfo"]:
+    """Run the pick loop in the native router core (runtime/csrc/
+    dynamo_router.cpp — the Rust-frontend analogue); None = unavailable, let
+    the caller's pure-Python loop decide. Scores are bit-identical either
+    way (tests/test_router_native.py), so this is a pure hot-path swap."""
+    try:
+        from dynamo_tpu.runtime.native import get_router_lib
+    except Exception:
+        return None
+    lib = get_router_lib()
+    if lib is None:
+        return None
+    try:
+        key = affinity_key.encode()
+        urls = [w.url.encode() for w in cands]
+        if b"\x00" in key or any(b"\x00" in u for u in urls):
+            return None  # C strings truncate at NUL; keep parity via Python
+        import ctypes
+
+        arr = (ctypes.c_char_p * len(urls))(*urls)
+        hr = (ctypes.c_double * len(cands))(*[w.headroom for w in cands])
+        idx = lib.dr_pick(key, arr, hr, len(cands))
+    except Exception:
+        return None
+    if 0 <= idx < len(cands):
+        return cands[idx]
+    return None
+
+
 def prefix_key(text: str, prefix_chars: int = 256) -> str:
     """Affinity key: the first prefix_chars of the prompt (system prompt +
     early turns), which is what shared KV pages actually cover."""
@@ -112,6 +142,9 @@ class Router:
             # no worker serves this model -> let the frontend 503 rather than
             # bouncing the request off a wrong-model worker's 400
             return None
+        native = _pick_native(affinity_key, cands)
+        if native is not None:
+            return native
         best, best_score = None, -1.0
         for w in cands:
             h = hashlib.sha256(

@@ -156,6 +156,13 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
             )
 
     cfg = EngineConfig.from_cli_args(args)
+    if backend_name == "trtllm_tpu" and not cfg.warmup:
+        # the profile's defining contract (docs/backends.md): /ready never
+        # precedes compile-completeness — not even --no-warmup or an
+        # engine-config 'warmup: false' may break it
+        log.warning("trtllm_tpu ignores warmup=false: the compiled-engine "
+                    "profile always builds before serving")
+        cfg.warmup = True
     from dynamo_tpu.parallel import distributed as dist
 
     dist_cfg = dist.resolve(args.coordinator, args.num_processes,

@@ -1,0 +1,70 @@
+"""Native router core (runtime/csrc/dynamo_router.cpp) vs the pure-Python
+scoring loop: the two must make bit-identical routing decisions, so the
+native path is a transparent hot-path swap."""
+
+import ctypes
+import hashlib
+
+import pytest
+
+from dynamo_tpu.runtime.native import get_router_lib
+from dynamo_tpu.serving.router import Router, WorkerInfo, _pick_native
+
+lib = get_router_lib()
+pytestmark = pytest.mark.skipif(
+    lib is None, reason="native router lib unavailable (no g++?)")
+
+
+def py_hash64(msg: str) -> int:
+    return int.from_bytes(hashlib.sha256(msg.encode()).digest()[:8], "big")
+
+
+def test_hash64_parity_various_lengths():
+    # cross the 55/56-byte padding boundary and multi-block messages
+    for msg in ["", "a", "x" * 55, "x" * 56, "x" * 63, "x" * 64, "x" * 65,
+                "key|http://w:8000", "яüñ" * 40, "b" * 1000]:
+        assert lib.dr_hash64(msg.encode()) == py_hash64(msg), repr(msg)
+
+
+def _py_pick(key, urls, headrooms):
+    best, best_score = -1, -1.0
+    for i, (u, hr) in enumerate(zip(urls, headrooms)):
+        score = (py_hash64(key + "|" + u) / 2**64) * (0.25 + 0.75 * hr)
+        if score > best_score:
+            best, best_score = i, score
+    return best
+
+
+def test_pick_parity_randomized():
+    import random
+
+    rnd = random.Random(7)
+    for trial in range(200):
+        n = rnd.randint(1, 12)
+        urls = [f"http://worker-{rnd.randint(0, 99)}:{8000 + i}"
+                for i in range(n)]
+        hrs = [rnd.random() for _ in range(n)]
+        key = "prefix-%d" % rnd.randint(0, 10**9)
+        arr = (ctypes.c_char_p * n)(*[u.encode() for u in urls])
+        hr = (ctypes.c_double * n)(*hrs)
+        assert lib.dr_pick(key.encode(), arr, hr, n) == \
+            _py_pick(key, urls, hrs)
+
+
+def test_router_uses_native_and_matches_python(monkeypatch):
+    r = Router()
+    for i in range(5):
+        r.register(f"http://w{i}:8000", "m", stats={
+            "max_num_seqs": 8, "active_seqs": i, "free_pages": 100 - i,
+            "total_pages": 100})
+    key = "the quick brown fox"
+    picked = r.pick("m", key)
+    # force the python fallback and compare
+    monkeypatch.setattr("dynamo_tpu.serving.router._pick_native",
+                        lambda *a: None)
+    assert r.pick("m", key).url == picked.url
+
+
+def test_pick_native_nul_falls_back():
+    w = [WorkerInfo("http://w:1", "m")]
+    assert _pick_native("bad\x00key", w) is None

@@ -80,6 +80,18 @@ class EngineConfig:
     # are identical to synchronous stepping.
     async_scheduling: bool = True
 
+    # speculative decoding: "off" | "ngram" (prompt-lookup drafts from each
+    # sequence's own token history — no draft model, the same capability the
+    # reference's vLLM/TRT-LLM engines ship). Greedy no-penalty sequences
+    # accept the longest draft prefix the verify forward agrees with; other
+    # sequences still get their one sampled token per verify step. Takes the
+    # place of multi-step windows when on.
+    speculative_mode: str = "off"
+    num_speculative_tokens: int = 4
+    # draft proposer: length of the history n-gram matched to find a
+    # continuation to propose
+    ngram_lookup: int = 2
+
     # runtime
     # AOT warmup: precompile every prefill bucket + decode window before the
     # worker flips /ready — the XLA analogue of the reference's TRT engine
@@ -114,6 +126,10 @@ class EngineConfig:
         p.add_argument("--ep", type=int, default=1)
         p.add_argument("--moe-capacity-factor", type=float, default=0.0)
         p.add_argument("--num-scheduler-steps", type=int, default=1)
+        p.add_argument("--speculative-mode", default="off",
+                       choices=["off", "ngram"])
+        p.add_argument("--num-speculative-tokens", type=int, default=4)
+        p.add_argument("--ngram-lookup", type=int, default=2)
         p.add_argument("--async-scheduling",
                        action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("--enable-prefix-caching",
@@ -163,6 +179,9 @@ class EngineConfig:
             expert_parallel=args.ep,
             moe_capacity_factor=args.moe_capacity_factor,
             num_scheduler_steps=args.num_scheduler_steps,
+            speculative_mode=getattr(args, "speculative_mode", "off"),
+            num_speculative_tokens=getattr(args, "num_speculative_tokens", 4),
+            ngram_lookup=getattr(args, "ngram_lookup", 2),
             async_scheduling=getattr(args, "async_scheduling", True),
             enable_prefix_caching=getattr(args, "enable_prefix_caching",
                                           True),

@@ -125,6 +125,10 @@ class EngineMetrics:
         self.prefill_time_s = 0.0
         self.decode_time_s = 0.0
         self.kv_oom = 0
+        # speculative decoding: drafts offered vs accepted (acceptance rate
+        # = accepted / drafted; bonus tokens not counted in either)
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
         self.phases: Dict[str, PhaseTimer] = {p: PhaseTimer()
                                               for p in self._PHASES}
 
@@ -384,6 +388,52 @@ class Engine:
             (True, True): make_decode_window(n_multi, True),
         }
 
+        def spec_fn(params, tokens, drafts, positions, context_lens, active,
+                    block_tables, temperature, top_p, top_k, presence,
+                    frequency, slot_keys, counts, room, k_pages, v_pages):
+            """One speculative verify step: current + K draft tokens through
+            a single forward, longest-prefix acceptance for pure-greedy
+            slots, the normal sampler for the rest (they emit one token per
+            verify step). Per-request output is IDENTICAL to sequential
+            decoding: accepted drafts match the greedy chain by
+            construction, and position-0 sampling uses the same
+            fold_in(slot_key, position) key the one-token path uses."""
+            b, k = drafts.shape
+            k1 = k + 1
+            toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            out = llama.decode_verify(
+                mcfg, params, toks, positions, block_tables, room,
+                k_pages, v_pages, page_size=page_size,
+            )
+            state = smp.SamplingState(
+                temperature, top_p, top_k, presence, frequency
+            )
+            keys = smp.fold_positions(slot_keys, positions)
+            t0 = smp.sample(out.logits[:, 0], state, keys, counts)
+            greedy_all = jnp.argmax(
+                out.logits.astype(jnp.float32), axis=-1
+            )  # [B, K1]
+            # acceptance only where sampling is pure greedy (no temperature,
+            # no penalties): there sample() == argmax, so the accepted chain
+            # reproduces sequential decoding exactly
+            eligible = ((temperature <= 0.0) & (presence == 0.0)
+                        & (frequency == 0.0) & room & active)
+            match = drafts == greedy_all[:, :-1]
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
+            n_acc = jnp.where(eligible, acc.sum(axis=1), 0)
+            emitted = jnp.concatenate([t0[:, None], greedy_all[:, 1:]], axis=1)
+            emit_mask = ((jnp.arange(k1)[None, :] <= n_acc[:, None])
+                         & active[:, None])
+            rows = jnp.repeat(jnp.arange(b), k1)
+            counts = counts.at[rows, emitted.reshape(-1)].add(
+                emit_mask.reshape(-1).astype(counts.dtype)
+            )
+            step = jnp.where(active, n_acc + 1, 0).astype(positions.dtype)
+            last = jnp.take_along_axis(emitted, n_acc[:, None], axis=1)[:, 0]
+            tokens_new = jnp.where(active, last, tokens)
+            return (rep((emitted, n_acc)), tokens_new, positions + step,
+                    context_lens + step, counts, out.k_pages, out.v_pages)
+
         def sample_first(logits, temperature, top_p, top_k, req_key, pos):
             """First-token sampling after prefill: logits [V] for one request.
             Penalties don't apply (no output yet); logprobs always computed
@@ -425,6 +475,7 @@ class Engine:
             self._prefill = ctx(prefill_fn)
             self._prefill_chunk = ctx(chunk_fn)
             self._windows = {k: ctx(f) for k, f in window_fns.items()}
+            self._spec = ctx(spec_fn)
             self._sample_first = ctx(sample_first)
             self._reset_count = ctx(reset_count_fn)
             self._import = ctx(import_fn)
@@ -439,12 +490,14 @@ class Engine:
             jc = jax.jit(chunk_fn, donate_argnums=(4, 5))
             jw = {k: jax.jit(f, donate_argnums=window_donate)
                   for k, f in window_fns.items()}
+            jspec = jax.jit(spec_fn, donate_argnums=(1, 3, 4, 13, 15, 16))
             js = jax.jit(sample_first)
             jr = jax.jit(reset_count_fn, donate_argnums=(0,))
             ji = jax.jit(import_fn, donate_argnums=(0, 1))
             self._prefill = ctx(jp)
             self._prefill_chunk = ctx(jc)
             self._windows = {k: ctx(f) for k, f in jw.items()}
+            self._spec = ctx(jspec)
             self._sample_first = ctx(js)
             self._reset_count = ctx(jr)
             self._import = ctx(ji)
@@ -462,6 +515,8 @@ class Engine:
                                  "reset_count": jr, "import": ji,
                                  **{f"window_{m}_{l}": f
                                     for (m, l), f in jw.items()}}
+            if cfg.speculative_mode != "off":
+                self._jit_handles["spec"] = jspec
 
     def reset_metrics(self) -> None:
         """Fresh metrics (post-warmup, bench phase boundaries)."""
@@ -635,7 +690,9 @@ class Engine:
             else:
                 events.extend(self._admit())
             if self.seqs:
-                if self.cfg.async_scheduling:
+                if self.cfg.speculative_mode != "off":
+                    events.extend(self._decode_spec())
+                elif self.cfg.async_scheduling:
                     events.extend(self._decode_async())
                 else:
                     events.extend(self._decode_once())
@@ -801,6 +858,7 @@ class Engine:
             ),
             logprobs=req.logprobs,
         )
+        seq.prompt_ids = list(req.prompt_token_ids)
         seq.output_tokens.append(first)
         self.seqs[slot] = seq
         self.block_tables[slot, :] = 0
@@ -968,17 +1026,23 @@ class Engine:
         async window is in flight over those pages), where 0 is returned so
         the caller drains the pipeline first."""
         cfg = self.cfg
+        # never provision past the block-table width: positions beyond it
+        # cannot be written (the spec path asks for K+1 ahead uniformly and
+        # handles per-slot shortfall via its room mask)
+        pcap = cfg.max_pages_per_seq - 1
         if window > 1:
             need_total = 0
             for seq in self.seqs.values():
-                last_page = (seq.num_tokens + offset + window - 1) \
-                    // cfg.page_size
+                last_page = min(
+                    (seq.num_tokens + offset + window - 1) // cfg.page_size,
+                    pcap)
                 need_total += max(0, last_page + 1 - len(seq.pages))
             if not self._ensure_pages(need_total):
                 window = 1
 
         for slot, seq in list(self.seqs.items()):
-            last_page = (seq.num_tokens + offset + window - 1) // cfg.page_size
+            last_page = min(
+                (seq.num_tokens + offset + window - 1) // cfg.page_size, pcap)
             need = max(0, last_page + 1 - len(seq.pages))
             if need == 0:
                 continue
@@ -998,6 +1062,102 @@ class Engine:
                 self.block_tables[slot, len(seq.pages) - 1] = page
             self._invalidate_dev(tables_only=True)
         return window
+
+    def _propose_ngram(self, seq: SeqState) -> List[int]:
+        """Prompt-lookup drafts: match the last `ngram_lookup` tokens of the
+        sequence's history (prompt + output) against earlier history and
+        propose the continuation of the most recent match; fall back to
+        repeating the last token (free, and exact inside degenerate loops).
+        Host-side and O(history) per call — speculative mode targets
+        low-batch latency where this is noise."""
+        cfg = self.cfg
+        k = cfg.num_speculative_tokens
+        hist = seq.prompt_ids + seq.output_tokens
+        n = max(1, cfg.ngram_lookup)
+        if len(hist) > n:
+            pat = hist[-n:]
+            for i in range(len(hist) - n - 1, -1, -1):
+                if hist[i:i + n] == pat:
+                    cont = hist[i + n:i + n + k]
+                    if cont:
+                        return (cont + [hist[-1]] * k)[:k]
+                    break
+        return [hist[-1] if hist else 0] * k
+
+    def _decode_spec(self) -> List[TokenEvent]:
+        """Speculative decode step: one verify dispatch emits 1..K+1 tokens
+        per greedy sequence (vLLM/TRT-LLM's n-gram speculation analogue).
+        Logprobs requests fall back to the classic window path for the step
+        (per-position logprob extraction is not wired through verify)."""
+        if any(s.logprobs is not None for s in self.seqs.values()):
+            return self._decode_once()
+        events: List[TokenEvent] = []
+        cfg = self.cfg
+        k = cfg.num_speculative_tokens
+        k1 = k + 1
+        got = self._grow_pages(k1, events)
+        if not self.seqs:
+            return events
+        limit = min(cfg.max_seq_len,
+                    cfg.max_pages_per_seq * cfg.page_size)
+        drafts = np.zeros((cfg.max_num_seqs, k), np.int32)
+        room = np.zeros((cfg.max_num_seqs,), np.bool_)
+        for slot, seq in self.seqs.items():
+            # draft only for slots whose acceptance can be nonzero: pure
+            # greedy (the device forces n_acc = 0 for everything else)
+            greedy = (seq.temperature <= 0.0 and self.presence[slot] == 0.0
+                      and self.frequency[slot] == 0.0)
+            if (got == k1 and greedy and seq.num_tokens + k1 <= limit
+                    and len(seq.pages) * cfg.page_size >= seq.num_tokens + k1):
+                room[slot] = True
+                drafts[slot] = self._propose_ngram(seq)
+
+        t0 = time.monotonic()
+        self._ensure_dev_state()
+        cur, pos, ctx_lens, active_dev = self._dev_state
+        temp, top_p, top_k, pres, freq, keys = self._dev_sampling
+        d_drafts, d_room = self._upload(drafts, room)
+        (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
+         self.v_pages) = self._spec(
+            self.params, cur, d_drafts, pos, ctx_lens, active_dev,
+            self._dev_tables, temp, top_p, top_k, pres, freq, keys,
+            self.token_counts, d_room, self.k_pages, self.v_pages,
+        )
+        self._dev_state = (cur, pos, ctx_lens, active_dev)
+        slots = list(self.seqs)
+        emitted_np = np.asarray(ys[0])  # [B, K1]
+        nacc_np = np.asarray(ys[1])  # [B]
+        dt = time.monotonic() - t0
+        total = sum(int(nacc_np[s]) + 1 for s in slots)
+        self.metrics.decode_steps += 1
+        self.metrics.decode_time_s += dt
+        self.metrics.spec_draft_tokens += int(room[slots].sum()) * k
+        self.metrics.spec_accepted_tokens += int(nacc_np[slots].sum())
+        self.metrics.observe_phase("decode_window", dt)
+        self.metrics.observe_phase("decode_step", dt / max(1, -(-total //
+                                                                len(slots))))
+        for slot in slots:
+            seq = self.seqs.get(slot)
+            if seq is None:
+                continue
+            for j in range(int(nacc_np[slot]) + 1):
+                tok = int(emitted_np[slot, j])
+                seq.num_tokens += 1
+                seq.output_tokens.append(tok)
+                self.cur_tokens[slot] = tok
+                self.metrics.output_tokens += 1
+                finished, reason = self._check_stop(seq, tok)
+                events.append(TokenEvent(
+                    seq.request_id, tok, len(seq.output_tokens) - 1,
+                    finished, reason,
+                ))
+                if finished:
+                    # mid-chain stop: later accepted tokens are discarded;
+                    # _finish_slot invalidates device state, so the stale
+                    # advanced position is rebuilt from mirrors next step
+                    self._finish_slot(slot, reason)
+                    break
+        return events
 
     def _decode_once(self) -> List[TokenEvent]:
         """Synchronous decode: dispatch one window and read it back."""
@@ -1047,15 +1207,14 @@ class Engine:
                 events.extend(self._materialize_pending())
         return events
 
-    def _dispatch_window(self, window: int) -> None:
-        t0 = time.monotonic()
-        cfg = self.cfg
+    def _ensure_dev_state(self) -> None:
+        """Rebuild invalidated device batch state from the host mirrors.
 
-        # rebuild invalidated device state from the host mirrors. Uploads go
-        # through the jitted identity `_upload` so the arrays carry the SAME
-        # sharding provenance as decode-window outputs — a plain jnp.asarray
-        # (uncommitted) input would key a second compilation of every window
-        # variant for the rebuild-following call.
+        Uploads go through the jitted identity `_upload` so the arrays carry
+        the SAME sharding provenance as decode-window outputs — a plain
+        jnp.asarray (uncommitted) input would key a second compilation of
+        every window variant for the rebuild-following call."""
+        cfg = self.cfg
         if self._dev_state is None:
             active = set(self.seqs)
             for slot in range(cfg.max_num_seqs):
@@ -1084,6 +1243,9 @@ class Engine:
                 self.presence, self.frequency, self.slot_keys,
             )
 
+    def _dispatch_window(self, window: int) -> None:
+        t0 = time.monotonic()
+        self._ensure_dev_state()
         want_lp = any(s.logprobs is not None for s in self.seqs.values())
         cur, pos, ctx_lens, active_dev = self._dev_state
         temp, top_p, top_k, pres, freq, keys = self._dev_sampling

@@ -230,7 +230,7 @@ class SeqState:
     __slots__ = (
         "request_id", "slot", "pages", "num_tokens", "output_tokens",
         "max_tokens", "temperature", "top_p", "top_k", "stop_token_ids",
-        "prompt_len", "logprobs",
+        "prompt_len", "logprobs", "prompt_ids",
     )
 
     def __init__(
@@ -258,6 +258,9 @@ class SeqState:
         self.top_k = top_k
         self.stop_token_ids = stop_token_ids or []
         self.logprobs = logprobs
+        # prompt token ids, retained for the n-gram speculative proposer
+        # (engine._propose_ngram fills it at slot installation)
+        self.prompt_ids: List[int] = []
 
     def needs_page(self, page_size: int) -> bool:
         """Will the next decoded token spill onto a new page?"""

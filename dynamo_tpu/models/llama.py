@@ -295,6 +295,73 @@ class DecodeOut(NamedTuple):
     v_pages: jax.Array
 
 
+class VerifyOut(NamedTuple):
+    logits: jax.Array  # [B, K1, V] — logits at every query position
+    k_pages: jax.Array
+    v_pages: jax.Array
+
+
+def decode_verify(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, K1] current token + K speculative drafts
+    positions: jax.Array,  # [B] absolute position of tokens[:, 0]
+    block_tables: jax.Array,  # [B, Pmax]
+    room: jax.Array,  # [B] bool: pages/limits cover all K draft writes
+    k_pages: jax.Array,  # [L, P, ps, KV*D]
+    v_pages: jax.Array,
+    *,
+    page_size: int,
+) -> VerifyOut:
+    """Speculative-decoding verification step: run current + K draft tokens
+    per sequence through one forward, returning logits at every position so
+    the sampler can accept the longest draft prefix the model agrees with
+    (vLLM/TRT-LLM ship the same capability on the reference's engines).
+
+    Draft K/V is written into the sequence's pages before attending (like
+    prefill_chunk); rejected drafts leave garbage K/V past the accepted
+    context length, which is masked by every later attention and overwritten
+    when real tokens reach those positions. Slots without `room` (end of
+    page table / near max_seq_len) divert their DRAFT writes to the trash
+    page and behave as a plain decode step for position 0; the engine
+    forces their acceptance count to zero.
+    """
+    b, k1 = tokens.shape
+    pos2 = positions[:, None] + jnp.arange(k1)[None, :]  # [B, K1]
+    flat_pos = pos2.reshape(b * k1)
+    flat_tables = jnp.repeat(block_tables, k1, axis=0)  # [B*K1, Pmax]
+    # j == 0 (the real current token) always writes; draft rows of a
+    # roomless slot target the trash page at position 0 instead of running
+    # off the page table (take_along_axis would clamp into the last page)
+    valid = (jnp.arange(b * k1) % k1 == 0) | jnp.repeat(room, k1)
+    flat_pos = jnp.where(valid, flat_pos, 0)
+    flat_tables = jnp.where(valid[:, None], flat_tables, 0)
+    x = quant.take_rows(params["embed"], tokens.reshape(b * k1), _dtype(cfg))
+
+    def body(x, scanned):
+        lp, kp, vp = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h, flat_pos)  # [B*K1, H, D], [B*K1, KV, D]
+        kp, vp = att.write_kv_token(
+            kp, vp, k, v, flat_tables, flat_pos, page_size=page_size
+        )
+        o = att.verify_attention(
+            q.reshape(b, k1, *q.shape[1:]), kp, vp, block_tables, positions,
+            page_size=page_size,
+        )
+        x = x + qeinsum("bhd,hde->be", o.reshape(b * k1, *o.shape[2:]),
+                        lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, lp, h)
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        body, x, (_layer_params(params), k_pages, v_pages)
+    )
+    logits = _logits(cfg, params, x).reshape(b, k1, -1)
+    return VerifyOut(logits, k_pages, v_pages)
+
+
 def decode_step(
     cfg: ModelConfig,
     params: Params,

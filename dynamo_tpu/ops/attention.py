@@ -306,6 +306,44 @@ def chunk_attention(
     return jnp.einsum("hcs,shd->chd", probs, v)
 
 
+def verify_attention(
+    q: jax.Array,  # [B, K1, H, D] — current token + K draft tokens per seq
+    k_pages: jax.Array,  # [P, ps, KV*D]
+    v_pages: jax.Array,
+    block_table: jax.Array,  # [B, Pmax]
+    positions: jax.Array,  # [B] absolute position of q[:, 0]
+    *,
+    page_size: int,
+) -> jax.Array:
+    """Speculative-verification attention: query j of sequence b sits at
+    absolute position `positions[b] + j` and attends causally over the
+    sequence's cached pages (which already contain the draft tokens' K/V —
+    the verify forward writes before attending, like prefill_chunk).
+
+    The batched analogue of chunk_attention's XLA gather path: one page
+    gather serves all K1 queries of a sequence. K1 is small (typically <=
+    8), so the [B, H, K1, S] score tensor stays modest; spec decode targets
+    low-batch latency where bandwidth, not score memory, is the limit.
+    Inactive slots carry zero block tables + position 0: their queries
+    attend only the trash page and are discarded by the engine.
+    """
+    b, k1, n_heads, head_dim = q.shape
+    n_kv = k_pages.shape[2] // head_dim
+    w = block_table.shape[1]
+    s_ctx = w * page_size
+    k = k_pages[block_table].reshape(b, s_ctx, n_kv, head_dim)
+    v = v_pages[block_table].reshape(b, s_ctx, n_kv, head_dim)
+    k = repeat_kv(k, n_heads // n_kv, axis=2)
+    v = repeat_kv(v, n_heads // n_kv, axis=2)
+    scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+    scores = jnp.einsum("bqhd,bshd->bhqs", q * scale, k)
+    qpos = positions[:, None, None, None] + jnp.arange(k1)[None, None, :, None]
+    spos = jnp.arange(s_ctx)[None, None, None, :]
+    scores = jnp.where(spos <= qpos, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqs,bshd->bqhd", probs, v)
+
+
 # --------------------------------------------------------------- dispatch --
 
 

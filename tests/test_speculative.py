@@ -1,0 +1,148 @@
+"""Speculative decoding (n-gram / prompt-lookup drafts + one-forward verify).
+
+The contract under test: per-request output is IDENTICAL to sequential
+decoding — accepted drafts reproduce the greedy chain by construction, and
+every other slot still gets its one normally-sampled token per verify step.
+The reference's engines (vLLM / TRT-LLM) ship the same capability.
+"""
+
+from typing import List
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.kv_cache import SeqState
+from dynamo_tpu.engine.request import GenRequest
+
+PROMPT = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]
+
+
+def make_engine(spec="ngram", **kw):
+    cfg = dict(
+        model="tiny-debug", page_size=4, num_pages=128, max_num_seqs=2,
+        max_seq_len=256, speculative_mode=spec, num_speculative_tokens=4,
+        prefill_chunk_tokens=0, enable_prefix_caching=False,
+    )
+    cfg.update(kw)
+    return Engine(EngineConfig(**cfg))
+
+
+def gen(eng, prompt=PROMPT, mt=24, temp=0.0, seed=None, **kw) -> List[int]:
+    return eng.generate(GenRequest("r", prompt, max_tokens=mt,
+                                   temperature=temp, seed=seed,
+                                   ignore_eos=True, **kw))
+
+
+def test_greedy_parity():
+    assert gen(make_engine("off")) == gen(make_engine("ngram"))
+
+
+def test_sampled_parity_seeded():
+    a = gen(make_engine("off"), temp=0.8, seed=42)
+    b = gen(make_engine("ngram"), temp=0.8, seed=42)
+    assert a == b
+
+
+def test_parity_with_chunked_prefill_and_prefix_cache():
+    kw = dict(prefill_chunk_tokens=8, enable_prefix_caching=True)
+    prompt = list(range(1, 30))
+    a = gen(make_engine("off", **kw), prompt=prompt)
+    b = gen(make_engine("ngram", **kw), prompt=prompt)
+    assert a == b
+
+
+def _oracle(eng, ref):
+    """Draft the true continuation: acceptance must then be near-total."""
+    k = eng.cfg.num_speculative_tokens
+
+    def propose(seq):
+        cont = ref[len(seq.output_tokens):len(seq.output_tokens) + k]
+        return (cont + [0] * k)[:k]
+
+    eng._propose_ngram = propose
+
+
+def test_oracle_drafts_accept_and_match():
+    ref = gen(make_engine("off"))
+    eng = make_engine("ngram")
+    _oracle(eng, ref)
+    out = gen(eng)
+    m = eng.metrics
+    assert out == ref
+    assert m.spec_accepted_tokens > len(ref) // 2
+    # 24 tokens in <= ceil(24/5)+1 verify steps instead of 23 decode steps
+    assert m.decode_steps <= len(ref) // (eng.cfg.num_speculative_tokens + 1) + 2
+
+
+def test_mid_chain_stop_token():
+    ref = gen(make_engine("off"), mt=24)
+    stop = ref[7]  # a token the chain will emit mid-verify
+
+    def gen_stop(eng):
+        # ignore_eos discards stop_token_ids (it means "no stop tokens"), so
+        # this test passes the stop list with ignore_eos off
+        return eng.generate(GenRequest("r", PROMPT, max_tokens=24,
+                                       temperature=0.0,
+                                       stop_token_ids=[stop]))
+
+    a = gen_stop(make_engine("off"))
+    eng = make_engine("ngram")
+    _oracle(eng, ref)
+    b = gen_stop(eng)
+    assert a == b
+    assert b[-1] == stop and len(b) == 8
+
+
+def test_max_tokens_respected_despite_chain():
+    ref = gen(make_engine("off"), mt=7)
+    eng = make_engine("ngram")
+    _oracle(eng, gen(make_engine("off"), mt=24))
+    out = gen(eng, mt=7)
+    assert out == ref and len(out) == 7
+
+
+def test_room_exhaustion_near_max_seq_len():
+    # max_seq_len barely above prompt: chains must clamp without crashing
+    kw = dict(max_seq_len=20, num_pages=32)
+    a = gen(make_engine("off", **kw), mt=16)
+    b = gen(make_engine("ngram", **kw), mt=16)
+    assert a == b
+
+
+def test_mixed_batch_parity():
+    """A greedy and a seeded-sampled request decoding concurrently produce
+    the same tokens as the off engine (per-slot key chains make sampling
+    independent of batch composition)."""
+
+    def run(spec):
+        eng = make_engine(spec)
+        eng.add_request(GenRequest("g", PROMPT, max_tokens=12,
+                                   temperature=0.0, ignore_eos=True))
+        eng.add_request(GenRequest("s", PROMPT, max_tokens=12,
+                                   temperature=0.9, seed=7, ignore_eos=True))
+        out = {"g": [], "s": []}
+        while eng.has_work:
+            for ev in eng.step():
+                if ev.token_id >= 0:
+                    out[ev.request_id].append(ev.token_id)
+        return out
+
+    assert run("off") == run("ngram")
+
+
+def test_ngram_proposer():
+    eng = make_engine("ngram", ngram_lookup=2)
+    seq = SeqState("r", 0, [1], prompt_len=6, max_tokens=8)
+    seq.prompt_ids = [1, 2, 3, 9, 1, 2]
+    seq.output_tokens = []
+    # last 2 = (1, 2); earlier match at index 0 -> continuation [3, 9, 1, 2]
+    assert eng._propose_ngram(seq) == [3, 9, 1, 2]
+    # no match -> repeat last token
+    seq.prompt_ids = [4, 5, 6, 7]
+    assert eng._propose_ngram(seq) == [7, 7, 7, 7]
+
+
+def test_acceptance_metrics_exposed():
+    eng = make_engine("ngram")
+    gen(eng)
+    snap = eng.metrics.snapshot()
+    assert "spec_draft_tokens" in snap and "spec_accepted_tokens" in snap

@@ -1112,6 +1112,13 @@ class Engine:
                 room[slot] = True
                 drafts[slot] = self._propose_ngram(seq)
 
+        if not room.any():
+            # nothing drafted (all-sampled batch, page shortfall): the
+            # verify forward would cost (K+1)x a decode step to emit the
+            # same one token per slot — use the plain window path instead
+            events.extend(self._decode_once())
+            return events
+
         t0 = time.monotonic()
         self._ensure_dev_state()
         cur, pos, ctx_lens, active_dev = self._dev_state
@@ -1329,6 +1336,15 @@ class Engine:
         self.allocator.free(seq.pages)
         self.block_tables[slot, :] = 0
         self.context_lens[slot] = 0
+        # reset the slot's sampling mirrors: the tiered sampler's fast-path
+        # gates (all-greedy / no-mask / no-penalty) read the FULL [B]
+        # arrays, so one finished temperature>0 request must not force the
+        # sort path on every later all-greedy batch
+        self.temperature[slot] = 0.0
+        self.top_p[slot] = 1.0
+        self.top_k[slot] = 0
+        self.presence[slot] = 0.0
+        self.frequency[slot] = 0.0
         self._free_slots.append(slot)
         self.metrics.num_finished += 1
         # the freed slot's device-side block-table row must stop pointing at

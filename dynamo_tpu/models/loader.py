@@ -52,7 +52,7 @@ def load_or_init_params(
 
     if quantization in (None, "none", ""):
         return _load()
-    if quantization != "int8":
+    if quantization not in ("int8", "w8a8"):
         raise ValueError(f"unknown quantization {quantization!r}")
     from dynamo_tpu.models import quant
 
@@ -66,15 +66,15 @@ def load_or_init_params(
         # the host and quantizing it (an hour-scale detour for the 8B bench
         # model). Small models keep init+quantize so int8 stays
         # token-parity-testable against the fp engine.
-        return random_quantized_params(cfg, seed)
+        return random_quantized_params(cfg, seed, mode=quantization)
     cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
         params = _load()
-        return quant.quantize_params(params)
+        return quant.quantize_params(params, mode=quantization)
 
 
-def random_quantized_params(cfg: ModelConfig, seed: int = 0
-                            ) -> Dict[str, jax.Array]:
+def random_quantized_params(cfg: ModelConfig, seed: int = 0,
+                            mode: str = "int8") -> Dict[str, jax.Array]:
     """Seeded random int8 params, generated directly as QTensors.
 
     Statistically equivalent to init + quantize (int8 values uniform over the
@@ -84,6 +84,7 @@ def random_quantized_params(cfg: ModelConfig, seed: int = 0
     from dynamo_tpu.models import quant
 
     dt = jnp.dtype(cfg.dtype)
+    cls = quant.qtensor_class(mode)
     rng = np.random.Generator(np.random.PCG64(seed))
     p: Dict[str, jax.Array] = {}
     # pin to host like the quantize path: the int8 tree crosses to the
@@ -105,7 +106,7 @@ def random_quantized_params(cfg: ModelConfig, seed: int = 0
                 sshape = tuple(1 if i in quant.QUANT_AXES[name] else s
                                for i, s in enumerate(shape))
                 scale = np.full(sshape, sigma * 4.5 / 127.0, dtype=np.float32)
-                p[name] = quant.QTensor(jnp.asarray(q), jnp.asarray(scale))
+                p[name] = cls(jnp.asarray(q), jnp.asarray(scale))
             else:
                 # unquantized weight (router etc.): small enough for normals
                 p[name] = jnp.asarray(

@@ -68,7 +68,9 @@ def param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
                 None if x.scale.shape[i] == 1 else rule[i]
                 for i in range(x.scale.ndim)
             ))
-            return QTensor(rule, scale_rule)
+            # preserve the subclass (QTensorA8): pytree node types must
+            # match the param tree's for spec/param tree.map pairing
+            return type(x)(rule, scale_rule)
         if name in PARAM_RULES:
             return PARAM_RULES[name]
         return P(*([None] * x.ndim))

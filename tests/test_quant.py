@@ -116,3 +116,54 @@ def test_engine_int8_matches_fp_greedy():
             "r", [1, 2, 3, 4, 5], max_tokens=8, temperature=0.0,
             ignore_eos=True))
     assert run("int8") == run("none")
+
+
+# ------------------------------------------------------------------- w8a8 --
+
+
+@pytest.mark.parametrize("spec,xs,ws,axes", [
+    ("te,ehd->thd", (5, 8), (8, 4, 16), (0,)),
+    ("thd,hde->te", (5, 4, 16), (4, 16, 8), (0, 1)),
+    ("te,ef->tf", (5, 8), (8, 12), (0,)),
+    ("te,ve->tv", (5, 8), (30, 8), (1,)),
+])
+def test_w8a8_einsum_close_to_dequantized(spec, xs, ws, axes):
+    """W8A8 adds per-token activation rounding on top of weight rounding;
+    the result must stay within the combined quantization error of the
+    dequantized reference."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=xs), jnp.float32)
+    w = jnp.asarray(rng.normal(size=ws), jnp.float32)
+    qt = quant.quantize(w, axes, cls=quant.QTensorA8)
+    ref = jnp.einsum(spec, x, qt.q.astype(jnp.float32)
+                     * qt.scale.astype(jnp.float32))
+    got = quant.einsum(spec, x, qt)
+    ref_n, got_n = np.asarray(ref).ravel(), np.asarray(got).ravel()
+    cos = np.dot(ref_n, got_n) / (
+        np.linalg.norm(ref_n) * np.linalg.norm(got_n) + 1e-12)
+    assert cos > 0.999, cos
+
+
+def test_w8a8_sharding_specs_preserve_subclass():
+    from dynamo_tpu.parallel import sharding as shd
+
+    cfg = ModelConfig.from_model_name("tiny-debug", dtype="float32")
+    from dynamo_tpu.models.loader import load_or_init_params
+
+    p = load_or_init_params(cfg, None, 0, "w8a8")
+    specs = shd.param_specs(p)
+    assert isinstance(p["wq"], quant.QTensorA8)
+    assert isinstance(specs["wq"], quant.QTensorA8)  # type mirrors the tree
+
+
+def test_engine_w8a8_generates():
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    eng = Engine(EngineConfig(
+        model="tiny-debug", quantization="w8a8", page_size=4, num_pages=64,
+        max_num_seqs=2, max_seq_len=64))
+    out = eng.generate(GenRequest("r", [1, 2, 3, 4, 5], max_tokens=8,
+                                  temperature=0.0, ignore_eos=True))
+    assert len(out) == 8 and all(t >= 0 for t in out)

@@ -181,3 +181,24 @@ def test_penalty_state_resets_between_requests(eng):
                               temperature=1.0, seed=9, ignore_eos=True)])
     again = _collect(eng, [req("r2")])
     assert _tokens(first["r1"]) == _tokens(again["r2"])
+
+
+def test_finish_resets_sampling_mirrors():
+    """A finished sampled request must not leave stale sampling params in
+    its slot: the tiered sampler's fast-path gates read the full [B]
+    mirrors, so stale values would force the sort path on every later
+    all-greedy batch."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4, num_pages=64,
+                              max_num_seqs=2, max_seq_len=64))
+    eng.generate(GenRequest("s", [1, 2, 3], max_tokens=3, temperature=0.9,
+                            top_p=0.5, top_k=7, presence_penalty=1.0,
+                            frequency_penalty=0.5, seed=1, ignore_eos=True))
+    assert (eng.temperature == 0.0).all()
+    assert (eng.top_p == 1.0).all()
+    assert (eng.top_k == 0).all()
+    assert (eng.presence == 0.0).all()
+    assert (eng.frequency == 0.0).all()

@@ -18,7 +18,9 @@ transiently-down tunnel.
 
 Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_STEPS, BENCH_PROMPT_LEN,
 BENCH_MULTISTEP (fused decode steps per dispatch; 1 disables),
-BENCH_QUANT (with BENCH_MODEL: none|int8),
+BENCH_QUANT (with BENCH_MODEL: none|int8|w8a8 — w8a8 is the fast
+quantized mode and the v5e headline default; int8 is weight-only),
+BENCH_TRACE=DIR (capture a jax.profiler/XProf trace of the timed loop),
 BENCH_FORCE_CPU, BENCH_SECONDARY=0 to skip the secondary run,
 BENCH_INIT_BUDGET_S (accelerator retry budget, default 300).
 """
@@ -188,6 +190,11 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     # decode phases so ITL percentiles exclude the batch ramp-up steps
     eng.metrics.reset_phases("decode_window", "decode_step")
 
+    trace_dir = os.environ.get("BENCH_TRACE")
+    if trace_dir:
+        # capture the steady-state decode loop for XProf (the same capture
+        # /debug/trace serves in workers); parse with xprof hlo_stats
+        jax.profiler.start_trace(trace_dir)
     t0 = time.perf_counter()
     tokens = 0
     steps_before = eng.metrics.decode_steps
@@ -196,6 +203,8 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
             if ev.token_id >= 0:
                 tokens += 1
     dt = time.perf_counter() - t0
+    if trace_dir:
+        jax.profiler.stop_trace()
     decode_steps = eng.metrics.decode_steps - steps_before
 
     tok_s = tokens / dt

@@ -61,13 +61,14 @@ def _next_bucket(n: int, page_size: int, max_len: int) -> int:
 
 
 class PhaseTimer:
-    """Bucketed per-phase latency histogram (log2 buckets, 0.25ms..8s).
+    """Bucketed per-phase latency histogram (quarter-octave log buckets,
+    0.25ms..8s — worst-case quantile error ~9% vs the octave buckets' 2x).
 
     The in-engine observability VERDICT/SURVEY §5 call for: per-phase
     step-time distributions (not just cumulative sums), cheap enough to run
     always-on in the hot loop."""
 
-    _EDGES_MS = [0.25 * 2 ** i for i in range(16)]  # 0.25ms .. ~8.2s
+    _EDGES_MS = [0.25 * 2 ** (i / 4) for i in range(61)]  # 0.25ms .. ~8.2s
 
     def __init__(self):
         self.count = 0
@@ -81,14 +82,17 @@ class PhaseTimer:
         if seconds > self.max_s:
             self.max_s = seconds
         ms = seconds * 1e3
-        for i, edge in enumerate(self._EDGES_MS):
-            if ms <= edge:
-                self.buckets[i] += 1
-                return
-        self.buckets[-1] += 1
+        lo, hi = 0, len(self._EDGES_MS)
+        while lo < hi:  # first edge >= ms (binary search; 61 edges)
+            mid = (lo + hi) // 2
+            if ms <= self._EDGES_MS[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        self.buckets[lo] += 1
 
     def quantile_ms(self, q: float) -> float:
-        """Upper-edge estimate of the q-quantile from the buckets."""
+        """Geometric-midpoint estimate of the q-quantile from the buckets."""
         if self.count == 0:
             return 0.0
         target = q * self.count
@@ -96,7 +100,12 @@ class PhaseTimer:
         for i, n in enumerate(self.buckets):
             seen += n
             if seen >= target:
-                return self._EDGES_MS[min(i, len(self._EDGES_MS) - 1)]
+                if i >= len(self._EDGES_MS):
+                    # overflow bucket: the top edge is a LOWER bound here
+                    return self._EDGES_MS[-1]
+                hi = self._EDGES_MS[i]
+                lo_edge = self._EDGES_MS[i - 1] if i > 0 else hi / 2 ** 0.25
+                return (lo_edge * hi) ** 0.5
         return self._EDGES_MS[-1]
 
     def snapshot(self) -> Dict[str, float]:

@@ -76,9 +76,12 @@ class PhaseTimer:
         self.max_s = 0.0
         self.buckets = [0] * (len(self._EDGES_MS) + 1)
 
-    def observe(self, seconds: float) -> None:
-        self.count += 1
-        self.sum_s += seconds
+    def observe(self, seconds: float, weight: int = 1) -> None:
+        """Record `weight` observations of `seconds` (a fused window's
+        per-step time counts once PER STEP, so a tail of 1-step windows
+        cannot outvote the steady-state windows in the quantiles)."""
+        self.count += weight
+        self.sum_s += seconds * weight
         if seconds > self.max_s:
             self.max_s = seconds
         ms = seconds * 1e3
@@ -89,7 +92,7 @@ class PhaseTimer:
                 hi = mid
             else:
                 lo = mid + 1
-        self.buckets[lo] += 1
+        self.buckets[lo] += weight
 
     def quantile_ms(self, q: float) -> float:
         """Geometric-midpoint estimate of the q-quantile from the buckets."""
@@ -141,8 +144,9 @@ class EngineMetrics:
         self.phases: Dict[str, PhaseTimer] = {p: PhaseTimer()
                                               for p in self._PHASES}
 
-    def observe_phase(self, phase: str, seconds: float) -> None:
-        self.phases[phase].observe(seconds)
+    def observe_phase(self, phase: str, seconds: float,
+                      weight: int = 1) -> None:
+        self.phases[phase].observe(seconds, weight)
 
     def reset_phases(self, *names: str) -> None:
         """Re-zero selected phase histograms (bench section boundaries)."""
@@ -1150,8 +1154,11 @@ class Engine:
         self.metrics.spec_draft_tokens += int(room[slots].sum()) * k
         self.metrics.spec_accepted_tokens += int(nacc_np[slots].sum())
         self.metrics.observe_phase("decode_window", dt)
-        self.metrics.observe_phase("decode_step", dt / max(1, -(-total //
-                                                                len(slots))))
+        # weight = effective steps this verify advanced, so spec verifies
+        # and fused windows carry proportional votes in the shared histogram
+        eff_steps = max(1, -(-total // len(slots)))
+        self.metrics.observe_phase("decode_step", dt / eff_steps,
+                                   weight=eff_steps)
         for slot in slots:
             seq = self.seqs.get(slot)
             if seq is None:
@@ -1301,7 +1308,7 @@ class Engine:
         self.metrics.decode_steps += window
         self.metrics.decode_time_s += dt
         self.metrics.observe_phase("decode_window", dt)
-        self.metrics.observe_phase("decode_step", dt / window)
+        self.metrics.observe_phase("decode_step", dt / window, weight=window)
 
         for slot in slots:
             seq = self.seqs.get(slot)

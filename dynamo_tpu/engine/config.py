@@ -57,6 +57,12 @@ class EngineConfig:
     # (BASELINE.json #3).
     quantization: str = "none"
 
+    # admission batching: up to this many same-bucket full-prefill prompts
+    # run in ONE padded prefill dispatch (amortizes the per-dispatch host
+    # round trip across a burst; 1 disables). Chunked/cached prompts keep
+    # their own paths.
+    max_prefill_batch: int = 4
+
     # chunked prefill: prompts longer than this many tokens are prefetched
     # in fixed-size chunks interleaved with decode windows, bounding the
     # decode stall a long admission causes (the reference's engines chunk
@@ -139,6 +145,7 @@ class EngineConfig:
         p.add_argument("--enable-prefix-caching",
                        action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("--prefill-chunk-tokens", type=int, default=256)
+        p.add_argument("--max-prefill-batch", type=int, default=4)
         p.add_argument("--disaggregation-mode", default="agg",
                        choices=["agg", "prefill", "decode"])
         p.add_argument("--is-prefill-worker", action="store_true")
@@ -190,6 +197,7 @@ class EngineConfig:
             enable_prefix_caching=getattr(args, "enable_prefix_caching",
                                           True),
             prefill_chunk_tokens=getattr(args, "prefill_chunk_tokens", 256),
+            max_prefill_batch=getattr(args, "max_prefill_batch", 4),
             disaggregation_mode=mode,
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,

@@ -325,6 +325,22 @@ class Engine:
             )
             return rep(out.last_logits), out.k_pages, out.v_pages
 
+        def prefill_batch_fn(params, tokens, seq_lens, k_pages, v_pages,
+                             pages):
+            out = llama.prefill_batch(
+                mcfg, params, tokens, seq_lens, k_pages, v_pages, pages,
+                page_size=page_size,
+            )
+            return rep(out.last_logits), out.k_pages, out.v_pages
+
+        def sample_first_batch(logits, temperature, top_p, top_k, keys,
+                               positions):
+            """First tokens for a batched prefill: [N, V] logits with
+            per-lane sampling params and per-request key chains."""
+            state = smp.make_state(temperature, top_p, top_k)
+            folded = smp.fold_positions(keys, positions)
+            return rep(smp.sample_with_logprobs(logits, state, folded))
+
         def chunk_fn(params, tokens, start, chunk_len, k_pages, v_pages,
                      pages):
             out = llama.prefill_chunk(
@@ -486,10 +502,12 @@ class Engine:
 
         if cfg.enforce_eager:
             self._prefill = ctx(prefill_fn)
+            self._prefill_batch = ctx(prefill_batch_fn)
             self._prefill_chunk = ctx(chunk_fn)
             self._windows = {k: ctx(f) for k, f in window_fns.items()}
             self._spec = ctx(spec_fn)
             self._sample_first = ctx(sample_first)
+            self._sample_first_batch = ctx(sample_first_batch)
             self._reset_count = ctx(reset_count_fn)
             self._import = ctx(import_fn)
             self._upload = lambda *xs: tuple(jnp.asarray(x) for x in xs)
@@ -500,6 +518,8 @@ class Engine:
             # reused across windows). tokens/pos/ctx/counts/k/v donated.
             window_donate = (1, 2, 3, 12, 13, 14)
             jp = jax.jit(prefill_fn, donate_argnums=(3, 4))
+            jpb = jax.jit(prefill_batch_fn, donate_argnums=(3, 4))
+            jsb = jax.jit(sample_first_batch)
             jc = jax.jit(chunk_fn, donate_argnums=(4, 5))
             jw = {k: jax.jit(f, donate_argnums=window_donate)
                   for k, f in window_fns.items()}
@@ -508,10 +528,12 @@ class Engine:
             jr = jax.jit(reset_count_fn, donate_argnums=(0,))
             ji = jax.jit(import_fn, donate_argnums=(0, 1))
             self._prefill = ctx(jp)
+            self._prefill_batch = ctx(jpb)
             self._prefill_chunk = ctx(jc)
             self._windows = {k: ctx(f) for k, f in jw.items()}
             self._spec = ctx(jspec)
             self._sample_first = ctx(js)
+            self._sample_first_batch = ctx(jsb)
             self._reset_count = ctx(jr)
             self._import = ctx(ji)
             # jitted upload whose outputs share the sharding provenance of
@@ -524,6 +546,7 @@ class Engine:
                 out_shardings=rep_sharding)
             # raw jitted fns, for warmup verification (compile-cache sizes)
             self._jit_handles = {"prefill": jp, "prefill_chunk": jc,
+                                 "prefill_batch": jpb,
                                  "sample_first": js,
                                  "reset_count": jr, "import": ji,
                                  **{f"window_{m}_{l}": f
@@ -611,6 +634,30 @@ class Engine:
                 self.add_request(r)
                 while self.has_work:  # one at a time: fused window needs
                     self.step()       # an empty pending queue to engage
+            if cfg.max_prefill_batch > 1:
+                # batched-admission variants: enqueue a full same-bucket
+                # burst per groupable bucket so _prefill_group's padded
+                # program compiles before /ready. A bucket is groupable
+                # when SOME prompt length in it passes the runtime
+                # `plen <= chunk` gate — the shortest prompt that still
+                # rounds to this bucket, not the bucket size itself
+                # (chunk can sit mid-bucket).
+                chunk = cfg.prefill_chunk_tokens
+                for bucket in sorted(buckets):
+                    shortest = bucket // 2 + 1 if bucket > cfg.page_size else 1
+                    p = min(bucket, cfg.max_seq_len - 1)
+                    if chunk > 0:
+                        if shortest > chunk:
+                            continue  # every prompt here takes chunked path
+                        p = min(p, chunk)
+                    for lane in range(cfg.max_prefill_batch):
+                        toks = [(bucket * 13 + lane * 5 + j) % 89 + 1
+                                for j in range(p)]
+                        self.add_request(GenRequest(
+                            f"__warm_g{bucket}_{lane}", toks, max_tokens=1,
+                            temperature=0.0, ignore_eos=True))
+                    while self.has_work:
+                        self.step()
         if cfg.disaggregation_mode == "decode":
             with self._exec_lock:
                 idx = jnp.asarray([0], jnp.int32)
@@ -781,6 +828,16 @@ class Engine:
                 # every active stream (FIFO holds: later admissions wait)
                 self._start_inflight(req, cached_pages, n_cached)
                 break
+            group = self._widen_group(req, chunk)
+            if len(group) > 1:
+                got = self._prefill_group(group)
+                if got is None:
+                    # pages vanished between ensure and alloc (shouldn't
+                    # happen with cumulative accounting, but never spin):
+                    # end this admission pass; decode will free pages
+                    break
+                events.extend(got)
+                continue
             try:
                 ev = self._prefill_request(req)
             except OutOfPages:
@@ -791,6 +848,130 @@ class Engine:
                 continue
             events.append(ev)
         return events
+
+    def _widen_group(self, req: GenRequest, chunk: int) -> List[GenRequest]:
+        """Pull further pending same-bucket full-prefill requests into one
+        batched admission (up to max_prefill_batch, bounded by free slots
+        and page supply). Requests on the chunked/cached path stay queued
+        for the normal loop."""
+        cfg = self.cfg
+        group = [req]
+        if cfg.max_prefill_batch <= 1:
+            return group
+        bucket = _next_bucket(len(req.prompt_token_ids), cfg.page_size,
+                              cfg.max_seq_len)
+        # pages the whole group will allocate — INCLUDING the lead request's
+        # (its earlier ensure was against the pool alone; the group's
+        # members must be ensured cumulatively or the later alloc can fail
+        # after every ensure passed)
+        pending_need = max(
+            1, -(-len(req.prompt_token_ids) // cfg.page_size))
+        while (len(group) < cfg.max_prefill_batch
+               and len(self._free_slots) > len(group)):
+            with self._lock:
+                if not self.pending:
+                    break
+                nxt = self.pending[0]
+            plen = len(nxt.prompt_token_ids)
+            if chunk > 0 and plen > chunk:
+                break  # chunked path
+            if _next_bucket(plen, cfg.page_size, cfg.max_seq_len) != bucket:
+                break  # different compile bucket
+            if (self.prefix_cache is not None
+                    and self.prefix_cache.has_prefix(nxt.prompt_token_ids)):
+                break  # cached prefix -> chunked path (normal loop)
+            n_pg = max(1, -(-plen // cfg.page_size))
+            if not self._ensure_pages(pending_need + n_pg):
+                break
+            pending_need += n_pg
+            with self._lock:
+                self.pending.popleft()
+            group.append(nxt)
+        return group
+
+    def _prefill_group(self, reqs: List[GenRequest]
+                       ) -> Optional[List[TokenEvent]]:
+        """One batched prefill dispatch for same-bucket admissions: the
+        per-dispatch host round trip (the dominant short-prompt TTFT cost
+        on networked TPU backends) is paid once for the whole burst.
+        Lanes are padded to max_prefill_batch with dummy all-trash rows so
+        each bucket compiles exactly one batched variant."""
+        cfg = self.cfg
+        t0 = time.monotonic()
+        bucket = _next_bucket(len(reqs[0].prompt_token_ids), cfg.page_size,
+                              cfg.max_seq_len)
+        npad = cfg.max_prefill_batch
+        w = bucket // cfg.page_size
+        tokens = np.zeros((npad, bucket), np.int32)
+        seq_lens = np.ones((npad,), np.int32)
+        pages_arr = np.zeros((npad, w), np.int32)
+        page_lists: List[List[int]] = []
+        try:
+            for i, r in enumerate(reqs):
+                plen = len(r.prompt_token_ids)
+                pages = self.allocator.alloc(
+                    max(1, -(-plen // cfg.page_size)))
+                page_lists.append(pages)
+                tokens[i, :plen] = r.prompt_token_ids
+                seq_lens[i] = plen
+                pages_arr[i, :len(pages)] = pages
+        except OutOfPages:
+            # give everything back and requeue: a later _admit pass retries
+            # (smaller group or singles) once decode frees pages
+            for pl in page_lists:
+                self.allocator.free(pl)
+            with self._lock:
+                for r in reversed(reqs):
+                    self.pending.appendleft(r)
+            return None
+
+        logits, self.k_pages, self.v_pages = self._prefill_batch(
+            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+            self.k_pages, self.v_pages, jnp.asarray(pages_arr),
+        )
+        keys = np.zeros((npad, 2), np.uint32)
+        temp = np.zeros((npad,), np.float32)
+        top_p = np.ones((npad,), np.float32)
+        top_k = np.zeros((npad,), np.int32)
+        for i, r in enumerate(reqs):
+            keys[i] = np.asarray(self._request_key(r), np.uint32)
+            temp[i], top_p[i], top_k[i] = r.temperature, r.top_p, r.top_k
+        toks, chosen, tids, tvals = self._sample_first_batch(
+            logits, jnp.asarray(temp), jnp.asarray(top_p),
+            jnp.asarray(top_k), jnp.asarray(keys),
+            jnp.asarray(seq_lens - 1),
+        )
+        toks_np, chosen_np = np.asarray(toks), np.asarray(chosen)
+        tids_np, tvals_np = np.asarray(tids), np.asarray(tvals)
+        dt = time.monotonic() - t0
+        self.metrics.prefill_time_s += dt
+        self.metrics.observe_phase("prefill", dt, weight=len(reqs))
+
+        events: List[TokenEvent] = []
+        for i, r in enumerate(reqs):
+            self.metrics.prompt_tokens += int(seq_lens[i])
+            events.append(self._finalize_admission(
+                r, page_lists[i], int(seq_lens[i]), int(toks_np[i]), keys[i],
+                (float(chosen_np[i]), tids_np[i], tvals_np[i]),
+            ))
+        return events
+
+    def _finalize_admission(self, req: GenRequest, pages, prompt_len: int,
+                            first: int, req_key, lp) -> TokenEvent:
+        """Shared post-prefill bookkeeping for the single and grouped
+        admission paths: publish the prefix, install the slot, stop-check
+        the first token, decorate logprobs."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt_token_ids, pages)
+        slot = self._free_slots.pop()
+        seq = self._install_slot(req, slot, pages, prompt_len, first, req_key)
+        finished, reason = self._check_stop(seq, first)
+        ev = TokenEvent(req.request_id, first, 0, finished, reason)
+        if req.logprobs is not None:
+            self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
+        if finished:
+            self._finish_slot(slot, reason)
+        return ev
 
     def _request_key(self, req: GenRequest):
         """Per-request PRNG chain root: deterministic when seeded."""
@@ -900,18 +1081,8 @@ class Engine:
 
     def _prefill_request(self, req: GenRequest) -> TokenEvent:
         first, pages, prompt_len, req_key, lp = self._run_prefill(req)
-        if self.prefix_cache is not None:
-            self.prefix_cache.insert(req.prompt_token_ids, pages)
-        slot = self._free_slots.pop()
-        seq = self._install_slot(req, slot, pages, prompt_len, first, req_key)
-
-        finished, reason = self._check_stop(seq, first)
-        ev = TokenEvent(req.request_id, first, 0, finished, reason)
-        if req.logprobs is not None:
-            self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
-        if finished:
-            self._finish_slot(slot, reason)
-        return ev
+        return self._finalize_admission(req, pages, prompt_len, first,
+                                        req_key, lp)
 
     def _ensure_pages(self, n: int) -> bool:
         """can_alloc with prefix-cache eviction as the pressure valve."""

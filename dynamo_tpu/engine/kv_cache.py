@@ -184,6 +184,15 @@ class PrefixCache:
             self.misses += 1
         return pages, len(pages) * self.page_size
 
+    def has_prefix(self, prompt_tokens) -> bool:
+        """True when lookup() would hit — WITHOUT taking references,
+        bumping LRU order, or touching hit/miss statistics (admission
+        grouping peeks to route cached prompts to the chunked path)."""
+        if len(prompt_tokens) <= self.page_size:
+            return False
+        first = self._chain(b"root", prompt_tokens[:self.page_size])
+        return first in self._map
+
     def insert(self, prompt_tokens, pages) -> None:
         """Publish a fully-prefilled prompt's FULL pages. Each newly
         published page gains a cache-owned reference."""

@@ -319,6 +319,68 @@ def prefill_chunk(
     return PrefillOut(logits, k_pages, v_pages)
 
 
+class PrefillBatchOut(NamedTuple):
+    last_logits: jax.Array  # [N, V] logits at each sequence's final token
+    k_pages: jax.Array
+    v_pages: jax.Array
+
+
+def prefill_batch(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [N, S] same-bucket prompts, zero-padded
+    seq_lens: jax.Array,  # [N] true lengths (>= 1; dummy lanes use 1)
+    k_pages: jax.Array,  # [L, P, ps, KV*D]
+    v_pages: jax.Array,
+    pages: jax.Array,  # [N, S // page_size] page ids (trash 0 for padding
+    #                     AND for every page of a dummy lane)
+    *,
+    page_size: int,
+) -> PrefillBatchOut:
+    """Prefill N same-bucket prompts in ONE dispatch.
+
+    Admission batching: under bursty load the per-dispatch host round trip
+    (large on tunneled TPUs) dominates short-prompt TTFT; grouping
+    same-bucket admissions amortizes it N-fold. Attention is the per-seq
+    prefill kernel vmapped over the group; KV writes share one flat
+    scatter (lane i's pages are disjoint by construction). Dummy padding
+    lanes carry all-trash page rows, so their writes land in the reserved
+    page and their logits are discarded by the engine."""
+    n, s = tokens.shape
+    positions = jnp.tile(jnp.arange(s), n)  # [N*S] per-lane positions
+    token_mask = (jnp.arange(s)[None, :] < seq_lens[:, None]).reshape(-1)
+    x = quant.take_rows(params["embed"], tokens.reshape(-1), _dtype(cfg))
+
+    def body(x, kp, vp, lp, page_off):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h, positions)  # [N*S, H/KV, D]
+        o = jax.vmap(
+            lambda qq, kk, vv, sl: att.prefill_attention(qq, kk, vv, sl)
+        )(
+            q.reshape(n, s, *q.shape[1:]),
+            k.reshape(n, s, *k.shape[1:]),
+            v.reshape(n, s, *v.shape[1:]),
+            seq_lens,
+        )
+        x = x + qeinsum("thd,hde->te", o.reshape(n * s, *o.shape[2:]),
+                        lp["wo"])
+        kp, vp = att.write_kv_prefill(
+            kp, vp, k, v, pages.reshape(-1) + page_off, page_size=page_size
+        )
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
+        return x, kp, vp
+
+    x, k_pages, v_pages = _scan_layers_paged(
+        params, body, x, k_pages, v_pages, cfg.num_layers
+    )
+    last = jnp.take_along_axis(
+        x.reshape(n, s, -1), (seq_lens - 1)[:, None, None], axis=1
+    )[:, 0]  # [N, E]
+    logits = _logits(cfg, params, last)
+    return PrefillBatchOut(logits, k_pages, v_pages)
+
+
 class DecodeOut(NamedTuple):
     logits: jax.Array  # [B, V]
     k_pages: jax.Array

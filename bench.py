@@ -21,6 +21,7 @@ BENCH_MULTISTEP (fused decode steps per dispatch; 1 disables),
 BENCH_QUANT (with BENCH_MODEL: none|int8|w8a8 — w8a8 is the fast
 quantized mode and the v5e headline default; int8 is weight-only),
 BENCH_TRACE=DIR (capture a jax.profiler/XProf trace of the timed loop),
+BENCH_KV=int8 (quantized KV-cache pages; halves KV HBM),
 BENCH_FORCE_CPU, BENCH_SECONDARY=0 to skip the secondary run,
 BENCH_INIT_BUDGET_S (accelerator retry budget, default 300).
 """
@@ -153,6 +154,7 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
             max_seq_len=max_seq,
             num_scheduler_steps=multistep,
             quantization=quant,
+            kv_cache_dtype=os.environ.get("BENCH_KV", "auto"),
         ),
         model_cfg=mcfg,
     )

@@ -49,6 +49,14 @@ class EngineConfig:
 
     seed: int = 0
 
+    # KV-cache dtype: auto (the model dtype) | int8 — int8 stores page rows
+    # as quantized values with a bf16 scale per (token, kv-head) packed into
+    # spare lanes of the same row, halving KV HBM footprint and stream (the
+    # binding constraint at the reference SLA's 4000-token ISL,
+    # /root/reference/examples/dgdr/trtllm/dgdr.yaml:23). v1 serves int8 KV
+    # through the XLA attention paths and requires tensor_parallel == 1.
+    kv_cache_dtype: str = "auto"
+
     # quantization: none | int8 (weight-only, per-channel symmetric; exact
     # w.r.t. the stored int8 weights) | w8a8 (same int8 weights plus dynamic
     # per-token int8 activations on the native int8 MXU path — the fast
@@ -157,6 +165,8 @@ class EngineConfig:
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--quantization", default="none",
                        choices=["none", "int8", "w8a8"])
+        p.add_argument("--kv-cache-dtype", default="auto",
+                       choices=["auto", "int8"])
         p.add_argument("--attention-backend", default="auto",
                        choices=["auto", "xla", "pallas", "pallas_interpret"])
         p.add_argument("--warmup", action=argparse.BooleanOptionalAction,
@@ -203,6 +213,7 @@ class EngineConfig:
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,
             seed=args.seed,
             quantization=getattr(args, "quantization", "none"),
+            kv_cache_dtype=getattr(args, "kv_cache_dtype", "auto"),
             attention_backend=args.attention_backend,
             warmup=getattr(args, "warmup", False),
         )

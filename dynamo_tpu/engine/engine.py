@@ -226,8 +226,14 @@ class Engine:
             self.params = shd.shard_params(params, self.mesh)
 
         # --- KV cache ---
+        if cfg.kv_cache_dtype == "int8" and cfg.tensor_parallel > 1:
+            # packed scale lanes don't shard cleanly on the fused lane axis
+            raise ValueError(
+                "kv_cache_dtype=int8 requires tensor_parallel == 1 (the "
+                "packed-scale page layout does not shard on the lane axis)")
         self.kv_spec = KVCacheSpec.from_model(
-            self.model_cfg, cfg.num_pages, cfg.page_size
+            self.model_cfg, cfg.num_pages, cfg.page_size,
+            kv_dtype=cfg.kv_cache_dtype,
         )
         self.k_pages, self.v_pages = alloc_kv_pages(
             self.kv_spec, shd.kv_sharding(self.mesh)
@@ -663,7 +669,7 @@ class Engine:
                 idx = jnp.asarray([0], jnp.int32)
                 one = jnp.zeros(
                     (self.kv_spec.num_layers, 1, cfg.page_size,
-                     self.kv_spec.num_kv_heads * self.kv_spec.head_dim),
+                     self.kv_spec.lane_width),
                     self.k_pages.dtype,
                 )
                 self.k_pages, self.v_pages = self._import(
@@ -1636,6 +1642,17 @@ class Engine:
         cfg = self.cfg
         n_prompt = len(req.prompt_token_ids)
         n_pages = k.shape[1]
+        if (k.shape[-1] != self.kv_spec.lane_width
+                or str(k.dtype) != str(self.k_pages.dtype)):
+            # fail the handshake loudly: a prefill/decode kv_cache_dtype
+            # mismatch must not surface as an opaque XLA shape error inside
+            # the jitted page scatter mid-request
+            raise ValueError(
+                f"transferred KV (dtype={k.dtype}, lanes={k.shape[-1]}) "
+                f"does not match this decode worker's pool "
+                f"(dtype={self.k_pages.dtype}, "
+                f"lanes={self.kv_spec.lane_width}) — prefill and decode "
+                f"roles must use the same --kv-cache-dtype")
         stop_ids = (
             [] if req.ignore_eos
             else (req.stop_token_ids or [self.model_cfg.eos_token_id])

@@ -38,20 +38,37 @@ class KVCacheSpec:
     num_pages: int
     page_size: int
     head_dim: int
-    dtype: str = "bfloat16"
+    dtype: str = "bfloat16"  # "int8" -> packed-scale quantized rows
 
     @staticmethod
     def from_model(
-        cfg: ModelConfig, num_pages: int, page_size: int
+        cfg: ModelConfig, num_pages: int, page_size: int,
+        kv_dtype: str = "auto",
     ) -> "KVCacheSpec":
+        if kv_dtype not in ("auto", "", "int8"):
+            # only exactly "int8" takes the packed-scale quantized path;
+            # any other narrow dtype would silently value-cast KV garbage
+            raise ValueError(
+                f"kv_cache_dtype must be 'auto' or 'int8', got {kv_dtype!r}")
         return KVCacheSpec(
             num_layers=cfg.num_layers,
             num_kv_heads=cfg.num_kv_heads,
             num_pages=num_pages,
             page_size=page_size,
             head_dim=cfg.head_dim,
-            dtype=cfg.dtype,
+            dtype=cfg.dtype if kv_dtype in ("auto", "") else kv_dtype,
         )
+
+    @property
+    def quantized(self) -> bool:
+        return self.dtype == "int8"
+
+    @property
+    def lane_width(self) -> int:
+        from dynamo_tpu.ops.attention import kv_lane_width
+
+        return kv_lane_width(self.num_kv_heads, self.head_dim,
+                             self.quantized)
 
     @property
     def shape(self):
@@ -59,12 +76,12 @@ class KVCacheSpec:
             self.num_layers,
             self.num_pages,
             self.page_size,
-            self.num_kv_heads * self.head_dim,
+            self.lane_width,
         )
 
     def bytes_per_token(self) -> int:
         itemsize = jnp.dtype(self.dtype).itemsize
-        return 2 * self.num_layers * self.num_kv_heads * self.head_dim * itemsize
+        return 2 * self.num_layers * self.lane_width * itemsize
 
 
 def alloc_kv_pages(spec: KVCacheSpec, sharding=None):

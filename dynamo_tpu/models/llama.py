@@ -304,7 +304,8 @@ def prefill_chunk(
             kp, vp, k, v, chunk_pages + page_off, page_size=page_size
         )
         o = att.chunk_attention(
-            q, kp, vp, pages + page_off, start, page_size=page_size
+            q, kp, vp, pages + page_off, start, page_size=page_size,
+            num_kv_heads=cfg.num_kv_heads,
         )
         x = x + qeinsum("bhd,hde->be", o, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -440,6 +441,7 @@ def decode_verify(
         o = att.verify_attention(
             q.reshape(b, k1, *q.shape[1:]), kp, vp,
             block_tables + page_off, positions, page_size=page_size,
+            num_kv_heads=cfg.num_kv_heads,
         )
         x = x + qeinsum("bhd,hde->be", o.reshape(b * k1, *o.shape[2:]),
                         lp["wo"])
@@ -477,7 +479,8 @@ def decode_step(
             kp, vp, k, v, tables, positions, page_size=page_size
         )
         o = att.paged_attention_decode(
-            q, kp, vp, tables, context_lens, page_size=page_size
+            q, kp, vp, tables, context_lens, page_size=page_size,
+            num_kv_heads=cfg.num_kv_heads,
         )
         x = x + qeinsum("bhd,hde->be", o, lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

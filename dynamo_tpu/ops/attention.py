@@ -124,6 +124,72 @@ def repeat_kv(x: jax.Array, n_rep: int, axis: int) -> jax.Array:
     return jnp.repeat(x, n_rep, axis=axis)
 
 
+# ---------------------------------------------------------------- int8 KV --
+# Quantized KV cache: pages store int8 values with a bf16 scale per
+# (token, kv-head) PACKED INTO SPARE LANES of the same page row, so the
+# pool stays ONE array — engine plumbing, transfer, and donation are
+# untouched; only the lane width and dtype change. Layout per row:
+#   [ KV*D int8 values | 2*KV int8 lanes = KV bf16 scales | zero pad ]
+# padded to a 128-lane multiple. Halves KV HBM footprint and stream
+# (the binding constraint at the reference SLA's 4k ISL). v1 serves int8
+# KV through the XLA attention paths; the Pallas kernels keep bf16.
+
+
+def kv_lane_width(n_kv: int, head_dim: int, quantized: bool) -> int:
+    """Lane (last-dim) width of one KV page row."""
+    lanes = n_kv * head_dim
+    if quantized:
+        lanes = -(-(lanes + 2 * n_kv) // 128) * 128
+    return lanes
+
+
+def pack_kv_rows(x: jax.Array, lane_width: int) -> jax.Array:
+    """[T, KV, D] values -> [T, lane_width] int8 rows (see layout above)."""
+    t, kv, d = x.shape
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=2)  # [T, KV]
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.bfloat16)
+    q = jnp.clip(jnp.round(x32 / scale.astype(jnp.float32)[:, :, None]),
+                 -127, 127).astype(jnp.int8)
+    sc8 = jax.lax.bitcast_convert_type(scale, jnp.int8)  # [T, KV, 2]
+    row = jnp.concatenate([q.reshape(t, kv * d), sc8.reshape(t, 2 * kv)],
+                          axis=1)
+    return jnp.pad(row, ((0, 0), (0, lane_width - row.shape[1])))
+
+
+def unpack_kv_rows(rows: jax.Array, n_kv: int, head_dim: int,
+                   dtype) -> jax.Array:
+    """[..., lane_width] int8 rows -> [..., KV, D] dequantized values."""
+    kvd = n_kv * head_dim
+    lead = rows.shape[:-1]
+    q = rows[..., :kvd].reshape(*lead, n_kv, head_dim)
+    sc8 = rows[..., kvd:kvd + 2 * n_kv].reshape(*lead, n_kv, 2)
+    scale = jax.lax.bitcast_convert_type(sc8, jnp.bfloat16)  # [..., KV]
+    return (q.astype(jnp.float32)
+            * scale.astype(jnp.float32)[..., None]).astype(dtype)
+
+
+def _pool_kv_heads(k_pages: jax.Array, head_dim: int,
+                   num_kv_heads) -> int:
+    """KV-head count for a pool: lane width encodes it for bf16 pools;
+    int8 pools (packed scale lanes) need the caller to say."""
+    if k_pages.dtype == jnp.int8:
+        assert num_kv_heads is not None, \
+            "int8 KV pools need explicit num_kv_heads"
+        return num_kv_heads
+    return k_pages.shape[-1] // head_dim
+
+
+def _gather_kv(pages_pool: jax.Array, idx: jax.Array, n_kv: int,
+               head_dim: int, dtype) -> jax.Array:
+    """Gather page rows by id and return [..., ps, KV, D] values
+    (dequantizing int8 pools)."""
+    rows = pages_pool[idx]
+    if pages_pool.dtype == jnp.int8:
+        return unpack_kv_rows(rows, n_kv, head_dim, dtype)
+    return rows.reshape(*rows.shape[:-1], n_kv, head_dim)
+
+
 def write_kv_token(
     k_pages: jax.Array,
     v_pages: jax.Array,
@@ -144,13 +210,16 @@ def write_kv_token(
         block_table, (positions // page_size)[:, None], axis=1
     ).squeeze(1)  # [B]
     slot_idx = positions % page_size  # [B]
-    # advanced indexing over (page, slot) pairs -> rows of [KV*D]
-    k_pages = k_pages.at[page_idx, slot_idx, :].set(
-        k_new.reshape(b, kv * d), mode="drop"
-    )
-    v_pages = v_pages.at[page_idx, slot_idx, :].set(
-        v_new.reshape(b, kv * d), mode="drop"
-    )
+    if k_pages.dtype == jnp.int8:
+        w = k_pages.shape[-1]
+        k_rows = pack_kv_rows(k_new, w)
+        v_rows = pack_kv_rows(v_new, w)
+    else:
+        k_rows = k_new.reshape(b, kv * d)
+        v_rows = v_new.reshape(b, kv * d)
+    # advanced indexing over (page, slot) pairs -> rows of [lane_width]
+    k_pages = k_pages.at[page_idx, slot_idx, :].set(k_rows, mode="drop")
+    v_pages = v_pages.at[page_idx, slot_idx, :].set(v_rows, mode="drop")
     return k_pages, v_pages
 
 
@@ -166,8 +235,13 @@ def write_kv_prefill(
     """Scatter a full (padded) prompt's K/V into its pages."""
     s, kv, d = k_new.shape
     n_pages = s // page_size
-    k_r = k_new.reshape(n_pages, page_size, kv * d)
-    v_r = v_new.reshape(n_pages, page_size, kv * d)
+    if k_pages.dtype == jnp.int8:
+        w = k_pages.shape[-1]
+        k_r = pack_kv_rows(k_new, w).reshape(n_pages, page_size, w)
+        v_r = pack_kv_rows(v_new, w).reshape(n_pages, page_size, w)
+    else:
+        k_r = k_new.reshape(n_pages, page_size, kv * d)
+        v_r = v_new.reshape(n_pages, page_size, kv * d)
     k_pages = k_pages.at[pages].set(k_r, mode="drop")
     v_pages = v_pages.at[pages].set(v_r, mode="drop")
     return k_pages, v_pages
@@ -175,12 +249,13 @@ def write_kv_prefill(
 
 def paged_attention_decode_xla(
     q: jax.Array,  # [B, H, D] — one query token per sequence
-    k_pages: jax.Array,  # [P, ps, KV*D]
+    k_pages: jax.Array,  # [P, ps, KV*D] (or int8 packed rows)
     v_pages: jax.Array,
     block_table: jax.Array,  # [B, Pmax]
     context_lens: jax.Array,  # [B]
     *,
     page_size: int,
+    num_kv_heads=None,
 ) -> jax.Array:
     """Reference paged decode attention (gather + masked softmax).
 
@@ -188,13 +263,13 @@ def paged_attention_decode_xla(
     kernel avoids materialising the gathered KV in HBM entirely.
     """
     bsz, n_heads, head_dim = q.shape
-    n_kv = k_pages.shape[2] // head_dim
+    n_kv = _pool_kv_heads(k_pages, head_dim, num_kv_heads)
     pmax = block_table.shape[1]
-    # gather pages: [B, Pmax, ps, KV*D] -> [B, KV, S, D]
-    k = k_pages[block_table].reshape(
+    # gather pages: [B, Pmax, ps, KV, D] -> [B, KV, S, D]
+    k = _gather_kv(k_pages, block_table, n_kv, head_dim, q.dtype).reshape(
         bsz, pmax * page_size, n_kv, head_dim
     ).transpose(0, 2, 1, 3)
-    v = v_pages[block_table].reshape(
+    v = _gather_kv(v_pages, block_table, n_kv, head_dim, q.dtype).reshape(
         bsz, pmax * page_size, n_kv, head_dim
     ).transpose(0, 2, 1, 3)
     k = repeat_kv(k, n_heads // n_kv, axis=1)
@@ -237,6 +312,7 @@ def chunk_attention(
     start,  # scalar int32: absolute position of q[0]
     *,
     page_size: int,
+    num_kv_heads=None,
 ) -> jax.Array:
     """Chunked-prefill attention: C chunk queries over the sequence's cached
     pages (prefix + the chunk itself, already written) with a causal mask in
@@ -259,7 +335,8 @@ def chunk_attention(
     # validation — once it defaults on, selection folds into
     # _resolve_backend() like the decode/prefill ops.
     backend = os.environ.get("DYNAMO_TPU_CHUNK_ATTENTION", "xla")
-    if backend in ("pallas", "pallas_interpret"):
+    if backend in ("pallas", "pallas_interpret") \
+            and k_pages.dtype != jnp.int8:  # int8 KV serves via XLA (v1)
         n_kv = k_pages.shape[2] // q.shape[2]
         mesh = _mesh_for_shard_map()
         tp = _mesh_tp(mesh)
@@ -291,10 +368,12 @@ def chunk_attention(
                 check_vma=False,
             )(q, k_pages, v_pages, pages, st)
     c, n_heads, head_dim = q.shape
-    n_kv = k_pages.shape[2] // head_dim
+    n_kv = _pool_kv_heads(k_pages, head_dim, num_kv_heads)
     s_ctx = pages.shape[0] * page_size
-    k = k_pages[pages].reshape(s_ctx, n_kv, head_dim)
-    v = v_pages[pages].reshape(s_ctx, n_kv, head_dim)
+    k = _gather_kv(k_pages, pages, n_kv, head_dim, q.dtype).reshape(
+        s_ctx, n_kv, head_dim)
+    v = _gather_kv(v_pages, pages, n_kv, head_dim, q.dtype).reshape(
+        s_ctx, n_kv, head_dim)
     k = repeat_kv(k, n_heads // n_kv, axis=1)
     v = repeat_kv(v, n_heads // n_kv, axis=1)
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
@@ -314,6 +393,7 @@ def verify_attention(
     positions: jax.Array,  # [B] absolute position of q[:, 0]
     *,
     page_size: int,
+    num_kv_heads=None,
 ) -> jax.Array:
     """Speculative-verification attention: query j of sequence b sits at
     absolute position `positions[b] + j` and attends causally over the
@@ -328,11 +408,13 @@ def verify_attention(
     attend only the trash page and are discarded by the engine.
     """
     b, k1, n_heads, head_dim = q.shape
-    n_kv = k_pages.shape[2] // head_dim
+    n_kv = _pool_kv_heads(k_pages, head_dim, num_kv_heads)
     w = block_table.shape[1]
     s_ctx = w * page_size
-    k = k_pages[block_table].reshape(b, s_ctx, n_kv, head_dim)
-    v = v_pages[block_table].reshape(b, s_ctx, n_kv, head_dim)
+    k = _gather_kv(k_pages, block_table, n_kv, head_dim, q.dtype).reshape(
+        b, s_ctx, n_kv, head_dim)
+    v = _gather_kv(v_pages, block_table, n_kv, head_dim, q.dtype).reshape(
+        b, s_ctx, n_kv, head_dim)
     k = repeat_kv(k, n_heads // n_kv, axis=2)
     v = repeat_kv(v, n_heads // n_kv, axis=2)
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
@@ -390,11 +472,22 @@ def paged_attention_decode(
     context_lens: jax.Array,  # [B]
     *,
     page_size: int,
+    num_kv_heads=None,
 ) -> jax.Array:
     backend = _resolve_backend()
     mesh = _mesh_for_shard_map()
-    n_kv = k_pages.shape[2] // q.shape[2]
+    n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
     tp = _mesh_tp(mesh)
+    if k_pages.dtype == jnp.int8:
+        # packed-scale rows: served by the XLA gather path (v1); the
+        # engine enforces tp == 1 for int8 KV, so no shard_map either
+        if backend != "xla":
+            import logging
+
+            logging.getLogger("dynamo_tpu.ops").warning(
+                "pallas decode does not read int8 KV pools (v1); using the "
+                "XLA gather path")
+        backend, mesh = "xla", None
     if not _pallas_head_gate(q.shape[1], n_kv, tp, "decode"):
         # the explicit head-parallel shard_map can't split a head — let
         # GSPMD place the op instead (weights replicated by
@@ -408,7 +501,8 @@ def paged_attention_decode(
     if backend == "xla":
         def call(q, kp, vp, bt, cl):
             return paged_attention_decode_xla(
-                q, kp, vp, bt, cl, page_size=page_size
+                q, kp, vp, bt, cl, page_size=page_size,
+                num_kv_heads=n_kv,
             )
     else:
         from dynamo_tpu.ops import pallas_attention as pa

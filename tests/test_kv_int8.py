@@ -1,0 +1,106 @@
+"""int8 KV cache: packed-scale page rows (values + bf16 per-token-head
+scales in one int8 row), halving KV HBM footprint. Served via the XLA
+attention paths; tensor_parallel > 1 is rejected (the packed layout does
+not shard on the lane axis)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.kv_cache import KVCacheSpec
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops import attention as att
+
+
+def test_pack_unpack_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 4, 16)), jnp.float32)
+    w = att.kv_lane_width(4, 16, True)
+    rows = att.pack_kv_rows(x, w)
+    assert rows.dtype == jnp.int8 and rows.shape == (8, w)
+    back = att.unpack_kv_rows(rows, 4, 16, jnp.float32)
+    # symmetric int8 with bf16 scale: error <= scale (scale itself is
+    # rounded to bf16, adding ~0.4% on top of the half-step)
+    amax = np.abs(np.asarray(x)).max(axis=2, keepdims=True)
+    bound = amax / 127.0 + 1e-6
+    assert (np.abs(np.asarray(back - x)) <= bound).all()
+
+
+def test_lane_width():
+    assert att.kv_lane_width(8, 128, False) == 1024
+    assert att.kv_lane_width(8, 128, True) == 1152  # 1024 + 16 -> pad
+    assert att.kv_lane_width(2, 16, True) == 128
+
+
+def test_spec_shape_and_bytes():
+    cfg = ModelConfig.from_model_name("tiny-debug", dtype="float32")
+    bf = KVCacheSpec.from_model(cfg, 64, 4)
+    q8 = KVCacheSpec.from_model(cfg, 64, 4, kv_dtype="int8")
+    assert q8.quantized and not bf.quantized
+    assert q8.shape[-1] == att.kv_lane_width(cfg.num_kv_heads, cfg.head_dim,
+                                             True)
+    # int8 rows beat the fp pool even with scale+pad overhead
+    assert q8.bytes_per_token() < bf.bytes_per_token()
+
+
+def _gen(kvd, **kw):
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4, num_pages=64,
+                              max_num_seqs=2, max_seq_len=64,
+                              kv_cache_dtype=kvd, **kw))
+    toks = eng.generate(GenRequest("r", [1, 2, 3, 4, 5, 6, 7, 8],
+                                   max_tokens=10, temperature=0.0,
+                                   ignore_eos=True))
+    return toks, eng
+
+
+def test_engine_int8_kv_matches_fp_kv_greedy():
+    # tiny-model logit gaps dwarf the KV quantization error, so greedy
+    # tokens must match exactly here (larger models may diverge slightly —
+    # that is the accepted quantization trade)
+    a, _ = _gen("auto")
+    b, eng = _gen("int8")
+    assert eng.k_pages.dtype == jnp.int8
+    assert a == b
+
+
+def test_int8_kv_with_chunked_prefill_and_prefix_cache():
+    a, _ = _gen("int8")
+    b, _ = _gen("int8", prefill_chunk_tokens=8, enable_prefix_caching=True)
+    assert a == b
+
+
+def test_int8_kv_with_speculative_decode():
+    a, _ = _gen("int8")
+    b, _ = _gen("int8", speculative_mode="ngram")
+    assert a == b
+
+
+def test_int8_kv_rejects_tensor_parallel():
+    with pytest.raises(ValueError, match="tensor_parallel"):
+        Engine(EngineConfig(model="tiny-debug", kv_cache_dtype="int8",
+                            tensor_parallel=2))
+
+
+def test_invalid_kv_dtype_rejected():
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        KVCacheSpec.from_model(
+            ModelConfig.from_model_name("tiny-debug"), 8, 4, kv_dtype="int4")
+
+
+def test_disagg_import_dtype_mismatch_fails_loudly():
+    # bf16 KV shipped to an int8-pool decode worker: clear handshake error,
+    # not an XLA shape error mid-scatter
+    dec = Engine(EngineConfig(model="tiny-debug", page_size=4, num_pages=64,
+                              max_num_seqs=2, max_seq_len=64,
+                              kv_cache_dtype="int8",
+                              disaggregation_mode="decode"))
+    bf_spec = KVCacheSpec.from_model(
+        ModelConfig.from_model_name("tiny-debug",
+                                    dtype=dec.model_cfg.dtype), 4, 4)
+    k = np.zeros((bf_spec.num_layers, 1, 4, bf_spec.lane_width), np.float32)
+    with pytest.raises(ValueError, match="kv-cache-dtype"):
+        dec.import_kv(GenRequest("x", [1, 2, 3], max_tokens=4,
+                                 ignore_eos=True), 5, k, k)

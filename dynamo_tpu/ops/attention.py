@@ -85,6 +85,14 @@ def _resolve_backend() -> str:
     return b
 
 
+def _explicit_backend() -> Optional[str]:
+    """The backend the USER pinned (context/global/env), or None for auto —
+    fallback warnings fire only when an explicit choice is overridden."""
+    ctx_backend, _ = _ATTN_CTX.get()
+    b = ctx_backend or _BACKEND or os.environ.get("DYNAMO_TPU_ATTN_BACKEND")
+    return None if b in (None, "auto") else b
+
+
 def _scoped_mesh() -> Optional[Mesh]:
     _, ctx_mesh = _ATTN_CTX.get()
     return ctx_mesh if ctx_mesh is not None else _MESH
@@ -174,8 +182,8 @@ def _pool_kv_heads(k_pages: jax.Array, head_dim: int,
     """KV-head count for a pool: lane width encodes it for bf16 pools;
     int8 pools (packed scale lanes) need the caller to say."""
     if k_pages.dtype == jnp.int8:
-        assert num_kv_heads is not None, \
-            "int8 KV pools need explicit num_kv_heads"
+        if num_kv_heads is None:
+            raise ValueError("int8 KV pools need explicit num_kv_heads")
         return num_kv_heads
     return k_pages.shape[-1] // head_dim
 
@@ -335,6 +343,12 @@ def chunk_attention(
     # validation — once it defaults on, selection folds into
     # _resolve_backend() like the decode/prefill ops.
     backend = os.environ.get("DYNAMO_TPU_CHUNK_ATTENTION", "xla")
+    if backend in ("pallas", "pallas_interpret") and k_pages.dtype == jnp.int8:
+        import logging
+
+        logging.getLogger("dynamo_tpu.ops").warning(
+            "pallas chunk attention does not read int8 KV pools (v1); "
+            "using the XLA gather path")
     if backend in ("pallas", "pallas_interpret") \
             and k_pages.dtype != jnp.int8:  # int8 KV serves via XLA (v1)
         n_kv = k_pages.shape[2] // q.shape[2]
@@ -481,7 +495,7 @@ def paged_attention_decode(
     if k_pages.dtype == jnp.int8:
         # packed-scale rows: served by the XLA gather path (v1); the
         # engine enforces tp == 1 for int8 KV, so no shard_map either
-        if backend != "xla":
+        if backend != "xla" and _explicit_backend() is not None:
             import logging
 
             logging.getLogger("dynamo_tpu.ops").warning(

@@ -357,6 +357,8 @@ class _Handler(JsonHTTPHandler):
                 return
             self._raw(200, data, "application/zip")
         elif path == "/worker/stats":
+            import dataclasses
+
             eng = self.ctx.engine
             out = {
                 "model": self.ctx.served_model,
@@ -366,6 +368,10 @@ class _Handler(JsonHTTPHandler):
                 "total_pages": eng.cfg.num_pages,
                 "max_num_seqs": eng.cfg.max_num_seqs,
                 "disaggregation_mode": eng.cfg.disaggregation_mode,
+                # the full effective EngineConfig: profiles, engine-config
+                # files, and CLI flags all merge before the engine starts,
+                # so operators need the RESOLVED values, not the manifest
+                "config": dataclasses.asdict(eng.cfg),
                 "metrics": eng.metrics.snapshot(),
             }
             pc = getattr(eng, "prefix_cache", None)

@@ -23,7 +23,13 @@ quantized mode and the v5e headline default; int8 is weight-only),
 BENCH_TRACE=DIR (capture a jax.profiler/XProf trace of the timed loop),
 BENCH_KV=int8 (quantized KV-cache pages; halves KV HBM),
 BENCH_FORCE_CPU, BENCH_SECONDARY=0 to skip the secondary run,
-BENCH_INIT_BUDGET_S (accelerator retry budget, default 300).
+BENCH_INIT_BUDGET_S (accelerator retry budget, default 900 — backoff probes
+span the whole budget plus one late retry; the tunnel flakes for hours).
+
+Every TPU-measured run also writes BENCH_TPU_SNAPSHOT.json (committed to the
+repo by the build loop); a CPU-fallback run attaches that snapshot as
+`last_tpu_snapshot` so a down-tunnel at bench time doesn't erase the round's
+TPU evidence. The fallback's own value/vs_baseline remain honest-CPU.
 """
 
 from __future__ import annotations
@@ -53,7 +59,7 @@ def _init_backend() -> str:
     if os.environ.get("BENCH_FORCE_CPU"):
         force_cpu()
         return "cpu"
-    budget = float(os.environ.get("BENCH_INIT_BUDGET_S", "300"))
+    budget = float(os.environ.get("BENCH_INIT_BUDGET_S", "900"))
     return init_backend_with_fallback(budget_s=budget)
 
 
@@ -273,12 +279,57 @@ def main() -> None:
               "itl_p95_ms"):
         if k in res:
             line[k] = res[k]
+    forced = bool(os.environ.get("BENCH_FORCE_CPU"))
     if not on_tpu:
-        line["note"] = ("cpu fallback (accelerator unreachable) — value not "
+        line["note"] = ("cpu run forced via BENCH_FORCE_CPU — value not "
+                        "comparable to the TPU north star") if forced else (
+                        "cpu fallback (accelerator unreachable) — value not "
                         "comparable to the TPU north star")
+        snap = None if forced else _load_snapshot()
+        if snap is not None:
+            # the most recent committed TPU-measured run (see _save_snapshot):
+            # evidence captured while the tunnel was up mid-round, preserved
+            # verbatim so a down-tunnel at bench time doesn't erase it. The
+            # headline value/vs_baseline above stay honest-CPU.
+            line["last_tpu_snapshot"] = snap
     if sec is not None:
         line["secondary"] = sec
+    if on_tpu:
+        _save_snapshot(line)
     print(json.dumps(line))
+
+
+SNAPSHOT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_TPU_SNAPSHOT.json")
+
+
+def _load_snapshot():
+    try:
+        with open(SNAPSHOT_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _save_snapshot(line: dict) -> None:
+    """Persist a TPU-measured result in-repo (committed by the build loop)."""
+    snap = dict(line)
+    snap["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        import subprocess
+        snap["git_commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, cwd=os.path.dirname(SNAPSHOT_PATH),
+            timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        snap["git_commit"] = None
+    try:
+        with open(SNAPSHOT_PATH, "w") as f:
+            json.dump(snap, f, indent=1)
+            f.write("\n")
+    except Exception as e:  # snapshotting must never break the bench output
+        print(f"snapshot save failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -98,7 +98,7 @@ def _devices_with_timeout(jax_mod, timeout_s: float) -> bool:
 
 
 def init_backend_with_fallback(
-    max_attempts: int = 5,
+    max_attempts: int | None = None,
     budget_s: float = 300.0,
     probe_timeout_s: float = 75.0,
 ) -> str:
@@ -108,8 +108,13 @@ def init_backend_with_fallback(
     The tunneled TPU backend fails in two modes: a fast UNAVAILABLE error and
     an indefinite hang inside backend init. Each attempt probes in a
     subprocess (bounded by probe_timeout_s); only after a successful probe do
-    we initialize in-process. Total retry budget is bounded by budget_s —
-    after that, CPU fallback, loudly logged."""
+    we initialize in-process.
+
+    The retry envelope spans the WHOLE budget (the tunnel is documented to
+    flake for long stretches, so a handful of up-front attempts followed by a
+    long give-up is the wrong shape): exponential backoff between probes,
+    capped at 60s, plus one final late probe right at the deadline so a
+    tunnel that recovers late in the budget is still caught."""
     import logging
     import time
 
@@ -118,12 +123,23 @@ def init_backend_with_fallback(
     if want_cpu_from_env():
         return "cpu"
 
-    deadline = time.monotonic() + budget_s
-    for attempt in range(1, max_attempts + 1):
+    t_start = time.monotonic()
+    deadline = t_start + budget_s
+    attempt = 0
+    sleep_s = 5.0
+    final_probe_done = False
+    while True:
+        attempt += 1
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            log.warning("accelerator init budget (%.0fs) exhausted", budget_s)
-            break
+            if final_probe_done:
+                log.warning("accelerator init budget (%.0fs) exhausted",
+                            budget_s)
+                break
+            # late retry: one last probe past the deadline — a tunnel that
+            # came back during the final backoff sleep should not be missed
+            final_probe_done = True
+            remaining = min(probe_timeout_s, budget_s)
         backend = _probe_accelerator(min(probe_timeout_s, remaining))
         if backend == "cpu":
             # clean CPU-only machine (no accelerator plugin registered):
@@ -138,7 +154,10 @@ def init_backend_with_fallback(
                 # (tunnel dropped since the probe succeeded) — bound it with
                 # a watchdog thread; backend RPC waits release the GIL
                 if _devices_with_timeout(
-                    jax, min(probe_timeout_s, deadline - time.monotonic())
+                    jax,
+                    min(probe_timeout_s,
+                        max(probe_timeout_s / 2,
+                            deadline - time.monotonic())),
                 ):
                     log.info(
                         "accelerator backend %r up after %d attempt(s)",
@@ -157,14 +176,19 @@ def init_backend_with_fallback(
                 pass
         else:
             log.warning(
-                "accelerator probe attempt %d/%d failed (timeout or error)",
-                attempt, max_attempts,
+                "accelerator probe attempt %d failed (timeout or error); "
+                "%.0fs of budget left", attempt,
+                max(0.0, deadline - time.monotonic()),
             )
-        if attempt < max_attempts:
-            time.sleep(min(5.0 * attempt,
-                           max(0.0, deadline - time.monotonic())))
+        if final_probe_done:
+            break
+        if max_attempts is not None and attempt >= max_attempts:
+            break  # outcome decided — don't burn a backoff sleep first
+        time.sleep(min(sleep_s, max(0.0, deadline - time.monotonic())))
+        sleep_s = min(sleep_s * 2, 60.0)
 
-    log.warning("accelerator unavailable after %d attempts; falling back to CPU",
-                max_attempts)
+    log.warning("accelerator unavailable after %d attempt(s) over %.0fs; "
+                "falling back to CPU", attempt,
+                time.monotonic() - t_start)
     force_cpu()
     return "cpu"

@@ -53,6 +53,25 @@ def test_probe_timeouts_respect_budget(monkeypatch):
     assert all(t <= 0.5 + 1e-6 for t in seen)
 
 
+def test_backoff_spans_budget_with_late_retry(monkeypatch):
+    """The retry envelope must cover the WHOLE budget: backoff between
+    probes, plus one final probe at/after the deadline (the tunnel flakes in
+    long stretches, so late recoveries matter)."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    times = []
+    t0 = time.monotonic()
+    monkeypatch.setattr(plat, "_probe_accelerator",
+                        lambda t: times.append(time.monotonic() - t0) or None)
+    sleeps = []
+    real_sleep = time.sleep
+    monkeypatch.setattr(time, "sleep",
+                        lambda s: sleeps.append(s) or real_sleep(min(s, 0.01)))
+    plat.init_backend_with_fallback(budget_s=0.05, probe_timeout_s=0.01)
+    assert len(times) >= 2  # at least one in-budget probe + the late retry
+    # the last probe is the late retry: it fires at/after the deadline
+    assert times[-1] >= 0.04
+
+
 def test_successful_probe_initializes_in_process(monkeypatch):
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setattr(plat, "_probe_accelerator", lambda t: "tpu")

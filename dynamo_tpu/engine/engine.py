@@ -226,14 +226,13 @@ class Engine:
             self.params = shd.shard_params(params, self.mesh)
 
         # --- KV cache ---
-        if cfg.kv_cache_dtype == "int8" and cfg.tensor_parallel > 1:
-            # packed scale lanes don't shard cleanly on the fused lane axis
-            raise ValueError(
-                "kv_cache_dtype=int8 requires tensor_parallel == 1 (the "
-                "packed-scale page layout does not shard on the lane axis)")
+        # int8 rows are lane-blocked per TP shard (KVCacheSpec.lane_blocks),
+        # so the fused lane axis shards cleanly and the Pallas decode/chunk
+        # kernels dequantize in-VMEM after the superblock DMA
         self.kv_spec = KVCacheSpec.from_model(
             self.model_cfg, cfg.num_pages, cfg.page_size,
             kv_dtype=cfg.kv_cache_dtype,
+            tensor_parallel=cfg.tensor_parallel,
         )
         self.k_pages, self.v_pages = alloc_kv_pages(
             self.kv_spec, shd.kv_sharding(self.mesh)
@@ -498,10 +497,11 @@ class Engine:
 
         backend = None if cfg.attention_backend == "auto" else cfg.attention_backend
         mesh = self.mesh
+        lane_blocks = self.kv_spec.lane_blocks
 
         def ctx(fn):
             def wrapped(*args):
-                with _att.attention_context(backend, mesh):
+                with _att.attention_context(backend, mesh, lane_blocks):
                     return fn(*args)
 
             return wrapped
@@ -1652,7 +1652,9 @@ class Engine:
                 f"does not match this decode worker's pool "
                 f"(dtype={self.k_pages.dtype}, "
                 f"lanes={self.kv_spec.lane_width}) — prefill and decode "
-                f"roles must use the same --kv-cache-dtype")
+                f"roles must use the same --kv-cache-dtype (and, for int8 "
+                f"KV, the same --tensor-parallel: the rows are lane-blocked "
+                f"per TP shard)")
         stop_ids = (
             [] if req.ignore_eos
             else (req.stop_token_ids or [self.model_cfg.eos_token_id])

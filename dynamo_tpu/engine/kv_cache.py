@@ -39,17 +39,29 @@ class KVCacheSpec:
     page_size: int
     head_dim: int
     dtype: str = "bfloat16"  # "int8" -> packed-scale quantized rows
+    # tensor-parallel blocking of int8 page rows: the row is laid out as
+    # `lane_blocks` independent [values | scales | pad] blocks so a plain
+    # lane split over the `model` mesh axis hands each shard its own heads'
+    # values AND scales (see dynamo_tpu.ops.attention, int8 KV section)
+    lane_blocks: int = 1
 
     @staticmethod
     def from_model(
         cfg: ModelConfig, num_pages: int, page_size: int,
-        kv_dtype: str = "auto",
+        kv_dtype: str = "auto", tensor_parallel: int = 1,
     ) -> "KVCacheSpec":
         if kv_dtype not in ("auto", "", "int8"):
             # only exactly "int8" takes the packed-scale quantized path;
             # any other narrow dtype would silently value-cast KV garbage
             raise ValueError(
                 f"kv_cache_dtype must be 'auto' or 'int8', got {kv_dtype!r}")
+        quantized = kv_dtype == "int8"
+        if quantized and cfg.num_kv_heads % tensor_parallel != 0:
+            raise ValueError(
+                f"kv_cache_dtype=int8 needs tensor_parallel "
+                f"({tensor_parallel}) to divide num_kv_heads "
+                f"({cfg.num_kv_heads}) — the packed-scale rows are blocked "
+                f"per TP shard")
         return KVCacheSpec(
             num_layers=cfg.num_layers,
             num_kv_heads=cfg.num_kv_heads,
@@ -57,6 +69,7 @@ class KVCacheSpec:
             page_size=page_size,
             head_dim=cfg.head_dim,
             dtype=cfg.dtype if kv_dtype in ("auto", "") else kv_dtype,
+            lane_blocks=tensor_parallel if quantized else 1,
         )
 
     @property
@@ -68,7 +81,7 @@ class KVCacheSpec:
         from dynamo_tpu.ops.attention import kv_lane_width
 
         return kv_lane_width(self.num_kv_heads, self.head_dim,
-                             self.quantized)
+                             self.quantized, self.lane_blocks)
 
     @property
     def shape(self):

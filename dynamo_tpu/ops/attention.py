@@ -35,12 +35,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-# (backend, mesh) bound by the engine around each jit call (incl. tracing),
-# so attention config is per-engine, not process-global — two engines with
-# different meshes/backends in one process (e.g. colocated disagg roles)
-# never reconfigure each other.
+# (backend, mesh, kv_lane_blocks) bound by the engine around each jit call
+# (incl. tracing), so attention config is per-engine, not process-global —
+# two engines with different meshes/backends in one process (e.g. colocated
+# disagg roles) never reconfigure each other. kv_lane_blocks is the
+# tensor-parallel blocking of int8 KV page rows (see the int8 KV section).
 _ATTN_CTX: contextvars.ContextVar = contextvars.ContextVar(
-    "dynamo_tpu_attn_ctx", default=(None, None)
+    "dynamo_tpu_attn_ctx", default=(None, None, 1)
 )
 
 _BACKEND: Optional[str] = None  # process-wide override (tests, ad-hoc use)
@@ -50,11 +51,13 @@ _VALID_BACKENDS = ("auto", "xla", "pallas", "pallas_interpret")
 
 
 @contextlib.contextmanager
-def attention_context(backend: Optional[str], mesh: Optional[Mesh]):
-    """Scope the attention backend + mesh for calls (and traces) within."""
+def attention_context(backend: Optional[str], mesh: Optional[Mesh],
+                      kv_lane_blocks: int = 1):
+    """Scope the attention backend + mesh (+ int8 KV lane blocking) for
+    calls (and traces) within."""
     if backend is not None and backend not in _VALID_BACKENDS:
         raise ValueError(f"backend {backend!r} not in {_VALID_BACKENDS}")
-    token = _ATTN_CTX.set((backend, mesh))
+    token = _ATTN_CTX.set((backend, mesh, kv_lane_blocks))
     try:
         yield
     finally:
@@ -76,7 +79,7 @@ def set_attention_mesh(mesh: Optional[Mesh]) -> None:
 
 
 def _resolve_backend() -> str:
-    ctx_backend, _ = _ATTN_CTX.get()
+    ctx_backend = _ATTN_CTX.get()[0]
     b = ctx_backend or _BACKEND or os.environ.get("DYNAMO_TPU_ATTN_BACKEND", "auto")
     if b not in _VALID_BACKENDS:
         raise ValueError(f"DYNAMO_TPU_ATTN_BACKEND {b!r} not in {_VALID_BACKENDS}")
@@ -88,13 +91,13 @@ def _resolve_backend() -> str:
 def _explicit_backend() -> Optional[str]:
     """The backend the USER pinned (context/global/env), or None for auto —
     fallback warnings fire only when an explicit choice is overridden."""
-    ctx_backend, _ = _ATTN_CTX.get()
+    ctx_backend = _ATTN_CTX.get()[0]
     b = ctx_backend or _BACKEND or os.environ.get("DYNAMO_TPU_ATTN_BACKEND")
     return None if b in (None, "auto") else b
 
 
 def _scoped_mesh() -> Optional[Mesh]:
-    _, ctx_mesh = _ATTN_CTX.get()
+    ctx_mesh = _ATTN_CTX.get()[1]
     return ctx_mesh if ctx_mesh is not None else _MESH
 
 
@@ -136,22 +139,37 @@ def repeat_kv(x: jax.Array, n_rep: int, axis: int) -> jax.Array:
 # Quantized KV cache: pages store int8 values with a bf16 scale per
 # (token, kv-head) PACKED INTO SPARE LANES of the same page row, so the
 # pool stays ONE array — engine plumbing, transfer, and donation are
-# untouched; only the lane width and dtype change. Layout per row:
-#   [ KV*D int8 values | 2*KV int8 lanes = KV bf16 scales | zero pad ]
-# padded to a 128-lane multiple. Halves KV HBM footprint and stream
-# (the binding constraint at the reference SLA's 4k ISL). v1 serves int8
-# KV through the XLA attention paths; the Pallas kernels keep bf16.
+# untouched; only the lane width and dtype change.
+#
+# The row is blocked by tensor-parallel shard (`lane_blocks` = TP degree at
+# allocation time) so a plain lane split over the `model` mesh axis hands
+# every shard exactly its own heads' values AND scales:
+#   [ block 0 | block 1 | ... ]   with each block =
+#   [ (KV/tp)*D int8 values | 2*KV/tp int8 lanes = KV/tp bf16 scales | pad ]
+# padded to a 128-lane multiple per block. Halves KV HBM footprint and
+# stream (the binding constraint at the reference SLA's 4k ISL). Both the
+# XLA gather paths and the Pallas decode/chunk kernels read this layout —
+# the kernels dequantize in-VMEM after the superblock DMA (int8 halves the
+# DMA bytes; the bf16 scale is rebuilt exactly via a 16-bit shift +
+# same-width bitcast, see pallas_attention._dequant_rows).
 
 
-def kv_lane_width(n_kv: int, head_dim: int, quantized: bool) -> int:
+def kv_lane_width(n_kv: int, head_dim: int, quantized: bool,
+                  lane_blocks: int = 1) -> int:
     """Lane (last-dim) width of one KV page row."""
-    lanes = n_kv * head_dim
-    if quantized:
-        lanes = -(-(lanes + 2 * n_kv) // 128) * 128
-    return lanes
+    if not quantized:
+        return n_kv * head_dim
+    if n_kv % lane_blocks != 0:
+        raise ValueError(
+            f"int8 KV lane blocking needs lane_blocks ({lane_blocks}) to "
+            f"divide num_kv_heads ({n_kv})")
+    kv_l = n_kv // lane_blocks
+    block = -(-(kv_l * head_dim + 2 * kv_l) // 128) * 128
+    return lane_blocks * block
 
 
-def pack_kv_rows(x: jax.Array, lane_width: int) -> jax.Array:
+def pack_kv_rows(x: jax.Array, lane_width: int,
+                 lane_blocks: int = 1) -> jax.Array:
     """[T, KV, D] values -> [T, lane_width] int8 rows (see layout above)."""
     t, kv, d = x.shape
     x32 = x.astype(jnp.float32)
@@ -160,21 +178,40 @@ def pack_kv_rows(x: jax.Array, lane_width: int) -> jax.Array:
     q = jnp.clip(jnp.round(x32 / scale.astype(jnp.float32)[:, :, None]),
                  -127, 127).astype(jnp.int8)
     sc8 = jax.lax.bitcast_convert_type(scale, jnp.int8)  # [T, KV, 2]
-    row = jnp.concatenate([q.reshape(t, kv * d), sc8.reshape(t, 2 * kv)],
-                          axis=1)
-    return jnp.pad(row, ((0, 0), (0, lane_width - row.shape[1])))
+    kv_l = kv // lane_blocks
+    wl = lane_width // lane_blocks
+    blocks = []
+    for b in range(lane_blocks):
+        row = jnp.concatenate(
+            [q[:, b * kv_l:(b + 1) * kv_l].reshape(t, kv_l * d),
+             sc8[:, b * kv_l:(b + 1) * kv_l].reshape(t, 2 * kv_l)],
+            axis=1)
+        blocks.append(jnp.pad(row, ((0, 0), (0, wl - row.shape[1]))))
+    return jnp.concatenate(blocks, axis=1)
 
 
 def unpack_kv_rows(rows: jax.Array, n_kv: int, head_dim: int,
-                   dtype) -> jax.Array:
+                   dtype, lane_blocks: int = 1) -> jax.Array:
     """[..., lane_width] int8 rows -> [..., KV, D] dequantized values."""
-    kvd = n_kv * head_dim
     lead = rows.shape[:-1]
-    q = rows[..., :kvd].reshape(*lead, n_kv, head_dim)
-    sc8 = rows[..., kvd:kvd + 2 * n_kv].reshape(*lead, n_kv, 2)
+    kv_l = n_kv // lane_blocks
+    kvd_l = kv_l * head_dim
+    wl = rows.shape[-1] // lane_blocks
+    qs, scs = [], []
+    for b in range(lane_blocks):
+        blk = rows[..., b * wl:(b + 1) * wl]
+        qs.append(blk[..., :kvd_l].reshape(*lead, kv_l, head_dim))
+        scs.append(blk[..., kvd_l:kvd_l + 2 * kv_l].reshape(*lead, kv_l, 2))
+    q = jnp.concatenate(qs, axis=-2)
+    sc8 = jnp.concatenate(scs, axis=-2)
     scale = jax.lax.bitcast_convert_type(sc8, jnp.bfloat16)  # [..., KV]
     return (q.astype(jnp.float32)
             * scale.astype(jnp.float32)[..., None]).astype(dtype)
+
+
+def _kv_lane_blocks() -> int:
+    """The int8 page-row lane blocking scoped by the engine (1 outside)."""
+    return _ATTN_CTX.get()[2]
 
 
 def _pool_kv_heads(k_pages: jax.Array, head_dim: int,
@@ -189,12 +226,15 @@ def _pool_kv_heads(k_pages: jax.Array, head_dim: int,
 
 
 def _gather_kv(pages_pool: jax.Array, idx: jax.Array, n_kv: int,
-               head_dim: int, dtype) -> jax.Array:
+               head_dim: int, dtype, lane_blocks=None) -> jax.Array:
     """Gather page rows by id and return [..., ps, KV, D] values
     (dequantizing int8 pools)."""
     rows = pages_pool[idx]
     if pages_pool.dtype == jnp.int8:
-        return unpack_kv_rows(rows, n_kv, head_dim, dtype)
+        if lane_blocks is None:
+            lane_blocks = _kv_lane_blocks()
+        return unpack_kv_rows(rows, n_kv, head_dim, dtype,
+                              lane_blocks=lane_blocks)
     return rows.reshape(*rows.shape[:-1], n_kv, head_dim)
 
 
@@ -220,8 +260,9 @@ def write_kv_token(
     slot_idx = positions % page_size  # [B]
     if k_pages.dtype == jnp.int8:
         w = k_pages.shape[-1]
-        k_rows = pack_kv_rows(k_new, w)
-        v_rows = pack_kv_rows(v_new, w)
+        lb = _kv_lane_blocks()
+        k_rows = pack_kv_rows(k_new, w, lane_blocks=lb)
+        v_rows = pack_kv_rows(v_new, w, lane_blocks=lb)
     else:
         k_rows = k_new.reshape(b, kv * d)
         v_rows = v_new.reshape(b, kv * d)
@@ -245,8 +286,11 @@ def write_kv_prefill(
     n_pages = s // page_size
     if k_pages.dtype == jnp.int8:
         w = k_pages.shape[-1]
-        k_r = pack_kv_rows(k_new, w).reshape(n_pages, page_size, w)
-        v_r = pack_kv_rows(v_new, w).reshape(n_pages, page_size, w)
+        lb = _kv_lane_blocks()
+        k_r = pack_kv_rows(k_new, w, lane_blocks=lb).reshape(
+            n_pages, page_size, w)
+        v_r = pack_kv_rows(v_new, w, lane_blocks=lb).reshape(
+            n_pages, page_size, w)
     else:
         k_r = k_new.reshape(n_pages, page_size, kv * d)
         v_r = v_new.reshape(n_pages, page_size, kv * d)
@@ -264,6 +308,7 @@ def paged_attention_decode_xla(
     *,
     page_size: int,
     num_kv_heads=None,
+    lane_blocks=None,
 ) -> jax.Array:
     """Reference paged decode attention (gather + masked softmax).
 
@@ -274,10 +319,12 @@ def paged_attention_decode_xla(
     n_kv = _pool_kv_heads(k_pages, head_dim, num_kv_heads)
     pmax = block_table.shape[1]
     # gather pages: [B, Pmax, ps, KV, D] -> [B, KV, S, D]
-    k = _gather_kv(k_pages, block_table, n_kv, head_dim, q.dtype).reshape(
+    k = _gather_kv(k_pages, block_table, n_kv, head_dim, q.dtype,
+                   lane_blocks).reshape(
         bsz, pmax * page_size, n_kv, head_dim
     ).transpose(0, 2, 1, 3)
-    v = _gather_kv(v_pages, block_table, n_kv, head_dim, q.dtype).reshape(
+    v = _gather_kv(v_pages, block_table, n_kv, head_dim, q.dtype,
+                   lane_blocks).reshape(
         bsz, pmax * page_size, n_kv, head_dim
     ).transpose(0, 2, 1, 3)
     k = repeat_kv(k, n_heads // n_kv, axis=1)
@@ -343,30 +390,36 @@ def chunk_attention(
     # validation — once it defaults on, selection folds into
     # _resolve_backend() like the decode/prefill ops.
     backend = os.environ.get("DYNAMO_TPU_CHUNK_ATTENTION", "xla")
-    if backend in ("pallas", "pallas_interpret") and k_pages.dtype == jnp.int8:
-        import logging
-
-        logging.getLogger("dynamo_tpu.ops").warning(
-            "pallas chunk attention does not read int8 KV pools (v1); "
-            "using the XLA gather path")
-    if backend in ("pallas", "pallas_interpret") \
-            and k_pages.dtype != jnp.int8:  # int8 KV serves via XLA (v1)
-        n_kv = k_pages.shape[2] // q.shape[2]
+    if backend in ("pallas", "pallas_interpret"):
+        quantized = k_pages.dtype == jnp.int8
+        n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
+        lb = _kv_lane_blocks() if quantized else 1
         mesh = _mesh_for_shard_map()
         tp = _mesh_tp(mesh)
+        span = n_kv * q.shape[2] if quantized else k_pages.shape[2]
         aligned = (
             _pallas_head_gate(q.shape[1], n_kv, tp, "chunk attention")
-            and _pallas_lane_gate(k_pages.shape[2], tp, "chunk attention")
+            and _pallas_lane_gate(span, tp, "chunk attention")
         )
+        if quantized and lb != max(tp, 1):
+            # the kernel reads single-block rows (see decode dispatch)
+            import logging
+
+            logging.getLogger("dynamo_tpu.ops").warning(
+                "pallas chunk attention on int8 KV needs the mesh TP (%d) "
+                "to equal the pool's lane blocking (%d); using the XLA "
+                "gather path", tp, lb)
+            aligned = False
         if aligned:
             from dynamo_tpu.ops import pallas_attention as pa
 
             interp = backend == "pallas_interpret"
+            n_kv_call = n_kv // max(tp, 1)
 
             def call(q, kp, vp, pg, st):
                 return pa.chunk_prefill_attention(
                     q, kp, vp, pg, st, page_size=page_size,
-                    num_kv_heads=kp.shape[2] // q.shape[2],
+                    num_kv_heads=n_kv_call,
                     interpret=interp,
                 )
 
@@ -492,31 +545,46 @@ def paged_attention_decode(
     mesh = _mesh_for_shard_map()
     n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
     tp = _mesh_tp(mesh)
-    if k_pages.dtype == jnp.int8:
-        # packed-scale rows: served by the XLA gather path (v1); the
-        # engine enforces tp == 1 for int8 KV, so no shard_map either
-        if backend != "xla" and _explicit_backend() is not None:
-            import logging
-
-            logging.getLogger("dynamo_tpu.ops").warning(
-                "pallas decode does not read int8 KV pools (v1); using the "
-                "XLA gather path")
-        backend, mesh = "xla", None
+    quantized = k_pages.dtype == jnp.int8
+    lb = _kv_lane_blocks() if quantized else 1
     if not _pallas_head_gate(q.shape[1], n_kv, tp, "decode"):
         # the explicit head-parallel shard_map can't split a head — let
         # GSPMD place the op instead (weights replicated by
         # sharding._fit_spec)
         mesh = None
+    if quantized and mesh is not None and lb % _mesh_tp(mesh) != 0:
+        # a lane split must hand each shard whole layout blocks; otherwise
+        # run the full blocked layout under GSPMD
+        mesh = None
     if backend != "xla":
         # e.g. tp=8 over 8 KV heads of dim 64 drops the local fused-KV span
-        # below a lane tile
-        if not _pallas_lane_gate(k_pages.shape[2], _mesh_tp(mesh), "decode"):
+        # below a lane tile. For int8 pools, gate on the VALUES span (the
+        # kernel slices rows[:, :kvd] in-VMEM) — the padded packed width is
+        # 128-aligned by construction and would always pass.
+        span = n_kv * q.shape[2] if quantized else k_pages.shape[2]
+        if not _pallas_lane_gate(span, _mesh_tp(mesh), "decode"):
             backend = "xla"
+    if quantized and backend != "xla" and lb != max(_mesh_tp(mesh), 1):
+        # the Pallas kernel reads SINGLE-block rows: the shard_map split
+        # count must equal the layout blocking (each shard then sees its own
+        # [values | scales | pad] block). Engine-built configs always match;
+        # mismatches (e.g. head gate dropped the mesh) fall back.
+        if _explicit_backend() is not None:
+            import logging
+
+            logging.getLogger("dynamo_tpu.ops").warning(
+                "pallas decode on int8 KV needs the mesh TP (%d) to equal "
+                "the pool's lane blocking (%d); using the XLA gather path",
+                _mesh_tp(mesh), lb)
+        backend = "xla"
+    tp_eff = _mesh_tp(mesh)
+    n_kv_call = n_kv // tp_eff  # per-shard KV heads seen by the inner call
+    lb_call = lb // tp_eff if quantized else 1
     if backend == "xla":
         def call(q, kp, vp, bt, cl):
             return paged_attention_decode_xla(
                 q, kp, vp, bt, cl, page_size=page_size,
-                num_kv_heads=n_kv,
+                num_kv_heads=n_kv_call, lane_blocks=lb_call,
             )
     else:
         from dynamo_tpu.ops import pallas_attention as pa
@@ -527,7 +595,7 @@ def paged_attention_decode(
             return pa.paged_attention_decode(
                 q, kp, vp, bt, cl,
                 page_size=page_size,
-                num_kv_heads=kp.shape[2] // q.shape[2],
+                num_kv_heads=n_kv_call,
                 interpret=interpret,
             )
 

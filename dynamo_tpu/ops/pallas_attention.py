@@ -29,6 +29,12 @@ loop, and decode attention is HBM-bandwidth-bound by definition):
 - Pages whose tokens lie past the context length are masked in-compute;
   blocks wholly past it are never fetched (the per-sequence block count is a
   dynamic `fori_loop` bound derived from the scalar-prefetched context lens).
+- **int8 KV pools** (packed-scale rows, see dynamo_tpu.ops.attention) are
+  read natively: the superblock DMA moves the int8 rows (half the HBM
+  bytes), and `_dequant_rows` rebuilds values in-VMEM with iota-selector
+  matmuls plus an exact shift-and-bitcast bf16 scale decode. Under TP the
+  rows are lane-blocked per shard, so the same head-parallel shard_map
+  applies unchanged.
 
 The prefill kernel is a standard flash (online-softmax) kernel over the
 `[S, KV, D]` pre-paging tensors, gridded over KV heads with queries blocked
@@ -74,6 +80,45 @@ DEFAULT_BLOCK_PAGES = _env_int("DYNAMO_TPU_DECODE_BLOCK_PAGES", 8, 1)
 # KV block buffers in the DMA ring: num_bufs - 1 blocks are in flight ahead
 # of the one being consumed (pipeline depth)
 DEFAULT_NUM_BUFS = _env_int("DYNAMO_TPU_DECODE_NUM_BUFS", 4, 2)
+
+
+# -------------------------------------------------------------- int8 dequant --
+
+
+def _dequant_rows(rows, n_kv: int, d: int, lane_width: int):
+    """Dequantize one lane block of packed int8 KV rows in-VMEM.
+
+    rows: [T, lane_width] int8 with layout [KV*D values | 2*KV scale lanes
+    (bf16 bitcast bytes, little-endian) | zero pad] — the single-shard form
+    of the layout in dynamo_tpu.ops.attention (int8 KV section). Returns
+    [T, KV*D] float32 dequantized values.
+
+    Mosaic-friendly construction: only whole-region lane slices (the values
+    span and the 128-aligned scale+pad tail), byte de-interleave and the
+    per-head D-lane broadcast both expressed as tiny iota-built selector
+    matmuls (MXU work is free here — the decode kernel is DMA-bound), and
+    the bf16 scale rebuilt EXACTLY by u16 << 16 + same-width int32->f32
+    bitcast (no exp2 rounding)."""
+    kvd = n_kv * d
+    vals = rows[:, :kvd].astype(jnp.float32)
+    r = lane_width - kvd  # scale lanes + pad (>= 2 * n_kv)
+    tail = (rows[:, kvd:].astype(jnp.int32) & 0xFF).astype(jnp.float32)
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (r, n_kv), 0)
+    col_i = jax.lax.broadcasted_iota(jnp.int32, (r, n_kv), 1)
+    sel_lo = (row_i == 2 * col_i).astype(jnp.float32)
+    sel_hi = (row_i == 2 * col_i + 1).astype(jnp.float32)
+    lo = jax.lax.dot(tail, sel_lo, preferred_element_type=jnp.float32)
+    hi = jax.lax.dot(tail, sel_hi, preferred_element_type=jnp.float32)
+    # u16 bit pattern reassembled in f32 (exact below 2^24), then widened to
+    # the bf16 value's f32 bit pattern by the 16-bit shift
+    bits = (lo + 256.0 * hi).astype(jnp.int32) << 16
+    scale = jax.lax.bitcast_convert_type(bits, jnp.float32)  # [T, KV]
+    head_i = jax.lax.broadcasted_iota(jnp.int32, (n_kv, kvd), 0)
+    lane_kv = jax.lax.broadcasted_iota(jnp.int32, (n_kv, kvd), 1) // d
+    expand = (head_i == lane_kv).astype(jnp.float32)  # [KV, KVD]
+    scale_full = jax.lax.dot(scale, expand,
+                             preferred_element_type=jnp.float32)  # [T, KVD]
+    return vals * scale_full
 
 
 # ------------------------------------------------------ flash accumulation --
@@ -139,6 +184,8 @@ def _decode_kernel(
     num_bufs: int,
     n_kv: int,
     scale: float,
+    lane_width: int,
+    quantized: bool,
 ):
     b = pl.program_id(0)
     i = pl.program_id(1)
@@ -238,8 +285,18 @@ def _decode_kernel(
         def _compute():
             q = q_ref[0].astype(jnp.float32) * scale  # [H, D]
             q_bd = jnp.where(bd_mask, jnp.tile(q, (1, n_kv)), 0.0)  # [H, KVD]
-            k = kbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
-            v = vbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+            if quantized:
+                k = _dequant_rows(
+                    kbuf[cur].reshape(tokens_per_block, lane_width),
+                    n_kv, d, lane_width)
+                v = _dequant_rows(
+                    vbuf[cur].reshape(tokens_per_block, lane_width),
+                    n_kv, d, lane_width)
+            else:
+                k = kbuf[cur].reshape(tokens_per_block, kvd).astype(
+                    jnp.float32)
+                v = vbuf[cur].reshape(tokens_per_block, kvd).astype(
+                    jnp.float32)
             s = jax.lax.dot_general(
                 q_bd, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -265,7 +322,7 @@ def _decode_kernel(
 
 def paged_attention_decode(
     q: jax.Array,  # [B, H, D]
-    k_pages: jax.Array,  # [P, ps, KV*D]
+    k_pages: jax.Array,  # [P, ps, KV*D] (or int8 packed single-block rows)
     v_pages: jax.Array,
     block_table: jax.Array,  # [B, Pmax] int32
     context_lens: jax.Array,  # [B] int32
@@ -277,8 +334,13 @@ def paged_attention_decode(
     interpret: bool = False,
 ) -> jax.Array:
     bsz, n_heads, head_dim = q.shape
-    kvd = k_pages.shape[2]
-    assert kvd == num_kv_heads * head_dim, (kvd, num_kv_heads, head_dim)
+    lane_width = k_pages.shape[2]
+    quantized = k_pages.dtype == jnp.int8
+    kvd = num_kv_heads * head_dim
+    if quantized:
+        assert lane_width >= kvd + 2 * num_kv_heads, (lane_width, kvd)
+    else:
+        assert lane_width == kvd, (lane_width, num_kv_heads, head_dim)
     pmax = block_table.shape[1]
     block_pages = max(1, min(block_pages, pmax))
     num_bufs = max(2, num_bufs)
@@ -297,8 +359,10 @@ def paged_attention_decode(
             (1, n_heads, head_dim), lambda b, i, bt, cl: (b, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((num_bufs, block_pages, page_size, kvd), k_pages.dtype),
-            pltpu.VMEM((num_bufs, block_pages, page_size, kvd), v_pages.dtype),
+            pltpu.VMEM((num_bufs, block_pages, page_size, lane_width),
+                       k_pages.dtype),
+            pltpu.VMEM((num_bufs, block_pages, page_size, lane_width),
+                       v_pages.dtype),
             pltpu.VMEM((n_heads, 128), jnp.float32),
             pltpu.VMEM((n_heads, 128), jnp.float32),
             pltpu.VMEM((n_heads, kvd), jnp.float32),
@@ -314,6 +378,8 @@ def paged_attention_decode(
         num_bufs=num_bufs,
         n_kv=num_kv_heads,
         scale=scale,
+        lane_width=lane_width,
+        quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,
@@ -492,6 +558,8 @@ def _chunk_kernel(
     num_bufs: int,
     n_kv: int,
     scale: float,
+    lane_width: int,
+    quantized: bool,
 ):
     """Chunked-prefill flash attention over the paged KV cache.
 
@@ -574,8 +642,14 @@ def _chunk_kernel(
             q = q_ref[0].astype(jnp.float32).reshape(rows, d) * scale
             qbd_ref[...] = jnp.where(bd_mask, jnp.tile(q, (1, n_kv)), 0.0)
 
-        k = kbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
-        v = vbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+        if quantized:
+            k = _dequant_rows(kbuf[cur].reshape(tokens_per_block, lane_width),
+                              n_kv, d, lane_width)
+            v = _dequant_rows(vbuf[cur].reshape(tokens_per_block, lane_width),
+                              n_kv, d, lane_width)
+        else:
+            k = kbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+            v = vbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
         s = jax.lax.dot_general(
             qbd_ref[...], k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -614,8 +688,13 @@ def chunk_prefill_attention(
     interpret: bool = False,
 ) -> jax.Array:
     c, n_heads, head_dim = q.shape
-    kvd = k_pages.shape[2]
-    assert kvd == num_kv_heads * head_dim, (kvd, num_kv_heads, head_dim)
+    lane_width = k_pages.shape[2]
+    quantized = k_pages.dtype == jnp.int8
+    kvd = num_kv_heads * head_dim
+    if quantized:
+        assert lane_width >= kvd + 2 * num_kv_heads, (lane_width, kvd)
+    else:
+        assert lane_width == kvd, (lane_width, num_kv_heads, head_dim)
     width = pages.shape[0]
     block_pages = max(1, min(block_pages, width))
     num_bufs = max(2, num_bufs)
@@ -644,8 +723,10 @@ def chunk_prefill_attention(
             lambda qb, kb, pg, st: (qb, 0, 0, 0),
         ),
         scratch_shapes=[
-            pltpu.VMEM((num_bufs, block_pages, page_size, kvd), k_pages.dtype),
-            pltpu.VMEM((num_bufs, block_pages, page_size, kvd), v_pages.dtype),
+            pltpu.VMEM((num_bufs, block_pages, page_size, lane_width),
+                       k_pages.dtype),
+            pltpu.VMEM((num_bufs, block_pages, page_size, lane_width),
+                       v_pages.dtype),
             pltpu.VMEM((rows, kvd), jnp.float32),
             pltpu.VMEM((rows, 128), jnp.float32),
             pltpu.VMEM((rows, 128), jnp.float32),
@@ -663,6 +744,8 @@ def chunk_prefill_attention(
         num_bufs=num_bufs,
         n_kv=num_kv_heads,
         scale=scale,
+        lane_width=lane_width,
+        quantized=quantized,
     )
     q4 = q.reshape(nq, block_q, n_heads, head_dim)
     out = pl.pallas_call(

@@ -1,8 +1,10 @@
 """int8 KV cache: packed-scale page rows (values + bf16 per-token-head
-scales in one int8 row), halving KV HBM footprint. Served via the XLA
-attention paths; tensor_parallel > 1 is rejected (the packed layout does
-not shard on the lane axis)."""
+scales in one int8 row), halving KV HBM footprint. Rows are lane-blocked
+per tensor-parallel shard so the fused lane axis shards cleanly, and BOTH
+the XLA gather paths and the Pallas decode/chunk kernels read the layout
+(the kernels dequantize in-VMEM after the superblock DMA)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -13,6 +15,7 @@ from dynamo_tpu.engine.kv_cache import KVCacheSpec
 from dynamo_tpu.engine.request import GenRequest
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops import attention as att
+from dynamo_tpu.ops import pallas_attention as pa
 
 
 def test_pack_unpack_roundtrip_error_bound():
@@ -78,10 +81,111 @@ def test_int8_kv_with_speculative_decode():
     assert a == b
 
 
-def test_int8_kv_rejects_tensor_parallel():
-    with pytest.raises(ValueError, match="tensor_parallel"):
-        Engine(EngineConfig(model="tiny-debug", kv_cache_dtype="int8",
-                            tensor_parallel=2))
+def test_pack_unpack_lane_blocked_roundtrip():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 4, 16)), jnp.float32)
+    w2 = att.kv_lane_width(4, 16, True, lane_blocks=2)
+    rows = att.pack_kv_rows(x, w2, lane_blocks=2)
+    assert rows.shape == (8, w2)
+    back = att.unpack_kv_rows(rows, 4, 16, jnp.float32, lane_blocks=2)
+    amax = np.abs(np.asarray(x)).max(axis=2, keepdims=True)
+    assert (np.abs(np.asarray(back - x)) <= amax / 127.0 + 1e-6).all()
+    # each lane block is EXACTLY the single-block pack of its head half —
+    # the property that makes a plain lane split hand a shard its own
+    # values + scales
+    half = att.pack_kv_rows(x[:, :2], w2 // 2)
+    np.testing.assert_array_equal(np.asarray(rows[:, :w2 // 2]),
+                                  np.asarray(half))
+
+
+def test_int8_kv_blocking_requires_divisibility():
+    with pytest.raises(ValueError, match="divide num_kv_heads"):
+        KVCacheSpec.from_model(
+            ModelConfig.from_model_name("tiny-debug"), 8, 4,
+            kv_dtype="int8", tensor_parallel=3)
+
+
+def _int8_pool_from(kp_f, n_kv, d, lane_blocks=1):
+    p, ps, _ = kp_f.shape
+    w = att.kv_lane_width(n_kv, d, True, lane_blocks=lane_blocks)
+    rows = att.pack_kv_rows(
+        kp_f.reshape(p * ps, n_kv, d), w, lane_blocks=lane_blocks)
+    return rows.reshape(p, ps, w)
+
+
+def _decode_case(key, bsz=4, n_heads=8, n_kv=2, d=128, ps=16, npages=32,
+                 pmax=6):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (bsz, n_heads, d), jnp.float32)
+    kp = jax.random.normal(ks[1], (npages, ps, n_kv * d), jnp.float32)
+    vp = jax.random.normal(ks[2], (npages, ps, n_kv * d), jnp.float32)
+    bt = (jnp.arange(bsz * pmax, dtype=jnp.int32).reshape(bsz, pmax)
+          % (npages - 1)) + 1
+    cl = jnp.array([1, ps * 2 + 5, ps * pmax, 0][:bsz], jnp.int32)
+    return q, kp, vp, bt, cl
+
+
+def test_pallas_decode_reads_int8_pool():
+    """The decode kernel dequantizes packed int8 rows in-VMEM: its output
+    must match the XLA gather path on the SAME int8 pool to float tolerance
+    (identical dequantized values feed both)."""
+    q, kp, vp, bt, cl = _decode_case(jax.random.PRNGKey(7))
+    k8 = _int8_pool_from(kp, 2, 128)
+    v8 = _int8_pool_from(vp, 2, 128)
+    ref = att.paged_attention_decode_xla(q, k8, v8, bt, cl, page_size=16,
+                                         num_kv_heads=2)
+    out = pa.paged_attention_decode(q, k8, v8, bt, cl, page_size=16,
+                                    num_kv_heads=2, interpret=True)
+    np.testing.assert_allclose(np.asarray(out[:3]), np.asarray(ref[:3]),
+                               rtol=2e-5, atol=2e-5)
+    # and both stay within quantization error of the unquantized pool
+    full = att.paged_attention_decode_xla(q, kp, vp, bt, cl, page_size=16)
+    np.testing.assert_allclose(np.asarray(out[:3]), np.asarray(full[:3]),
+                               rtol=0.1, atol=0.1)
+
+
+def test_pallas_chunk_reads_int8_pool():
+    rng = np.random.default_rng(13)
+    ps, n_kv, d, h = 16, 2, 128, 8
+    kp = jnp.asarray(rng.normal(size=(32, ps, n_kv * d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(32, ps, n_kv * d)), jnp.float32)
+    k8, v8 = _int8_pool_from(kp, n_kv, d), _int8_pool_from(vp, n_kv, d)
+    pages = jnp.asarray(list(range(1, 7)) + [0, 0], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(16, h, d)), jnp.float32)
+    ref = att.chunk_attention(q, k8, v8, pages, 48, page_size=ps,
+                              num_kv_heads=n_kv)
+    out = pa.chunk_prefill_attention(q, k8, v8, pages, 48, page_size=ps,
+                                     num_kv_heads=n_kv, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_decode_int8_shard_map_tp2():
+    """tp=2 over a lane-blocked int8 pool: the shard_map lane split hands
+    each shard one [values|scales|pad] block; outputs match the full-layout
+    XLA path."""
+    from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(tensor_parallel=2))
+    q, kp, vp, bt, cl = _decode_case(jax.random.PRNGKey(8), n_heads=4,
+                                     n_kv=2, d=128)
+    k8 = _int8_pool_from(kp, 2, 128, lane_blocks=2)
+    v8 = _int8_pool_from(vp, 2, 128, lane_blocks=2)
+    with att.attention_context("xla", None, 2):
+        ref = att.paged_attention_decode(q, k8, v8, bt, cl, page_size=16,
+                                         num_kv_heads=2)
+    with att.attention_context("pallas_interpret", mesh, 2):
+        out = att.paged_attention_decode(q, k8, v8, bt, cl, page_size=16,
+                                         num_kv_heads=2)
+    np.testing.assert_allclose(np.asarray(out[:3]), np.asarray(ref[:3]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_engine_int8_kv_tensor_parallel_matches_tp1():
+    a, _ = _gen("int8")
+    b, eng = _gen("int8", tensor_parallel=2)
+    assert eng.kv_spec.lane_blocks == 2
+    assert a == b
 
 
 def test_invalid_kv_dtype_rejected():

@@ -224,15 +224,20 @@ class ServingContext:
         # --- disaggregation wiring (mirrors the reference's role flags,
         # /root/reference/examples/deploy/sglang/disagg.yaml:45-52) ---
         self.kv_source = None
+        self.kv_device_source = None
         self.disagg_client = None
         mode = engine.cfg.disaggregation_mode
         if mode == "prefill":
-            from dynamo_tpu.transfer.kv_transfer import KVSource
+            from dynamo_tpu.transfer.kv_transfer import DeviceKVSource, KVSource
 
             self.kv_source = KVSource(
                 engine, port=engine.cfg.disaggregation_bootstrap_port
             )
             log.info("prefill role: KV bootstrap on port %d", self.kv_source.port)
+            if engine.cfg.disaggregation_transfer_backend == "ici":
+                # cross-process leg of the ici plane: stage parked KV for
+                # device-buffer pulls (TCP KVSource stays as the fallback)
+                self.kv_device_source = DeviceKVSource(engine)
         elif mode == "decode":
             from dynamo_tpu.serving.disagg import DisaggDecodeClient, PrefillPool
 
@@ -390,6 +395,10 @@ class _Handler(JsonHTTPHandler):
                 self._completion(self._read_json_body())
             elif path == "/disagg/prefill":
                 self._disagg_prefill(self._read_json_body())
+            elif path == "/disagg/stage":
+                self._disagg_stage(self._read_json_body())
+            elif path == "/disagg/release":
+                self._disagg_release(self._read_json_body())
             else:
                 self._error(404, f"no route {path}")
         except proto.BadRequest as e:
@@ -446,8 +455,46 @@ class _Handler(JsonHTTPHandler):
             "n_tokens": n_tokens,
             "bootstrap_port": ctx.kv_source.port,
             "transfer_backend": ctx.engine.cfg.disaggregation_transfer_backend,
+            # staging itself is lazy (/disagg/stage) so a TCP-pulling peer
+            # never pins a gathered device copy in the transfer server
+            "device_transfer": bool(ctx.kv_device_source is not None
+                                    and ctx.kv_device_source.eligible),
             **extras,
         })
+
+    def _disagg_stage(self, body):
+        """Stage a parked sequence's KV with the transfer server and return
+        the device-pull coordinates (called by an ici decode peer just
+        before it pulls)."""
+        ctx = self.ctx
+        if ctx.kv_device_source is None:
+            raise proto.BadRequest(
+                "this worker does not serve device-buffer KV transfer")
+        rid = body.get("request_id")
+        if not rid:
+            raise proto.BadRequest("need request_id")
+        try:
+            staged = ctx.kv_device_source.stage(rid)
+        except KeyError:
+            raise proto.BadRequest(f"unknown request {rid!r}")
+        if staged is None:
+            raise proto.BadRequest("device-buffer staging unavailable")
+        self._json(200, {"request_id": rid, **staged})
+
+    def _disagg_release(self, body):
+        """Decode-side ack for a device-buffer KV pull: free the parked
+        pages (the TCP plane acks in-stream; the TTL sweep covers peers
+        that crash between pull and release)."""
+        ctx = self.ctx
+        if ctx.kv_source is None:
+            raise proto.BadRequest(
+                "this worker is not in --disaggregation-mode prefill"
+            )
+        rid = body.get("request_id")
+        if not rid:
+            raise proto.BadRequest("need request_id")
+        ctx.engine.release_parked(rid)
+        self._json(200, {"request_id": rid, "released": True})
 
     def _check_model(self, model: str):
         if model not in (self.ctx.served_model, self.ctx.engine.cfg.model):

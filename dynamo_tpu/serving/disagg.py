@@ -78,6 +78,20 @@ class DisaggDecodeClient:
     def __init__(self, ctx, pool: PrefillPool):
         self.ctx = ctx  # ServingContext
         self.pool = pool
+        self._device_client = None
+        self._dcn_warned: set = set()
+
+    def _warn_dcn_fallback(self, prefill_url: str, why: str):
+        """--disaggregation-transfer-backend ici was requested but this pair
+        degrades to the TCP plane: say so LOUDLY, once per pair (an operator
+        deploying ici across pods must see the downgrade, not discover it in
+        a bandwidth profile)."""
+        if prefill_url in self._dcn_warned:
+            return
+        self._dcn_warned.add(prefill_url)
+        log.warning(
+            "ici transfer backend: prefill %s %s — falling back to the dcn "
+            "(TCP host-bounce) plane for this pair", prefill_url, why)
 
     def start(self, req: GenRequest) -> "object":
         """Returns the event queue, with the first token already delivered."""
@@ -93,8 +107,6 @@ class DisaggDecodeClient:
             local = ici_registry.lookup(prefill_url)
             if local is not None:
                 return self._start_ici(req, local, prefill_url)
-            log.debug("ici backend: %s not in-process; dcn fallback",
-                      prefill_url)
 
         body = json.dumps({
             "request_id": req.request_id,
@@ -119,8 +131,28 @@ class DisaggDecodeClient:
                 out = json.loads(resp.read())
             first_token = out["first_token"]
             host = urllib.parse.urlparse(prefill_url).hostname
-            k, v, n_tokens = fetch_kv(host, out["bootstrap_port"],
-                                      req.request_id)
+            released = False
+            k = None
+            want_ici = (
+                ctx.engine.cfg.disaggregation_transfer_backend == "ici")
+            if want_ici and out.get("device_transfer"):
+                try:
+                    # cross-process device-buffer pull (no host bounce):
+                    # stage RPC + direct pull from the peer's device memory
+                    k, v = self._pull_device(prefill_url, host, req.request_id)
+                    n_tokens = out["n_tokens"]
+                except Exception as e:
+                    self._warn_dcn_fallback(
+                        prefill_url, f"device-buffer pull failed ({e})")
+            elif want_ici:
+                self._warn_dcn_fallback(
+                    prefill_url,
+                    "is neither in-process nor advertising device-buffer "
+                    "transfer")
+            if k is None:
+                k, v, n_tokens = fetch_kv(host, out["bootstrap_port"],
+                                          req.request_id)
+                released = True  # the TCP plane acks (and releases) in-stream
         except urllib.error.HTTPError as e:
             # a definitive client error from the prefill side stays definitive
             # (400), so callers don't retry a request that can never succeed
@@ -138,8 +170,9 @@ class DisaggDecodeClient:
                 f"prefill worker {prefill_url} unreachable: {e}"
             ) from e
         log.info(
-            "disagg: prefill(%d tok)+transfer(%.1f MB) in %.3fs via %s",
-            n_tokens, (k.nbytes + v.nbytes) / 1e6, time.monotonic() - t0,
+            "disagg%s: prefill(%d tok)+transfer(%.1f MB) in %.3fs via %s",
+            "" if released else "[ici-device]", n_tokens,
+            (k.nbytes + v.nbytes) / 1e6, time.monotonic() - t0,
             prefill_url,
         )
 
@@ -149,6 +182,9 @@ class DisaggDecodeClient:
         except Exception:
             ctx.service.detach(req.request_id)
             raise
+        finally:
+            if not released:
+                self._release_remote(prefill_url, req.request_id)
         ev = TokenEvent(req.request_id, first_token, 0, finished, reason)
         if req.logprobs is not None and "logprob" in out:
             ev.logprob = out["logprob"]
@@ -156,6 +192,53 @@ class DisaggDecodeClient:
         q.put(ev)
         ctx.service.wake()
         return q
+
+    def _pull_device(self, prefill_url: str, host: str, request_id: str):
+        """Stage (RPC) then pull a parked sequence's KV via the jax transfer
+        server (cross-process ici leg). A wildcard-bound advertised address
+        is substituted with the prefill worker's URL host."""
+        from dynamo_tpu.transfer.kv_transfer import DeviceKVClient
+
+        if self._device_client is None:
+            self._device_client = DeviceKVClient()
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                prefill_url.rstrip("/") + "/disagg/stage",
+                data=json.dumps({"request_id": request_id}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            ),
+            timeout=30,
+        ) as resp:
+            staged = json.loads(resp.read())
+        addr = staged["transfer_address"]
+        bind_host, _, port = addr.rpartition(":")
+        if bind_host.strip("[]") in ("", "::", "0.0.0.0"):
+            addr = f"{host}:{port}"
+        return self._device_client.pull(
+            addr, staged["transfer_uuid"], staged["kv_shape"],
+            staged["kv_dtype"])
+
+    def _release_remote(self, prefill_url: str, request_id: str) -> None:
+        """Best-effort parked-page release after a device-buffer pull, on a
+        background thread — the first token is already in hand and must not
+        wait on cleanup (the prefill side's TTL sweep covers lost acks)."""
+        def _post():
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        prefill_url.rstrip("/") + "/disagg/release",
+                        data=json.dumps({"request_id": request_id}).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    ),
+                    timeout=10,
+                ).close()
+            except Exception as e:
+                log.warning("parked-KV release on %s failed (%s); TTL sweep "
+                            "will reclaim", prefill_url, e)
+
+        threading.Thread(target=_post, daemon=True,
+                         name="disagg-release").start()
 
     def _start_ici(self, req: GenRequest, prefill_engine, prefill_url: str):
         """In-process (colocated) prefill: direct engine calls + the

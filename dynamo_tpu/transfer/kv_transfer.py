@@ -3,10 +3,14 @@
 Two data planes, selected by `--disaggregation-transfer-backend`
 (mirroring /root/reference/examples/deploy/sglang/disagg.yaml:47-48):
 
-- "ici": both roles share a process/slice — the handoff is a device-to-device
-  page copy placed by XLA over ICI (`Engine.export_kv`/`import_kv` on
-  jax.Arrays; no host roundtrip when src/dst shardings are compatible).
-  Used by the colocated topology and by in-process tests.
+- "ici": the handoff stays in device buffers. Two legs: (a) IN-PROCESS —
+  colocated roles found via transfer.ici_registry move pages as jax.Arrays
+  (XLA places a device-to-device copy; no host roundtrip); (b)
+  CROSS-PROCESS — the prefill side stages the pages with a
+  `jax.experimental.transfer` server (DeviceKVSource) and the decode side
+  pulls them straight into its own device memory (DeviceKVClient). A pair
+  that can do neither degrades to the TCP plane with a LOUD per-pair
+  warning on the decode side.
 - "dcn": cross-host — pages serialize to bytes and stream over the native
   transport (transfer.transport), with NIXL-style key rendezvous on the
   prefill worker's bootstrap port.
@@ -134,6 +138,133 @@ def fetch_kv(host: str, port: int, request_id: str
         return k, v, header["n_tokens"]
     finally:
         conn.close()
+
+
+# ------------------------------------------------------- device-buffer plane --
+# Cross-PROCESS leg of the "ici" backend: when prefill and decode engines
+# are colocated on one slice but in different processes (the reference's
+# standard disagg topology — separate pods,
+# /root/reference/examples/deploy/sglang/disagg.yaml:47-52), the parked KV
+# streams through `jax.experimental.transfer` — the decode side pulls the
+# prefill side's device buffers directly (no np.asarray readback, no JSON
+# byte pump). The in-process registry path remains the fastest leg; the TCP
+# (dcn) plane remains the cross-slice fallback.
+
+
+def _uuid64(request_id: str) -> int:
+    """Stable 63-bit pull id for a request (both sides derive it)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.sha256(request_id.encode()).digest()[:8], "big") >> 1
+
+
+_XFER_LOCK = threading.Lock()
+_XFER_SERVER = None
+
+
+def _transfer_server():
+    """Process-wide jax transfer server, started lazily.
+
+    Lazy on purpose: (a) starting two servers in ONE process crashes the
+    local bulk-transport factory (jaxlib streaming.cc CHECK), and in-process
+    handoffs never need a server; (b) worker startup shouldn't pay the
+    socket setup unless disagg device transfer is actually used.
+    Bind host comes from DYNAMO_TPU_TRANSFER_BIND (default 0.0.0.0 — the
+    advertised wildcard is substituted with the worker's URL host by the
+    decode side)."""
+    global _XFER_SERVER
+    with _XFER_LOCK:
+        if _XFER_SERVER is None:
+            import os
+
+            import jax
+            from jax.experimental import transfer as jxfer
+
+            bind = os.environ.get("DYNAMO_TPU_TRANSFER_BIND", "0.0.0.0")
+            client = jax.devices()[0].client
+            _XFER_SERVER = jxfer.start_transfer_server(
+                client, f"{bind}:0", [f"{bind}:0"])
+        return _XFER_SERVER
+
+
+class DeviceKVSource:
+    """Prefill side: stages a parked sequence's KV for a remote device pull.
+
+    stage() schedules the device arrays with the transfer server and returns
+    Staging is LAZY (the decode side's /disagg/stage RPC, not the prefill
+    response): an eager await_pull would pin a gathered KV copy in device
+    memory for every request whose peer then pulls over TCP instead — an
+    HBM leak, since the transfer server has no un-await. The remaining
+    window (peer stages but crashes before pulling) is bounded by the
+    parked-KV TTL for pool pages; the staged gather itself is dropped by
+    the server once pulled. Pages are released by the decode side's
+    /disagg/release RPC (or the TTL sweep)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._warned = False
+
+    @property
+    def eligible(self) -> bool:
+        """Cheap pre-check advertised in the prefill response: v1 pulls
+        single-device buffers, so a TP-sharded KV pool never stages (and
+        never pays the export gather only to discard it)."""
+        return len(self.engine.k_pages.sharding.device_set) == 1
+
+    def stage(self, request_id: str) -> Optional[dict]:
+        if not self.eligible:
+            return None
+        k, v, _ = self.engine.export_kv_device(request_id)
+        try:
+            srv = _transfer_server()
+            uid = _uuid64(request_id)
+            srv.await_pull(uid, [k, v])
+        except Exception as e:  # backend without transfer-server support
+            if not self._warned:
+                self._warned = True
+                log.warning(
+                    "device-buffer KV staging unavailable (%s); this "
+                    "prefill worker will serve KV over the TCP plane", e)
+            return None
+        return {
+            "transfer_address": srv.address(),
+            "transfer_uuid": uid,
+            "kv_shape": list(k.shape),
+            "kv_dtype": str(k.dtype),
+        }
+
+
+class DeviceKVClient:
+    """Decode side: pulls staged KV into local device memory."""
+
+    def __init__(self):
+        self._conns: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def pull(self, address: str, uuid: int, shape, dtype: str):
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        srv = _transfer_server()
+        with self._lock:
+            conn = self._conns.get(address)
+            if conn is None:
+                conn = srv.connect(address)
+                self._conns[address] = conn
+        sds = jax.ShapeDtypeStruct(
+            tuple(shape), jnp_dtype(dtype),
+            sharding=SingleDeviceSharding(jax.devices()[0]))
+        k, v = conn.pull(uuid, [sds, sds])
+        return k, v
+
+
+def jnp_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
 
 
 class ICIHandoff:

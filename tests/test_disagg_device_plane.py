@@ -1,0 +1,112 @@
+"""Cross-PROCESS device-buffer KV handoff (`ici` backend, second leg).
+
+The reference's NIXL plane is specifically a cross-pod transfer
+(/root/reference/examples/deploy/sglang/disagg.yaml:47-52). Here a prefill
+worker runs in a SEPARATE process, stages parked KV with its
+jax.experimental.transfer server, and the decode worker pulls the device
+buffers directly — with the TCP pull (fetch_kv) forbidden, proving the pair
+did not degrade to the host-bounce plane.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+
+KW = dict(model="tiny-debug", page_size=4, num_pages=64, max_num_seqs=2,
+          max_seq_len=64, seed=7, disaggregation_bootstrap_port=0)
+
+PREFILL_WORKER = r'''
+import sys
+from dynamo_tpu.utils.platform import force_cpu
+force_cpu()
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.serving.api import ServingContext, make_server
+
+eng = Engine(EngineConfig(
+    model="tiny-debug", page_size=4, num_pages=64, max_num_seqs=2,
+    max_seq_len=64, seed=7, disaggregation_bootstrap_port=0,
+    disaggregation_mode="prefill", disaggregation_transfer_backend="ici"))
+ctx = ServingContext(eng, served_model="tiny-debug")
+srv = make_server(ctx, host="127.0.0.1", port=0)
+with open(sys.argv[1], "w") as f:
+    f.write(f"http://127.0.0.1:{srv.server_address[1]}")
+srv.serve_forever()
+'''
+
+
+@pytest.mark.slow
+def test_cross_process_device_pull_no_host_bounce(monkeypatch):
+    url_file = tempfile.mktemp()
+    env = dict(os.environ)
+    proc = subprocess.Popen([sys.executable, "-c", PREFILL_WORKER, url_file],
+                            env=env)
+    try:
+        deadline = time.monotonic() + 300
+        prefill_url = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError("prefill worker died during startup")
+            if os.path.exists(url_file):
+                prefill_url = open(url_file).read().strip()
+                if prefill_url:
+                    break
+            time.sleep(0.5)
+        assert prefill_url, "prefill worker never came up"
+
+        from dynamo_tpu.serving.api import ServingContext, make_server
+
+        dec = Engine(EngineConfig(
+            disaggregation_mode="decode",
+            disaggregation_transfer_backend="ici", **KW))
+        dec_ctx = ServingContext(dec, served_model="tiny-debug",
+                                 prefill_urls=[prefill_url])
+        dec_srv = make_server(dec_ctx, host="127.0.0.1", port=0)
+        threading.Thread(target=dec_srv.serve_forever, daemon=True).start()
+
+        # the TCP plane must NOT be touched: a fallback is a test failure
+        def boom(*a, **k):
+            raise AssertionError("TCP host-bounce pull used under ici")
+        monkeypatch.setattr("dynamo_tpu.serving.disagg.fetch_kv", boom)
+
+        body = {"model": "tiny-debug",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 6, "temperature": 0, "seed": 11}
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{dec_srv.server_address[1]}"
+                "/v1/chat/completions",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            out = json.load(urllib.request.urlopen(req, timeout=300))
+            text = out["choices"][0]["message"]["content"]
+
+            # byte-identical to an aggregated run of the same params/seed
+            # (both processes init identical params from seed=7)
+            agg = Engine(EngineConfig(**KW))
+            from dynamo_tpu.engine.tokenizer import ByteTokenizer
+
+            tok = ByteTokenizer()
+            ids = tok.encode(tok.apply_chat_template(body["messages"]))
+            ref = agg.generate(GenRequest("ref", ids, max_tokens=6,
+                                          temperature=0.0))
+            assert text == tok.decode(ref)
+        finally:
+            dec_srv.shutdown()
+            dec_ctx.close()
+    finally:
+        proc.kill()
+        proc.wait()
+        if os.path.exists(url_file):
+            os.unlink(url_file)

@@ -8,9 +8,11 @@ chunks back over reply inboxes (dynamo_tpu.serving.nats_plane).
 
 Two pieces:
 - `NatsClient`: a synchronous client speaking the standard NATS text protocol
-  (INFO/CONNECT/PING/PONG/SUB/PUB/MSG, queue groups, reply inboxes) — works
-  against the official `nats-server` the platform manifests deploy
-  (deploy/platform/nats.yaml).
+  (INFO/CONNECT/PING/PONG/SUB/PUB/MSG, HMSG from headers-enabled servers,
+  queue groups, reply inboxes) — works against the official `nats-server`
+  the platform manifests deploy (deploy/platform/nats.yaml); conformance
+  covered by recorded-transcript tests plus an opt-in run against the real
+  binary (tests/test_nats_conformance.py).
 - `MiniNatsBroker`: an in-process broker implementing the same core subset,
   used by the test suite and for single-node dev (`python -m
   dynamo_tpu.serving.nats` serves one on :4222). Subject matching supports
@@ -94,12 +96,17 @@ class _LineReader:
 
 
 class Msg:
-    __slots__ = ("subject", "reply", "data")
+    __slots__ = ("subject", "reply", "data", "headers")
 
-    def __init__(self, subject: str, reply: Optional[str], data: bytes):
+    def __init__(self, subject: str, reply: Optional[str], data: bytes,
+                 headers: Optional[bytes] = None):
         self.subject = subject
         self.reply = reply
         self.data = data
+        # raw NATS/1.0 header block from HMSG frames (None for MSG); the
+        # request plane doesn't use headers, but a headers-enabled server
+        # must not desync the reader (see _read_loop)
+        self.headers = headers
 
 
 class NatsClient:
@@ -150,7 +157,12 @@ class NatsClient:
             b"CONNECT "
             + json.dumps({"verbose": False, "pedantic": False,
                           "name": self._name, "lang": "python",
-                          "version": "0"}).encode()
+                          "version": "0", "protocol": 1,
+                          # we can PARSE HMSG (defensive), so advertising
+                          # headers support is honest — a headers-enabled
+                          # nats-server may then route headered publishes
+                          # from other clients to us intact
+                          "headers": True, "no_responders": False}).encode()
             + b"\r\n"
         )
         # re-issue active subscriptions (no-op on first connect)
@@ -166,6 +178,14 @@ class NatsClient:
     def _send(self, data: bytes) -> None:
         with self._wlock:
             self.sock.sendall(data)
+
+    def _dispatch(self, sid: int, msg: Msg) -> None:
+        cb = self._subs.get(sid)
+        if cb is not None:
+            try:
+                cb(msg)
+            except Exception:
+                log.exception("nats subscription callback failed")
 
     def _read_loop(self) -> None:
         backoff = 0.2
@@ -186,13 +206,24 @@ class NatsClient:
                             reply = None
                         data = self._reader.read_exact(int(nbytes))
                         self._reader.read_exact(2)  # trailing CRLF
-                        cb = self._subs.get(int(sid))
-                        if cb is not None:
-                            try:
-                                cb(Msg(subject, reply, data))
-                            except Exception:
-                                log.exception(
-                                    "nats subscription callback failed")
+                        self._dispatch(int(sid), Msg(subject, reply, data))
+                    elif line.startswith(b"HMSG "):
+                        # HMSG <subject> <sid> [reply-to] <#hdr> <#total> —
+                        # sent by headers-enabled servers (nats-server 2.2+)
+                        # when a peer publishes with headers. Headers ride
+                        # along raw; payload is the post-header remainder.
+                        parts = line.decode().split(" ")
+                        if len(parts) == 6:
+                            _, subject, sid, reply, hbytes, tbytes = parts
+                        else:
+                            _, subject, sid, hbytes, tbytes = parts
+                            reply = None
+                        blob = self._reader.read_exact(int(tbytes))
+                        self._reader.read_exact(2)  # trailing CRLF
+                        nh = int(hbytes)
+                        self._dispatch(
+                            int(sid),
+                            Msg(subject, reply, blob[nh:], headers=blob[:nh]))
                     elif line.startswith(b"-ERR"):
                         log.warning("nats error: %s",
                                     line.decode(errors="replace"))

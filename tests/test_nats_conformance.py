@@ -1,0 +1,196 @@
+"""NatsClient conformance beyond the self-referential MiniNatsBroker loop.
+
+Two tiers (VERDICT r3 #7):
+- A scripted server replaying REAL nats-server protocol bytes (v2.10-style
+  INFO with headers:true, PING, MSG, HMSG, -ERR, restart) — always runs.
+- An opt-in test against the official `nats-server` binary when present on
+  PATH (the thing deploy/platform/nats.yaml actually deploys).
+"""
+
+import json
+import queue
+import shutil
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from dynamo_tpu.serving.nats import NatsClient
+
+REAL_INFO = (
+    b'INFO {"server_id":"NDYZ54LYIIBGQV7EHRQM","server_name":"nats-0",'
+    b'"version":"2.10.14","proto":1,"git_commit":"0d23d2f","go":"go1.21.9",'
+    b'"host":"0.0.0.0","port":4222,"headers":true,"max_payload":1048576,'
+    b'"client_id":7,"client_ip":"127.0.0.1"}\r\n'
+)
+
+
+class ScriptedServer:
+    """One-connection-at-a-time fake nats-server driven by the test body."""
+
+    def __init__(self):
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self.conn = None
+        self.buf = b""
+
+    def accept(self, timeout=10.0):
+        self._srv.settimeout(timeout)
+        self.conn, _ = self._srv.accept()
+        self.conn.settimeout(10.0)
+        self.buf = b""
+        self.conn.sendall(REAL_INFO)
+
+    def read_line(self):
+        while b"\r\n" not in self.buf:
+            chunk = self.conn.recv(65536)
+            if not chunk:
+                raise ConnectionError("client closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def send(self, data: bytes):
+        self.conn.sendall(data)
+
+    def drop_conn(self):
+        self.conn.shutdown(socket.SHUT_RDWR)
+        self.conn.close()
+
+    def close(self):
+        try:
+            if self.conn:
+                self.conn.close()
+        finally:
+            self._srv.close()
+
+
+def test_scripted_real_server_transcript():
+    srv = ScriptedServer()
+    got = queue.Queue()
+    client = None
+    try:
+        t = threading.Thread(
+            target=lambda: srv.accept(), daemon=True)
+        t.start()
+        client = NatsClient(f"nats://127.0.0.1:{srv.port}")
+        t.join(timeout=10)
+
+        # CONNECT must be valid JSON advertising headers support
+        connect = srv.read_line()
+        assert connect.startswith(b"CONNECT ")
+        opts = json.loads(connect[8:])
+        assert opts["headers"] is True and opts["protocol"] == 1
+
+        client.subscribe("orders.*", got.put)
+        sub = srv.read_line()
+        assert sub.split(b" ")[0] == b"SUB" and b"orders.*" in sub
+        sid = sub.split(b" ")[-1].decode()
+
+        # server PING -> client must PONG (or the server disconnects it)
+        srv.send(b"PING\r\n")
+        assert srv.read_line() == b"PONG"
+
+        # plain MSG
+        srv.send(f"MSG orders.eu {sid} 5\r\n".encode() + b"hello\r\n")
+        msg = got.get(timeout=10)
+        assert (msg.subject, msg.data, msg.headers) == ("orders.eu", b"hello",
+                                                        None)
+
+        # HMSG from a headers-enabled server: payload intact, headers carried
+        hdr = b"NATS/1.0\r\nTrace-Id: abc\r\n\r\n"
+        payload = b"with-headers"
+        total = len(hdr) + len(payload)
+        srv.send(
+            f"HMSG orders.us {sid} reply.here {len(hdr)} {total}\r\n".encode()
+            + hdr + payload + b"\r\n")
+        msg = got.get(timeout=10)
+        assert msg.data == payload
+        assert msg.reply == "reply.here"
+        assert msg.headers.startswith(b"NATS/1.0")
+
+        # -ERR must not kill the reader: traffic continues
+        srv.send(b"-ERR 'Unknown Protocol Operation'\r\n")
+        srv.send(f"MSG orders.eu {sid} 2\r\nok\r\n".encode())
+        assert got.get(timeout=10).data == b"ok"
+    finally:
+        if client:
+            client.close()
+        srv.close()
+
+
+def test_scripted_restart_reissues_subscriptions():
+    """Server restart: the client redials, re-sends CONNECT on the REAL wire
+    format, and re-issues every subscription with its original sid."""
+    srv = ScriptedServer()
+    got = queue.Queue()
+    client = None
+    try:
+        t = threading.Thread(target=lambda: srv.accept(), daemon=True)
+        t.start()
+        client = NatsClient(f"nats://127.0.0.1:{srv.port}")
+        t.join(timeout=10)
+        srv.read_line()  # CONNECT
+        client.subscribe("jobs", got.put, queue_group="workers")
+        sub = srv.read_line()
+        assert sub == b"SUB jobs workers 1"
+
+        srv.drop_conn()  # broker bounce
+        srv.accept(timeout=30)  # client redials
+        connect = srv.read_line()
+        assert connect.startswith(b"CONNECT ")
+        resub = srv.read_line()
+        assert resub == b"SUB jobs workers 1"
+        srv.send(b"MSG jobs 1 4\r\nback\r\n")
+        assert got.get(timeout=10).data == b"back"
+    finally:
+        if client:
+            client.close()
+        srv.close()
+
+
+NATS_BIN = shutil.which("nats-server")
+
+
+@pytest.mark.skipif(NATS_BIN is None, reason="official nats-server not on PATH")
+def test_against_official_nats_server():
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        port = s.getsockname()[1]
+    proc = subprocess.Popen([NATS_BIN, "-a", "127.0.0.1", "-p", str(port)],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 15
+        client = None
+        while time.monotonic() < deadline:
+            try:
+                client = NatsClient(f"nats://127.0.0.1:{port}")
+                break
+            except OSError:
+                time.sleep(0.3)
+        assert client, "could not reach official nats-server"
+        got = queue.Queue()
+        client.subscribe("t.>", got.put)
+        time.sleep(0.2)  # server must process SUB before the publish
+        client.publish("t.x", b"ping-official")
+        assert got.get(timeout=10).data == b"ping-official"
+
+        # headered publish from a raw peer -> arrives as HMSG
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as raw:
+            raw.recv(65536)  # INFO
+            raw.sendall(b'CONNECT {"verbose":false,"headers":true}\r\n')
+            hdr = b"NATS/1.0\r\nX: 1\r\n\r\n"
+            body = b"hdr-payload"
+            raw.sendall(
+                f"HPUB t.h {len(hdr)} {len(hdr) + len(body)}\r\n".encode()
+                + hdr + body + b"\r\n")
+            raw.sendall(b"PING\r\n")
+            raw.recv(65536)  # flush
+        msg = got.get(timeout=10)
+        assert msg.data == body and msg.headers is not None
+        client.close()
+    finally:
+        proc.kill()
+        proc.wait()

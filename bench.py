@@ -22,6 +22,9 @@ BENCH_QUANT (with BENCH_MODEL: none|int8|w8a8 — w8a8 is the fast
 quantized mode and the v5e headline default; int8 is weight-only),
 BENCH_TRACE=DIR (capture a jax.profiler/XProf trace of the timed loop),
 BENCH_KV=int8 (quantized KV-cache pages; halves KV HBM),
+BENCH_SPEC=ngram (n-gram speculative decoding; acceptance reported),
+BENCH_PREFILL_CHUNK=N (override the engine's chunked-prefill size; 0 whole),
+BENCH_REPETITIVE_PROMPTS=1 (looping prompts — the spec proposer's best case),
 BENCH_FORCE_CPU, BENCH_SECONDARY=0 to skip the secondary run,
 BENCH_INIT_BUDGET_S (accelerator retry budget, default 900 — backoff probes
 span the whole budget plus one late retry; the tunnel flakes for hours).
@@ -151,6 +154,13 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
         while batch > 4 and batch * kv_seq > budget * 0.8:
             batch //= 2
 
+    # engine-config overrides only when explicitly asked (engine defaults —
+    # e.g. prefill_chunk_tokens=256 — otherwise apply unchanged)
+    extra = {}
+    if os.environ.get("BENCH_PREFILL_CHUNK") is not None:
+        extra["prefill_chunk_tokens"] = int(os.environ["BENCH_PREFILL_CHUNK"])
+    if os.environ.get("BENCH_SPEC"):
+        extra["speculative_mode"] = os.environ["BENCH_SPEC"]
     eng = Engine(
         EngineConfig(
             model=model,
@@ -161,12 +171,26 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
             num_scheduler_steps=multistep,
             quantization=quant,
             kv_cache_dtype=os.environ.get("BENCH_KV", "auto"),
+            **extra,
         ),
         model_cfg=mcfg,
     )
 
-    prompts = [[(i * 7 + j) % 200 + 1 for j in range(prompt_len)]
-               for i in range(batch)]
+    if os.environ.get("BENCH_REPETITIVE_PROMPTS"):
+        # short cycles: the n-gram speculative proposer's best case (and a
+        # realistic stand-in for templated/structured generation). The cycle
+        # LENGTH depends on the salt (8 vs 9) so timed prompts can never
+        # alias warmup prompts — equal streams would need both cycles
+        # constant — and the prefix cache can't absorb the timed prefills.
+        def mk(i, salt):
+            n = 8 + salt // 2
+            base = [(i * 13 + salt * 31 + j) % 97 + 3 for j in range(n)]
+            return (base * (prompt_len // n + 1))[:prompt_len]
+    else:
+        def mk(i, salt):
+            return [(i * (7 + salt) + j * (1 + salt)) % 199 + 1
+                    for j in range(prompt_len)]
+    prompts = [mk(i, 0) for i in range(batch)]
     # warmup compiles prefill + BOTH decode paths (the fused multi-step window
     # needs every sequence to have >= multistep tokens of headroom, so warm
     # generations must be long enough to trigger it)
@@ -183,8 +207,7 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
 
     # FRESH prompts for the timed run: reusing the warmup prompts would let
     # the prefix cache absorb every prefill and report cache-hit TTFT
-    timed_prompts = [[(i * 11 + j * 3) % 197 + 2 for j in range(prompt_len)]
-                     for i in range(batch)]
+    timed_prompts = [mk(i, 2) for i in range(batch)]
     for i, p in enumerate(timed_prompts):
         eng.add_request(
             GenRequest(f"b{i}", p, max_tokens=steps, temperature=0.0,
@@ -231,6 +254,12 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     }
     if quant != "none":
         out["quantization"] = quant
+    if eng.metrics.spec_draft_tokens:
+        out["spec_drafted"] = eng.metrics.spec_draft_tokens
+        out["spec_accepted"] = eng.metrics.spec_accepted_tokens
+        out["spec_acceptance"] = round(
+            eng.metrics.spec_accepted_tokens
+            / max(eng.metrics.spec_draft_tokens, 1), 4)
     if chip is not None:
         # decode-phase utilization against datasheet peaks: MFU from the
         # roofline's active-param FLOP model, MBU from weight+KV stream bytes
@@ -276,7 +305,8 @@ def main() -> None:
         "itl_ms": res["itl_ms"],
     }
     for k in ("mfu", "mbu", "quantization", "ttft_p50_ms", "itl_p50_ms",
-              "itl_p95_ms"):
+              "itl_p95_ms", "spec_drafted", "spec_accepted",
+              "spec_acceptance"):
         if k in res:
             line[k] = res[k]
     forced = bool(os.environ.get("BENCH_FORCE_CPU"))

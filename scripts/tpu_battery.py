@@ -1,0 +1,137 @@
+"""TPU measurement battery: capture every chip-dependent round-4 number the
+moment the flaky tunnel comes up, in ONE long-lived process.
+
+Waits for the accelerator (huge retry budget — it IS the watcher), then runs
+the measurement matrix on the 8B w8a8 headline config, persisting each row
+to bench_results/tpu_battery_r04.jsonl as it lands so a mid-battery tunnel
+drop keeps everything measured so far:
+
+  1. decode multistep window sweep: 16 / 32 / 64   (VERDICT r3 #3)
+  2. int8 KV + Pallas decode combined               (VERDICT r3 #2)
+  3. chunked prefill TTFT at 4k ISL, XLA vs Pallas chunk kernel (#6)
+  4. n-gram speculative decoding, repetitive + natural workloads (#8)
+  5. headline bench.py line -> BENCH_TPU_SNAPSHOT.json (committed) (#1)
+
+Usage: python scripts/tpu_battery.py [--budget-s N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench_results", "tpu_battery_r04.jsonl")
+
+
+def emit(row: dict) -> None:
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print("ROW", json.dumps(row), flush=True)
+
+
+def run_case(tag: str, env: dict, bench_mod, chip, model: str, quant: str):
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+    t0 = time.time()
+    try:
+        res = bench_mod.bench_model(model, True, chip, quant=quant)
+        emit({"case": tag, "env": {k: v for k, v in env.items()
+                                   if v is not None}, **res,
+              "wall_s": round(time.time() - t0, 1)})
+        return res
+    except Exception as e:  # persist the failure, keep the battery going
+        emit({"case": tag, "error": f"{type(e).__name__}: {e}",
+              "trace": traceback.format_exc()[-1500:]})
+        return None
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-s", type=float, default=6 * 3600)
+    args = ap.parse_args()
+
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "dynamo_tpu",
+                     "jax-comp-cache"))
+    import logging
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    from dynamo_tpu.utils.platform import init_backend_with_fallback
+
+    backend = init_backend_with_fallback(budget_s=args.budget_s,
+                                        probe_timeout_s=120.0)
+    if backend == "cpu":
+        emit({"case": "init", "error": "accelerator unreachable for the "
+              f"whole {args.budget_s:.0f}s budget"})
+        sys.exit(1)
+    import jax
+
+    import bench as bench_mod
+
+    dev = jax.devices()[0]
+    chip = bench_mod._chip_spec(dev)
+    emit({"case": "init", "backend": backend,
+          "chip": getattr(dev, "device_kind", str(dev))})
+
+    model, quant = "meta-llama-3-8b-instruct", "w8a8"
+
+    # 1) multistep window sweep (ITL vs host round-trip amortization)
+    for w in (16, 32, 64):
+        run_case(f"multistep_{w}", {"BENCH_MULTISTEP": w}, bench_mod, chip,
+                 model, quant)
+
+    # 2) int8 KV + Pallas decode combined (both headline HBM levers at once);
+    #    doubled batch is the point of halving KV
+    run_case("int8kv_pallas", {"BENCH_KV": "int8", "BENCH_MULTISTEP": 32},
+             bench_mod, chip, model, quant)
+    run_case("int8kv_pallas_b128",
+             {"BENCH_KV": "int8", "BENCH_MULTISTEP": 32, "BENCH_BATCH": 128},
+             bench_mod, chip, model, quant)
+
+    # 3) chunked prefill TTFT at the reference SLA's 4k ISL
+    #    (dgdr.yaml isl: 4000), XLA gather vs Pallas chunk kernel
+    base_4k = {"BENCH_PROMPT_LEN": 4096, "BENCH_BATCH": 8, "BENCH_STEPS": 32,
+               "BENCH_PREFILL_CHUNK": 512}
+    run_case("chunk4k_xla", {**base_4k, "DYNAMO_TPU_CHUNK_ATTENTION": "xla"},
+             bench_mod, chip, model, quant)
+    run_case("chunk4k_pallas",
+             {**base_4k, "DYNAMO_TPU_CHUNK_ATTENTION": "pallas"},
+             bench_mod, chip, model, quant)
+
+    # 4) speculative decoding: acceptance + tok/s on a repetition-heavy
+    #    prompt set (ngram's best case) and the default varied set
+    run_case("spec_off_b8", {"BENCH_BATCH": 8}, bench_mod, chip, model, quant)
+    run_case("spec_ngram_b8", {"BENCH_BATCH": 8, "BENCH_SPEC": "ngram"},
+             bench_mod, chip, model, quant)
+    run_case("spec_ngram_rep_b8",
+             {"BENCH_BATCH": 8, "BENCH_SPEC": "ngram",
+              "BENCH_REPETITIVE_PROMPTS": "1"},
+             bench_mod, chip, model, quant)
+
+    print("battery complete; run `python bench.py` for the snapshot line",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

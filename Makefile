@@ -4,7 +4,9 @@
 #   make dynamo         - install the Dynamo-TPU platform (CRDs, operator, TPU plugin)
 #   make install        - both of the above
 #   make benchmark-env  - set up the benchmark virtualenv
-.PHONY: k8s dynamo install benchmark-env help
+#   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
+#   make test-full      - the whole suite incl. compile-heavy + slow tests
+.PHONY: k8s dynamo install benchmark-env test test-full help
 
 help:
 	@echo "Targets:"
@@ -12,6 +14,8 @@ help:
 	@echo "  dynamo         install Dynamo-TPU platform (CRDs, operator, etcd, NATS, TPU device plugin)"
 	@echo "  install        k8s + dynamo"
 	@echo "  benchmark-env  create benchmark virtualenv + deps"
+	@echo "  test           fast test tier (skips compile-heavy/slow; CI-grade, <5 min on 1 CPU)"
+	@echo "  test-full      full suite (compile-heavy + slow included)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -27,3 +31,9 @@ install: k8s dynamo
 
 benchmark-env:
 	./setup-benchmark-env.sh
+
+test:
+	python -m pytest tests/ -q -m "not slow and not compile_heavy"
+
+test-full:
+	python -m pytest tests/ -q -m ""

@@ -169,11 +169,16 @@ def init_backend_with_fallback(
                 log.warning("in-process init failed after probe ok: %s", e)
             # JAX caches backend-init failures for the life of the process;
             # without clearing, every later attempt re-raises the cached
-            # error without re-contacting the hardware
+            # error without re-contacting the hardware. jax.extend is NOT
+            # auto-imported by `import jax` — the explicit submodule import
+            # is load-bearing (a bare attribute access AttributeErrors).
             try:
+                import jax.extend.backend
+
                 jax.extend.backend.clear_backends()
             except Exception:
-                pass
+                log.warning("clear_backends failed; later attempts may "
+                            "re-raise a cached init error", exc_info=True)
         else:
             log.warning(
                 "accelerator probe attempt %d failed (timeout or error); "

@@ -382,6 +382,11 @@ class _Handler(JsonHTTPHandler):
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 out["prefix_cache"] = pc.stats()
+            dc = self.ctx.disagg_client
+            if dc is not None:
+                # which KV plane requests ACTUALLY used (an ici deployment
+                # that degraded to dcn shows up here, not just in a log)
+                out["transfer_planes"] = dict(dc.plane_counts)
             self._json(200, out)
         else:
             self._error(404, f"no route {path}")

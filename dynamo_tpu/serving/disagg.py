@@ -75,11 +75,30 @@ class PrefillPool:
 class DisaggDecodeClient:
     """Runs the prefill RPC + KV pull + import for one request."""
 
+    PLANES = ("ici_inproc", "ici_device", "dcn")
+
     def __init__(self, ctx, pool: PrefillPool):
         self.ctx = ctx  # ServingContext
         self.pool = pool
         self._device_client = None
         self._dcn_warned: set = set()
+        # per-plane COMPLETED-transfer counts (thread-safe labeled Counter,
+        # scraped at /metrics and mirrored in /worker/stats): an ici
+        # deployment that degrades to dcn is visible operationally
+        from dynamo_tpu.serving.metrics import Counter
+
+        self._plane_counter = Counter(
+            "dynamo_worker_kv_transfers_total",
+            "Completed disagg KV transfers by data plane",
+            ctx.metrics.registry)
+
+    @property
+    def plane_counts(self) -> dict:
+        vals = {p: 0 for p in self.PLANES}
+        with self._plane_counter._lock:
+            for lbl, v in self._plane_counter._values.items():
+                vals[dict(lbl)["plane"]] = int(v)
+        return vals
 
     def _warn_dcn_fallback(self, prefill_url: str, why: str):
         """--disaggregation-transfer-backend ici was requested but this pair
@@ -141,6 +160,7 @@ class DisaggDecodeClient:
                     # stage RPC + direct pull from the peer's device memory
                     k, v = self._pull_device(prefill_url, host, req.request_id)
                     n_tokens = out["n_tokens"]
+                    self._plane_counter.inc(plane="ici_device")
                 except Exception as e:
                     self._warn_dcn_fallback(
                         prefill_url, f"device-buffer pull failed ({e})")
@@ -153,6 +173,7 @@ class DisaggDecodeClient:
                 k, v, n_tokens = fetch_kv(host, out["bootstrap_port"],
                                           req.request_id)
                 released = True  # the TCP plane acks (and releases) in-stream
+                self._plane_counter.inc(plane="dcn")
         except urllib.error.HTTPError as e:
             # a definitive client error from the prefill side stays definitive
             # (400), so callers don't retry a request that can never succeed
@@ -248,6 +269,7 @@ class DisaggDecodeClient:
         t0 = time.monotonic()
         first_token, n_tokens, extras = prefill_engine.prefill_only(req)
         k, v, _ = prefill_engine.export_kv_device(req.request_id)
+        self._plane_counter.inc(plane="ici_inproc")  # handoff data in hand
         q = ctx.service.attach(req.request_id)
         try:
             finished, reason = ctx.engine.import_kv(req, first_token, k, v)

@@ -102,6 +102,13 @@ def test_cross_process_device_pull_no_host_bounce(monkeypatch):
             ref = agg.generate(GenRequest("ref", ids, max_tokens=6,
                                           temperature=0.0))
             assert text == tok.decode(ref)
+
+            # /worker/stats reports which plane actually served the request
+            stats = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{dec_srv.server_address[1]}/worker/stats",
+                timeout=30))
+            assert stats["transfer_planes"] == {
+                "ici_inproc": 0, "ici_device": 1, "dcn": 0}
         finally:
             dec_srv.shutdown()
             dec_ctx.close()

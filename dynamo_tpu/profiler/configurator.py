@@ -29,16 +29,47 @@ def sweep(
     system: SystemSpec,
     isl: int,
     osl: int,
+    quantization: str = "none",
+    kv_dtype: str = "auto",
 ) -> List[roofline.Estimate]:
     """All feasible (tp, batch) points on the system, throughput-sorted."""
     out = []
     for tp in valid_tp_sizes(system):
         for b in _BATCHES:
-            e = roofline.estimate(cfg, system, tp, b, isl, osl)
+            e = roofline.estimate(cfg, system, tp, b, isl, osl,
+                                  quantization=quantization,
+                                  kv_dtype=kv_dtype)
             if e.feasible:
                 out.append(e)
     out.sort(key=lambda e: e.tok_s_per_chip, reverse=True)
     return out
+
+
+def tiered_sweep(
+    cfg: ModelConfig,
+    system: SystemSpec,
+    isl: int,
+    osl: int,
+    ttft_ms: Optional[float] = None,
+    itl_ms: Optional[float] = None,
+    min_replicas: int = 1,
+) -> List[roofline.Estimate]:
+    """Sweep the serving quantization tiers in PREFERENCE order and return
+    the first tier with an SLA-meeting config (quantization has an accuracy
+    cost, so it is recommended only when the plain config cannot fit or
+    cannot meet the SLA — the call an operator would make by hand). Falls
+    back to the best-throughput feasible points across all tiers when no
+    tier meets the SLA; [] only when nothing fits at batch 1."""
+    all_cands: List[roofline.Estimate] = []
+    for quant, kvd in roofline.QUANT_TIERS:
+        cands = [e for e in sweep(cfg, system, isl, osl, quant, kvd)
+                 if e.replicas >= min_replicas]
+        meeting = [e for e in cands if e.meets(ttft_ms, itl_ms)]
+        if meeting:
+            return meeting
+        all_cands.extend(cands)
+    all_cands.sort(key=lambda e: e.tok_s_per_chip, reverse=True)
+    return all_cands
 
 
 def best_config(
@@ -49,18 +80,14 @@ def best_config(
     ttft_ms: Optional[float] = None,
     itl_ms: Optional[float] = None,
 ) -> Optional[roofline.Estimate]:
-    """Highest-throughput feasible point that meets the SLA.
-
-    Falls back to the highest-throughput feasible point (ignoring the SLA) if
-    nothing meets it — mirroring the reference posture of warn-and-continue
-    rather than refuse (deploy waits warn, /root/reference/deploy-incluster.sh:528-529).
-    Returns None only when the model cannot fit on the system at batch 1.
-    """
-    cands = sweep(cfg, system, isl, osl)
-    if not cands:
-        return None
-    meeting = [e for e in cands if e.meets(ttft_ms, itl_ms)]
-    return (meeting or cands)[0]
+    """Best point across quantization tiers: highest-throughput SLA-meeting
+    config of the least-quantized sufficient tier, else the
+    highest-throughput feasible point overall — mirroring the reference
+    posture of warn-and-continue rather than refuse (deploy waits warn,
+    /root/reference/deploy-incluster.sh:528-529). Returns None only when the
+    model cannot fit on the system at batch 1 under any tier."""
+    cands = tiered_sweep(cfg, system, isl, osl, ttft_ms, itl_ms)
+    return cands[0] if cands else None
 
 
 def disagg_split(est: roofline.Estimate, isl: int, osl: int) -> Optional[Dict[str, int]]:
@@ -124,6 +151,22 @@ def _set_flag(args: List[str], flag: str, value: str) -> List[str]:
             i += 1
     if not done:
         out += [flag, value]
+    return out
+
+
+def _unset_flag(args: List[str], flag: str) -> List[str]:
+    """Remove `flag value` / `flag=value` from an argv list (re-applied
+    DGDs must not keep a stale lever the new decision didn't choose)."""
+    out, i = [], 0
+    while i < len(args):
+        a = args[i]
+        if a == flag:
+            i += 2
+        elif a.startswith(flag + "="):
+            i += 1
+        else:
+            out.append(a)
+            i += 1
     return out
 
 
@@ -192,26 +235,48 @@ def apply_sla_overrides(
     }
     has_disagg = "prefill" in roles.values()
 
-    cands = sweep(cfg, sys_spec, isl, osl)
+    # disaggregation needs >= 2 replica groups (one per pool); a winner
+    # that consumes the whole slice would double the chip demand
+    min_reps = 2 if has_disagg else 1
+    cands = tiered_sweep(cfg, sys_spec, isl, osl, ttft, itl,
+                         min_replicas=min_reps)
     if not cands:
-        return skip("infeasible", model=model)
-    if has_disagg:
-        # disaggregation needs >= 2 replica groups (one per pool); a winner
-        # that consumes the whole slice would double the chip demand
-        cands = [e for e in cands if e.replicas >= 2]
-        if not cands:
+        if has_disagg and tiered_sweep(cfg, sys_spec, isl, osl, ttft, itl):
             return skip("disagg_infeasible", model=model,
                         reason="no config with >=2 replica groups fits")
-    meeting = [e for e in cands if e.meets(ttft, itl)]
-    est = (meeting or cands)[0]
+        return skip("infeasible", model=model)
+    est = cands[0]
     split = disagg_split(est, isl, osl) if has_disagg else None
+
+    # host topology: tp groups wider than one host become multi-host gangs
+    # (hostsPerReplica), with limits.tpu = chips per HOST — the operator's
+    # gang StatefulSets handle the rest (materialize.build_gang_statefulset)
+    cph = sys_spec.chip.chips_per_host
+    hosts = max(1, -(-est.tp // cph))
+    tpu_per_pod = est.tp if hosts == 1 else cph
 
     for name, svc in workers.items():
         args = _get_args(svc)
         args = _set_flag(args, "--tp", str(est.tp))
         args = _set_flag(args, "--max-num-seqs", str(est.batch))
+        # serving quantization levers: set when the winning tier needs
+        # them, REMOVED when it doesn't (a re-applied DGD must not keep a
+        # stale lever that contradicts the new decision annotation)
+        if est.quantization != "none":
+            args = _set_flag(args, "--quantization", est.quantization)
+        else:
+            args = _unset_flag(args, "--quantization")
+        if est.kv_dtype != "auto":
+            args = _set_flag(args, "--kv-cache-dtype", est.kv_dtype)
+        else:
+            args = _unset_flag(args, "--kv-cache-dtype")
         _set_args(svc, args)
-        svc.setdefault("resources", {}).setdefault("limits", {})["tpu"] = str(est.tp)
+        svc.setdefault("resources", {}).setdefault("limits", {})["tpu"] = \
+            str(tpu_per_pod)
+        if hosts > 1:
+            svc["hostsPerReplica"] = hosts
+        else:
+            svc.pop("hostsPerReplica", None)
         if split and roles[name] in ("prefill", "decode"):
             svc["replicas"] = split[roles[name]]
         else:
@@ -222,7 +287,10 @@ def apply_sla_overrides(
         "model": model,
         "tp": est.tp,
         "replicas": est.replicas,
+        "hosts_per_replica": hosts,
         "max_num_seqs": est.batch,
+        "quantization": est.quantization,
+        "kv_cache_dtype": est.kv_dtype,
         "split": split,
         "est_ttft_ms": round(est.ttft_s * 1e3, 2),
         "est_itl_ms": round(est.itl_s * 1e3, 2),

@@ -57,13 +57,39 @@ def active_param_count(cfg: ModelConfig) -> float:
     return param_count(cfg) - cfg.num_layers * inactive
 
 
-def kv_bytes_per_token(cfg: ModelConfig) -> float:
-    return 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim * BYTES
+def kv_bytes_per_token(cfg: ModelConfig, kv_dtype: str = "auto",
+                       tp: int = 1) -> float:
+    lanes = cfg.num_kv_heads * cfg.head_dim
+    if kv_dtype == "int8":
+        # packed-scale int8 rows, lane-BLOCKED per TP shard and padded to a
+        # 128 multiple PER BLOCK (dynamo_tpu.ops.attention.kv_lane_width) —
+        # at high tp the padding can eat the entire saving (e.g. 8 KV heads
+        # of dim 128 at tp=8: 8 x 256-lane blocks = bf16-sized rows), so
+        # the roofline must model the real layout, not lanes/2
+        kv_l = max(cfg.num_kv_heads // max(tp, 1), 1)
+        block = -(-(kv_l * cfg.head_dim + 2 * kv_l) // 128) * 128
+        return 2.0 * cfg.num_layers * max(tp, 1) * block
+    return 2.0 * cfg.num_layers * lanes * BYTES
+
+
+# Serving quantization tiers the engine implements (`--quantization`,
+# `--kv-cache-dtype`), in PREFERENCE order: unquantized first — quantization
+# is only recommended when the plain config can't fit or can't meet the SLA
+# (matching how an operator would actually use the levers).
+QUANT_TIERS = (
+    ("none", "auto"),
+    ("w8a8", "auto"),
+    ("w8a8", "int8"),
+)
+
+
+def weight_bytes(quant: str) -> float:
+    return 1.0 if quant in ("int8", "w8a8") else float(BYTES)
 
 
 @dataclasses.dataclass(frozen=True)
 class Estimate:
-    """Roofline estimate for one (tp, batch) point."""
+    """Roofline estimate for one (tp, batch, quant tier) point."""
     tp: int
     replicas: int            # data-parallel engine replicas (chips // tp)
     batch: int               # per-replica decode batch (max_num_seqs)
@@ -72,6 +98,8 @@ class Estimate:
     tok_s_per_chip: float    # aggregate decode throughput / total chips
     hbm_used_frac: float     # worst-chip HBM occupancy at full batch
     feasible: bool
+    quantization: str = "none"   # none | w8a8 (weights/activations)
+    kv_dtype: str = "auto"       # auto (model dtype) | int8
 
     def meets(self, ttft_ms: Optional[float], itl_ms: Optional[float]) -> bool:
         if not self.feasible:
@@ -98,17 +126,34 @@ def estimate(
     batch: int,
     isl: int,
     osl: int,
+    quantization: str = "none",
+    kv_dtype: str = "auto",
 ) -> Estimate:
-    """Roofline TTFT/ITL/throughput for tp-way sharding and a decode batch."""
+    """Roofline TTFT/ITL/throughput for tp-way sharding and a decode batch.
+
+    `quantization`/`kv_dtype` model the engine's serving levers: int8
+    weights halve the weight footprint AND stream; w8a8 additionally runs
+    int8xint8 MXU contractions (modeled only through bytes — conservative);
+    int8 KV halves the per-token page stream and pool pressure."""
     replicas = max(sys.num_chips // tp, 1)
     p_total = param_count(cfg)
     p_active = active_param_count(cfg)
     chip = sys.chip
+    wb = weight_bytes(quantization)
+    kvb = kv_bytes_per_token(cfg, kv_dtype, tp=tp)
+    if kv_dtype == "int8" and cfg.num_kv_heads % tp != 0:
+        # the lane-blocked int8 layout requires tp | num_kv_heads
+        # (engine.KVCacheSpec.from_model raises for this combination)
+        return Estimate(tp=tp, replicas=max(sys.num_chips // tp, 1),
+                        batch=batch, ttft_s=float("inf"),
+                        itl_s=float("inf"), tok_s_per_chip=0.0,
+                        hbm_used_frac=float("inf"), feasible=False,
+                        quantization=quantization, kv_dtype=kv_dtype)
 
     # --- capacity: per-chip share of weights + this replica's KV pages.
     avg_ctx = isl + osl / 2.0
-    kv_per_seq_full = kv_bytes_per_token(cfg) * (isl + osl)
-    weights_per_chip = p_total * BYTES / tp
+    kv_per_seq_full = kvb * (isl + osl)
+    weights_per_chip = p_total * wb / tp
     kv_per_chip = batch * kv_per_seq_full / tp
     hbm_frac = (weights_per_chip + kv_per_chip) / (chip.hbm_bytes * 0.92)
     feasible = hbm_frac <= 1.0
@@ -123,7 +168,7 @@ def estimate(
     ttft = t_compute + t_coll + DISPATCH_OVERHEAD_S
 
     # --- decode step for the full batch at average context length.
-    read_bytes = p_total * BYTES + batch * kv_bytes_per_token(cfg) * avg_ctx
+    read_bytes = p_total * wb + batch * kvb * avg_ctx
     t_mem = read_bytes / (tp * chip.hbm_bw * HBM_EFF)
     t_flops = 2.0 * p_active * batch / (tp * chip.bf16_flops * MFU_DECODE)
     dec_act = batch * cfg.hidden_size * BYTES
@@ -136,4 +181,5 @@ def estimate(
         ttft_s=ttft, itl_s=itl,
         tok_s_per_chip=tok_s / sys.num_chips,
         hbm_used_frac=hbm_frac, feasible=feasible,
+        quantization=quantization, kv_dtype=kv_dtype,
     )

@@ -24,6 +24,7 @@ class ChipSpec:
     hbm_bw: float              # HBM bandwidth per chip, bytes/s
     ici_link_bw: float         # one-direction ICI bandwidth per link, bytes/s
     ici_links: int             # ICI links per chip (torus degree)
+    chips_per_host: int = 4    # chips attached to one host VM (pod slices)
 
     @property
     def ici_bisection_bw(self) -> float:
@@ -33,10 +34,10 @@ class ChipSpec:
 
 # Public datasheet numbers (cloud.google.com/tpu/docs/system-architecture).
 CHIPS: Dict[str, ChipSpec] = {
-    "v4": ChipSpec("v4", 275e12, 32 * GiB, 1.2e12, 4.5e10, 6),
-    "v5e": ChipSpec("v5e", 197e12, 16 * GiB, 8.19e11, 4.5e10, 4),
-    "v5p": ChipSpec("v5p", 459e12, 95 * GiB, 2.765e12, 9.0e10, 6),
-    "v6e": ChipSpec("v6e", 918e12, 32 * GiB, 1.64e12, 9.0e10, 4),
+    "v4": ChipSpec("v4", 275e12, 32 * GiB, 1.2e12, 4.5e10, 6, 4),
+    "v5e": ChipSpec("v5e", 197e12, 16 * GiB, 8.19e11, 4.5e10, 4, 8),
+    "v5p": ChipSpec("v5p", 459e12, 95 * GiB, 2.765e12, 9.0e10, 6, 4),
+    "v6e": ChipSpec("v6e", 918e12, 32 * GiB, 1.64e12, 9.0e10, 4, 8),
 }
 
 

@@ -112,14 +112,89 @@ def test_apply_sla_overrides_unknown_model_skips():
 
 
 def test_apply_sla_overrides_disagg_needs_two_replica_groups():
-    # 70B on v5e-16: fits only at tp=16 (one replica group) -> disagg
-    # infeasible, template left unchanged rather than doubling the chip demand
+    # 70B on v5e-8: even int8 weights at tp=8 leave only ONE replica group
+    # -> disagg infeasible, template left unchanged rather than doubling the
+    # chip demand
     dgd = _disagg_dgd("meta-llama-3-70b-instruct")
     before = json.dumps(dgd["spec"])
-    out = apply_sla_overrides(dgd, {"isl": 4000, "osl": 500}, system="v5e-16")
+    out = apply_sla_overrides(dgd, {"isl": 4000, "osl": 500}, system="v5e-8")
     decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
     assert decision["result"] == "disagg_infeasible"
     assert json.dumps(out["spec"]) == before
+
+
+def test_quant_tier_unlocks_disagg_on_small_chips():
+    # 70B bf16 on v5e-16 fits only at tp=16 (one group); the w8a8 tier
+    # halves the weight footprint, so tp=8 x 2 replica groups fits and the
+    # profiler recommends the quantization levers it needed
+    dgd = _disagg_dgd("meta-llama-3-70b-instruct")
+    out = apply_sla_overrides(dgd, {"isl": 4000, "osl": 500}, system="v5e-16")
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["quantization"] == "w8a8"
+    assert decision["replicas"] >= 2
+    args = out["spec"]["services"]["DecodeWorker"]["extraPodSpec"][
+        "mainContainer"]["args"]
+    assert "--quantization" in args
+    assert args[args.index("--quantization") + 1] == "w8a8"
+
+
+def test_quant_tier_prefers_unquantized_when_sufficient():
+    # 1B on v5e-8 meets a lax SLA without quantization: no --quantization /
+    # --kv-cache-dtype flags are injected (quantization costs accuracy and
+    # must only be recommended when needed)
+    dgd = _disagg_dgd("llama-3.2-1b-instruct")
+    out = apply_sla_overrides(dgd, {"isl": 1000, "osl": 100}, system="v5e-8")
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["quantization"] == "none"
+    assert decision["kv_cache_dtype"] == "auto"
+    args = out["spec"]["services"]["DecodeWorker"]["extraPodSpec"][
+        "mainContainer"]["args"]
+    assert "--quantization" not in args
+    assert "--kv-cache-dtype" not in args
+
+
+def test_apply_sla_overrides_multi_host_topology():
+    # 70B on v5p-64: tp=8 spans 2 v5p hosts (4 chips/host) -> the profiler
+    # writes hostsPerReplica + per-HOST tpu limits so the materialized gang
+    # StatefulSet is actually schedulable
+    dgd = _disagg_dgd("meta-llama-3-70b-instruct")
+    out = apply_sla_overrides(
+        dgd, {"isl": 4000, "osl": 500, "ttft": 600, "itl": 25},
+        system="v5p-64")
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["hosts_per_replica"] == 2
+    svc = out["spec"]["services"]["DecodeWorker"]
+    assert svc["hostsPerReplica"] == 2
+    assert svc["resources"]["limits"]["tpu"] == "4"
+
+
+def test_apply_sla_overrides_removes_stale_quant_flags():
+    # a re-applied DGD whose earlier decision quantized must lose the
+    # levers when the new winner is the unquantized tier
+    dgd = _disagg_dgd("llama-3.2-1b-instruct")
+    for name in ("PrefillWorker", "DecodeWorker"):
+        dgd["spec"]["services"][name]["extraPodSpec"]["mainContainer"][
+            "args"] += ["--quantization", "w8a8", "--kv-cache-dtype", "int8"]
+    out = apply_sla_overrides(dgd, {"isl": 1000, "osl": 100}, system="v5e-8")
+    decision = json.loads(out["metadata"]["annotations"][ANNOTATION])
+    assert decision["quantization"] == "none"
+    args = out["spec"]["services"]["DecodeWorker"]["extraPodSpec"][
+        "mainContainer"]["args"]
+    assert "--quantization" not in args
+    assert "--kv-cache-dtype" not in args
+
+
+def test_int8_kv_roofline_models_lane_blocking():
+    from dynamo_tpu.profiler.roofline import kv_bytes_per_token
+
+    cfg = ModelConfig.from_model_name("meta-llama-3-70b-instruct")
+    # 8 KV heads x dim 128: tp=8 pads every 1-head block to 256 lanes —
+    # int8 KV saves NOTHING there, and the model must say so
+    assert kv_bytes_per_token(cfg, "int8", tp=8) == \
+        kv_bytes_per_token(cfg, "auto")
+    # at tp=1 the packed layout really does halve (modulo scale lanes)
+    assert kv_bytes_per_token(cfg, "int8", tp=1) < \
+        0.6 * kv_bytes_per_token(cfg, "auto")
 
 
 def test_get_system_parses_arbitrary_shape():

@@ -22,6 +22,7 @@ pod-group label consumed by the gang scheduler (the Grove/KAI analogue,
 
 from __future__ import annotations
 
+import copy
 from typing import Any, Dict, List, Optional
 
 GROUP = "tpu.dynamo.ai"
@@ -134,6 +135,11 @@ def _container(
         c["command"] = list(main["command"])
     if main.get("args"):
         c["args"] = list(main["args"])
+    # user-supplied probes ride through (the gang builder only installs its
+    # leader-readiness probe when none is given)
+    for probe in ("readinessProbe", "livenessProbe", "startupProbe"):
+        if main.get(probe):
+            c[probe] = copy.deepcopy(main[probe])
     if not c.get("command") and not c.get("args"):
         # sensible defaults matching our runtime modules
         if ctype == "frontend":
@@ -302,23 +308,23 @@ def build_gang_statefulset(
     cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any],
     gang: bool = False, gang_scheduler: str = DEFAULT_GANG_SCHEDULER,
 ) -> Dict[str, Any]:
-    """Multi-host worker group: one StatefulSet whose `hostsPerReplica` pods
-    form a single jax.distributed gang (the Grove multinode analogue,
+    """Multi-host worker pool: one StatefulSet of `replicas` gangs x
+    `hostsPerReplica` pods (the Grove multinode analogue,
     /root/reference/install-dynamo-1node.sh:207-212).
 
     StatefulSet (not Deployment) because gang membership needs STABLE pod
-    identities: the ordinal is the jax process id, and pod -0's stable DNS
-    name (via the headless gang Service) is the coordinator address that
-    every member dials.
+    identities: ordinal o belongs to gang o // H with process id o % H, and
+    each gang's first pod's stable DNS name (via the headless gang Service)
+    is the coordinator the other members dial
+    (parallel.distributed._resolve_replicated_gang). Only gang LEADERS
+    (process id 0) serve HTTP; the readiness probe keeps follower pods out
+    of the worker Service's endpoints, so scaling `replicas` scales gangs
+    with one uniform pod template.
     """
     from dynamo_tpu.parallel.distributed import COORDINATOR_PORT
 
     hosts = hosts_per_replica(spec)
-    if int(spec.get("replicas", 1)) != 1:
-        raise ValueError(
-            "hostsPerReplica > 1 requires replicas == 1 (one gang per "
-            "service; scale multi-host workers with more DGD services)"
-        )
+    replicas = int(spec.get("replicas", 1))
     namespace = cr["metadata"].get("namespace", "default")
     dgd_name = cr["metadata"]["name"]
     ctype = spec.get("componentType", "worker")
@@ -336,10 +342,17 @@ def build_gang_statefulset(
     main["env"] = (main.get("env") or []) + [
         {"name": "POD_NAME",
          "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}}},
-        {"name": "DYNAMO_TPU_NUM_PROCESSES", "value": str(hosts)},
-        {"name": "DYNAMO_TPU_COORDINATOR",
-         "value": f"{name}-0.{gang_svc}.{namespace}.svc:{COORDINATOR_PORT}"},
+        {"name": "DYNAMO_TPU_GANG_SIZE", "value": str(hosts)},
+        {"name": "DYNAMO_TPU_GANG_DOMAIN",
+         "value": f"{gang_svc}.{namespace}.svc:{COORDINATOR_PORT}"},
     ]
+    # leaders-only HTTP endpoints: followers run the replication loop with
+    # no server, fail this probe, and stay out of the worker Service
+    main.setdefault("readinessProbe", {
+        "httpGet": {"path": "/ready", "port": FRONTEND_PORT},
+        "periodSeconds": 5,
+        "failureThreshold": 3,
+    })
     if gang:
         pod_meta["annotations"] = {POD_GROUP_ANNOTATION: name}
         pod_spec.setdefault("schedulerName", gang_scheduler)
@@ -353,8 +366,14 @@ def build_gang_statefulset(
             "ownerReferences": [owner_reference(cr)],
         },
         "spec": {
-            "replicas": hosts,
+            "replicas": replicas * hosts,
             "serviceName": gang_svc,
+            # OnDelete: the default RollingUpdate waits for each pod to
+            # become Ready highest-ordinal-first, and followers are never
+            # Ready by design — a rollout would deadlock on the first
+            # follower. Gangs must restart as a unit anyway; updates roll
+            # by deleting a gang's pods together.
+            "updateStrategy": {"type": "OnDelete"},
             "podManagementPolicy": "Parallel",  # the gang starts as a unit
             "selector": {"matchLabels": {COMPONENT_LABEL: svc_name.lower(),
                                          NS_LABEL: labels[NS_LABEL]}},
@@ -385,6 +404,9 @@ def build_gang_service(
         },
         "spec": {
             "clusterIP": "None",
+            # coordinator DNS must resolve for FOLLOWER pods too, which by
+            # design never become Ready (no HTTP server)
+            "publishNotReadyAddresses": True,
             "selector": {COMPONENT_LABEL: svc_name.lower(),
                          NS_LABEL: labels[NS_LABEL]},
             "ports": [
@@ -405,10 +427,10 @@ def build_service(
     `-d`/`-p` suffixed names from frontend selection (:459-464) — worker
     services here are headless, so both filters behave identically.
 
-    Multi-host gangs: only pod -0 (the jax.distributed leader) serves
-    HTTP — followers run the replication loop with no server — so the
-    selector additionally pins the StatefulSet leader pod via its stable
-    statefulset.kubernetes.io/pod-name label.
+    Multi-host gangs: only gang leaders (process id 0) serve HTTP —
+    followers run the replication loop with no server and fail the pod
+    template's readiness probe, so this Service's endpoints are exactly
+    the leaders without any pod pinning.
     """
     namespace = cr["metadata"].get("namespace", "default")
     dgd_name = cr["metadata"]["name"]
@@ -433,9 +455,8 @@ def build_service(
     }
     if ctype != "frontend":
         svc["spec"]["clusterIP"] = "None"
-    if hosts_per_replica(spec) > 1:
-        svc["spec"]["selector"][
-            "statefulset.kubernetes.io/pod-name"] = f"{name}-0"
+    # multi-host pools need no pod pinning: follower pods fail the gang
+    # readiness probe, so the endpoints are exactly the gang LEADERS
     return svc
 
 

@@ -63,7 +63,11 @@ def resolve(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
 ) -> DistConfig:
-    """CLI > DYNAMO_TPU_* env > GKE TPU gang env."""
+    """CLI > replicated-gang env > DYNAMO_TPU_* env > GKE TPU gang env."""
+    if coordinator is None and num_processes is None:
+        cfg = _resolve_replicated_gang()
+        if cfg is not None:
+            return cfg
     coord = coordinator or os.environ.get("DYNAMO_TPU_COORDINATOR") or None
     n = num_processes or int(os.environ.get("DYNAMO_TPU_NUM_PROCESSES") or 0)
     pid: Optional[str] = (
@@ -97,6 +101,31 @@ def resolve(
         )
     return DistConfig(coordinator=coord, num_processes=n,
                       process_id=int(pid))
+
+
+def _resolve_replicated_gang() -> Optional[DistConfig]:
+    """Replicated multi-host gangs in ONE StatefulSet (operator/materialize.
+    build_gang_statefulset): R gangs x H hosts = R*H ordered pods. Gang g
+    owns ordinals [g*H, (g+1)*H); within a gang the process id is
+    `ordinal % H` and the coordinator is the gang's FIRST pod's stable DNS
+    name. Pods derive all three from their own ordinal, so one uniform pod
+    template serves every gang."""
+    gang_size = int(os.environ.get("DYNAMO_TPU_GANG_SIZE") or 0)
+    if gang_size <= 1:
+        return None
+    domain = os.environ.get("DYNAMO_TPU_GANG_DOMAIN")
+    pod_name = os.environ.get("POD_NAME", "")
+    base, _, tail = pod_name.rpartition("-")
+    if not domain or not tail.isdigit():
+        return None
+    ordinal = int(tail)
+    pid = ordinal % gang_size
+    leader_ordinal = ordinal - pid
+    return DistConfig(
+        coordinator=f"{base}-{leader_ordinal}.{domain}",
+        num_processes=gang_size,
+        process_id=pid,
+    )
 
 
 def initialize(cfg: DistConfig) -> None:

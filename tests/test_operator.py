@@ -402,9 +402,9 @@ def test_multihost_service_materializes_gang_statefulset():
     assert sts["spec"]["podManagementPolicy"] == "Parallel"
     tmpl = sts["spec"]["template"]
     env = {e["name"]: e for e in tmpl["spec"]["containers"][0]["env"]}
-    assert env["DYNAMO_TPU_NUM_PROCESSES"]["value"] == "4"
-    assert env["DYNAMO_TPU_COORDINATOR"]["value"].startswith(
-        "mh-bigworker-0.mh-bigworker-gang.demo.svc:")
+    assert env["DYNAMO_TPU_GANG_SIZE"]["value"] == "4"
+    assert env["DYNAMO_TPU_GANG_DOMAIN"]["value"].startswith(
+        "mh-bigworker-gang.demo.svc:")
     assert env["POD_NAME"]["valueFrom"]["fieldRef"]["fieldPath"] == \
         "metadata.name"
     # gang gating: PodGroup wants ALL hosts, pods annotated into the group
@@ -412,15 +412,56 @@ def test_multihost_service_materializes_gang_statefulset():
     assert pgs["mh-bigworker"]["spec"]["minMember"] == 4
     assert tmpl["metadata"]["annotations"][mat.POD_GROUP_ANNOTATION] == \
         "mh-bigworker"
-    # headless coordinator service exists
+    # headless coordinator service: follower pods (never Ready by design)
+    # must still get DNS records
     names = {s["metadata"]["name"]: s for s in desired["services"]}
     assert names["mh-bigworker-gang"]["spec"]["clusterIP"] == "None"
-    # plain worker service pins the leader pod: followers serve no HTTP
-    assert names["mh-bigworker"]["spec"]["selector"][
-        "statefulset.kubernetes.io/pod-name"] == "mh-bigworker-0"
+    assert names["mh-bigworker-gang"]["spec"]["publishNotReadyAddresses"]
+    # followers fail the readiness probe, so the worker Service's endpoints
+    # are exactly the gang leaders — no pod pinning
+    assert "statefulset.kubernetes.io/pod-name" not in \
+        names["mh-bigworker"]["spec"]["selector"]
+    probe = tmpl["spec"]["containers"][0]["readinessProbe"]
+    assert probe["httpGet"]["path"] == "/ready"
     # single-host frontend stays a plain Deployment without gang gating
     assert {d["metadata"]["name"] for d in desired["deployments"]} == \
         {"mh-frontend"}
+
+
+def test_replicated_gangs_scale_in_one_statefulset():
+    """replicas > 1 with hostsPerReplica > 1: R gangs x H hosts ride one
+    StatefulSet (R*H ordered pods); members derive gang/process identity
+    from their ordinal (parallel.distributed._resolve_replicated_gang) and
+    the PodGroup demands every pod of every gang."""
+    from dynamo_tpu.operator import materialize as mat
+
+    dgd = _multihost_dgd()
+    dgd["spec"]["services"]["BigWorker"]["replicas"] = 3
+    desired = mat.materialize(dgd, gang=True)
+    sts = desired["statefulsets"][0]
+    assert sts["spec"]["replicas"] == 12  # 3 gangs x 4 hosts
+    pgs = {p["metadata"]["name"]: p for p in desired["podgroups"]}
+    assert pgs["mh-bigworker"]["spec"]["minMember"] == 12
+
+
+def test_resolve_replicated_gang_identity(monkeypatch):
+    from dynamo_tpu.parallel import distributed as dist
+
+    monkeypatch.setenv("DYNAMO_TPU_GANG_SIZE", "4")
+    monkeypatch.setenv("DYNAMO_TPU_GANG_DOMAIN",
+                       "mh-bigworker-gang.demo.svc:7777")
+    for ordinal, (gang_leader, pid) in {
+        0: (0, 0), 3: (0, 3), 4: (4, 0), 11: (8, 3),
+    }.items():
+        monkeypatch.setenv("POD_NAME", f"mh-bigworker-{ordinal}")
+        cfg = dist.resolve()
+        assert cfg.num_processes == 4
+        assert cfg.process_id == pid
+        assert cfg.coordinator == (
+            f"mh-bigworker-{gang_leader}.mh-bigworker-gang.demo.svc:7777")
+    # explicit CLI args override the gang derivation
+    cfg = dist.resolve(coordinator="x:1", num_processes=2, process_id=1)
+    assert cfg.coordinator == "x:1" and cfg.process_id == 1
 
 
 def test_single_replica_multihost_is_gang_eligible():

@@ -12,6 +12,7 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
+import socket
 import threading
 import time
 import urllib.parse
@@ -22,6 +23,14 @@ from dynamo_tpu.engine.request import GenRequest, TokenEvent
 from dynamo_tpu.transfer.kv_transfer import fetch_kv
 
 log = logging.getLogger("dynamo_tpu.disagg")
+
+
+class _PrefillUnreachable(Exception):
+    """Connection-level failure BEFORE any KV moved (retry-safe)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
 
 
 class PrefillPool:
@@ -59,8 +68,8 @@ class PrefillPool:
         with self._lock:
             return list(dict.fromkeys(self._static + self._discovered))
 
-    def pick(self, affinity_key: str) -> Optional[str]:
-        urls = self.urls()
+    def pick(self, affinity_key: str, exclude=()) -> Optional[str]:
+        urls = [u for u in self.urls() if u not in exclude]
         if not urls:
             return None
         best, best_score = None, -1
@@ -113,13 +122,34 @@ class DisaggDecodeClient:
             "(TCP host-bounce) plane for this pair", prefill_url, why)
 
     def start(self, req: GenRequest) -> "object":
-        """Returns the event queue, with the first token already delivered."""
-        ctx = self.ctx
-        affinity = "".join(map(str, req.prompt_token_ids[:64]))
-        prefill_url = self.pool.pick(affinity)
-        if prefill_url is None:
-            raise RuntimeError("no prefill worker available")
+        """Returns the event queue, with the first token already delivered.
 
+        Bounded prefill failover: an UNREACHABLE prefill worker (connection
+        refused / dropped before any KV moved) is retried on the pool's
+        next rendezvous pick; definitive rejections (400) and mid-transfer
+        failures stay terminal."""
+        affinity = "".join(map(str, req.prompt_token_ids[:64]))
+        tried: list = []
+        while True:
+            prefill_url = self.pool.pick(affinity, exclude=tried)
+            if prefill_url is None:
+                if tried:
+                    raise RuntimeError(
+                        f"prefill workers unreachable: {', '.join(tried)}")
+                raise RuntimeError("no prefill worker available")
+            try:
+                return self._start_on(req, prefill_url)
+            except _PrefillUnreachable as e:
+                log.warning("prefill %s unreachable (%s); failing over",
+                            prefill_url, e.reason)
+                tried.append(prefill_url)
+                if len(tried) >= 3:
+                    raise RuntimeError(
+                        f"prefill workers unreachable: {', '.join(tried)}"
+                    ) from e
+
+    def _start_on(self, req: GenRequest, prefill_url: str) -> "object":
+        ctx = self.ctx
         if ctx.engine.cfg.disaggregation_transfer_backend == "ici":
             from dynamo_tpu.transfer import ici_registry
 
@@ -139,6 +169,10 @@ class DisaggDecodeClient:
             "logprobs": req.logprobs,
         }).encode()
         t0 = time.monotonic()
+        # phase 1 — the prefill RPC. ONLY connection-phase failures here
+        # are retry-safe (no prefill ran, no KV parked anywhere); a read
+        # TIMEOUT means the worker accepted and may be computing, so a
+        # retry would duplicate the prefill — terminal instead.
         try:
             with urllib.request.urlopen(
                 urllib.request.Request(
@@ -148,32 +182,6 @@ class DisaggDecodeClient:
                 timeout=300,
             ) as resp:
                 out = json.loads(resp.read())
-            first_token = out["first_token"]
-            host = urllib.parse.urlparse(prefill_url).hostname
-            released = False
-            k = None
-            want_ici = (
-                ctx.engine.cfg.disaggregation_transfer_backend == "ici")
-            if want_ici and out.get("device_transfer"):
-                try:
-                    # cross-process device-buffer pull (no host bounce):
-                    # stage RPC + direct pull from the peer's device memory
-                    k, v = self._pull_device(prefill_url, host, req.request_id)
-                    n_tokens = out["n_tokens"]
-                    self._plane_counter.inc(plane="ici_device")
-                except Exception as e:
-                    self._warn_dcn_fallback(
-                        prefill_url, f"device-buffer pull failed ({e})")
-            elif want_ici:
-                self._warn_dcn_fallback(
-                    prefill_url,
-                    "is neither in-process nor advertising device-buffer "
-                    "transfer")
-            if k is None:
-                k, v, n_tokens = fetch_kv(host, out["bootstrap_port"],
-                                          req.request_id)
-                released = True  # the TCP plane acks (and releases) in-stream
-                self._plane_counter.inc(plane="dcn")
         except urllib.error.HTTPError as e:
             # a definitive client error from the prefill side stays definitive
             # (400), so callers don't retry a request that can never succeed
@@ -187,9 +195,45 @@ class DisaggDecodeClient:
                 f"prefill worker {prefill_url} failed ({e.code}): {msg}"
             ) from e
         except (urllib.error.URLError, ConnectionError, OSError) as e:
-            raise RuntimeError(
-                f"prefill worker {prefill_url} unreachable: {e}"
-            ) from e
+            reason = getattr(e, "reason", e)
+            if isinstance(reason, (TimeoutError, socket.timeout)):
+                raise RuntimeError(
+                    f"prefill worker {prefill_url} timed out mid-prefill"
+                ) from e
+            raise _PrefillUnreachable(str(e)) from e
+        # phase 2 — the KV pull. The prefill side now holds parked pages;
+        # failures here are terminal for this request (the parked-KV TTL
+        # sweep reclaims the pages), never silently retried elsewhere.
+        first_token = out["first_token"]
+        host = urllib.parse.urlparse(prefill_url).hostname
+        released = False
+        k = None
+        want_ici = (
+            ctx.engine.cfg.disaggregation_transfer_backend == "ici")
+        if want_ici and out.get("device_transfer"):
+            try:
+                # cross-process device-buffer pull (no host bounce):
+                # stage RPC + direct pull from the peer's device memory
+                k, v = self._pull_device(prefill_url, host, req.request_id)
+                n_tokens = out["n_tokens"]
+                self._plane_counter.inc(plane="ici_device")
+            except Exception as e:
+                self._warn_dcn_fallback(
+                    prefill_url, f"device-buffer pull failed ({e})")
+        elif want_ici:
+            self._warn_dcn_fallback(
+                prefill_url,
+                "is neither in-process nor advertising device-buffer "
+                "transfer")
+        if k is None:
+            try:
+                k, v, n_tokens = fetch_kv(host, out["bootstrap_port"],
+                                          req.request_id)
+            except (ConnectionError, OSError) as e:
+                raise RuntimeError(
+                    f"KV transfer from {prefill_url} failed: {e}") from e
+            released = True  # the TCP plane acks (and releases) in-stream
+            self._plane_counter.inc(plane="dcn")
         log.info(
             "disagg%s: prefill(%d tok)+transfer(%.1f MB) in %.3fs via %s",
             "" if released else "[ici-device]", n_tokens,

@@ -179,27 +179,56 @@ class _FrontendHandler(JsonHTTPHandler):
             else:
                 self._send_nats_response(parts, model, t0)
                 return
-        req = urllib.request.Request(
-            worker.url.rstrip("/") + path,
-            data=raw,
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        try:
-            resp = urllib.request.urlopen(req, timeout=600)
-        except urllib.error.HTTPError as e:
-            payload = e.read()
-            self.send_response(e.code)
-            self.send_header("Content-Type",
-                             e.headers.get("Content-Type", "application/json"))
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-            return
-        except (urllib.error.URLError, socket.error) as e:
-            ctx.router.deregister(worker.url)
-            self._error(502, f"worker {worker.url} unreachable: {e}",
-                        "bad_gateway")
+        # bounded failover: a CONNECT-phase failure (refused / no route /
+        # DNS) proves the request never reached a worker, so retrying the
+        # next pick is safe; a read timeout means a worker accepted and may
+        # be generating — retrying would duplicate the generation, so it is
+        # terminal (504). 502 only when no live worker accepts.
+        resp = None
+        last_err: Optional[str] = None
+        for attempt in range(3):
+            if attempt:
+                worker = ctx.router.pick(model, affinity)
+                if worker is None:
+                    break
+            req = urllib.request.Request(
+                worker.url.rstrip("/") + path,
+                data=raw,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                resp = urllib.request.urlopen(req, timeout=600)
+                break
+            except urllib.error.HTTPError as e:
+                # the worker is alive and answered: a real API response,
+                # not a routing failure — pass it through
+                payload = e.read()
+                self.send_response(e.code)
+                self.send_header(
+                    "Content-Type",
+                    e.headers.get("Content-Type", "application/json"))
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            except (urllib.error.URLError, socket.error) as e:
+                reason = getattr(e, "reason", e)
+                if isinstance(reason, (TimeoutError, socket.timeout)):
+                    self._error(
+                        504, f"worker {worker.url} timed out mid-request",
+                        "timeout")
+                    return
+                log.warning("worker %s unreachable (%s); failing over",
+                            worker.url, e)
+                ctx.router.deregister(worker.url)
+                last_err = str(e)
+        if resp is None:
+            self._error(
+                502,
+                f"no reachable worker for model {model!r}"
+                + (f" (last error: {last_err})" if last_err else ""),
+                "bad_gateway")
             return
 
         ctype = resp.headers.get("Content-Type", "application/json")

@@ -273,3 +273,59 @@ def test_ici_backend_serves_without_host_bounce(monkeypatch):
     text_dcn = run("dcn", forbid_host_paths=False)
     text_ici = run("ici", forbid_host_paths=True)
     assert text_ici == text_dcn
+
+
+def test_decode_fails_over_unreachable_prefill(monkeypatch):
+    """An unreachable prefill worker (connection refused, no KV moved) is
+    retried on the pool's next pick; the request still completes and the
+    tokens match the single-worker path."""
+    import socket
+    import threading
+
+    from dynamo_tpu.serving.api import ServingContext, make_server
+    from dynamo_tpu.serving.disagg import DisaggDecodeClient, PrefillPool
+
+    kw = dict(model="tiny-debug", page_size=4, num_pages=64, max_num_seqs=2,
+              max_seq_len=64, seed=3, disaggregation_bootstrap_port=0)
+    pre = Engine(EngineConfig(disaggregation_mode="prefill", **kw))
+    pre_ctx = ServingContext(pre, served_model="tiny-debug")
+    pre_srv = make_server(pre_ctx, host="127.0.0.1", port=0)
+    threading.Thread(target=pre_srv.serve_forever, daemon=True).start()
+    live_url = f"http://127.0.0.1:{pre_srv.server_address[1]}"
+    # bound-but-not-listening: refused connects, port reserved for the test
+    dead_sock = socket.socket()
+    dead_sock.bind(("127.0.0.1", 0))
+    dead_url = f"http://127.0.0.1:{dead_sock.getsockname()[1]}"
+
+    dec = Engine(EngineConfig(disaggregation_mode="decode", **kw),
+                 params=pre.params)
+    dec_ctx = ServingContext(dec, served_model="tiny-debug")
+    client = DisaggDecodeClient(dec_ctx, PrefillPool([dead_url, live_url]))
+    # deterministic: the DEAD worker wins the first pick
+    real_pick = client.pool.pick
+    monkeypatch.setattr(
+        client.pool, "pick",
+        lambda aff, exclude=(): (dead_url if dead_url not in exclude
+                                 else real_pick(aff, exclude)))
+    try:
+        req = GenRequest("fo1", [1, 2, 3, 4], max_tokens=4, temperature=0.0,
+                         ignore_eos=True)
+        q = client.start(req)
+        toks = []
+        while True:
+            ev = q.get(timeout=60)
+            if ev.token_id >= 0:
+                toks.append(ev.token_id)
+            if ev.finished:
+                break
+        ref = Engine(EngineConfig(**{k: v for k, v in kw.items()
+                                     if k != "disaggregation_bootstrap_port"}),
+                     params=pre.params).generate(
+            GenRequest("ref", [1, 2, 3, 4], max_tokens=4, temperature=0.0,
+                       ignore_eos=True))
+        assert toks == ref
+    finally:
+        dead_sock.close()
+        pre_srv.shutdown()
+        pre_ctx.close()
+        dec_ctx.close()

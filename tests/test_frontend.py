@@ -129,3 +129,52 @@ def test_dead_worker_evicted(stack):
             break
     alive = {w.url for w in fctx.router.alive()}
     assert "http://127.0.0.1:9/" not in alive
+
+
+def test_failover_to_live_worker_on_unreachable(stack):
+    """A dead worker must not cost the request: the frontend deregisters it
+    and retries on the next live pick (nothing has streamed yet), so the
+    client sees a normal 200 — 502 is reserved for no-live-worker-left."""
+    import socket
+
+    register(stack)
+    # bound-but-not-listening: connects are REFUSED while the port stays
+    # reserved for the whole test (closing first would let the OS reassign
+    # it to a real listener mid-test)
+    dead_sock = socket.socket()
+    dead_sock.bind(("127.0.0.1", 0))
+    dead_url = f"http://127.0.0.1:{dead_sock.getsockname()[1]}"
+    post(stack["frontend"], "/internal/register", {
+        "url": dead_url, "model": MODEL, "mode": "agg",
+        # max headroom so rendezvous routinely considers it
+        "stats": {"max_num_seqs": 64, "free_pages": 128, "total_pages": 128},
+    })
+    fctx = stack["fctx"]
+    # force the dead worker to be picked FIRST (deterministic failover)
+    real_pick = fctx.router.pick
+    state = {"first": True}
+
+    def pick_dead_first(model, affinity, roles=("agg", "decode")):
+        if state["first"]:
+            state["first"] = False
+            w = next((w for w in fctx.router.alive(roles, model)
+                      if w.url == dead_url), None)
+            if w is not None:
+                return w
+        return real_pick(model, affinity, roles)
+
+    fctx.router.pick = pick_dead_first
+    try:
+        out = post(stack["frontend"], "/v1/chat/completions", {
+            "model": MODEL,
+            "messages": [{"role": "user", "content": "failover"}],
+            "max_tokens": 4, "temperature": 0,
+        })
+        assert out["choices"][0]["message"]["content"] is not None
+    finally:
+        fctx.router.pick = real_pick
+        dead_sock.close()
+    # the dead worker was deregistered by the failover path
+    urls = [w["url"] for w in json.loads(
+        get(stack["frontend"], "/internal/workers"))["workers"]]
+    assert dead_url not in urls

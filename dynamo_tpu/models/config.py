@@ -66,13 +66,23 @@ class ModelConfig:
         eos = cfg.get("eos_token_id", 2)
         if isinstance(eos, list):
             eos = eos[0]
+        # expert count: Mixtral uses num_local_experts, DeepSeek
+        # n_routed_experts, Qwen3-MoE plain num_experts
+        n_experts = (cfg.get("num_local_experts")
+                     or cfg.get("n_routed_experts")
+                     or cfg.get("num_experts") or 0)
+        if n_experts:
+            # MoE configs carry BOTH intermediate_size (dense-equivalent,
+            # unused) and moe_intermediate_size (per-expert, the real one)
+            inter = (cfg.get("moe_intermediate_size")
+                     or cfg.get("intermediate_size") or 4 * hidden)
+        else:
+            inter = cfg.get("intermediate_size") or 4 * hidden
         return ModelConfig(
             name=name,
             vocab_size=cfg["vocab_size"],
             hidden_size=hidden,
-            intermediate_size=cfg.get("intermediate_size")
-            or cfg.get("moe_intermediate_size")
-            or 4 * hidden,
+            intermediate_size=inter,
             num_layers=cfg["num_hidden_layers"],
             num_heads=num_heads,
             num_kv_heads=cfg.get("num_key_value_heads", num_heads),
@@ -83,7 +93,7 @@ class ModelConfig:
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             qk_norm="Qwen3" in arch,
             attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
-            num_experts=cfg.get("num_local_experts", cfg.get("n_routed_experts", 0)) or 0,
+            num_experts=n_experts,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             dtype=dtype,
             eos_token_id=eos,
@@ -196,6 +206,26 @@ PRESETS = {
         num_experts_per_tok=2,
         eos_token_id=2,
         bos_token_id=1,
+    ),
+    # fine-grained MoE + per-head q/k RMSNorm (the qwen3 combination) —
+    # 30.5B total / ~3.3B active; the modern expert-parallel serving target
+    # beyond Mixtral's 8-expert layout
+    "qwen3-30b-a3b": ModelConfig(
+        name="qwen3-30b-a3b",
+        vocab_size=151936,
+        hidden_size=2048,
+        intermediate_size=768,  # PER-EXPERT width (hf moe_intermediate_size)
+        num_layers=48,
+        num_heads=32,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1000000.0,
+        qk_norm=True,
+        tie_word_embeddings=False,
+        num_experts=128,
+        num_experts_per_tok=8,
+        eos_token_id=151645,
+        bos_token_id=151643,
     ),
 }
 # Aliases matching the ids used in the reference manifests

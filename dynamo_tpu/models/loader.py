@@ -144,6 +144,9 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
     def g(name: str) -> jax.Array:
         return raw.pop(name)
 
+    def has(name: str) -> bool:
+        return name in raw
+
     def to_dt(x) -> jax.Array:
         return jnp.asarray(x).astype(dt)
 
@@ -190,20 +193,31 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
         p["k_norm"] = stack("model.layers.{i}.self_attn.k_norm.weight", to_dt)
     if cfg.is_moe:
         x = cfg.num_experts
+        # two upstream MoE naming schemes: Mixtral's block_sparse_moe with
+        # w1/w3/w2, Qwen3-MoE's mlp.experts with gate/up/down_proj
+        if has("model.layers.0.block_sparse_moe.gate.weight"):
+            moe_base = "block_sparse_moe"
+            names = {"gate": "w1", "up": "w3", "down": "w2"}
+        else:
+            moe_base = "mlp"
+            names = {"gate": "gate_proj", "up": "up_proj",
+                     "down": "down_proj"}
         p["router"] = stack(
-            "model.layers.{i}.block_sparse_moe.gate.weight", lambda w: to_dt(w).T
+            f"model.layers.{{i}}.{moe_base}.gate.weight",
+            lambda w: to_dt(w).T
         )
 
         def experts(i: int, which: str) -> jnp.ndarray:
             ws = [
-                to_dt(g(f"model.layers.{i}.block_sparse_moe.experts.{j}.{which}.weight")).T
+                to_dt(g(f"model.layers.{i}.{moe_base}.experts.{j}"
+                        f".{names[which]}.weight")).T
                 for j in range(x)
             ]
             return jnp.stack(ws)  # [X, in, out]
 
-        p["moe_w_gate"] = jnp.stack([experts(i, "w1") for i in range(l)])
-        p["moe_w_up"] = jnp.stack([experts(i, "w3") for i in range(l)])
-        p["moe_w_down"] = jnp.stack([experts(i, "w2") for i in range(l)])
+        p["moe_w_gate"] = jnp.stack([experts(i, "gate") for i in range(l)])
+        p["moe_w_up"] = jnp.stack([experts(i, "up") for i in range(l)])
+        p["moe_w_down"] = jnp.stack([experts(i, "down") for i in range(l)])
     else:
         p["w_gate"] = stack(
             "model.layers.{i}.mlp.gate_proj.weight", lambda w: to_dt(w).T

@@ -41,9 +41,12 @@ def prefill_logits(cfg, params, tokens, seq_len):
         {},
         {"qk_norm": True, "attention_bias": True},
         {"num_experts": 4, "num_experts_per_tok": 2},
+        # per-head q/k RMSNorm COMBINED with MoE routing — the qwen3-moe
+        # family layout (qwen3-30b-a3b preset)
+        {"qk_norm": True, "num_experts": 4, "num_experts_per_tok": 2},
         {"tie_word_embeddings": False},
     ],
-    ids=["llama", "qwen", "moe", "untied"],
+    ids=["llama", "qwen", "moe", "qwen3moe", "untied"],
 )
 def test_decode_matches_prefill(cfg_kwargs):
     cfg, params = make(cfg_kwargs)

@@ -33,6 +33,15 @@ def test_param_count_mixtral_total_vs_active():
     assert active < total
 
 
+def test_param_count_qwen3_moe():
+    from dynamo_tpu.profiler.roofline import active_param_count
+
+    cfg = ModelConfig.from_model_name("qwen3-30b-a3b")
+    total, active = param_count(cfg), active_param_count(cfg)
+    assert 29e9 < total < 32e9        # ~30.5B
+    assert 2.7e9 < active < 3.6e9     # ~3.3B active
+
+
 def test_sweep_8b_on_v5e8_meets_reference_sla():
     cfg = ModelConfig.from_model_name("meta-llama-3-8b-instruct")
     best = best_config(cfg, get_system("v5e-8"), 4000, 500, ttft_ms=600, itl_ms=25)

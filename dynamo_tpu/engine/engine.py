@@ -234,8 +234,13 @@ class Engine:
             kv_dtype=cfg.kv_cache_dtype,
             tensor_parallel=cfg.tensor_parallel,
         )
+        # MLA pools replicate across the model axis (every TP shard scores
+        # its local heads against the FULL shared latent row); classic
+        # pools lane-split the fused per-head axis
         self.k_pages, self.v_pages = alloc_kv_pages(
-            self.kv_spec, shd.kv_sharding(self.mesh)
+            self.kv_spec,
+            shd.replicated(self.mesh) if self.model_cfg.is_mla
+            else shd.kv_sharding(self.mesh),
         )
         self.allocator = PageAllocator(cfg.num_pages)
         self.prefix_cache: Optional[PrefixCache] = None

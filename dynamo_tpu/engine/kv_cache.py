@@ -56,20 +56,26 @@ class KVCacheSpec:
             raise ValueError(
                 f"kv_cache_dtype must be 'auto' or 'int8', got {kv_dtype!r}")
         quantized = kv_dtype == "int8"
-        if quantized and cfg.num_kv_heads % tensor_parallel != 0:
+        # cache geometry comes from the cache_* properties: MLA stores ONE
+        # shared [c_kv | k_rope] latent row per token, classic attention
+        # per-head K/V. MLA pools REPLICATE across the model axis (no lane
+        # split), so their int8 rows are never TP-blocked.
+        kv_heads, head_dim = cfg.cache_kv_heads, cfg.cache_head_dim
+        blocks = 1 if cfg.is_mla else tensor_parallel
+        if quantized and kv_heads % blocks != 0:
             raise ValueError(
                 f"kv_cache_dtype=int8 needs tensor_parallel "
-                f"({tensor_parallel}) to divide num_kv_heads "
-                f"({cfg.num_kv_heads}) — the packed-scale rows are blocked "
+                f"({tensor_parallel}) to divide the cache KV-head count "
+                f"({kv_heads}) — the packed-scale rows are blocked "
                 f"per TP shard")
         return KVCacheSpec(
             num_layers=cfg.num_layers,
-            num_kv_heads=cfg.num_kv_heads,
+            num_kv_heads=kv_heads,
             num_pages=num_pages,
             page_size=page_size,
-            head_dim=cfg.head_dim,
+            head_dim=head_dim,
             dtype=cfg.dtype if kv_dtype in ("auto", "") else kv_dtype,
-            lane_blocks=tensor_parallel if quantized else 1,
+            lane_blocks=blocks if quantized else 1,
         )
 
     @property

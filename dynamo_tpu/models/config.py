@@ -43,6 +43,24 @@ class ModelConfig:
     # tokens past an expert's capacity drop that expert — a throughput/
     # fidelity trade the operator opts into per deployment
     moe_capacity_factor: float = 0.0
+    # DeepSeek-style SHARED experts: always-active dense experts added to
+    # the routed top-k output (each of width intermediate_size)
+    num_shared_experts: int = 0
+    # router gate convention: True (Mixtral/Qwen3) renormalizes the top-k
+    # weights to sum 1; False (DeepSeek norm_topk_prob=false) keeps the
+    # global-softmax probabilities, scaled by routed_scaling_factor
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
+    # MLA (DeepSeek-V2-family multi-head latent attention). kv_lora_rank > 0
+    # switches attention to the latent form: the paged cache stores ONE
+    # shared [c_kv | k_rope] row per token (kv_lora_rank + qk_rope_head_dim
+    # lanes) instead of per-head K/V — a 4x+ KV-cache compression — and
+    # decode runs in the ABSORBED form (q_nope folded through W_UK so
+    # queries attend directly over the latent rows).
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0   # per-head no-rope query/key dim
+    qk_rope_head_dim: int = 0   # shared rope dim appended to the latent row
+    v_head_dim: int = 0         # per-head value dim out of W_UV
     # dtype for params/compute (bfloat16 on TPU; float32 for CPU tests)
     dtype: str = "bfloat16"
     eos_token_id: int = 2
@@ -51,6 +69,22 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    # --- KV-cache geometry (what the paged pools actually store): MLA keeps
+    # one shared latent row per token; classic attention keeps per-head K/V.
+    @property
+    def cache_kv_heads(self) -> int:
+        return 1 if self.is_mla else self.num_kv_heads
+
+    @property
+    def cache_head_dim(self) -> int:
+        if self.is_mla:
+            return self.kv_lora_rank + self.qk_rope_head_dim
+        return self.head_dim
 
     @staticmethod
     def from_hf_config(cfg: dict, name: str = "hf-model", dtype: str = "bfloat16") -> "ModelConfig":
@@ -66,6 +100,12 @@ class ModelConfig:
         eos = cfg.get("eos_token_id", 2)
         if isinstance(eos, list):
             eos = eos[0]
+        if cfg.get("first_k_dense_replace"):
+            # DeepSeek's dense-first-k layout breaks the uniform layer scan
+            raise ValueError(
+                "first_k_dense_replace (dense first layers in an MoE "
+                "model) is not supported yet — all layers must share one "
+                "structure for the lax.scan layer stack")
         # expert count: Mixtral uses num_local_experts, DeepSeek
         # n_routed_experts, Qwen3-MoE plain num_experts
         n_experts = (cfg.get("num_local_experts")
@@ -95,6 +135,14 @@ class ModelConfig:
             attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
             num_experts=n_experts,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
+            norm_topk_prob=bool(cfg.get("norm_topk_prob", True)),
+            routed_scaling_factor=float(
+                cfg.get("routed_scaling_factor", 1.0)),
+            kv_lora_rank=cfg.get("kv_lora_rank", 0) or 0,
+            qk_nope_head_dim=cfg.get("qk_nope_head_dim", 0) or 0,
+            qk_rope_head_dim=cfg.get("qk_rope_head_dim", 0) or 0,
+            v_head_dim=cfg.get("v_head_dim", 0) or 0,
             dtype=dtype,
             eos_token_id=eos,
             bos_token_id=cfg.get("bos_token_id", 1),
@@ -134,6 +182,11 @@ PRESETS = {
     "tiny-debug": ModelConfig(),
     "tiny-moe-debug": ModelConfig(
         name="tiny-moe-debug", num_experts=4, num_experts_per_tok=2
+    ),
+    "tiny-mla-debug": ModelConfig(
+        name="tiny-mla-debug",
+        kv_lora_rank=32, qk_nope_head_dim=16, qk_rope_head_dim=8,
+        v_head_dim=16,
     ),
     "llama-3.2-1b-instruct": ModelConfig(
         name="llama-3.2-1b-instruct",
@@ -226,6 +279,37 @@ PRESETS = {
         num_experts_per_tok=8,
         eos_token_id=151645,
         bos_token_id=151643,
+    ),
+    # DeepSeek-V2-Lite dims: MLA latent attention — the paged cache stores
+    # one shared 576-lane [c_kv | k_rope] row per token in each of the K/V
+    # pools (1152 lanes total vs 4096 for the equivalent per-head MHA:
+    # 3.6x KV compression; the symmetric-pool duplication keeps the whole
+    # engine/transfer/donation machinery unchanged) + 64 routed top-6 / 2
+    # shared experts. DEVIATION from the checkpoint: the real model's FIRST
+    # layer is a dense FFN (first_k_dense_replace=1), which the uniform
+    # layer scan doesn't support yet — here every layer is MoE, so param
+    # count runs ~0.5B over the published 15.7B.
+    "deepseek-v2-lite": ModelConfig(
+        name="deepseek-v2-lite",
+        vocab_size=102400,
+        hidden_size=2048,
+        intermediate_size=1408,  # per-expert (hf moe_intermediate_size)
+        num_layers=27,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=128,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        num_experts=64,
+        num_experts_per_tok=6,
+        num_shared_experts=2,
+        norm_topk_prob=False,  # DeepSeek gate convention
+        kv_lora_rank=512,
+        qk_nope_head_dim=128,
+        qk_rope_head_dim=64,
+        v_head_dim=128,
+        eos_token_id=100001,
+        bos_token_id=100000,
     ),
 }
 # Aliases matching the ids used in the reference manifests

@@ -65,16 +65,32 @@ def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float
         return (shape, "normal",
                 sigma if sigma is not None else 1.0 / shape[-1] ** 0.5)
 
+    # NOTE: insertion ORDER is load-bearing for existing configs —
+    # init_params assigns PRNG subkeys positionally, so reordering names
+    # would silently change every random-init weight
     p = {
         "embed": w((cfg.vocab_size, e), 0.02),
         "final_norm": ((e,), "ones", 0.0),
         "attn_norm": ((l, e), "ones", 0.0),
-        "wq": w((l, e, h, d)),
-        "wk": w((l, e, kv, d)),
-        "wv": w((l, e, kv, d)),
-        "wo": w((l, h, d, e)),
-        "mlp_norm": ((l, e), "ones", 0.0),
     }
+    if cfg.is_mla:
+        # multi-head latent attention (DeepSeek-V2 family): queries project
+        # per-head to [nope | rope]; keys/values come from ONE shared
+        # latent row per token via the up-projections W_UK / W_UV
+        nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+        lora, vd = cfg.kv_lora_rank, cfg.v_head_dim
+        p["wq_mla"] = w((l, e, h, nope + rope))
+        p["w_kv_a"] = w((l, e, lora + rope))
+        p["kv_a_norm"] = ((l, lora), "ones", 0.0)
+        p["w_uk"] = w((l, h, nope, lora))
+        p["w_uv"] = w((l, h, lora, vd))
+        p["wo"] = w((l, h, vd, e))
+    else:
+        p["wq"] = w((l, e, h, d))
+        p["wk"] = w((l, e, kv, d))
+        p["wv"] = w((l, e, kv, d))
+        p["wo"] = w((l, h, d, e))
+    p["mlp_norm"] = ((l, e), "ones", 0.0)
     if not cfg.tie_word_embeddings:
         p["lm_head"] = w((e, cfg.vocab_size), 0.02)
     if cfg.attention_bias:
@@ -90,6 +106,14 @@ def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float
         p["moe_w_gate"] = w((l, x, e, f))
         p["moe_w_up"] = w((l, x, e, f))
         p["moe_w_down"] = w((l, x, f, e))
+        if cfg.num_shared_experts > 0:
+            # DeepSeek-style always-active shared experts: one fused dense
+            # SwiGLU of width shared*f alongside the routed top-k (reuses
+            # the dense-MLP param names/rules)
+            fs = cfg.num_shared_experts * f
+            p["w_gate"] = w((l, e, fs))
+            p["w_up"] = w((l, e, fs))
+            p["w_down"] = w((l, fs, e))
     else:
         p["w_gate"] = w((l, e, f))
         p["w_up"] = w((l, e, f))
@@ -155,7 +179,14 @@ def _scan_layers_paged(params: Params, body, x, k_pages, v_pages,
 
 
 def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array):
-    """x: [T, E] -> q [T, H, D], k/v [T, KV, D] with rope applied."""
+    """x: [T, E] -> q [T, H, D], k/v [T, KV, D] with rope applied.
+
+    MLA models route through _qkv_mla: the returned "k"/"v" are the SHARED
+    latent rows [T, 1, lora+rope] (what the paged cache stores) and q is
+    the absorbed query over the latent space — the generic paged-attention
+    ops then serve MLA unchanged."""
+    if cfg.is_mla:
+        return _qkv_mla(cfg, lp, x, positions)
     q = qeinsum("te,ehd->thd", x, lp["wq"])
     k = qeinsum("te,ekd->tkd", x, lp["wk"])
     v = qeinsum("te,ekd->tkd", x, lp["wv"])
@@ -171,6 +202,56 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array):
     return q, k, v
 
 
+def _qkv_mla(cfg: ModelConfig, lp: Params, x: jax.Array,
+             positions: jax.Array):
+    """Absorbed-form MLA projections (DeepSeek-V2 family).
+
+    The cache stores ONE [c_kv | k_rope] row per token (kv_lora_rank +
+    qk_rope_head_dim lanes, shared by every head) — the 4x+ KV compression
+    that makes MLA a bandwidth win on TPU. Decode never reconstructs
+    per-head keys: q_nope is folded through W_UK once per step
+    (q_eff = [q_nope @ W_UK | q_rope]), so the generic paged ops score
+    queries directly against the latent rows. Their internal
+    1/sqrt(latent_width) scale is corrected to MLA's 1/sqrt(nope+rope)
+    here. The V pool stores the same row; the attention output's first
+    kv_lora_rank lanes are probs @ c_kv, which _attn_out expands through
+    W_UV (the k_rope lanes are sliced away there).
+    """
+    nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    lora = cfg.kv_lora_rank
+    q = qeinsum("te,ehd->thd", x, lp["wq_mla"])  # [T, H, nope+rope]
+    q_nope, q_rope = q[..., :nope], q[..., nope:]
+    q_rope = apply_rope(q_rope, positions, cfg.rope_theta)
+    kv = qeinsum("te,er->tr", x, lp["w_kv_a"])  # [T, lora+rope]
+    c_kv = rms_norm(kv[:, :lora], lp["kv_a_norm"], cfg.rms_norm_eps)
+    k_rope = apply_rope(kv[:, None, lora:], positions, cfg.rope_theta)[:, 0]
+    q_lat = jnp.einsum("thn,hnr->thr", q_nope.astype(jnp.float32),
+                       lp["w_uk"].astype(jnp.float32)).astype(q.dtype)
+    # generic ops scale scores by 1/sqrt(q.shape[-1]); MLA's true scale is
+    # 1/sqrt(nope+rope)
+    fix = ((lora + rope) / (nope + rope)) ** 0.5
+    q_eff = jnp.concatenate([q_lat, q_rope], axis=-1) * jnp.asarray(
+        fix, q.dtype)
+    row = jnp.concatenate([c_kv, k_rope], axis=-1)[:, None, :]  # [T, 1, W]
+    return q_eff, row, row
+
+
+def _attn_out(cfg: ModelConfig, lp: Params, o: jax.Array) -> jax.Array:
+    """Attention output [..., H, D] -> residual [..., E].
+
+    MLA: o's first kv_lora_rank lanes are probs @ c_kv; expand through
+    W_UV per head, then the normal output projection."""
+    lead = o.shape[:-2]
+    h = o.shape[-2]
+    o2 = o.reshape((-1, h, o.shape[-1]))
+    if cfg.is_mla:
+        o2 = jnp.einsum("thr,hrv->thv",
+                        o2[..., :cfg.kv_lora_rank].astype(jnp.float32),
+                        lp["w_uv"].astype(jnp.float32)).astype(o.dtype)
+    out = qeinsum("thd,hde->te", o2, lp["wo"])
+    return out.reshape(lead + (out.shape[-1],))
+
+
 def _mlp(cfg: ModelConfig, lp: Params, x: jax.Array,
          token_mask: jax.Array | None = None,
          allow_capacity: bool = False) -> jax.Array:
@@ -179,17 +260,24 @@ def _mlp(cfg: ModelConfig, lp: Params, x: jax.Array,
     path is prefill-only (allow_capacity): decode batches contain inactive
     slots with no mask to exclude them, and are small enough that dense
     dispatch wins anyway."""
-    if not cfg.is_moe:
+    def dense(x):
         g = qeinsum("te,ef->tf", x, lp["w_gate"])
         u = qeinsum("te,ef->tf", x, lp["w_up"])
         return qeinsum("tf,fe->te", jax.nn.silu(g) * u, lp["w_down"])
+
+    if not cfg.is_moe:
+        return dense(x)
+    shared = dense(x) if cfg.num_shared_experts > 0 else 0.0
     # MoE: top-k routing into a dense [T, X] combine matrix, then one of two
     # dispatch paths (dynamo_tpu.ops.moe): exact dense-masked by default;
     # capacity-based gather (T*k*cf expert-MLP rows instead of T*X) when the
     # deployment opts in via moe_capacity_factor > 0. Both partition over the
     # `expert` mesh axis via the sharding rules on moe_w_*.
     logits = jnp.einsum("te,ex->tx", x, lp["router"]).astype(jnp.float32)
-    combine = moe_ops.topk_combine(logits, cfg.num_experts_per_tok, x.dtype)
+    combine = moe_ops.topk_combine(
+        logits, cfg.num_experts_per_tok, x.dtype,
+        renormalize=cfg.norm_topk_prob,
+        scaling_factor=cfg.routed_scaling_factor)
     if token_mask is not None:
         # padding rows must not claim expert capacity (nor compute)
         combine = combine * token_mask.astype(combine.dtype)[:, None]
@@ -200,11 +288,11 @@ def _mlp(cfg: ModelConfig, lp: Params, x: jax.Array,
             cfg.moe_capacity_factor,
         )
         if cap < t:  # gather only pays off when capacity actually cuts rows
-            return moe_ops.moe_mlp_dropping(
+            return shared + moe_ops.moe_mlp_dropping(
                 x, combine, lp["moe_w_gate"], lp["moe_w_up"],
                 lp["moe_w_down"], capacity=cap,
             )
-    return moe_ops.moe_mlp_dense(
+    return shared + moe_ops.moe_mlp_dense(
         x, combine, lp["moe_w_gate"], lp["moe_w_up"], lp["moe_w_down"]
     )
 
@@ -247,7 +335,7 @@ def prefill(
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(cfg, lp, h, positions)
         o = att.prefill_attention(q, k, v, seq_len)
-        x = x + qeinsum("thd,hde->te", o, lp["wo"])
+        x = x + _attn_out(cfg, lp, o)
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, pages + page_off, page_size=page_size
         )
@@ -305,9 +393,9 @@ def prefill_chunk(
         )
         o = att.chunk_attention(
             q, kp, vp, pages + page_off, start, page_size=page_size,
-            num_kv_heads=cfg.num_kv_heads,
+            num_kv_heads=cfg.cache_kv_heads,
         )
-        x = x + qeinsum("bhd,hde->be", o, lp["wo"])
+        x = x + _attn_out(cfg, lp, o)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
         return x, kp, vp
@@ -363,8 +451,7 @@ def prefill_batch(
             v.reshape(n, s, *v.shape[1:]),
             seq_lens,
         )
-        x = x + qeinsum("thd,hde->te", o.reshape(n * s, *o.shape[2:]),
-                        lp["wo"])
+        x = x + _attn_out(cfg, lp, o.reshape(n * s, *o.shape[2:]))
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, pages.reshape(-1) + page_off, page_size=page_size
         )
@@ -441,10 +528,9 @@ def decode_verify(
         o = att.verify_attention(
             q.reshape(b, k1, *q.shape[1:]), kp, vp,
             block_tables + page_off, positions, page_size=page_size,
-            num_kv_heads=cfg.num_kv_heads,
+            num_kv_heads=cfg.cache_kv_heads,
         )
-        x = x + qeinsum("bhd,hde->be", o.reshape(b * k1, *o.shape[2:]),
-                        lp["wo"])
+        x = x + _attn_out(cfg, lp, o.reshape(b * k1, *o.shape[2:]))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, lp, h)
         return x, kp, vp
@@ -480,9 +566,9 @@ def decode_step(
         )
         o = att.paged_attention_decode(
             q, kp, vp, tables, context_lens, page_size=page_size,
-            num_kv_heads=cfg.num_kv_heads,
+            num_kv_heads=cfg.cache_kv_heads,
         )
-        x = x + qeinsum("bhd,hde->be", o, lp["wo"])
+        x = x + _attn_out(cfg, lp, o)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(cfg, lp, h)
         return x, kp, vp

@@ -162,22 +162,53 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
     p["mlp_norm"] = stack(
         "model.layers.{i}.post_attention_layernorm.weight", lambda w: to_dt(w)
     )
-    p["wq"] = stack(
-        "model.layers.{i}.self_attn.q_proj.weight",
-        lambda w: to_dt(w).T.reshape(e, h, d),
-    )
-    p["wk"] = stack(
-        "model.layers.{i}.self_attn.k_proj.weight",
-        lambda w: to_dt(w).T.reshape(e, kv, d),
-    )
-    p["wv"] = stack(
-        "model.layers.{i}.self_attn.v_proj.weight",
-        lambda w: to_dt(w).T.reshape(e, kv, d),
-    )
-    p["wo"] = stack(
-        "model.layers.{i}.self_attn.o_proj.weight",
-        lambda w: to_dt(w).T.reshape(h, d, e),
-    )
+    if cfg.is_mla:
+        # DeepSeek-V2-family MLA names: q_proj, kv_a_proj_with_mqa (latent
+        # down-projection + shared rope key), kv_a_layernorm, and
+        # kv_b_proj whose rows interleave per head as [W_UK^T | W_UV^T]
+        nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+        lora, vd = cfg.kv_lora_rank, cfg.v_head_dim
+        p["wq_mla"] = stack(
+            "model.layers.{i}.self_attn.q_proj.weight",
+            lambda w: to_dt(w).T.reshape(e, h, nope + rope),
+        )
+        p["w_kv_a"] = stack(
+            "model.layers.{i}.self_attn.kv_a_proj_with_mqa.weight",
+            lambda w: to_dt(w).T,
+        )
+        p["kv_a_norm"] = stack(
+            "model.layers.{i}.self_attn.kv_a_layernorm.weight", to_dt)
+
+        def split_kv_b(w):
+            # [h*(nope+vd), lora] -> W_UK [h, nope, lora], W_UV [h, lora, vd]
+            b = to_dt(w).reshape(h, nope + vd, lora)
+            return b[:, :nope, :], jnp.swapaxes(b[:, nope:, :], 1, 2)
+
+        kv_b = [split_kv_b(g(f"model.layers.{i}.self_attn.kv_b_proj.weight"))
+                for i in range(l)]
+        p["w_uk"] = jnp.stack([b[0] for b in kv_b])
+        p["w_uv"] = jnp.stack([b[1] for b in kv_b])
+        p["wo"] = stack(
+            "model.layers.{i}.self_attn.o_proj.weight",
+            lambda w: to_dt(w).T.reshape(h, vd, e),
+        )
+    else:
+        p["wq"] = stack(
+            "model.layers.{i}.self_attn.q_proj.weight",
+            lambda w: to_dt(w).T.reshape(e, h, d),
+        )
+        p["wk"] = stack(
+            "model.layers.{i}.self_attn.k_proj.weight",
+            lambda w: to_dt(w).T.reshape(e, kv, d),
+        )
+        p["wv"] = stack(
+            "model.layers.{i}.self_attn.v_proj.weight",
+            lambda w: to_dt(w).T.reshape(e, kv, d),
+        )
+        p["wo"] = stack(
+            "model.layers.{i}.self_attn.o_proj.weight",
+            lambda w: to_dt(w).T.reshape(h, d, e),
+        )
     if cfg.attention_bias:
         p["bq"] = stack(
             "model.layers.{i}.self_attn.q_proj.bias", lambda w: to_dt(w).reshape(h, d)
@@ -193,6 +224,16 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
         p["k_norm"] = stack("model.layers.{i}.self_attn.k_norm.weight", to_dt)
     if cfg.is_moe:
         x = cfg.num_experts
+        if (has("model.layers.0.mlp.gate_proj.weight")
+                and not has("model.layers.0.mlp.gate.weight")):
+            # DeepSeek's first_k_dense_replace layout: layer 0 is a plain
+            # dense FFN while later layers are MoE — the uniform layer scan
+            # cannot represent it, so fail with the real reason instead of
+            # a KeyError deep in the expert stacking
+            raise ValueError(
+                "checkpoint has a dense first layer "
+                "(first_k_dense_replace); heterogeneous layer stacks are "
+                "not supported yet")
         # two upstream MoE naming schemes: Mixtral's block_sparse_moe with
         # w1/w3/w2, Qwen3-MoE's mlp.experts with gate/up/down_proj
         if has("model.layers.0.block_sparse_moe.gate.weight"):
@@ -218,6 +259,17 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
         p["moe_w_gate"] = jnp.stack([experts(i, "gate") for i in range(l)])
         p["moe_w_up"] = jnp.stack([experts(i, "up") for i in range(l)])
         p["moe_w_down"] = jnp.stack([experts(i, "down") for i in range(l)])
+        if cfg.num_shared_experts > 0:
+            # DeepSeek shared experts load into the dense-MLP param slots
+            p["w_gate"] = stack(
+                f"model.layers.{{i}}.{moe_base}.shared_experts"
+                ".gate_proj.weight", lambda w: to_dt(w).T)
+            p["w_up"] = stack(
+                f"model.layers.{{i}}.{moe_base}.shared_experts"
+                ".up_proj.weight", lambda w: to_dt(w).T)
+            p["w_down"] = stack(
+                f"model.layers.{{i}}.{moe_base}.shared_experts"
+                ".down_proj.weight", lambda w: to_dt(w).T)
     else:
         p["w_gate"] = stack(
             "model.layers.{i}.mlp.gate_proj.weight", lambda w: to_dt(w).T

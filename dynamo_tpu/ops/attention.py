@@ -643,6 +643,16 @@ def prefill_attention(
         out = ring_prefill_attention(q, k, v, seq_len, sp_mesh)
         return out[:s] if pad else out
     backend = _resolve_backend()
+    if backend != "xla" and q.shape[2] % 128 != 0 and q.shape[2] not in (32, 64):
+        # e.g. MLA's latent width (kv_lora_rank + rope = 576): no Mosaic
+        # tiling for off-size trailing dims — serve via XLA
+        if _explicit_backend() is not None:
+            import logging
+
+            logging.getLogger("dynamo_tpu.ops").warning(
+                "pallas prefill needs a tileable head dim (got %d); using "
+                "the XLA path", q.shape[2])
+        backend = "xla"
     if backend == "xla":
         return prefill_attention_xla(q, k, v, seq_len)
     from dynamo_tpu.ops import pallas_attention as pa

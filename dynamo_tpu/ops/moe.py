@@ -29,11 +29,28 @@ import jax.numpy as jnp
 from dynamo_tpu.models.quant import einsum as qeinsum
 
 
-def topk_combine(logits: jax.Array, k: int, dtype) -> jax.Array:
-    """Router logits [T, X] -> dense combine matrix [T, X]: softmaxed top-k
-    weights scattered back, zeros elsewhere."""
+def topk_combine(logits: jax.Array, k: int, dtype,
+                 renormalize: bool = True,
+                 scaling_factor: float = 1.0) -> jax.Array:
+    """Router logits [T, X] -> dense combine matrix [T, X]: top-k gate
+    weights scattered back, zeros elsewhere.
+
+    renormalize=True (Mixtral/Qwen3 convention): softmax over the selected
+    top-k logits, weights sum to 1. renormalize=False (DeepSeek-V2
+    norm_topk_prob=false): the GLOBAL softmax probabilities of the selected
+    experts, sum < 1, optionally scaled by routed_scaling_factor."""
     topv, topi = jax.lax.top_k(logits, k)
-    weights = jax.nn.softmax(topv, axis=-1).astype(dtype)  # [T, K]
+    if renormalize:
+        weights = jax.nn.softmax(topv, axis=-1)
+    else:
+        denom = jnp.sum(jnp.exp(logits - jnp.max(logits, axis=-1,
+                                                 keepdims=True)),
+                        axis=-1, keepdims=True)
+        weights = jnp.exp(topv - jnp.max(logits, axis=-1, keepdims=True)) \
+            / denom
+    if scaling_factor != 1.0:
+        weights = weights * scaling_factor
+    weights = weights.astype(dtype)  # [T, K]
     t = logits.shape[0]
     return (
         jnp.zeros(logits.shape, dtype)

@@ -32,6 +32,14 @@ PARAM_RULES: Dict[str, P] = {
     "bv": P(None, "model", None),
     "q_norm": P(None, None),
     "k_norm": P(None, None),
+    # MLA (latent attention): head-carrying projections shard on `model`;
+    # the shared latent down-projection and norm replicate (every shard
+    # scores its local heads against the full latent row)
+    "wq_mla": P(None, None, "model", None),   # [L, E, H, nope+rope]
+    "w_kv_a": P(None, None, None),            # [L, E, lora+rope] shared
+    "kv_a_norm": P(None, None),
+    "w_uk": P(None, "model", None, None),     # [L, H, nope, lora]
+    "w_uv": P(None, "model", None, None),     # [L, H, lora, v]
     # dense MLP
     "mlp_norm": P(None, None),
     "w_gate": P(None, None, "model"),  # [L, E, F] column-parallel

@@ -38,9 +38,22 @@ DISPATCH_OVERHEAD_S = 0.004  # per-step host dispatch + scheduling
 def param_count(cfg: ModelConfig) -> float:
     """Total parameter count (all experts for MoE)."""
     h, hd = cfg.hidden_size, cfg.head_dim
-    attn = h * cfg.num_heads * hd + 2 * h * cfg.num_kv_heads * hd + cfg.num_heads * hd * h
+    if cfg.is_mla:
+        nh, nope, rope = (cfg.num_heads, cfg.qk_nope_head_dim,
+                          cfg.qk_rope_head_dim)
+        lora, vd = cfg.kv_lora_rank, cfg.v_head_dim
+        attn = (h * nh * (nope + rope)      # q projection
+                + h * (lora + rope)         # latent down-projection
+                + nh * nope * lora          # W_UK
+                + nh * lora * vd            # W_UV
+                + nh * vd * h)              # output projection
+    else:
+        attn = (h * cfg.num_heads * hd + 2 * h * cfg.num_kv_heads * hd
+                + cfg.num_heads * hd * h)
     mlp_one = 3 * h * cfg.intermediate_size
     mlp = mlp_one * max(cfg.num_experts, 1)
+    if cfg.is_moe and cfg.num_shared_experts:
+        mlp += mlp_one * cfg.num_shared_experts
     router = h * cfg.num_experts if cfg.is_moe else 0
     per_layer = attn + mlp + router + 2 * h  # + rmsnorm scales
     embed = cfg.vocab_size * h * (1 if cfg.tie_word_embeddings else 2)
@@ -48,7 +61,7 @@ def param_count(cfg: ModelConfig) -> float:
 
 
 def active_param_count(cfg: ModelConfig) -> float:
-    """Params touched per token (MoE: only routed experts)."""
+    """Params touched per token (MoE: routed top-k + shared experts)."""
     if not cfg.is_moe:
         return param_count(cfg)
     h = cfg.hidden_size
@@ -59,15 +72,21 @@ def active_param_count(cfg: ModelConfig) -> float:
 
 def kv_bytes_per_token(cfg: ModelConfig, kv_dtype: str = "auto",
                        tp: int = 1) -> float:
-    lanes = cfg.num_kv_heads * cfg.head_dim
+    # cache geometry, not attention geometry: MLA stores one shared latent
+    # row per token (cache_kv_heads == 1) in REPLICATED pools — no TP lane
+    # blocking applies
+    kv_heads, head_dim = cfg.cache_kv_heads, cfg.cache_head_dim
+    if cfg.is_mla:
+        tp = 1
+    lanes = kv_heads * head_dim
     if kv_dtype == "int8":
         # packed-scale int8 rows, lane-BLOCKED per TP shard and padded to a
         # 128 multiple PER BLOCK (dynamo_tpu.ops.attention.kv_lane_width) —
         # at high tp the padding can eat the entire saving (e.g. 8 KV heads
         # of dim 128 at tp=8: 8 x 256-lane blocks = bf16-sized rows), so
         # the roofline must model the real layout, not lanes/2
-        kv_l = max(cfg.num_kv_heads // max(tp, 1), 1)
-        block = -(-(kv_l * cfg.head_dim + 2 * kv_l) // 128) * 128
+        kv_l = max(kv_heads // max(tp, 1), 1)
+        block = -(-(kv_l * head_dim + 2 * kv_l) // 128) * 128
         return 2.0 * cfg.num_layers * max(tp, 1) * block
     return 2.0 * cfg.num_layers * lanes * BYTES
 
@@ -141,20 +160,25 @@ def estimate(
     chip = sys.chip
     wb = weight_bytes(quantization)
     kvb = kv_bytes_per_token(cfg, kv_dtype, tp=tp)
-    if kv_dtype == "int8" and cfg.num_kv_heads % tp != 0:
-        # the lane-blocked int8 layout requires tp | num_kv_heads
-        # (engine.KVCacheSpec.from_model raises for this combination)
+    if (kv_dtype == "int8" and not cfg.is_mla
+            and cfg.cache_kv_heads % tp != 0):
+        # the lane-blocked int8 layout requires tp | cache KV heads
+        # (engine.KVCacheSpec.from_model raises for this combination;
+        # MLA pools replicate, so the blocking never applies there)
         return Estimate(tp=tp, replicas=max(sys.num_chips // tp, 1),
                         batch=batch, ttft_s=float("inf"),
                         itl_s=float("inf"), tok_s_per_chip=0.0,
                         hbm_used_frac=float("inf"), feasible=False,
                         quantization=quantization, kv_dtype=kv_dtype)
+    # MLA latent pools REPLICATE across the model axis: every chip holds
+    # and streams the full KV pool (tp shards only the weights)
+    kv_shards = 1 if cfg.is_mla else tp
 
     # --- capacity: per-chip share of weights + this replica's KV pages.
     avg_ctx = isl + osl / 2.0
     kv_per_seq_full = kvb * (isl + osl)
     weights_per_chip = p_total * wb / tp
-    kv_per_chip = batch * kv_per_seq_full / tp
+    kv_per_chip = batch * kv_per_seq_full / kv_shards
     hbm_frac = (weights_per_chip + kv_per_chip) / (chip.hbm_bytes * 0.92)
     feasible = hbm_frac <= 1.0
 
@@ -167,9 +191,12 @@ def estimate(
     t_coll = 2 * l * _allreduce_time(act_bytes, tp, sys)
     ttft = t_compute + t_coll + DISPATCH_OVERHEAD_S
 
-    # --- decode step for the full batch at average context length.
-    read_bytes = p_total * wb + batch * kvb * avg_ctx
-    t_mem = read_bytes / (tp * chip.hbm_bw * HBM_EFF)
+    # --- decode step for the full batch at average context length
+    # (per-chip read bytes over per-chip bandwidth; replicated MLA pools
+    # get no TP bandwidth speedup on the KV stream).
+    read_per_chip = (p_total * wb / tp
+                     + batch * kvb * avg_ctx / kv_shards)
+    t_mem = read_per_chip / (chip.hbm_bw * HBM_EFF)
     t_flops = 2.0 * p_active * batch / (tp * chip.bf16_flops * MFU_DECODE)
     dec_act = batch * cfg.hidden_size * BYTES
     t_dcoll = 2 * l * _allreduce_time(dec_act, tp, sys)

@@ -99,7 +99,7 @@ def test_pack_unpack_lane_blocked_roundtrip():
 
 
 def test_int8_kv_blocking_requires_divisibility():
-    with pytest.raises(ValueError, match="divide num_kv_heads"):
+    with pytest.raises(ValueError, match="divide the cache KV-head count"):
         KVCacheSpec.from_model(
             ModelConfig.from_model_name("tiny-debug"), 8, 4,
             kv_dtype="int8", tensor_parallel=3)

@@ -139,3 +139,105 @@ def test_from_hf_config_dense_keeps_intermediate():
         "num_hidden_layers": 2, "num_attention_heads": 4,
     }, name="dense-test")
     assert cfg.num_experts == 0 and cfg.intermediate_size == 256
+
+
+def test_load_mla_checkpoint_names(tmp_path):
+    """DeepSeek-V2-family tensor names load: kv_a_proj_with_mqa,
+    kv_a_layernorm, and kv_b_proj split per head into W_UK / W_UV."""
+    from safetensors.numpy import save_file
+
+    cfg = dataclasses.replace(
+        ModelConfig.from_model_name("tiny-mla-debug", dtype="float32"),
+        tie_word_embeddings=False, num_experts=4, num_experts_per_tok=2,
+        num_shared_experts=2)
+    rng = np.random.default_rng(1)
+    e, h = cfg.hidden_size, cfg.num_heads
+    nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    lora, vd, f = cfg.kv_lora_rank, cfg.v_head_dim, cfg.intermediate_size
+
+    def w(*shape):
+        return rng.standard_normal(shape).astype(np.float32)
+
+    t = {"model.embed_tokens.weight": w(cfg.vocab_size, e),
+         "model.norm.weight": w(e), "lm_head.weight": w(cfg.vocab_size, e)}
+    for i in range(cfg.num_layers):
+        L = f"model.layers.{i}"
+        t[f"{L}.input_layernorm.weight"] = w(e)
+        t[f"{L}.post_attention_layernorm.weight"] = w(e)
+        t[f"{L}.self_attn.q_proj.weight"] = w(h * (nope + rope), e)
+        t[f"{L}.self_attn.kv_a_proj_with_mqa.weight"] = w(lora + rope, e)
+        t[f"{L}.self_attn.kv_a_layernorm.weight"] = w(lora)
+        t[f"{L}.self_attn.kv_b_proj.weight"] = w(h * (nope + vd), lora)
+        t[f"{L}.self_attn.o_proj.weight"] = w(e, h * vd)
+        t[f"{L}.mlp.gate.weight"] = w(cfg.num_experts, e)
+        for j in range(cfg.num_experts):
+            E = f"{L}.mlp.experts.{j}"
+            t[f"{E}.gate_proj.weight"] = w(f, e)
+            t[f"{E}.up_proj.weight"] = w(f, e)
+            t[f"{E}.down_proj.weight"] = w(e, f)
+        S = f"{L}.mlp.shared_experts"
+        t[f"{S}.gate_proj.weight"] = w(2 * f, e)
+        t[f"{S}.up_proj.weight"] = w(2 * f, e)
+        t[f"{S}.down_proj.weight"] = w(e, 2 * f)
+    path = tmp_path / "model.safetensors"
+    save_file(t, str(path))
+    p = load_hf_safetensors(cfg, [str(path)])
+    l = cfg.num_layers
+    assert p["wq_mla"].shape == (l, e, h, nope + rope)
+    assert p["w_kv_a"].shape == (l, e, lora + rope)
+    assert p["w_uk"].shape == (l, h, nope, lora)
+    assert p["w_uv"].shape == (l, h, lora, vd)
+    assert p["wo"].shape == (l, h, vd, e)
+    assert p["w_gate"].shape == (l, e, 2 * f)  # shared experts
+    # kv_b split round-trips: stitching W_UK/W_UV back rebuilds kv_b rows
+    kv_b = t["model.layers.0.self_attn.kv_b_proj.weight"].reshape(
+        h, nope + vd, lora)
+    np.testing.assert_allclose(np.asarray(p["w_uk"][0]), kv_b[:, :nope, :],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p["w_uv"][0]),
+                               np.swapaxes(kv_b[:, nope:, :], 1, 2),
+                               rtol=1e-6)
+
+
+def test_from_hf_config_deepseek_mla_keys():
+    cfg = ModelConfig.from_hf_config({
+        "architectures": ["DeepseekV2ForCausalLM"],
+        "vocab_size": 102400, "hidden_size": 2048,
+        "intermediate_size": 10944, "moe_intermediate_size": 1408,
+        "num_hidden_layers": 27, "num_attention_heads": 16,
+        "n_routed_experts": 64, "num_experts_per_tok": 6,
+        "n_shared_experts": 2, "kv_lora_rank": 512,
+        "qk_nope_head_dim": 128, "qk_rope_head_dim": 64, "v_head_dim": 128,
+    }, name="dsv2")
+    assert cfg.is_mla and cfg.kv_lora_rank == 512
+    assert cfg.num_shared_experts == 2
+    assert cfg.intermediate_size == 1408
+    assert cfg.cache_head_dim == 576 and cfg.cache_kv_heads == 1
+
+
+def test_from_hf_config_rejects_dense_first_layers():
+    with pytest.raises(ValueError, match="first_k_dense_replace"):
+        ModelConfig.from_hf_config({
+            "vocab_size": 100, "hidden_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "first_k_dense_replace": 1,
+            "n_routed_experts": 4,
+        }, name="dsv2-dense-first")
+
+
+def test_loader_rejects_dense_first_layer_checkpoint(tmp_path):
+    from safetensors.numpy import save_file
+
+    cfg = dataclasses.replace(
+        ModelConfig.from_model_name("tiny-moe-debug", dtype="float32"))
+    t = _hf_tensors(cfg, "qwen3moe")
+    # turn layer 0 into a dense FFN (DeepSeek first_k_dense_replace=1)
+    for k in [k for k in t if k.startswith("model.layers.0.mlp.")]:
+        del t[k]
+    e, f = cfg.hidden_size, cfg.intermediate_size
+    rng = np.random.default_rng(2)
+    t["model.layers.0.mlp.gate_proj.weight"] = \
+        rng.standard_normal((f, e)).astype(np.float32)
+    path = tmp_path / "m.safetensors"
+    save_file(t, str(path))
+    with pytest.raises(ValueError, match="first_k_dense_replace"):
+        load_hf_safetensors(cfg, [str(path)])

@@ -18,7 +18,9 @@ def make(cfg_kwargs=None):
 
 
 def fresh_cache(cfg, num_pages=32):
-    shape = (cfg.num_layers, num_pages, PS, cfg.num_kv_heads * cfg.head_dim)
+    # cache geometry, not attention geometry (MLA stores shared latent rows)
+    shape = (cfg.num_layers, num_pages, PS,
+             cfg.cache_kv_heads * cfg.cache_head_dim)
     return jnp.zeros(shape), jnp.zeros(shape)
 
 
@@ -44,9 +46,13 @@ def prefill_logits(cfg, params, tokens, seq_len):
         # per-head q/k RMSNorm COMBINED with MoE routing — the qwen3-moe
         # family layout (qwen3-30b-a3b preset)
         {"qk_norm": True, "num_experts": 4, "num_experts_per_tok": 2},
+        # MLA latent attention + shared experts — the deepseek-v2 family
+        {"kv_lora_rank": 32, "qk_nope_head_dim": 16, "qk_rope_head_dim": 8,
+         "v_head_dim": 16, "num_experts": 4, "num_experts_per_tok": 2,
+         "num_shared_experts": 1},
         {"tie_word_embeddings": False},
     ],
-    ids=["llama", "qwen", "moe", "qwen3moe", "untied"],
+    ids=["llama", "qwen", "moe", "qwen3moe", "mla", "untied"],
 )
 def test_decode_matches_prefill(cfg_kwargs):
     cfg, params = make(cfg_kwargs)

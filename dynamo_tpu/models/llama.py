@@ -227,12 +227,19 @@ def _qkv_mla(cfg: ModelConfig, lp: Params, x: jax.Array,
     k_rope = apply_rope(kv[:, None, lora:], positions, cfg.rope_theta)[:, 0]
     q_lat = jnp.einsum("thn,hnr->thr", q_nope.astype(jnp.float32),
                        lp["w_uk"].astype(jnp.float32)).astype(q.dtype)
-    # generic ops scale scores by 1/sqrt(q.shape[-1]); MLA's true scale is
-    # 1/sqrt(nope+rope)
-    fix = ((lora + rope) / (nope + rope)) ** 0.5
+    # generic ops scale scores by 1/sqrt(q.shape[-1]) — the PADDED cache
+    # width (cache_head_dim rounds real latent rows up to a 128-lane
+    # multiple for Pallas DMA tiling; zero lanes add nothing to scores);
+    # MLA's true scale is 1/sqrt(nope+rope)
+    width = cfg.cache_head_dim
+    fix = (width / (nope + rope)) ** 0.5
     q_eff = jnp.concatenate([q_lat, q_rope], axis=-1) * jnp.asarray(
         fix, q.dtype)
     row = jnp.concatenate([c_kv, k_rope], axis=-1)[:, None, :]  # [T, 1, W]
+    pad = width - (lora + rope)
+    if pad:
+        q_eff = jnp.pad(q_eff, ((0, 0), (0, 0), (0, pad)))
+        row = jnp.pad(row, ((0, 0), (0, 0), (0, pad)))
     return q_eff, row, row
 
 

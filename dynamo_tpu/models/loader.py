@@ -168,13 +168,31 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
         # kv_b_proj whose rows interleave per head as [W_UK^T | W_UV^T]
         nope, rope = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
         lora, vd = cfg.kv_lora_rank, cfg.v_head_dim
+        # DeepSeek checkpoints store the rope lanes INTERLEAVED (pair
+        # [2i, 2i+1] rotates together; HF de-interleaves at runtime before
+        # rotate_half). Our apply_rope is half-split (neox), so fold the
+        # de-interleave permutation into the rope output columns once at
+        # load: deint[c] = 2c for the first half, 2(c - rope/2)+1 after.
+        import numpy as _np
+
+        deint = _np.concatenate([_np.arange(0, rope, 2),
+                                 _np.arange(1, rope, 2)])
+
+        def fix_q(w):
+            w = to_dt(w).T.reshape(e, h, nope + rope)
+            return jnp.concatenate(
+                [w[..., :nope], w[..., nope + deint]], axis=-1)
+
+        def fix_kv_a(w):
+            w = to_dt(w).T  # [E, lora + rope]
+            return jnp.concatenate(
+                [w[..., :lora], w[..., lora + deint]], axis=-1)
+
         p["wq_mla"] = stack(
-            "model.layers.{i}.self_attn.q_proj.weight",
-            lambda w: to_dt(w).T.reshape(e, h, nope + rope),
-        )
+            "model.layers.{i}.self_attn.q_proj.weight", fix_q)
         p["w_kv_a"] = stack(
             "model.layers.{i}.self_attn.kv_a_proj_with_mqa.weight",
-            lambda w: to_dt(w).T,
+            fix_kv_a,
         )
         p["kv_a_norm"] = stack(
             "model.layers.{i}.self_attn.kv_a_layernorm.weight", to_dt)

@@ -43,11 +43,8 @@ def topk_combine(logits: jax.Array, k: int, dtype,
     if renormalize:
         weights = jax.nn.softmax(topv, axis=-1)
     else:
-        denom = jnp.sum(jnp.exp(logits - jnp.max(logits, axis=-1,
-                                                 keepdims=True)),
-                        axis=-1, keepdims=True)
-        weights = jnp.exp(topv - jnp.max(logits, axis=-1, keepdims=True)) \
-            / denom
+        weights = jnp.take_along_axis(jax.nn.softmax(logits, axis=-1),
+                                      topi, axis=-1)
     if scaling_factor != 1.0:
         weights = weights * scaling_factor
     weights = weights.astype(dtype)  # [T, K]

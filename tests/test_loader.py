@@ -212,7 +212,7 @@ def test_from_hf_config_deepseek_mla_keys():
     assert cfg.is_mla and cfg.kv_lora_rank == 512
     assert cfg.num_shared_experts == 2
     assert cfg.intermediate_size == 1408
-    assert cfg.cache_head_dim == 576 and cfg.cache_kv_heads == 1
+    assert cfg.cache_head_dim == 640 and cfg.cache_kv_heads == 1  # padded for Pallas
 
 
 def test_from_hf_config_rejects_dense_first_layers():

@@ -180,3 +180,36 @@ def test_deepseek_gate_convention():
     scaled = topk_combine(logits, 2, jnp.float32, renormalize=False,
                           scaling_factor=16.0)
     np.testing.assert_allclose(np.asarray(scaled), raw_np * 16.0, rtol=1e-5)
+
+
+def test_real_size_latent_rows_pad_for_pallas():
+    cfg = ModelConfig.from_model_name("deepseek-v2-lite")
+    assert cfg.kv_lora_rank + cfg.qk_rope_head_dim == 576
+    assert cfg.cache_head_dim == 640  # padded to a 128-lane multiple
+    # tiny test config stays unpadded (below a lane tile)
+    tiny = ModelConfig.from_model_name("tiny-mla-debug")
+    assert tiny.cache_head_dim == 40
+
+
+def test_pallas_decode_serves_mla_shaped_pool():
+    """MQA-shaped latent pool (n_kv=1, 640 lanes): the bandwidth-first
+    decode kernel must agree with the XLA gather path (interpret mode)."""
+    import jax
+
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops import pallas_attention as pa
+
+    b, h, d, ps, npages, pmax = 2, 8, 640, 4, 16, 4
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, h, d), jnp.float32)
+    kp = jax.random.normal(ks[1], (npages, ps, d), jnp.float32)
+    vp = jax.random.normal(ks[2], (npages, ps, d), jnp.float32)
+    bt = (jnp.arange(b * pmax, dtype=jnp.int32).reshape(b, pmax)
+          % (npages - 1)) + 1
+    cl = jnp.asarray([3, 11], jnp.int32)
+    ref = att.paged_attention_decode_xla(q, kp, vp, bt, cl, page_size=ps,
+                                         num_kv_heads=1)
+    out = pa.paged_attention_decode(q, kp, vp, bt, cl, page_size=ps,
+                                    num_kv_heads=1, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

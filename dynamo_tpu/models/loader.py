@@ -173,10 +173,8 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
         # rotate_half). Our apply_rope is half-split (neox), so fold the
         # de-interleave permutation into the rope output columns once at
         # load: deint[c] = 2c for the first half, 2(c - rope/2)+1 after.
-        import numpy as _np
-
-        deint = _np.concatenate([_np.arange(0, rope, 2),
-                                 _np.arange(1, rope, 2)])
+        deint = np.concatenate([np.arange(0, rope, 2),
+                                np.arange(1, rope, 2)])
 
         def fix_q(w):
             w = to_dt(w).T.reshape(e, h, nope + rope)

@@ -385,11 +385,17 @@ def chunk_attention(
       Gated off by default until validated on hardware (interpret-mode
       tests cover semantics; Mosaic lowering needs a real chip).
     """
-    # NOTE: a process-wide env gate (not the per-engine attention_context)
-    # on purpose, and only while the Pallas chunk kernel awaits hardware
-    # validation — once it defaults on, selection folds into
-    # _resolve_backend() like the decode/prefill ops.
-    backend = os.environ.get("DYNAMO_TPU_CHUNK_ATTENTION", "xla")
+    # Selection: the DYNAMO_TPU_CHUNK_ATTENTION env var wins when set;
+    # otherwise, once the kernel is hardware-validated
+    # (pallas_attention.CHUNK_KERNEL_HW_VALIDATED — flipped by the battery's
+    # chunk_kernel_parity case), selection follows _resolve_backend() like
+    # the decode/prefill ops. Until then the default stays the XLA path.
+    backend = os.environ.get("DYNAMO_TPU_CHUNK_ATTENTION")
+    if not backend:
+        from dynamo_tpu.ops import pallas_attention as _pa
+
+        backend = (_resolve_backend() if _pa.CHUNK_KERNEL_HW_VALIDATED
+                   else "xla")
     if backend in ("pallas", "pallas_interpret"):
         quantized = k_pages.dtype == jnp.int8
         n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)

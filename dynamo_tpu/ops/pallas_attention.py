@@ -74,6 +74,14 @@ def _env_int(name: str, default: int, lo: int) -> int:
         return default
 
 
+# Chunk-prefill kernel hardware-validation flag: while False, chunked
+# prefill defaults to the XLA gather path unless DYNAMO_TPU_CHUNK_ATTENTION
+# explicitly selects the kernel. Flip to True once the battery's
+# chunk_kernel_parity case passes on a real chip (interpret mode cannot
+# validate Mosaic lowering) — selection then follows the engine's
+# attention backend like the decode/prefill ops.
+CHUNK_KERNEL_HW_VALIDATED = False
+
 # pages per decode superblock (tokens per block = this * page_size);
 # DYNAMO_TPU_DECODE_BLOCK_PAGES / _NUM_BUFS override for hardware tuning
 DEFAULT_BLOCK_PAGES = _env_int("DYNAMO_TPU_DECODE_BLOCK_PAGES", 8, 1)

@@ -114,3 +114,36 @@ def test_chunked_engine_with_pallas_chunk_kernel(monkeypatch):
         GenRequest("x", prompt, max_tokens=8, temperature=0.0,
                    ignore_eos=True))
     assert out == ref
+
+
+def test_chunk_backend_follows_engine_backend_once_validated(monkeypatch):
+    """With no env override, chunk attention stays XLA until the kernel is
+    hardware-validated; once CHUNK_KERNEL_HW_VALIDATED flips, selection
+    follows the engine's attention backend like the other ops."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops import pallas_attention as pa
+
+    rng = np.random.default_rng(21)
+    ps, n_kv, d, h = 16, 2, 64, 4
+    kp = jnp.asarray(rng.normal(size=(16, ps, n_kv * d)), jnp.float32)
+    pages = jnp.asarray([1, 2, 3, 4], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(16, h, d)), jnp.float32)
+    monkeypatch.delenv("DYNAMO_TPU_CHUNK_ATTENTION", raising=False)
+
+    calls = []
+    real = pa.chunk_prefill_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(pa, "chunk_prefill_attention", spy)
+    with att.attention_context("pallas_interpret", None):
+        att.chunk_attention(q, kp, kp, pages, 16, page_size=ps)
+        assert not calls  # not validated: XLA path even under pallas ctx
+        monkeypatch.setattr(pa, "CHUNK_KERNEL_HW_VALIDATED", True)
+        att.chunk_attention(q, kp, kp, pages, 16, page_size=ps)
+        assert calls  # validated: follows the engine backend

@@ -241,3 +241,36 @@ def test_loader_rejects_dense_first_layer_checkpoint(tmp_path):
     save_file(t, str(path))
     with pytest.raises(ValueError, match="first_k_dense_replace"):
         load_hf_safetensors(cfg, [str(path)])
+
+
+def test_rope_deinterleave_matches_hf_reference():
+    """Folding the de-interleave into the weights must reproduce HF's
+    DeepSeek rope exactly: interleaved pairs de-interleaved at runtime
+    then rotate_half == our half-split apply_rope on the permuted weights."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.rope import apply_rope, rope_freqs
+
+    rng = np.random.default_rng(7)
+    e, rope, t, theta = 16, 8, 5, 10000.0
+    W = rng.standard_normal((rope, e)).astype(np.float32)  # HF [out, in]
+    x = rng.standard_normal((t, e)).astype(np.float32)
+    positions = np.arange(t)
+
+    # HF reference: project with the RAW (interleaved) weight, de-interleave
+    # pairs, then half-split rotation
+    y = x @ W.T  # [t, rope] interleaved lanes
+    y_d = np.concatenate([y[:, 0::2], y[:, 1::2]], axis=1)
+    inv = np.asarray(rope_freqs(rope, theta))
+    ang = positions[:, None] * inv  # [t, rope/2]
+    cos, sin = np.cos(ang), np.sin(ang)
+    y1, y2 = y_d[:, :rope // 2], y_d[:, rope // 2:]
+    ref = np.concatenate([y1 * cos - y2 * sin, y2 * cos + y1 * sin], axis=1)
+
+    # our path: permute the weight ROWS once (what fix_q/fix_kv_a do to the
+    # rope output columns), project, then the repo's half-split apply_rope
+    deint = np.concatenate([np.arange(0, rope, 2), np.arange(1, rope, 2)])
+    Wp = W[deint]  # fold the de-interleave into the weight
+    out = apply_rope(jnp.asarray(x @ Wp.T)[:, None, :],
+                     jnp.asarray(positions), theta)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)

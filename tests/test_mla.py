@@ -113,8 +113,9 @@ def test_absorbed_decode_matches_explicit_reference():
     lora, h = cfg.kv_lora_rank, cfg.num_heads
 
     q_eff, row, _ = llama._qkv_mla(cfg, lp, x, positions)
-    # absorbed scores (undo the op-scale correction to get raw dot products)
-    fix = ((lora + rope) / (nope + rope)) ** 0.5
+    # absorbed scores (undo the op-scale correction to get raw dot
+    # products); production scales by the PADDED cache width
+    fix = (cfg.cache_head_dim / (nope + rope)) ** 0.5
     s_abs = jnp.einsum("thr,sr->ths", q_eff / fix, row[:, 0, :])
 
     # explicit reference: reconstruct per-head K from the latent

@@ -38,6 +38,11 @@ class EngineConfig:
     tensor_parallel: int = 1
     data_parallel: int = 1
     expert_parallel: int = 1
+    # long-context: shard PREFILL sequence over a `seq` mesh axis (ring /
+    # Ulysses attention over ICI, ops/ring_attention.py). Requires
+    # data_parallel == expert_parallel == 1; decode stays paged on the
+    # (seq x model) mesh via GSPMD. Beyond reference parity (SURVEY §5).
+    sequence_parallel: int = 1
     # MoE prefill dispatch: 0 = exact dense-masked; > 0 enables the
     # capacity-gather path with this capacity factor (ops/moe.py)
     moe_capacity_factor: float = 0.0
@@ -142,6 +147,8 @@ class EngineConfig:
         p.add_argument("--tp", "--tensor-parallel-size", type=int, default=1, dest="tp")
         p.add_argument("--dp", type=int, default=1)
         p.add_argument("--ep", type=int, default=1)
+        p.add_argument("--sp", "--sequence-parallel", type=int, default=1,
+                       dest="sp")
         p.add_argument("--moe-capacity-factor", type=float, default=0.0)
         p.add_argument("--num-scheduler-steps", type=int, default=1)
         p.add_argument("--speculative-mode", default="off",
@@ -198,6 +205,7 @@ class EngineConfig:
             tensor_parallel=args.tp,
             data_parallel=args.dp,
             expert_parallel=args.ep,
+            sequence_parallel=getattr(args, "sp", 1),
             moe_capacity_factor=args.moe_capacity_factor,
             num_scheduler_steps=args.num_scheduler_steps,
             speculative_mode=getattr(args, "speculative_mode", "off"),

@@ -200,13 +200,27 @@ class Engine:
                 model_cfg, moe_capacity_factor=cfg.moe_capacity_factor
             )
         self.model_cfg = model_cfg
-        self.mesh = build_mesh(
-            MeshConfig(
-                tensor_parallel=cfg.tensor_parallel,
-                data_parallel=cfg.data_parallel,
-                expert_parallel=cfg.expert_parallel,
+        if cfg.sequence_parallel > 1:
+            # long-context serving: prefill shards the sequence over the
+            # `seq` axis (ring/Ulysses over ICI); params/KV shard on
+            # `model` as usual and replicate over `seq`; the paged decode
+            # ops exclude seq meshes and run GSPMD on the same mesh
+            if cfg.data_parallel > 1 or cfg.expert_parallel > 1:
+                raise ValueError(
+                    "sequence_parallel composes with tensor_parallel only "
+                    "(set --dp/--ep to 1)")
+            from dynamo_tpu.parallel.mesh import build_long_context_mesh
+
+            self.mesh = build_long_context_mesh(
+                cfg.sequence_parallel, cfg.tensor_parallel)
+        else:
+            self.mesh = build_mesh(
+                MeshConfig(
+                    tensor_parallel=cfg.tensor_parallel,
+                    data_parallel=cfg.data_parallel,
+                    expert_parallel=cfg.expert_parallel,
+                )
             )
-        )
         self.metrics = EngineMetrics()
         self._lock = threading.Lock()
         # serialises every computation that touches the donated KV pools
@@ -244,6 +258,18 @@ class Engine:
         )
         self.allocator = PageAllocator(cfg.num_pages)
         self.prefix_cache: Optional[PrefixCache] = None
+        if cfg.sequence_parallel > 1 and cfg.prefill_chunk_tokens > 0:
+            # chunked prefill routes through the paged chunk op, which the
+            # ring/Ulysses path does not serve — a long-context sp worker
+            # exists precisely for whole-prompt ring prefills
+            import dataclasses as _dc
+
+            log.warning(
+                "sequence_parallel=%d disables chunked prefill (ring "
+                "attention serves whole-prompt prefills)",
+                cfg.sequence_parallel)
+            cfg = _dc.replace(cfg, prefill_chunk_tokens=0)
+            self.cfg = cfg
         if cfg.enable_prefix_caching and cfg.prefill_chunk_tokens > 0:
             self.prefix_cache = PrefixCache(self.allocator, cfg.page_size)
 

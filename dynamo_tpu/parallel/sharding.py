@@ -87,13 +87,17 @@ def param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
-    """Drop (replicate) spec axes whose mesh extent doesn't divide the dim —
-    e.g. KV-head projections when tp > num_kv_heads (GQA over-sharding):
-    the weights replicate, and attention still lane-shards the fused KV*D
-    axis downstream."""
+    """Drop (replicate) spec axes that don't fit this mesh: axes whose mesh
+    extent doesn't divide the dim — e.g. KV-head projections when tp >
+    num_kv_heads (GQA over-sharding) — and axes the mesh doesn't HAVE at
+    all — e.g. 'expert' rules on the ('seq','model') long-context mesh.
+    Either way the weight replicates and downstream sharding still works."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     fixed = []
     for i, axis in enumerate(spec):
+        if isinstance(axis, str) and axis not in sizes:
+            fixed.append(None)
+            continue
         n = sizes.get(axis, 1) if isinstance(axis, str) else 1
         fixed.append(axis if (axis is None or shape[i] % n == 0) else None)
     return P(*fixed)

@@ -147,3 +147,56 @@ def test_prefill_dispatch_pads_to_seq_axis_multiple():
     assert out.shape == q.shape
     np.testing.assert_allclose(np.asarray(out[:17]), np.asarray(ref[:17]),
                                atol=2e-5)
+
+
+def test_engine_sequence_parallel_serving_parity():
+    """--sp N end-to-end: an engine built with sequence_parallel shards
+    prefill over the `seq` axis (ring attention over ICI) and produces
+    token-identical output to the single-device engine."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    prompt = list(range(1, 49))
+
+    def run(**kw):
+        eng = Engine(EngineConfig(model="tiny-debug", page_size=4,
+                                  num_pages=64, max_num_seqs=2,
+                                  max_seq_len=128, **kw))
+        # chunked prefill is auto-disabled under sp (warning logged)
+        assert eng.cfg.prefill_chunk_tokens == 0 or "sequence_parallel" \
+            not in kw
+        return eng.generate(GenRequest("r", prompt, max_tokens=6,
+                                       temperature=0.0, ignore_eos=True))
+
+    a = run(prefill_chunk_tokens=0)
+    b = run(sequence_parallel=4, tensor_parallel=2)
+    assert a == b
+
+
+def test_sp_engine_disables_prefix_cache_with_chunking():
+    """The sp chunk-disable must precede prefix-cache construction: an
+    active cache with chunk==0 would leak page refs on every hit."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4, num_pages=64,
+                              max_num_seqs=2, max_seq_len=128,
+                              sequence_parallel=4, tensor_parallel=2))
+    assert eng.cfg.prefill_chunk_tokens == 0
+    assert eng.prefix_cache is None
+
+
+def test_sp_moe_engine_constructs():
+    """MoE params carry 'expert' sharding rules the ('seq','model') mesh
+    lacks; _fit_spec must replicate them instead of raising."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    eng = Engine(EngineConfig(model="tiny-moe-debug", page_size=4,
+                              num_pages=64, max_num_seqs=2, max_seq_len=128,
+                              sequence_parallel=4, tensor_parallel=2))
+    toks = eng.generate(GenRequest("r", list(range(1, 33)), max_tokens=4,
+                                   temperature=0.0, ignore_eos=True))
+    assert len(toks) == 4

@@ -396,7 +396,8 @@ def chunk_attention(
 
         backend = (_resolve_backend() if _pa.CHUNK_KERNEL_HW_VALIDATED
                    else "xla")
-    if backend in ("pallas", "pallas_interpret"):
+    if (backend in ("pallas", "pallas_interpret")
+            and _seq_parallel_mesh() is None):  # see decode's seq-mesh note
         quantized = k_pages.dtype == jnp.int8
         n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
         lb = _kv_lane_blocks() if quantized else 1
@@ -548,6 +549,11 @@ def paged_attention_decode(
     num_kv_heads=None,
 ) -> jax.Array:
     backend = _resolve_backend()
+    if backend != "xla" and _seq_parallel_mesh() is not None:
+        # long-context (seq) mesh: the pool is GSPMD-sharded on `model`,
+        # and an unannotated pallas_call would force an all-gather of the
+        # whole pool per step — the XLA gather path partitions cleanly
+        backend = "xla"
     mesh = _mesh_for_shard_map()
     n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
     tp = _mesh_tp(mesh)
@@ -632,21 +638,44 @@ def prefill_attention(
 ) -> jax.Array:
     sp_mesh = _seq_parallel_mesh()
     if sp_mesh is not None:
-        # Long-context path: sequence sharded over the `seq` axis, ring
-        # attention over ICI (the reference has no analogue — SURVEY.md §5).
-        # The engine pads prompts to page_size multiples, not sp multiples,
-        # so pad here to the ring's divisibility requirement and slice back
-        # (the tail past seq_len is masked inside the kernel either way).
-        from dynamo_tpu.ops.ring_attention import ring_prefill_attention
+        # Long-context path: sequence sharded over the `seq` axis (the
+        # reference has no analogue — SURVEY.md §5). Strategy via
+        # DYNAMO_TPU_SP_STRATEGY: `ring` (default; ppermute neighbour hops,
+        # one ICI step per hop) or `ulysses` (all_to_all head/sequence
+        # exchange — fewer collectives, favors meshes with all-to-all
+        # bandwidth). The engine pads prompts to page_size multiples, not
+        # sp multiples, so pad here to the divisibility requirement and
+        # slice back (the tail past seq_len is masked inside either way).
+        from dynamo_tpu.ops import ring_attention as ra
 
-        sp = dict(zip(sp_mesh.axis_names, sp_mesh.devices.shape))["seq"]
+        strategy = os.environ.get("DYNAMO_TPU_SP_STRATEGY", "ring")
+        if strategy not in ("ring", "ulysses"):
+            raise ValueError(
+                f"DYNAMO_TPU_SP_STRATEGY {strategy!r} not in "
+                f"('ring', 'ulysses')")
+        sizes = dict(zip(sp_mesh.axis_names, sp_mesh.devices.shape))
+        sp = sizes["seq"]
+        if strategy == "ulysses":
+            # Ulysses' all_to_all splits the LOCAL head axis across `seq`:
+            # per-model-shard query heads must divide by sp, else the
+            # ring (which has no head requirement) serves the prompt
+            local_h = q.shape[1] // max(sizes.get("model", 1), 1)
+            if local_h % sp != 0:
+                import logging
+
+                logging.getLogger("dynamo_tpu.ops").warning(
+                    "ulysses needs local query heads (%d) divisible by "
+                    "the seq axis (%d); using ring attention", local_h, sp)
+                strategy = "ring"
+        fn = (ra.ulysses_prefill_attention if strategy == "ulysses"
+              else ra.ring_prefill_attention)
         s = q.shape[0]
         pad = (-s) % sp
         if pad:
             q = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
             k = jnp.pad(k, ((0, pad), (0, 0), (0, 0)))
             v = jnp.pad(v, ((0, pad), (0, 0), (0, 0)))
-        out = ring_prefill_attention(q, k, v, seq_len, sp_mesh)
+        out = fn(q, k, v, seq_len, sp_mesh)
         return out[:s] if pad else out
     backend = _resolve_backend()
     if backend != "xla" and q.shape[2] % 128 != 0 and q.shape[2] not in (32, 64):

@@ -86,16 +86,24 @@ def param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
     return {k: spec_for(k, v) for k, v in params.items()}
 
 
+# axis names any repo mesh can carry; a PARAM_RULES axis outside this set
+# is a typo and must stay LOUD (reach NamedSharding and raise), never be
+# silently replicated
+KNOWN_MESH_AXES = frozenset({"data", "expert", "model", "seq"})
+
+
 def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
     """Drop (replicate) spec axes that don't fit this mesh: axes whose mesh
     extent doesn't divide the dim — e.g. KV-head projections when tp >
-    num_kv_heads (GQA over-sharding) — and axes the mesh doesn't HAVE at
-    all — e.g. 'expert' rules on the ('seq','model') long-context mesh.
-    Either way the weight replicates and downstream sharding still works."""
+    num_kv_heads (GQA over-sharding) — and KNOWN axes the mesh doesn't
+    carry — e.g. 'expert' rules on the ('seq','model') long-context mesh.
+    Either way the weight replicates and downstream sharding still works;
+    unknown axis names pass through so typos fail loudly."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     fixed = []
     for i, axis in enumerate(spec):
-        if isinstance(axis, str) and axis not in sizes:
+        if (isinstance(axis, str) and axis not in sizes
+                and axis in KNOWN_MESH_AXES):
             fixed.append(None)
             continue
         n = sizes.get(axis, 1) if isinstance(axis, str) else 1

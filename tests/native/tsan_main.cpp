@@ -1,0 +1,87 @@
+// ThreadSanitizer harness for the native transport (SURVEY.md §5: the
+// reference has no sanitizer story at all — standard C++ hygiene here is
+// an exceed-parity item). Compiled WITH dynamo_transport.cpp under
+// -fsanitize=thread by tests/test_native_tsan.py and run as a standalone
+// binary: a listener thread accepts and echoes concurrently while several
+// client threads connect/send/recv — any data race in the transport's
+// socket plumbing trips TSAN (nonzero exit via TSAN_OPTIONS=exitcode).
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int dt_listen(uint16_t port, uint16_t* port_out);
+int dt_accept(int listen_fd, char* key_out, int timeout_ms);
+int dt_connect(const char* host, uint16_t port, const char* key);
+int dt_send_msg(int fd, const void* buf, int64_t len);
+int64_t dt_recv_len(int fd);
+int dt_recv_into(int fd, void* buf, int64_t len);
+void dt_close(int fd);
+int dt_key_len();
+}
+
+static std::atomic<int> failures{0};
+
+int main() {
+  uint16_t port = 0;
+  int lfd = dt_listen(0, &port);
+  if (lfd < 0) { std::fprintf(stderr, "listen failed\n"); return 1; }
+
+  const int kClients = 8;
+  const int kMsgs = 32;
+
+  std::thread server([&] {
+    std::vector<std::thread> handlers;
+    for (int i = 0; i < kClients; i++) {
+      std::string key(dt_key_len() + 1, '\0');  // accept writes len+1
+      int fd = dt_accept(lfd, key.data(), 10000);
+      if (fd < 0) { failures++; break; }  // join handlers before returning
+      handlers.emplace_back([fd] {  // echo loop, one thread per conn
+        for (int m = 0; m < kMsgs; m++) {
+          int64_t n = dt_recv_len(fd);
+          if (n < 0) { failures++; break; }
+          std::vector<char> buf(n);
+          if (dt_recv_into(fd, buf.data(), n) != 0) { failures++; break; }
+          if (dt_send_msg(fd, buf.data(), n) != 0) { failures++; break; }
+        }
+        dt_close(fd);
+      });
+    }
+    for (auto& h : handlers) h.join();
+  });
+
+  std::vector<std::thread> clients;
+  for (int c = 0; c < kClients; c++) {
+    clients.emplace_back([&, c] {
+      std::string key = "req-" + std::to_string(c);
+      int fd = dt_connect("127.0.0.1", port, key.c_str());
+      if (fd < 0) { failures++; return; }
+      for (int m = 0; m < kMsgs; m++) {
+        std::string msg = "payload-" + std::to_string(c) + "-" +
+                          std::to_string(m);
+        msg.resize(512 + (c * 37 + m) % 512, 'x');
+        if (dt_send_msg(fd, msg.data(), (int64_t)msg.size())) {
+          failures++; break;
+        }
+        int64_t n = dt_recv_len(fd);
+        if (n != (int64_t)msg.size()) { failures++; break; }
+        std::vector<char> buf(n);
+        if (dt_recv_into(fd, buf.data(), n) != 0 ||
+            std::memcmp(buf.data(), msg.data(), n) != 0) {
+          failures++; break;
+        }
+      }
+      dt_close(fd);
+    });
+  }
+  for (auto& c : clients) c.join();
+  server.join();
+  dt_close(lfd);
+  if (failures.load()) { std::fprintf(stderr, "io failures\n"); return 1; }
+  std::puts("tsan harness ok");
+  return 0;
+}

@@ -1,0 +1,39 @@
+"""ThreadSanitizer run over the native transport (exceed-parity hygiene,
+SURVEY.md §5: the reference ships no sanitizer story at all).
+
+Compiles dynamo_transport.cpp together with a concurrent echo harness
+(tests/native/tsan_main.cpp) under -fsanitize=thread into a STANDALONE
+binary (TSAN inside a .so loaded by an unsanitized python would need
+libtsan preloading; a plain executable avoids that entirely) and runs it:
+8 client threads x 32 messages against per-connection server threads.
+Any data race in the transport's socket plumbing fails the run via
+TSAN_OPTIONS=exitcode.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "..", "dynamo_tpu", "runtime", "csrc",
+                   "dynamo_transport.cpp")
+HARNESS = os.path.join(HERE, "native", "tsan_main.cpp")
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_transport_under_thread_sanitizer(tmp_path):
+    binary = tmp_path / "tsan_transport"
+    build = subprocess.run(
+        ["g++", "-O1", "-g", "-fsanitize=thread", "-std=c++17", "-Wall",
+         SRC, HARNESS, "-o", str(binary), "-lpthread"],
+        capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr[-1000:]
+    run = subprocess.run(
+        [str(binary)], capture_output=True, text=True, timeout=300,
+        env={**os.environ, "TSAN_OPTIONS": "exitcode=66 halt_on_error=0"})
+    assert "ThreadSanitizer" not in run.stderr, run.stderr[-2000:]
+    assert run.returncode == 0, (run.returncode, run.stderr[-1000:])
+    assert "tsan harness ok" in run.stdout

@@ -200,3 +200,31 @@ def test_sp_moe_engine_constructs():
     toks = eng.generate(GenRequest("r", list(range(1, 33)), max_tokens=4,
                                    temperature=0.0, ignore_eos=True))
     assert len(toks) == 4
+
+
+def test_sp_strategy_env_selects_ulysses(monkeypatch):
+    from dynamo_tpu.ops.attention import attention_context, prefill_attention
+    from dynamo_tpu.ops import ring_attention as ra
+
+    q, k, v = _qkv(s=32, h=4, kv=2, d=16, seed=9)
+    ref = prefill_attention_xla(q, k, v, 30)
+    mesh = build_long_context_mesh(4, 1)
+    calls = []
+    real = ra.ulysses_prefill_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ra, "ulysses_prefill_attention", spy)
+    monkeypatch.setenv("DYNAMO_TPU_SP_STRATEGY", "ulysses")
+    with attention_context(None, mesh):
+        out = prefill_attention(q, k, v, 30)
+    assert calls, "ulysses strategy not dispatched"
+    np.testing.assert_allclose(np.asarray(out[:30]), np.asarray(ref[:30]),
+                               rtol=2e-5, atol=2e-5)
+
+    monkeypatch.setenv("DYNAMO_TPU_SP_STRATEGY", "bogus")
+    import pytest as _pytest
+    with attention_context(None, mesh), _pytest.raises(ValueError):
+        prefill_attention(q, k, v, 30)

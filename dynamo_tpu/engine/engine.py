@@ -209,6 +209,17 @@ class Engine:
                 raise ValueError(
                     "sequence_parallel composes with tensor_parallel only "
                     "(set --dp/--ep to 1)")
+            # fail fast on a bad strategy: the env var is read at trace
+            # time inside the jitted prefill (baked into the compiled
+            # executable — a process-start setting, not a live knob), so
+            # without this check a typo would 500 the first request
+            import os as _os
+
+            strategy = _os.environ.get("DYNAMO_TPU_SP_STRATEGY", "ring")
+            if strategy not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"DYNAMO_TPU_SP_STRATEGY {strategy!r} not in "
+                    f"('ring', 'ulysses')")
             from dynamo_tpu.parallel.mesh import build_long_context_mesh
 
             self.mesh = build_long_context_mesh(

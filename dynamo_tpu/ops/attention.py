@@ -396,8 +396,16 @@ def chunk_attention(
 
         backend = (_resolve_backend() if _pa.CHUNK_KERNEL_HW_VALIDATED
                    else "xla")
-    if (backend in ("pallas", "pallas_interpret")
-            and _seq_parallel_mesh() is None):  # see decode's seq-mesh note
+    if backend in ("pallas", "pallas_interpret") \
+            and _seq_parallel_mesh() is not None:
+        # see the decode dispatch's seq-mesh note
+        import logging
+
+        logging.getLogger("dynamo_tpu.ops").warning(
+            "pallas chunk attention is unavailable under a "
+            "sequence-parallel mesh; using the XLA gather path")
+        backend = "xla"
+    if backend in ("pallas", "pallas_interpret"):
         quantized = k_pages.dtype == jnp.int8
         n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
         lb = _kv_lane_blocks() if quantized else 1
@@ -553,6 +561,12 @@ def paged_attention_decode(
         # long-context (seq) mesh: the pool is GSPMD-sharded on `model`,
         # and an unannotated pallas_call would force an all-gather of the
         # whole pool per step — the XLA gather path partitions cleanly
+        if _explicit_backend() is not None:
+            import logging
+
+            logging.getLogger("dynamo_tpu.ops").warning(
+                "pallas decode is unavailable under a sequence-parallel "
+                "mesh; using the XLA gather path")
         backend = "xla"
     mesh = _mesh_for_shard_map()
     n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)

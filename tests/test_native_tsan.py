@@ -30,10 +30,17 @@ def test_transport_under_thread_sanitizer(tmp_path):
         ["g++", "-O1", "-g", "-fsanitize=thread", "-std=c++17", "-Wall",
          SRC, HARNESS, "-o", str(binary), "-lpthread"],
         capture_output=True, text=True, timeout=180)
+    if build.returncode != 0 and "tsan" in build.stderr.lower():
+        pytest.skip(f"TSAN runtime unavailable: {build.stderr[-300:]}")
     assert build.returncode == 0, build.stderr[-1000:]
     run = subprocess.run(
         [str(binary)], capture_output=True, text=True, timeout=300,
         env={**os.environ, "TSAN_OPTIONS": "exitcode=66 halt_on_error=0"})
+    if ("FATAL: ThreadSanitizer" in run.stderr
+            and "data race" not in run.stderr):
+        # e.g. 'unexpected memory mapping' on kernels TSAN rejects — an
+        # environment limitation, not a transport race
+        pytest.skip(f"TSAN cannot run here: {run.stderr[-300:]}")
     assert "ThreadSanitizer" not in run.stderr, run.stderr[-2000:]
     assert run.returncode == 0, (run.returncode, run.stderr[-1000:])
     assert "tsan harness ok" in run.stdout

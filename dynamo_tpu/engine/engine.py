@@ -742,11 +742,33 @@ class Engine:
                 f"{self.cfg.num_pages - 1}"
             )
 
+    def _insert_pending(self, req: GenRequest, requeue: bool = False) -> None:
+        """Priority-aware queue insertion (caller holds self._lock).
+
+        vLLM priority semantics: LOWER value admits sooner (0 default).
+        The queue stays ascending by priority with FIFO inside a level;
+        requeued requests predate same-level arrivals, so they re-insert
+        BEFORE their level's existing entries."""
+        if requeue:
+            idx = next((i for i, r in enumerate(self.pending)
+                        if r.priority >= req.priority), None)
+        else:
+            idx = next((i for i, r in enumerate(self.pending)
+                        if r.priority > req.priority), None)
+        if idx is None:
+            self.pending.append(req)
+        else:
+            self.pending.insert(idx, req)
+
     def add_request(self, req: GenRequest) -> None:
-        """Enqueue a request (raises like validate_request)."""
+        """Enqueue a request (raises like validate_request).
+
+        Priority admission (vLLM semantics: lower value = sooner, stable
+        FIFO within a level); running sequences are never preempted, so
+        priority only reorders the queue."""
         self.validate_request(req)
         with self._lock:
-            self.pending.append(req)
+            self._insert_pending(req)
             self.metrics.num_requests += 1
 
     def abort_request(self, request_id: str) -> None:
@@ -969,8 +991,11 @@ class Engine:
             for pl in page_lists:
                 self.allocator.free(pl)
             with self._lock:
+                # priority-aware requeue: an add_request may have landed a
+                # sooner-priority request at the head in between, and a
+                # blind appendleft would break the queue's sorted invariant
                 for r in reversed(reqs):
-                    self.pending.appendleft(r)
+                    self._insert_pending(r, requeue=True)
             return None
 
         logits, self.k_pages, self.v_pages = self._prefill_batch(

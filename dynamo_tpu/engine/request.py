@@ -23,6 +23,10 @@ class GenRequest:
     presence_penalty: float = 0.0  # subtract if token appeared in output
     frequency_penalty: float = 0.0  # subtract per occurrence in output
     logprobs: Optional[int] = None  # None = off; N = return top-N alternatives
+    # admission priority (vLLM semantics: LOWER value admits sooner, 0
+    # default); FIFO within a priority level. Running sequences are never
+    # preempted.
+    priority: int = 0
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
 
 

@@ -121,6 +121,7 @@ class GenerationHandle:
             seed=None if seed is None else seed + index,
             logprobs=params.get("logprobs"),
             ignore_eos=params.get("ignore_eos", False),
+            priority=params.get("priority", 0),
         )
         if ctx.disagg_client is not None:
             # decode role: prefill remotely, pull KV, continue locally

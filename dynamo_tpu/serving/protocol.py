@@ -40,6 +40,9 @@ def _common_sampling(body: Dict[str, Any]) -> Dict[str, Any]:
     n = body.get("n", 1)
     if isinstance(n, bool) or not isinstance(n, int) or not 1 <= n <= MAX_N:
         raise BadRequest(f"'n' must be an integer in [1, {MAX_N}]")
+    priority = body.get("priority", 0)
+    if isinstance(priority, bool) or not isinstance(priority, int):
+        raise BadRequest("'priority' must be an integer")
     return {
         "temperature": temperature,
         "top_p": _num(body, "top_p", 1.0),
@@ -48,6 +51,8 @@ def _common_sampling(body: Dict[str, Any]) -> Dict[str, Any]:
         "frequency_penalty": _num(body, "frequency_penalty", 0.0),
         "seed": seed,
         "n": n,
+        # admission-priority extension (vLLM semantics: lower = sooner)
+        "priority": priority,
         "stop": _parse_stop(body),
         "stream": bool(body.get("stream", False)),
         "include_usage": _include_usage(body),

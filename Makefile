@@ -6,7 +6,13 @@
 #   make benchmark-env  - set up the benchmark virtualenv
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
-.PHONY: k8s dynamo install benchmark-env test test-full help
+#   make image          - build the runtime container image (all pod roles)
+.PHONY: k8s dynamo install benchmark-env test test-full image help
+
+RELEASE_VERSION ?= latest
+IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
+JAX_EXTRA ?= tpu
+DOCKER ?= docker
 
 help:
 	@echo "Targets:"
@@ -31,6 +37,13 @@ install: k8s dynamo
 
 benchmark-env:
 	./setup-benchmark-env.sh
+
+# The single runtime image every pod role runs from (operator, frontend,
+# workers, exporter) — the artifact the reference consumes as
+# nvcr.io/nvidia/ai-dynamo/<backend>-runtime. JAX_EXTRA= builds CPU-only.
+image:
+	$(DOCKER) build --build-arg JAX_EXTRA=$(JAX_EXTRA) -t $(IMAGE) .
+	@echo "built $(IMAGE) — deploy with: DYNAMO_IMAGE=$(IMAGE) ./install-dynamo-1node.sh"
 
 test:
 	python -m pytest tests/ -q -m "not slow and not compile_heavy"

@@ -23,6 +23,9 @@ SECRET_NAME="${SECRET_NAME:-hf-token-secret}"
 POLL_PERIOD="${POLL_PERIOD:-3}"
 DISCOVER_TIMEOUT="${DISCOVER_TIMEOUT:-180}"
 READY_TIMEOUT="${READY_TIMEOUT:-1200}"
+# Optional image override: when set, the default dev tag in the manifest is
+# swapped for this ref at apply time (explicitly-pinned images are untouched)
+DYNAMO_IMAGE="${DYNAMO_IMAGE:-}"
 NS_LABEL="tpu.dynamo.ai/dynamo-namespace"
 
 log()  { echo "[deploy] $*"; }
@@ -89,9 +92,17 @@ kubectl create secret generic "$SECRET_NAME" -n "$NAMESPACE" \
   --from-literal=token="$HF_TOKEN" \
   --dry-run=client -o yaml | kubectl apply -f - >/dev/null
 
-# ---- apply the manifest (as-is, never edited) --------------------------------
+# ---- apply the manifest ------------------------------------------------------
+# Applied as-is (never edited) unless DYNAMO_IMAGE is set, in which case the
+# default dev image tag is swapped for the requested release ref.
 log "applying ${MANIFEST}"
-kubectl apply -n "$NAMESPACE" -f "$MANIFEST" || die "kubectl apply failed"
+if [[ -n "$DYNAMO_IMAGE" ]]; then
+  log "image override: ${DYNAMO_IMAGE}"
+  sed "s|dynamo-tpu/runtime:latest|${DYNAMO_IMAGE}|g" "$MANIFEST" \
+    | kubectl apply -n "$NAMESPACE" -f - || die "kubectl apply failed"
+else
+  kubectl apply -n "$NAMESPACE" -f "$MANIFEST" || die "kubectl apply failed"
+fi
 
 # DGD name: first metadata.name in the manifest's DynamoGraphDeployment doc.
 DGD_NAME="$(awk '

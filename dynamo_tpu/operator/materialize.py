@@ -23,6 +23,7 @@ pod-group label consumed by the gang scheduler (the Grove/KAI analogue,
 from __future__ import annotations
 
 import copy
+import os
 from typing import Any, Dict, List, Optional
 
 GROUP = "tpu.dynamo.ai"
@@ -38,6 +39,17 @@ COMPONENT_TYPE_LABEL = f"{GROUP}/component-type"
 MANAGED_BY_LABEL = "app.kubernetes.io/managed-by"
 OPERATOR_NAME = "dynamo-tpu-operator"
 POD_GROUP_LABEL = f"{GROUP}/pod-group"
+
+
+def default_image() -> str:
+    """Image for services that don't pin one in extraPodSpec.mainContainer.
+
+    The operator Deployment sets DYNAMO_TPU_DEFAULT_IMAGE to the image it
+    itself runs from (threaded by install-dynamo-1node.sh via DYNAMO_IMAGE),
+    so a versioned install materializes versioned workers. Read at call
+    time, not import time, so `kubectl set env` takes effect on restart."""
+    return os.environ.get("DYNAMO_TPU_DEFAULT_IMAGE",
+                          "dynamo-tpu/runtime:latest")
 # coscheduling (scheduler-plugins) contract — the Grove/KAI-analogue gang
 # scheduler consumes these (/root/reference/install-dynamo-1node.sh:35-36,
 # 207-212 gates the reference's equivalents behind the same kind of opt-in)
@@ -126,7 +138,7 @@ def _container(
     main = ((spec.get("extraPodSpec") or {}).get("mainContainer")) or {}
     c: Dict[str, Any] = {
         "name": "main",
-        "image": main.get("image", "dynamo-tpu/runtime:latest"),
+        "image": main.get("image") or default_image(),
         "ports": [{"containerPort": FRONTEND_PORT, "name": "http"}],
     }
     if main.get("workingDir"):

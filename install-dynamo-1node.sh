@@ -15,6 +15,10 @@ set -euo pipefail
 # ---- configuration (env-overridable) ----------------------------------------
 NAMESPACE="${NAMESPACE:-dynamo-system}"
 RELEASE_VERSION="${RELEASE_VERSION:-local}"     # "local" applies deploy/ from this repo
+# Runtime image for the operator AND the default for materialized workers
+# (built by `make image`; the analogue of the reference's consumed
+# nvcr.io/nvidia/ai-dynamo/*-runtime images)
+DYNAMO_IMAGE="${DYNAMO_IMAGE:-dynamo-tpu/runtime:${RELEASE_VERSION/#local/latest}}"
 NAMESPACE_RESTRICTED_OPERATOR="${NAMESPACE_RESTRICTED_OPERATOR:-false}"
 ENABLE_GANG_SCHEDULING="${ENABLE_GANG_SCHEDULING:-false}"   # Grove/KAI analogue
 PROMETHEUS_ENDPOINT="${PROMETHEUS_ENDPOINT:-http://prometheus-kube-prometheus-prometheus.monitoring.svc.cluster.local:9090}"
@@ -64,7 +68,8 @@ kubectl create namespace "$NAMESPACE" --dry-run=client -o yaml | kubectl apply -
 # The operator Deployment lives in the namespace hardcoded by operator.yaml
 # (its RBAC + ServiceAccount are bound there), independent of $NAMESPACE.
 OPERATOR_NAMESPACE="dynamo-system"
-operator_env=("PROMETHEUS_ENDPOINT=${PROMETHEUS_ENDPOINT}")
+operator_env=("PROMETHEUS_ENDPOINT=${PROMETHEUS_ENDPOINT}"
+              "DYNAMO_TPU_DEFAULT_IMAGE=${DYNAMO_IMAGE}")
 if [[ "$NAMESPACE_RESTRICTED_OPERATOR" == "true" ]]; then
   operator_env+=("WATCH_NAMESPACE=${NAMESPACE}")
 fi
@@ -73,8 +78,12 @@ if [[ "$ENABLE_GANG_SCHEDULING" == "true" ]]; then
 fi
 
 kubectl apply -n "$NAMESPACE" -f "${REPO_ROOT}/deploy/platform/"
-# operator.yaml carries its own namespace refs; apply then inject env config
-kubectl apply -f "${REPO_ROOT}/deploy/operator.yaml"
+# operator.yaml carries its own namespace refs; apply then inject env config.
+# The image ref is parameterized: the checked-in manifest pins the :latest
+# dev tag, sed swaps in $DYNAMO_IMAGE for versioned installs.
+log "operator image: ${DYNAMO_IMAGE}"
+sed "s|dynamo-tpu/runtime:latest|${DYNAMO_IMAGE}|g" \
+  "${REPO_ROOT}/deploy/operator.yaml" | kubectl apply -f -
 kubectl set env -n "$OPERATOR_NAMESPACE" \
   deployment/dynamo-tpu-operator-controller-manager "${operator_env[@]}" >/dev/null
 
@@ -94,7 +103,8 @@ if [[ "$INSTALL_TPU_PLUGIN" == "true" ]]; then
 fi
 if [[ "$INSTALL_TPU_EXPORTER" == "true" && -f "${REPO_ROOT}/deploy/tpu-metrics-exporter.yaml" ]]; then
   log "installing TPU metrics exporter DaemonSet"
-  kubectl apply -f "${REPO_ROOT}/deploy/tpu-metrics-exporter.yaml"
+  sed "s|dynamo-tpu/runtime:latest|${DYNAMO_IMAGE}|g" \
+    "${REPO_ROOT}/deploy/tpu-metrics-exporter.yaml" | kubectl apply -f -
 fi
 
 # ---- step 6: verify google.com/tpu allocatable -------------------------------

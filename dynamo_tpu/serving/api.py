@@ -388,6 +388,12 @@ class _Handler(JsonHTTPHandler):
                 # which KV plane requests ACTUALLY used (an ici deployment
                 # that degraded to dcn shows up here, not just in a log)
                 out["transfer_planes"] = dict(dc.plane_counts)
+            ds = self.ctx.kv_device_source
+            if ds is not None:
+                # stage ledger health: leaked > 0 means a decode peer is
+                # staging and crashing before pull/release, pinning HBM
+                out["staged_kv"] = {"live": ds.staged_count,
+                                    "leaked": ds.leaked_count}
             self._json(200, out)
         else:
             self._error(404, f"no route {path}")
@@ -500,6 +506,10 @@ class _Handler(JsonHTTPHandler):
         if not rid:
             raise proto.BadRequest("need request_id")
         ctx.engine.release_parked(rid)
+        if ctx.kv_device_source is not None:
+            # forget the staged gather too, so the stage ledger (and its
+            # array refs) doesn't wait out the TTL for well-behaved peers
+            ctx.kv_device_source.mark_released(rid)
         self._json(200, {"request_id": rid, "released": True})
 
     def _check_model(self, model: str):

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 from dynamo_tpu.engine.request import GenRequest, TokenEvent
 from dynamo_tpu.transfer.kv_transfer import fetch_kv
+from dynamo_tpu.utils import net
 
 log = logging.getLogger("dynamo_tpu.disagg")
 
@@ -195,12 +196,21 @@ class DisaggDecodeClient:
                 f"prefill worker {prefill_url} failed ({e.code}): {msg}"
             ) from e
         except (urllib.error.URLError, ConnectionError, OSError) as e:
+            # only pre-send failures (refused / no route / DNS) are
+            # retry-safe; a reset AFTER the request was written means the
+            # worker may be mid-prefill and a retry would duplicate it and
+            # park orphan KV — terminal, like timeouts
+            if net.pre_send_failure(e):
+                raise _PrefillUnreachable(str(e)) from e
             reason = getattr(e, "reason", e)
             if isinstance(reason, (TimeoutError, socket.timeout)):
                 raise RuntimeError(
                     f"prefill worker {prefill_url} timed out mid-prefill"
                 ) from e
-            raise _PrefillUnreachable(str(e)) from e
+            raise RuntimeError(
+                f"prefill worker {prefill_url} connection lost after the "
+                f"request was sent ({e}); not retried"
+            ) from e
         # phase 2 — the KV pull. The prefill side now holds parked pages;
         # failures here are terminal for this request (the parked-KV TTL
         # sweep reclaims the pages), never silently retried elsewhere.

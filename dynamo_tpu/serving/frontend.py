@@ -27,6 +27,7 @@ from dynamo_tpu.serving import protocol as proto
 from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
 from dynamo_tpu.serving.metrics import FrontendMetrics, Gauge
 from dynamo_tpu.serving.router import Router, prefix_key
+from dynamo_tpu.utils import net
 
 log = logging.getLogger("dynamo_tpu.frontend")
 
@@ -218,6 +219,16 @@ class _FrontendHandler(JsonHTTPHandler):
                     self._error(
                         504, f"worker {worker.url} timed out mid-request",
                         "timeout")
+                    return
+                if not net.pre_send_failure(e):
+                    # connection lost AFTER the request was written: the
+                    # worker may already be generating — a retry would
+                    # duplicate the whole generation, so answer terminally
+                    self._error(
+                        502,
+                        f"worker {worker.url} connection lost after the "
+                        "request was sent; not retried",
+                        "bad_gateway")
                     return
                 log.warning("worker %s unreachable (%s); failing over",
                             worker.url, e)

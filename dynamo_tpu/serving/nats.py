@@ -187,6 +187,25 @@ class NatsClient:
             except Exception:
                 log.exception("nats subscription callback failed")
 
+    def _skip_frame(self, line: bytes, parts) -> None:
+        """Resync past a malformed/future-variant MSG/HMSG control line.
+
+        Both variants advertise the payload byte count as the LAST token;
+        consuming that many bytes (+CRLF) realigns the stream so one odd
+        frame doesn't tear down the connection and force a full reconnect.
+        When even the count is unparseable, give up on this frame and let
+        the next read_line find the next control line (worst case the
+        server closes on protocol error and the redial loop recovers)."""
+        log.warning("malformed nats control line %r; skipping one frame",
+                    line[:120])
+        try:
+            n = int(parts[-1])
+        except (ValueError, IndexError):
+            return
+        if 0 <= n <= (64 << 20):  # a garbage count must not hang the reader
+            self._reader.read_exact(n)
+            self._reader.read_exact(2)
+
     def _read_loop(self) -> None:
         backoff = 0.2
         while not self._closed:
@@ -194,35 +213,63 @@ class NatsClient:
                 while not self._closed:
                     line = self._reader.read_line()
                     backoff = 0.2  # healthy traffic resets the redial clock
+                    # first whitespace-delimited token routes the frame:
+                    # the protocol permits tab separators, which a
+                    # startswith(b"MSG ") check would misroute to ignore
+                    # (and then misparse the payload as control lines)
+                    op = line.split(None, 1)[0] if line.strip() else b""
                     if line == b"PING":
                         self._send(b"PONG\r\n")
-                    elif line.startswith(b"MSG "):
-                        parts = line.decode().split(" ")
-                        # MSG <subject> <sid> [reply-to] <#bytes>
+                    elif op == b"MSG":
+                        # MSG <subject> <sid> [reply-to] <#bytes> — split()
+                        # tolerates the runs of spaces/tabs the protocol
+                        # permits; a malformed line costs one frame, not
+                        # the whole connection (see _skip_frame)
+                        # "replace" decoding: a misaligned stream can hand
+                        # payload bytes to the control-line parser, and a
+                        # UnicodeDecodeError here would kill the reader
+                        # thread with no redial — garbage must cost frames,
+                        # never the loop
+                        parts = line.decode("utf-8", "replace").split()
                         if len(parts) == 5:
                             _, subject, sid, reply, nbytes = parts
-                        else:
+                        elif len(parts) == 4:
                             _, subject, sid, nbytes = parts
                             reply = None
-                        data = self._reader.read_exact(int(nbytes))
+                        else:
+                            self._skip_frame(line, parts)
+                            continue
+                        try:
+                            n, isid = int(nbytes), int(sid)
+                        except ValueError:
+                            self._skip_frame(line, parts)
+                            continue
+                        data = self._reader.read_exact(n)
                         self._reader.read_exact(2)  # trailing CRLF
-                        self._dispatch(int(sid), Msg(subject, reply, data))
-                    elif line.startswith(b"HMSG "):
+                        self._dispatch(isid, Msg(subject, reply, data))
+                    elif op == b"HMSG":
                         # HMSG <subject> <sid> [reply-to] <#hdr> <#total> —
                         # sent by headers-enabled servers (nats-server 2.2+)
                         # when a peer publishes with headers. Headers ride
                         # along raw; payload is the post-header remainder.
-                        parts = line.decode().split(" ")
+                        parts = line.decode("utf-8", "replace").split()
                         if len(parts) == 6:
                             _, subject, sid, reply, hbytes, tbytes = parts
-                        else:
+                        elif len(parts) == 5:
                             _, subject, sid, hbytes, tbytes = parts
                             reply = None
-                        blob = self._reader.read_exact(int(tbytes))
+                        else:
+                            self._skip_frame(line, parts)
+                            continue
+                        try:
+                            nt, nh, isid = int(tbytes), int(hbytes), int(sid)
+                        except ValueError:
+                            self._skip_frame(line, parts)
+                            continue
+                        blob = self._reader.read_exact(nt)
                         self._reader.read_exact(2)  # trailing CRLF
-                        nh = int(hbytes)
                         self._dispatch(
-                            int(sid),
+                            isid,
                             Msg(subject, reply, blob[nh:], headers=blob[:nh]))
                     elif line.startswith(b"-ERR"):
                         log.warning("nats error: %s",

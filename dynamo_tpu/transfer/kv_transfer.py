@@ -151,12 +151,14 @@ def fetch_kv(host: str, port: int, request_id: str
 # (dcn) plane remains the cross-slice fallback.
 
 
-def _uuid64(request_id: str) -> int:
-    """Stable 63-bit pull id for a request (both sides derive it)."""
+def _uuid64(key: str) -> int:
+    """63-bit pull id. The decode side never derives this — it uses the
+    `transfer_uuid` from the stage descriptor — so the key carries a
+    per-stage nonce (see DeviceKVSource.stage)."""
     import hashlib
 
     return int.from_bytes(
-        hashlib.sha256(request_id.encode()).digest()[:8], "big") >> 1
+        hashlib.sha256(key.encode()).digest()[:8], "big") >> 1
 
 
 _XFER_LOCK = threading.Lock()
@@ -191,19 +193,46 @@ def _transfer_server():
 class DeviceKVSource:
     """Prefill side: stages a parked sequence's KV for a remote device pull.
 
-    stage() schedules the device arrays with the transfer server and returns
     Staging is LAZY (the decode side's /disagg/stage RPC, not the prefill
     response): an eager await_pull would pin a gathered KV copy in device
     memory for every request whose peer then pulls over TCP instead — an
-    HBM leak, since the transfer server has no un-await. The remaining
-    window (peer stages but crashes before pulling) is bounded by the
-    parked-KV TTL for pool pages; the staged gather itself is dropped by
-    the server once pulled. Pages are released by the decode side's
-    /disagg/release RPC (or the TTL sweep)."""
+    HBM leak, since the transfer server has no un-await. Pages are released
+    by the decode side's /disagg/release RPC (or the TTL sweep).
 
-    def __init__(self, engine):
+    Stage-then-crash peers are contained three ways:
+    - outstanding stages are CAPPED (`max_staged`), counting BOTH live
+      stages and expired-but-never-released ones: an un-pulled gather
+      stays pinned in the transfer server (it has no un-await), so its
+      slot is only freed by /disagg/release — making the cap a true hard
+      bound on server-pinned HBM. Past the cap, stage() refuses and the
+      peer degrades to the TCP plane.
+    - a TTL sweep demotes expired entries to the leaked ledger (loudly),
+      so operators see stage-then-crash peers in logs and /worker/stats;
+      a late re-stage for a leaked request RESURRECTS the original
+      coordinates instead of pinning a second gather.
+    - each stage derives its pull uuid from a fresh NONCE, so a re-stage
+      after release can never re-issue await_pull for a uuid the server
+      has already seen (whose behavior is undefined — a jaxlib CHECK
+      could kill the process rather than raise).
+    A duplicate stage() for a request that is still staged returns the
+    ORIGINAL coordinates instead of staging again (the peer retried the
+    RPC or lost the response; the arrays are consumed by whichever pull
+    lands first). The whole stage body runs under one lock: concurrent
+    duplicate RPCs must not race past the ledger check and double-pin
+    (the export gather is milliseconds; stage RPCs are per-request)."""
+
+    def __init__(self, engine, staged_ttl_s: float = 120.0,
+                 max_staged: int = 64):
         self.engine = engine
+        self.staged_ttl_s = staged_ttl_s
+        self.max_staged = max_staged
         self._warned = False
+        self._lock = threading.Lock()
+        # request_id -> (monotonic ts, descriptor dict, (k, v) array refs)
+        self._staged: Dict[str, tuple] = {}
+        # expired un-released stages: the transfer server still pins their
+        # gathers, so they keep holding cap slots until /disagg/release
+        self._leaked: Dict[str, tuple] = {}
 
     @property
     def eligible(self) -> bool:
@@ -212,27 +241,80 @@ class DeviceKVSource:
         never pays the export gather only to discard it)."""
         return len(self.engine.k_pages.sharding.device_set) == 1
 
+    @property
+    def staged_count(self) -> int:
+        with self._lock:
+            return len(self._staged)
+
+    @property
+    def leaked_count(self) -> int:
+        """Expired un-released stages whose gathers the transfer server
+        still pins (surfaced in /worker/stats for operators)."""
+        with self._lock:
+            return len(self._leaked)
+
+    def _sweep_locked(self, now: float) -> None:
+        dead = [rid for rid, (ts, _, _) in self._staged.items()
+                if now - ts > self.staged_ttl_s]
+        for rid in dead:
+            self._leaked[rid] = self._staged.pop(rid)
+        if dead:
+            log.warning(
+                "%d staged KV gather(s) expired un-pulled (%s): their "
+                "device copies stay pinned in the transfer server (no "
+                "un-await) and keep holding stage slots until "
+                "/disagg/release", len(dead), ", ".join(dead[:5]))
+
+    def mark_released(self, request_id: str) -> None:
+        """Decode side released the request (post-pull): forget the stage."""
+        with self._lock:
+            self._staged.pop(request_id, None)
+            self._leaked.pop(request_id, None)
+
     def stage(self, request_id: str) -> Optional[dict]:
         if not self.eligible:
             return None
-        k, v, _ = self.engine.export_kv_device(request_id)
-        try:
-            srv = _transfer_server()
-            uid = _uuid64(request_id)
-            srv.await_pull(uid, [k, v])
-        except Exception as e:  # backend without transfer-server support
-            if not self._warned:
-                self._warned = True
+        import secrets
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            hit = self._staged.get(request_id)
+            if hit is not None:
+                return dict(hit[1])
+            leaked = self._leaked.pop(request_id, None)
+            if leaked is not None:
+                # the peer came back after the TTL: its gather is still
+                # pinned and pullable — resurrect rather than double-pin
+                self._staged[request_id] = (now, leaked[1], leaked[2])
+                return dict(leaked[1])
+            if len(self._staged) + len(self._leaked) >= self.max_staged:
                 log.warning(
-                    "device-buffer KV staging unavailable (%s); this "
-                    "prefill worker will serve KV over the TCP plane", e)
-            return None
-        return {
-            "transfer_address": srv.address(),
-            "transfer_uuid": uid,
-            "kv_shape": list(k.shape),
-            "kv_dtype": str(k.dtype),
-        }
+                    "staged-KV cap reached (%d live + %d leaked); refusing "
+                    "stage for %s — peer will use the TCP plane",
+                    len(self._staged), len(self._leaked), request_id)
+                return None
+            k, v, _ = self.engine.export_kv_device(request_id)
+            uid = _uuid64(f"{request_id}:{secrets.token_hex(8)}")
+            try:
+                srv = _transfer_server()
+                srv.await_pull(uid, [k, v])
+            except Exception as e:  # backend without transfer-server support
+                if not self._warned:
+                    self._warned = True
+                    log.warning(
+                        "device-buffer KV staging unavailable (%s); this "
+                        "prefill worker will serve KV over the TCP plane", e)
+                return None
+            desc = {
+                "transfer_address": srv.address(),
+                "transfer_uuid": uid,
+                "kv_shape": list(k.shape),
+                "kv_dtype": str(k.dtype),
+            }
+            self._staged[request_id] = (now, desc, (k, v))
+            return dict(desc)
 
 
 class DeviceKVClient:

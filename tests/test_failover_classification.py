@@ -1,0 +1,175 @@
+"""Retry-safety classification + staged-KV ledger hardening (ADVICE r4).
+
+Failover policy: only failures that PROVE the request never reached the
+peer are retried — a reset after the bytes were written may duplicate a
+prefill/generation, so it is terminal (see dynamo_tpu/utils/net.py).
+
+DeviceKVSource ledger: duplicate stages return the original coordinates
+(never a second await_pull), outstanding stages are capped, expired stages
+are swept, and releases clear the ledger.
+"""
+
+import errno
+import socket
+import urllib.error
+
+import numpy as np
+
+from dynamo_tpu.utils.net import pre_send_failure
+
+
+def test_pre_send_failures_are_retry_safe():
+    assert pre_send_failure(ConnectionRefusedError())
+    assert pre_send_failure(socket.gaierror(8, "nodename not known"))
+    assert pre_send_failure(OSError(errno.EHOSTUNREACH, "no route"))
+    assert pre_send_failure(OSError(errno.ENETUNREACH, "net unreachable"))
+    # urllib wraps the socket error in URLError.reason
+    assert pre_send_failure(urllib.error.URLError(ConnectionRefusedError()))
+    assert pre_send_failure(
+        urllib.error.URLError(socket.gaierror(8, "unknown host")))
+
+
+def test_post_send_failures_are_terminal():
+    # a reset/broken pipe after connect means the peer may be mid-request
+    assert not pre_send_failure(ConnectionResetError())
+    assert not pre_send_failure(BrokenPipeError())
+    assert not pre_send_failure(ConnectionAbortedError())
+    assert not pre_send_failure(urllib.error.URLError(ConnectionResetError()))
+    assert not pre_send_failure(TimeoutError())
+    assert not pre_send_failure(socket.timeout())
+    assert not pre_send_failure(urllib.error.URLError(socket.timeout()))
+    assert not pre_send_failure(OSError(errno.EPIPE, "broken pipe"))
+    assert not pre_send_failure(ValueError("unrelated"))
+
+
+# ------------------------------------------------------ staged-KV ledger --
+
+
+class _FakeSharding:
+    device_set = {"one-device"}
+
+
+class _FakeArr(np.ndarray):
+    pass
+
+
+def _arr():
+    a = np.zeros((2, 4), np.float32).view(_FakeArr)
+    return a
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.k_pages = type("P", (), {"sharding": _FakeSharding()})()
+        self.export_calls = 0
+
+    def export_kv_device(self, request_id):
+        self.export_calls += 1
+        return _arr(), _arr(), 4
+
+
+class _FakeXferServer:
+    def __init__(self):
+        self.await_calls = []
+
+    def await_pull(self, uid, arrs):
+        self.await_calls.append(uid)
+
+    def address(self):
+        return "0.0.0.0:9999"
+
+
+def _mk_source(monkeypatch, **kw):
+    from dynamo_tpu.transfer import kv_transfer
+
+    srv = _FakeXferServer()
+    monkeypatch.setattr(kv_transfer, "_transfer_server", lambda: srv)
+    return kv_transfer.DeviceKVSource(_FakeEngine(), **kw), srv
+
+
+def test_duplicate_stage_returns_original_coordinates(monkeypatch):
+    src, srv = _mk_source(monkeypatch)
+    d1 = src.stage("req-1")
+    d2 = src.stage("req-1")  # peer retried the RPC / lost the response
+    assert d1["transfer_uuid"] == d2["transfer_uuid"]
+    # the identical uuid was never re-issued to the transfer server
+    # (duplicate await_pull behavior is undefined in jaxlib)
+    assert len(srv.await_calls) == 1
+    assert src.engine.export_calls == 1
+
+
+def test_stage_uuids_carry_a_nonce(monkeypatch):
+    src, srv = _mk_source(monkeypatch)
+    d1 = src.stage("req-1")
+    src.mark_released("req-1")
+    d2 = src.stage("req-1")  # re-stage after release: fresh uuid
+    assert d1["transfer_uuid"] != d2["transfer_uuid"]
+    assert len(srv.await_calls) == 2
+
+
+def test_stage_cap_refuses_and_degrades(monkeypatch):
+    src, srv = _mk_source(monkeypatch, max_staged=2)
+    assert src.stage("a") is not None
+    assert src.stage("b") is not None
+    assert src.stage("c") is None  # over cap: peer falls back to TCP plane
+    assert src.staged_count == 2
+    src.mark_released("a")
+    assert src.stage("c") is not None  # release freed a slot
+
+
+def test_stage_ttl_sweep_demotes_to_leaked(monkeypatch):
+    src, srv = _mk_source(monkeypatch, staged_ttl_s=0.0)
+    assert src.stage("a") is not None
+    # ttl 0: the next stage's sweep demotes the expired entry — the
+    # transfer server still pins its gather, so it is tracked, not dropped
+    assert src.stage("b") is not None
+    assert src.staged_count == 1 and src.leaked_count == 1
+    assert "a" in src._leaked and "b" in src._staged
+
+
+def test_leaked_stages_hold_cap_slots(monkeypatch):
+    """The cap is a hard bound on server-pinned gathers: expiry must NOT
+    free slots (the server has no un-await), only /disagg/release does."""
+    src, srv = _mk_source(monkeypatch, staged_ttl_s=0.0, max_staged=2)
+    assert src.stage("a") is not None
+    assert src.stage("b") is not None  # sweeps "a" into leaked: 1 live + 1
+    assert src.stage("c") is None      # 1 live + 1 leaked == cap: refused
+    assert len(srv.await_calls) == 2
+    src.mark_released("a")             # late release frees the leaked slot
+    assert src.stage("c") is not None
+
+
+def test_leaked_stage_resurrects_original_coordinates(monkeypatch):
+    src, srv = _mk_source(monkeypatch, staged_ttl_s=0.0)
+    d1 = src.stage("a")
+    assert src.stage("b") is not None  # sweep demotes "a"
+    assert src.leaked_count == 1
+    d2 = src.stage("a")  # peer came back late: same gather, no double-pin
+    assert d2["transfer_uuid"] == d1["transfer_uuid"]
+    # ttl=0 swept "b" too on that call; "a" is live again, "b" leaked
+    assert "a" in src._staged and "b" in src._leaked
+    assert len(srv.await_calls) == 2  # a, b — never a second pin for "a"
+
+
+def test_concurrent_duplicate_stages_pin_once(monkeypatch):
+    """ThreadingHTTPServer handlers race /disagg/stage for one request:
+    the whole stage body is locked, so exactly one await_pull issues."""
+    import threading as th
+
+    src, srv = _mk_source(monkeypatch)
+    descs = []
+    ts = [th.Thread(target=lambda: descs.append(src.stage("r")))
+          for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(srv.await_calls) == 1
+    assert len({d["transfer_uuid"] for d in descs}) == 1
+
+
+def test_release_clears_ledger(monkeypatch):
+    src, srv = _mk_source(monkeypatch)
+    src.stage("a")
+    assert src.staged_count == 1
+    src.mark_released("a")
+    assert src.staged_count == 0
+    src.mark_released("never-staged")  # idempotent

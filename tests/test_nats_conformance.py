@@ -194,3 +194,44 @@ def test_against_official_nats_server():
     finally:
         proc.kill()
         proc.wait()
+
+
+def test_malformed_control_lines_cost_one_frame_not_connection():
+    """A malformed or future-variant MSG control line must be skipped via
+    its advertised byte count — not raise ValueError in the read loop and
+    force a full reconnect (ADVICE r4). The ScriptedServer accepts exactly
+    one connection, so continued delivery proves the client never redialed."""
+    srv = ScriptedServer()
+    got = queue.Queue()
+    client = None
+    try:
+        t = threading.Thread(target=lambda: srv.accept(), daemon=True)
+        t.start()
+        client = NatsClient(f"nats://127.0.0.1:{srv.port}")
+        t.join(timeout=10)
+        srv.read_line()  # CONNECT
+        client.subscribe("orders.*", got.put)
+        sid = srv.read_line().split(b" ")[-1].decode()
+
+        # runs of spaces between tokens (protocol-legal) parse fine
+        srv.send(f"MSG  orders.eu   {sid}  5\r\n".encode() + b"hello\r\n")
+        assert got.get(timeout=10).data == b"hello"
+
+        # tab separators (protocol-legal) must not be misrouted to ignore
+        srv.send(f"MSG\torders.eu\t{sid}\t3\r\n".encode() + b"tab\r\n")
+        assert got.get(timeout=10).data == b"tab"
+
+        # future variant with an extra token: skipped via the advertised
+        # count, realigning the stream past the payload
+        srv.send(f"MSG orders.eu {sid} x1 x2 7\r\n".encode()
+                 + b"payload\r\n")
+        # unparseable byte count: the frame is abandoned at the line
+        srv.send(f"MSG orders.eu {sid} NaN\r\n".encode())
+
+        # traffic continues on the SAME connection
+        srv.send(f"MSG orders.eu {sid} 2\r\nok\r\n".encode())
+        assert got.get(timeout=10).data == b"ok"
+    finally:
+        if client:
+            client.close()
+        srv.close()

@@ -54,7 +54,10 @@ def default_image() -> str:
 # scheduler consumes these (/root/reference/install-dynamo-1node.sh:35-36,
 # 207-212 gates the reference's equivalents behind the same kind of opt-in)
 POD_GROUP_API = "scheduling.x-k8s.io/v1alpha1"
-POD_GROUP_ANNOTATION = "scheduling.x-k8s.io/pod-group"
+# the coscheduling plugin associates a pod with its PodGroup via this key as
+# a LABEL; gang sites also stamp it as an annotation for tooling that
+# expects the older convention (same key, both conventions, one constant)
+POD_GROUP_KEY = "scheduling.x-k8s.io/pod-group"
 DEFAULT_GANG_SCHEDULER = "scheduler-plugins-scheduler"
 
 FRONTEND_PORT = 8000
@@ -250,8 +253,10 @@ def build_deployment(
     pod_spec = _pod_spec(namespace, dgd_name, svc_name, spec, ctype, frontend)
     if gang and _gang_eligible(spec, ctype):
         # all-or-nothing placement via the coscheduling plugin: pods carry
-        # the PodGroup annotation and are bound by the gang scheduler
-        pod_meta["annotations"] = {POD_GROUP_ANNOTATION: name}
+        # the PodGroup label (what the plugin actually matches on) and are
+        # bound by the gang scheduler
+        pod_labels[POD_GROUP_KEY] = name
+        pod_meta["annotations"] = {POD_GROUP_KEY: name}
         pod_spec.setdefault("schedulerName", gang_scheduler)
     return {
         "apiVersion": "apps/v1",
@@ -366,7 +371,8 @@ def build_gang_statefulset(
         "failureThreshold": 3,
     })
     if gang:
-        pod_meta["annotations"] = {POD_GROUP_ANNOTATION: name}
+        pod_labels[POD_GROUP_KEY] = name
+        pod_meta["annotations"] = {POD_GROUP_KEY: name}
         pod_spec.setdefault("schedulerName", gang_scheduler)
     return {
         "apiVersion": "apps/v1",

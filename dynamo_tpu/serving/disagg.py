@@ -26,6 +26,12 @@ from dynamo_tpu.utils import net
 log = logging.getLogger("dynamo_tpu.disagg")
 
 
+class _StagedPullError(Exception):
+    """Device pull failed AFTER the stage RPC pinned a gather remotely:
+    the TCP fallback must still send /disagg/release or the prefill
+    worker's stage-ledger slot (and the gathered HBM copy) leaks."""
+
+
 class _PrefillUnreachable(Exception):
     """Connection-level failure BEFORE any KV moved (retry-safe)."""
 
@@ -217,6 +223,7 @@ class DisaggDecodeClient:
         first_token = out["first_token"]
         host = urllib.parse.urlparse(prefill_url).hostname
         released = False
+        staged_ok = False  # stage RPC pinned a gather on the prefill side
         k = None
         want_ici = (
             ctx.engine.cfg.disaggregation_transfer_backend == "ici")
@@ -227,6 +234,10 @@ class DisaggDecodeClient:
                 k, v = self._pull_device(prefill_url, host, req.request_id)
                 n_tokens = out["n_tokens"]
                 self._plane_counter.inc(plane="ici_device")
+            except _StagedPullError as e:
+                staged_ok = True
+                self._warn_dcn_fallback(
+                    prefill_url, f"device-buffer pull failed ({e})")
             except Exception as e:
                 self._warn_dcn_fallback(
                     prefill_url, f"device-buffer pull failed ({e})")
@@ -258,7 +269,12 @@ class DisaggDecodeClient:
             ctx.service.detach(req.request_id)
             raise
         finally:
-            if not released:
+            # staged_ok + released: the TCP in-stream ack freed the parked
+            # POOL pages but not the prefill side's stage-ledger slot (and
+            # its pinned gather) — /disagg/release clears both and
+            # engine.release_parked is idempotent for the already-freed
+            # pages
+            if not released or staged_ok:
                 self._release_remote(prefill_url, req.request_id)
         ev = TokenEvent(req.request_id, first_token, 0, finished, reason)
         if req.logprobs is not None and "logprob" in out:
@@ -285,13 +301,18 @@ class DisaggDecodeClient:
             timeout=30,
         ) as resp:
             staged = json.loads(resp.read())
-        addr = staged["transfer_address"]
-        bind_host, _, port = addr.rpartition(":")
-        if bind_host.strip("[]") in ("", "::", "0.0.0.0"):
-            addr = f"{host}:{port}"
-        return self._device_client.pull(
-            addr, staged["transfer_uuid"], staged["kv_shape"],
-            staged["kv_dtype"])
+        try:
+            addr = staged["transfer_address"]
+            bind_host, _, port = addr.rpartition(":")
+            if bind_host.strip("[]") in ("", "::", "0.0.0.0"):
+                addr = f"{host}:{port}"
+            return self._device_client.pull(
+                addr, staged["transfer_uuid"], staged["kv_shape"],
+                staged["kv_dtype"])
+        except Exception as e:
+            # the stage RPC already pinned a gather remotely: the caller
+            # must release it even though it falls back to the TCP plane
+            raise _StagedPullError(str(e)) from e
 
     def _release_remote(self, prefill_url: str, request_id: str) -> None:
         """Best-effort parked-page release after a device-buffer pull, on a

@@ -243,14 +243,22 @@ class DeviceKVSource:
 
     @property
     def staged_count(self) -> int:
+        import time as _time
+
         with self._lock:
+            # sweep here too: expiry must be observable in /worker/stats
+            # even when no new stage traffic arrives to trigger it
+            self._sweep_locked(_time.monotonic())
             return len(self._staged)
 
     @property
     def leaked_count(self) -> int:
         """Expired un-released stages whose gathers the transfer server
         still pins (surfaced in /worker/stats for operators)."""
+        import time as _time
+
         with self._lock:
+            self._sweep_locked(_time.monotonic())
             return len(self._leaked)
 
     def _sweep_locked(self, now: float) -> None:

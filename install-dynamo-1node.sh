@@ -75,6 +75,16 @@ if [[ "$NAMESPACE_RESTRICTED_OPERATOR" == "true" ]]; then
 fi
 if [[ "$ENABLE_GANG_SCHEDULING" == "true" ]]; then
   operator_env+=("ENABLE_GANG_SCHEDULING=true")
+  # install the coscheduling second scheduler (PodGroup CRD + deployment)
+  # BEFORE the operator env lands: materialized multi-pod workers reference
+  # schedulerName scheduler-plugins-scheduler, which must exist or their
+  # pods sit Pending forever. Grove/KAI analogue
+  # (/root/reference/install-dynamo-1node.sh:207-212).
+  log "installing gang (coscheduling) scheduler"
+  kubectl apply -f "${REPO_ROOT}/deploy/gang-scheduler.yaml"
+  kubectl wait -n scheduler-plugins --for=condition=Available \
+    deployment/scheduler-plugins-scheduler --timeout="$WAIT_TIMEOUT" \
+    || log "WARN: gang scheduler not Available yet; gang pods stay Pending until it is"
 fi
 
 kubectl apply -n "$NAMESPACE" -f "${REPO_ROOT}/deploy/platform/"

@@ -2,6 +2,7 @@
 two-worker HTTP topology (the reference's disagg.yaml flow)."""
 
 import json
+import time
 import urllib.request
 
 import pytest
@@ -329,3 +330,59 @@ def test_decode_fails_over_unreachable_prefill(monkeypatch):
         pre_srv.shutdown()
         pre_ctx.close()
         dec_ctx.close()
+
+
+def test_stage_then_tcp_fallback_releases_stage_ledger(monkeypatch):
+    """A successful /disagg/stage whose device pull then fails must not
+    leave the prefill worker's stage ledger holding a slot forever: after
+    the TCP fallback serves the request, /disagg/release clears the
+    ledger too (stage-then-fallback loops would otherwise pin max_staged
+    gathers and permanently disable the device plane)."""
+    from dynamo_tpu.serving.api import (
+        ServingContext, make_server, serve_forever_in_thread,
+    )
+    from dynamo_tpu.transfer import ici_registry
+    from dynamo_tpu.transfer.kv_transfer import DeviceKVClient
+
+    shared = Engine(EngineConfig(**KW))
+    pe = Engine(EngineConfig(**{**KW, "disaggregation_mode": "prefill",
+                                "disaggregation_bootstrap_port": 0,
+                                "disaggregation_transfer_backend": "ici"}),
+                params=shared.params)
+    pctx = ServingContext(pe, "tiny-debug")
+    psrv = make_server(pctx, "127.0.0.1", 0)
+    serve_forever_in_thread(psrv)
+    prefill_url = f"http://127.0.0.1:{psrv.server_address[1]}"
+
+    de = Engine(EngineConfig(**{**KW, "disaggregation_mode": "decode",
+                                "disaggregation_transfer_backend": "ici"}),
+                params=shared.params)
+    dctx = ServingContext(de, "tiny-debug", prefill_urls=[prefill_url])
+    try:
+        # force the CROSS-process shape: in-process registry misses, and
+        # the device pull explodes after the stage RPC has pinned a gather
+        monkeypatch.setattr(ici_registry, "lookup", lambda url: None)
+        monkeypatch.setattr(
+            DeviceKVClient, "pull",
+            lambda self, *a, **k: (_ for _ in ()).throw(
+                RuntimeError("pull boom")))
+
+        req = GenRequest("stage-fb-1", [5, 6, 7, 8], max_tokens=2,
+                         temperature=0.0, ignore_eos=True)
+        q = dctx.disagg_client.start(req)
+        assert q.get(timeout=30).token_id >= 0  # served via TCP fallback
+        assert dctx.disagg_client.plane_counts["dcn"] == 1
+
+        src = pctx.kv_device_source
+        if src is None or (src.staged_count + src.leaked_count) == 0:
+            pytest.skip("transfer server unavailable; stage never pinned")
+        # the async /disagg/release must drain the ledger
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                src.staged_count + src.leaked_count):
+            time.sleep(0.1)
+        assert src.staged_count + src.leaked_count == 0
+    finally:
+        psrv.shutdown()
+        dctx.close()
+        pctx.close()

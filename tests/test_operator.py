@@ -335,7 +335,7 @@ def test_gang_scheduling_emits_podgroups():
 
     deps = {d["metadata"]["name"]: d for d in out["deployments"]}
     wtpl = deps["g-worker"]["spec"]["template"]
-    assert wtpl["metadata"]["annotations"][mat.POD_GROUP_ANNOTATION] == "g-worker"
+    assert wtpl["metadata"]["annotations"][mat.POD_GROUP_KEY] == "g-worker"
     assert wtpl["spec"]["schedulerName"] == mat.DEFAULT_GANG_SCHEDULER
     for untouched in ("g-frontend", "g-solo"):
         tpl = deps[untouched]["spec"]["template"]
@@ -410,7 +410,7 @@ def test_multihost_service_materializes_gang_statefulset():
     # gang gating: PodGroup wants ALL hosts, pods annotated into the group
     pgs = {p["metadata"]["name"]: p for p in desired["podgroups"]}
     assert pgs["mh-bigworker"]["spec"]["minMember"] == 4
-    assert tmpl["metadata"]["annotations"][mat.POD_GROUP_ANNOTATION] == \
+    assert tmpl["metadata"]["annotations"][mat.POD_GROUP_KEY] == \
         "mh-bigworker"
     # headless coordinator service: follower pods (never Ready by design)
     # must still get DNS records

@@ -34,12 +34,27 @@ def main(argv=None) -> None:
                    default=os.environ.get("GANG_SCHEDULER_NAME") or None)
     p.add_argument("--once", action="store_true",
                    help="single reconcile pass (CI / debugging)")
+    p.add_argument("--watch", action=argparse.BooleanOptionalAction,
+                   default=os.environ.get("OPERATOR_WATCH",
+                                          "true").lower() != "false",
+                   help="resourceVersion watch streams + periodic resync "
+                        "instead of a fixed poll loop")
+    p.add_argument("--resync", type=float,
+                   default=float(os.environ.get("RESYNC_INTERVAL", "30")))
+    p.add_argument("--leader-elect", action=argparse.BooleanOptionalAction,
+                   default=os.environ.get("LEADER_ELECT",
+                                          "true").lower() != "false",
+                   help="coordination.k8s.io Lease election so replicas>1 "
+                        "is an HA pair (one active reconciler)")
+    p.add_argument("--leader-identity",
+                   default=os.environ.get("POD_NAME") or None)
     args = p.parse_args(argv)
 
     from dynamo_tpu.operator import materialize as mat
 
+    client = K8sClient.from_env()
     ctrl = Controller(
-        K8sClient.from_env(), namespace=args.namespace, gang=args.gang,
+        client, namespace=args.namespace, gang=args.gang,
         gang_scheduler=args.gang_scheduler or mat.DEFAULT_GANG_SCHEDULER,
     )
     if args.once:
@@ -47,7 +62,20 @@ def main(argv=None) -> None:
         scope = args.namespace or "all namespaces"
         print(f"reconciled {n} custom resources in {scope}")
         return
-    ctrl.run(interval=args.interval)
+    leader = None
+    if args.leader_elect:
+        import socket
+
+        from dynamo_tpu.operator.leader import LeaderElector
+
+        identity = args.leader_identity or (
+            f"{socket.gethostname()}-{os.getpid()}")
+        lease_ns = (os.environ.get("OPERATOR_NAMESPACE")
+                    or args.namespace or "dynamo-system")
+        leader = LeaderElector(client, lease_ns, identity)
+        leader.start()
+    ctrl.run(interval=args.interval, watch=args.watch, resync_s=args.resync,
+             leader=leader)
 
 
 if __name__ == "__main__":

@@ -1,9 +1,13 @@
 """Reconcile loop: DynamoGraphDeployment(Request) CRs -> child resources.
 
-Level-triggered, poll-based reconciliation (list + diff every interval)
-rather than watches — single-node scale doesn't need informer caches, and a
-relist loop is self-healing by construction (the reference's recovery posture
-is the same K8s-native self-healing, SURVEY.md §5).
+Level-triggered reconciliation in two modes: resourceVersion WATCH streams
+with a periodic full-relist resync (the controller-runtime-style default —
+events trigger immediate passes, the resync backstop self-heals missed
+ones), or a plain poll loop (`--no-watch`, single-node dev). Every pass is
+a full list + diff, so both modes are self-healing by construction (the
+reference's recovery posture is the same K8s-native self-healing,
+SURVEY.md §5). Lease-based leader election (leader.py) gates passes so
+`replicas: 2` is an HA pair.
 
 DGD flow:  CR -> materialize() -> upsert Deployments/Services/PVCs, delete
 stale children by ownership labels, roll child readiness up into CR status.
@@ -17,7 +21,6 @@ from __future__ import annotations
 
 import json
 import logging
-import time
 from typing import Any, Dict, List, Optional
 
 from dynamo_tpu.operator import materialize as mat
@@ -296,16 +299,99 @@ class Controller:
                 log.exception("DGD %s reconcile failed", cr["metadata"]["name"])
         return n
 
-    def run(self, interval: float = 3.0, stop=None) -> None:
-        log.info("operator reconciling namespace %s every %.1fs",
-                 self.namespace, interval)
-        while stop is None or not stop.is_set():
+    def run(self, interval: float = 3.0, stop=None, watch: bool = False,
+            resync_s: float = 30.0, leader=None) -> None:
+        """Reconcile until `stop`.
+
+        watch=False: plain poll every `interval` (single-node dev default —
+        self-healing by construction). watch=True: resourceVersion watch
+        streams on both CRD kinds trigger immediate passes, with a full
+        relist every `resync_s` as the informer-style resync backstop (a
+        missed event costs at most one resync period, not correctness).
+
+        `leader` (optional LeaderElector) gates every pass on is_leader so
+        `replicas: 2` is an HA pair, not two writers."""
+        import threading
+
+        stop = stop or threading.Event()
+        trigger = threading.Event()
+        if watch:
+            for plural in (mat.DGD_PLURAL, mat.DGDR_PLURAL):
+                threading.Thread(
+                    target=self._watch_loop, args=(plural, trigger, stop),
+                    daemon=True, name=f"watch-{plural}",
+                ).start()
+        log.info(
+            "operator reconciling namespace %s (%s)", self.namespace,
+            f"watch + {resync_s:.0f}s resync" if watch
+            else f"poll every {interval:.1f}s")
+        while not stop.is_set():
+            # clear BEFORE the pass: an event landing mid-pass re-arms the
+            # trigger and wakes the next pass immediately instead of
+            # waiting out a full resync period
+            trigger.clear()
+            if leader is None or leader.is_leader:
+                try:
+                    self.reconcile_once()
+                except Exception:
+                    log.exception("reconcile pass failed")
+            wait_s = resync_s if watch else interval
+            # wake on the next watch event OR the resync/poll deadline
+            if trigger.wait(timeout=wait_s):
+                # debounce: one burst of events -> one pass
+                stop.wait(0.05)
+
+    def _watch_loop(self, plural: str, trigger, stop) -> None:
+        """list -> watch -> trigger; relist on any stream failure (incl.
+        410 Gone when our resourceVersion aged out of the event window)."""
+        while not stop.is_set():
             try:
-                self.reconcile_once()
+                _, rv = self.k8s.list_with_rv(
+                    mat.API_VERSION, plural, self.namespace)
+            except ApiError as e:
+                # CRD not installed yet (404): nothing to watch — back off a
+                # full resync period rather than hammering the apiserver
+                stop.wait(30.0 if e.not_found else 2.0)
+                continue
             except Exception:
-                log.exception("reconcile pass failed")
-            if stop is not None:
-                if stop.wait(interval):
-                    return
-            else:
-                time.sleep(interval)
+                log.exception("watch relist for %s failed", plural)
+                stop.wait(2.0)
+                continue
+            trigger.set()  # state observed fresh: run a pass
+            while not stop.is_set():
+                try:
+                    relist = False
+                    for ev in self.k8s.watch(
+                        mat.API_VERSION, plural, self.namespace,
+                        resource_version=rv, timeout_s=60.0,
+                    ):
+                        if ev.get("type") == "ERROR":
+                            # in-stream failure (the apiserver's usual way
+                            # to deliver 410 once a watch is established):
+                            # our rv is unusable — relist, don't re-watch
+                            log.info(
+                                "watch on %s got ERROR event (%s); "
+                                "relisting", plural,
+                                (ev.get("object") or {}).get("code"))
+                            relist = True
+                            break
+                        obj_rv = ((ev.get("object") or {}).get("metadata")
+                                  or {}).get("resourceVersion")
+                        if obj_rv:
+                            rv = obj_rv
+                        trigger.set()
+                    if relist:
+                        break
+                except ApiError as e:
+                    if e.status == 410:
+                        log.info("watch on %s expired (410); relisting",
+                                 plural)
+                    elif not e.not_found:
+                        log.warning("watch on %s failed: %s", plural, e)
+                    break  # relist from scratch
+                except Exception as e:
+                    log.warning("watch stream on %s dropped: %s", plural, e)
+                    break
+                # clean server-side close (timeoutSeconds): resume from the
+                # last seen rv without relisting
+            # fell out of the watch: loop back to relist

@@ -124,11 +124,69 @@ class K8sClient:
         namespace: Optional[str] = None,
         label_selector: Optional[str] = None,
     ) -> List[Dict[str, Any]]:
+        return self.list_with_rv(api_version, plural, namespace,
+                                 label_selector)[0]
+
+    def list_with_rv(
+        self,
+        api_version: str,
+        plural: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+    ) -> tuple:
+        """List plus the collection resourceVersion — the token a subsequent
+        watch() resumes from (the informer list-then-watch handshake)."""
         params = {"labelSelector": label_selector} if label_selector else None
         out = self._request(
             "GET", resource_path(api_version, plural, namespace), params=params
         )
-        return out.get("items", [])
+        rv = (out.get("metadata") or {}).get("resourceVersion") or "0"
+        return out.get("items", []), rv
+
+    def watch(
+        self,
+        api_version: str,
+        plural: str,
+        namespace: Optional[str] = None,
+        resource_version: str = "0",
+        timeout_s: float = 60.0,
+        label_selector: Optional[str] = None,
+    ):
+        """Yield watch events ({"type": ..., "object": ...}) after
+        `resource_version` until the server closes the stream (bounded by
+        timeoutSeconds, the apiserver contract). Raises ApiError(410) when
+        the version is too old — the caller must relist and re-watch."""
+        params = {
+            "watch": "true",
+            "resourceVersion": str(resource_version),
+            "timeoutSeconds": str(int(timeout_s)),
+        }
+        if label_selector:
+            params["labelSelector"] = label_selector
+        url = (self.base_url + resource_path(api_version, plural, namespace)
+               + "?" + urllib.parse.urlencode(params))
+        req = urllib.request.Request(url, method="GET")
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            # read timeout a bit past the server-side bound so a healthy
+            # stream is always closed by the server, not the socket
+            with urllib.request.urlopen(
+                req, timeout=timeout_s + 15.0, context=self._ctx
+            ) as r:
+                for raw in r:
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # truncated tail line at stream close
+        except urllib.error.HTTPError as e:
+            raise ApiError(
+                e.code, e.reason, e.read().decode(errors="replace")
+            ) from None
 
     def get(
         self, api_version: str, plural: str, namespace: Optional[str], name: str

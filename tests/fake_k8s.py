@@ -57,9 +57,13 @@ def _matches_selector(obj: Dict[str, Any], selector: Optional[str]) -> bool:
 class FakeK8sStore:
     def __init__(self):
         self.lock = threading.Lock()
+        self.changed = threading.Condition(self.lock)  # wakes watchers
         # (api_key, ns, plural) -> {name: obj}
         self.objs: Dict[Tuple[str, str, str], Dict[str, Dict[str, Any]]] = {}
         self._rv = 0
+        # watch event log: (rv, api_key, ns, plural, type, obj)
+        self.events: list = []
+        self.min_rv = 0  # tests raise this to force 410 Gone on old watches
 
     def _bucket(self, api_key: str, ns: str, plural: str) -> Dict[str, Dict]:
         return self.objs.setdefault((api_key, ns, plural), {})
@@ -70,6 +74,12 @@ class FakeK8sStore:
             if ak == api_key and pl == plural:
                 out.extend(bucket.values())
         return out
+
+    def record(self, api_key: str, ns: str, plural: str, etype: str,
+               obj: Dict[str, Any]) -> None:
+        """Append a watch event (caller holds the lock) and wake watchers."""
+        self.events.append((self._rv, api_key, ns, plural, etype, obj))
+        self.changed.notify_all()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -109,6 +119,9 @@ class _Handler(BaseHTTPRequestHandler):
         if not r:
             return self._error(404, "bad path")
         api_key, ns, plural, name, _sub, selector = r
+        qs = parse_qs(urlparse(self.path).query)
+        if qs.get("watch", ["false"])[0] in ("true", "1") and name is None:
+            return self._watch(api_key, ns, plural, selector, qs)
         st = self.store
         with st.lock:
             if name is None:
@@ -118,11 +131,58 @@ class _Handler(BaseHTTPRequestHandler):
                     else list(st._bucket(api_key, ns, plural).values())
                 )
                 items = [o for o in items if _matches_selector(o, selector)]
-                return self._send(200, {"kind": "List", "items": items})
+                return self._send(200, {
+                    "kind": "List",
+                    "metadata": {"resourceVersion": str(st._rv)},
+                    "items": items,
+                })
             obj = st._bucket(api_key, ns or "default", plural).get(name)
             if obj is None:
                 return self._error(404, f"{plural}/{name} not found")
             return self._send(200, obj)
+
+    def _watch(self, api_key, ns, plural, selector, qs):
+        """Streamed watch: newline-delimited JSON events after the given
+        resourceVersion, like the real apiserver's ?watch=true."""
+        import time as _time
+
+        st = self.store
+        try:
+            since = int(qs.get("resourceVersion", ["0"])[0] or 0)
+        except ValueError:
+            since = 0
+        timeout_s = float(qs.get("timeoutSeconds", ["30"])[0])
+        with st.lock:
+            if since and since < st.min_rv:
+                return self._error(410, "too old resource version")
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()  # no Content-Length: stream until timeout
+        deadline = _time.monotonic() + timeout_s
+        sent = since
+        while _time.monotonic() < deadline:
+            with st.lock:
+                batch = [e for e in st.events
+                         if e[0] > sent and e[1] == api_key and e[3] == plural
+                         and (ns is None or e[2] == ns)
+                         and _matches_selector(e[5], selector)]
+                if not batch:
+                    st.changed.wait(
+                        timeout=min(0.2, max(0.0,
+                                             deadline - _time.monotonic())))
+                    batch = [e for e in st.events
+                             if e[0] > sent and e[1] == api_key
+                             and e[3] == plural
+                             and (ns is None or e[2] == ns)
+                             and _matches_selector(e[5], selector)]
+            for rv, _ak, _ns, _pl, etype, obj in batch:
+                sent = max(sent, rv)
+                line = json.dumps({"type": etype, "object": obj}) + "\n"
+                try:
+                    self.wfile.write(line.encode())
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionError):
+                    return
 
     def do_POST(self):
         r = self._route()
@@ -143,6 +203,7 @@ class _Handler(BaseHTTPRequestHandler):
             st._rv += 1
             obj["metadata"]["resourceVersion"] = str(st._rv)
             bucket[name] = obj
+            st.record(api_key, ns or "default", plural, "ADDED", obj)
             return self._send(201, obj)
 
     def do_PUT(self):
@@ -157,11 +218,19 @@ class _Handler(BaseHTTPRequestHandler):
             if name not in bucket:
                 return self._error(404, f"{plural}/{name} not found")
             prev = bucket[name]
+            # optimistic concurrency, like the real apiserver: a PUT
+            # carrying a stale resourceVersion loses the write race
+            want_rv = obj.get("metadata", {}).get("resourceVersion")
+            if want_rv and want_rv != prev["metadata"].get("resourceVersion"):
+                return self._error(
+                    409, f"resourceVersion conflict: have "
+                    f"{prev['metadata'].get('resourceVersion')}, got {want_rv}")
             obj.setdefault("metadata", {})["uid"] = prev["metadata"].get("uid")
             obj["metadata"]["namespace"] = ns or "default"
             st._rv += 1
             obj["metadata"]["resourceVersion"] = str(st._rv)
             bucket[name] = obj
+            st.record(api_key, ns or "default", plural, "MODIFIED", obj)
             return self._send(200, obj)
 
     def do_PATCH(self):
@@ -181,6 +250,7 @@ class _Handler(BaseHTTPRequestHandler):
             st._rv += 1
             merged.setdefault("metadata", {})["resourceVersion"] = str(st._rv)
             bucket[name] = merged
+            st.record(api_key, ns or "default", plural, "MODIFIED", merged)
             return self._send(200, merged)
 
     def do_DELETE(self):
@@ -193,7 +263,9 @@ class _Handler(BaseHTTPRequestHandler):
             bucket = st._bucket(api_key, ns or "default", plural)
             if name not in bucket:
                 return self._error(404, f"{plural}/{name} not found")
-            del bucket[name]
+            gone = bucket.pop(name)
+            st._rv += 1
+            st.record(api_key, ns or "default", plural, "DELETED", gone)
             return self._send(200, {"kind": "Status", "status": "Success"})
 
 

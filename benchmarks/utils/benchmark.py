@@ -15,10 +15,11 @@ import dataclasses
 import json
 import os
 import statistics
-import time
 from typing import Dict, List
 
-from benchmarks.utils.loadgen import LoadConfig, RequestResult, run_load
+from benchmarks.utils.loadgen import (
+    LoadConfig, RequestResult, run_load_timed,
+)
 
 
 def _pctl(values: List[float], q: float) -> float:
@@ -78,7 +79,14 @@ def main(argv=None) -> int:
     p.add_argument("--output-dir", required=True)
     p.add_argument("--concurrency", default="1,2,4,8",
                    help="comma-separated concurrency sweep")
-    p.add_argument("--requests-per-level", type=int, default=32)
+    p.add_argument("--requests-per-level", type=int, default=128)
+    p.add_argument("--duration-s", type=float, default=None,
+                   help="per-level wall-clock window; overrides "
+                        "--requests-per-level so percentile sample size "
+                        "scales with throughput")
+    p.add_argument("--warmup-requests", type=int, default=None,
+                   help="excluded warmup requests per level "
+                        "(default: 2 x concurrency, min 8)")
     p.add_argument("--isl", type=int, default=128,
                    help="synthetic input length (words)")
     p.add_argument("--osl", type=int, default=64, help="max output tokens")
@@ -91,7 +99,12 @@ def main(argv=None) -> int:
     os.makedirs(args.output_dir, exist_ok=True)
     levels = [int(c) for c in args.concurrency.split(",") if c.strip()]
     sweep = []
+    # a falsy --duration-s (0) means count mode everywhere, so the log line,
+    # LoadConfig, and loadgen's `is not None` check can never disagree
+    duration_s = args.duration_s or None
     for conc in levels:
+        warmup = (args.warmup_requests if args.warmup_requests is not None
+                  else max(8, 2 * conc))
         cfg = LoadConfig(
             endpoint_url=args.endpoint_url,
             model=args.model,
@@ -100,14 +113,17 @@ def main(argv=None) -> int:
             input_len=args.isl,
             max_tokens=args.osl,
             timeout_s=args.timeout,
+            warmup_requests=warmup,
+            duration_s=duration_s,
         )
+        load_desc = (f"duration={duration_s}s" if duration_s
+                     else f"requests={cfg.num_requests}")
         print(f"[benchmark] {args.benchmark_name}: concurrency={conc} "
-              f"requests={cfg.num_requests} isl~{args.isl}w osl={args.osl}")
-        t0 = time.perf_counter()
-        results = run_load(cfg)
-        wall = time.perf_counter() - t0
+              f"{load_desc} warmup={warmup} isl~{args.isl}w osl={args.osl}")
+        results, wall = run_load_timed(cfg)
         summary = summarize(results, wall, args.num_chips)
         summary["concurrency"] = conc
+        summary["warmup_excluded"] = warmup
         sweep.append(summary)
         print(f"[benchmark]   -> {summary['output_tok_per_s']} tok/s, "
               f"TTFT p50 {summary['ttft_ms']['p50']}ms, "

@@ -32,12 +32,19 @@ class RequestResult:
 class LoadConfig:
     endpoint_url: str
     model: str
-    num_requests: int = 32
+    num_requests: int = 128
     concurrency: int = 4
     input_len: int = 128          # synthetic prompt length (words)
     max_tokens: int = 64
     timeout_s: float = 300.0
     prompt: Optional[str] = None  # overrides the synthetic prompt
+    # statistics hygiene: warmup requests run first (compile/caches/batch
+    # ramp) and are EXCLUDED from results; duration_s switches the timed
+    # phase from a fixed count to a fixed wall-clock window, so percentile
+    # sample size scales with throughput instead of being fixed at
+    # num_requests (p99 over 32 samples is noise)
+    warmup_requests: int = 0
+    duration_s: Optional[float] = None
 
 
 def _synthetic_prompt(n_words: int, seed: int) -> str:
@@ -111,20 +118,29 @@ def run_one(cfg: LoadConfig, seed: int) -> RequestResult:
     return res
 
 
-def run_load(cfg: LoadConfig) -> List[RequestResult]:
-    """Closed-loop load: `concurrency` workers pull request ids off a queue."""
-    results: List[Optional[RequestResult]] = [None] * cfg.num_requests
+def _run_phase(cfg: LoadConfig, n_requests: Optional[int],
+               deadline: Optional[float], seed_base: int
+               ) -> List[RequestResult]:
+    """Closed-loop phase: `concurrency` workers pull request ids until the
+    count is exhausted (count mode) or the deadline passes (duration mode —
+    requests already in flight at the deadline run to completion, so the
+    tail isn't censored toward fast requests)."""
+    results: List[RequestResult] = []
     next_id = [0]
     lock = threading.Lock()
 
     def worker():
         while True:
             with lock:
-                if next_id[0] >= cfg.num_requests:
+                if n_requests is not None and next_id[0] >= n_requests:
+                    return
+                if deadline is not None and time.perf_counter() >= deadline:
                     return
                 rid = next_id[0]
                 next_id[0] += 1
-            results[rid] = run_one(cfg, rid)
+            r = run_one(cfg, seed_base + rid)
+            with lock:
+                results.append(r)
 
     threads = [
         threading.Thread(target=worker, daemon=True, name=f"loadgen-{i}")
@@ -134,4 +150,22 @@ def run_load(cfg: LoadConfig) -> List[RequestResult]:
         t.start()
     for t in threads:
         t.join()
-    return [r for r in results if r is not None]
+    return results
+
+
+def run_load_timed(cfg: LoadConfig) -> tuple:
+    """Warmup (excluded) then the timed phase (count- or duration-based).
+    Returns (results, timed_wall_s) — the wall clock covers ONLY the timed
+    phase, so throughput is never diluted by warmup compiles."""
+    if cfg.warmup_requests > 0:
+        _run_phase(cfg, cfg.warmup_requests, None, seed_base=1_000_000)
+    t0 = time.perf_counter()
+    if cfg.duration_s is not None:
+        results = _run_phase(cfg, None, t0 + cfg.duration_s, seed_base=0)
+    else:
+        results = _run_phase(cfg, cfg.num_requests, None, seed_base=0)
+    return results, time.perf_counter() - t0
+
+
+def run_load(cfg: LoadConfig) -> List[RequestResult]:
+    return run_load_timed(cfg)[0]

@@ -185,7 +185,12 @@ class Engine:
         cfg: EngineConfig,
         model_cfg: Optional[ModelConfig] = None,
         params=None,
+        devices=None,
     ):
+        """`devices`: optional explicit device list for this engine's mesh —
+        disaggregated roles colocated on one slice place prefill and decode
+        on DISJOINT sub-meshes of the same host this way (None = the
+        process-global jax.devices(), the single-role default)."""
         self.cfg = cfg
         backend = jax.default_backend()
         default_dtype = "float32" if backend == "cpu" else "bfloat16"
@@ -223,14 +228,15 @@ class Engine:
             from dynamo_tpu.parallel.mesh import build_long_context_mesh
 
             self.mesh = build_long_context_mesh(
-                cfg.sequence_parallel, cfg.tensor_parallel)
+                cfg.sequence_parallel, cfg.tensor_parallel, devices=devices)
         else:
             self.mesh = build_mesh(
                 MeshConfig(
                     tensor_parallel=cfg.tensor_parallel,
                     data_parallel=cfg.data_parallel,
                     expert_parallel=cfg.expert_parallel,
-                )
+                ),
+                devices=devices,
             )
         self.metrics = EngineMetrics()
         self._lock = threading.Lock()
@@ -1740,10 +1746,21 @@ class Engine:
         self._ensure_pages(n_pages)  # evict cached pages under pressure
         pages = self.allocator.alloc(n_pages)
         idx = jnp.asarray(pages, jnp.int32)
+        k = jnp.asarray(k).astype(self.k_pages.dtype)
+        v = jnp.asarray(v).astype(self.v_pages.dtype)
+        mesh_devs = set(self.mesh.devices.flat)
+        if set(k.sharding.device_set) != mesh_devs:
+            # cross-sub-mesh handoff (prefill and decode on different device
+            # subsets of one slice): move the pages onto THIS engine's mesh
+            # with the pool's own layout before the jitted scatter — XLA
+            # lowers it to a device-to-device copy (ICI on TPU), and the
+            # jit below requires every operand on its mesh
+            pool_sharding = jax.sharding.NamedSharding(
+                self.mesh, self.k_pages.sharding.spec)
+            k = jax.device_put(k, pool_sharding)
+            v = jax.device_put(v, pool_sharding)
         self.k_pages, self.v_pages = self._import(
-            self.k_pages, self.v_pages, idx,
-            jnp.asarray(k).astype(self.k_pages.dtype),
-            jnp.asarray(v).astype(self.v_pages.dtype),
+            self.k_pages, self.v_pages, idx, k, v,
         )
         slot = self._free_slots.pop()
         # seeded requests continue the same per-request key chain the prefill

@@ -51,6 +51,8 @@ extra_args=()
 [[ -n "${OSL:-}" ]] && extra_args+=(--osl "$OSL")
 [[ -n "${CONCURRENCY:-}" ]] && extra_args+=(--concurrency "$CONCURRENCY")
 [[ -n "${REQUESTS_PER_LEVEL:-}" ]] && extra_args+=(--requests-per-level "$REQUESTS_PER_LEVEL")
+[[ -n "${DURATION_S:-}" ]] && extra_args+=(--duration-s "$DURATION_S")
+[[ -n "${WARMUP_REQUESTS:-}" ]] && extra_args+=(--warmup-requests "$WARMUP_REQUESTS")
 [[ -n "${NUM_CHIPS:-}" ]] && extra_args+=(--num-chips "$NUM_CHIPS")
 
 mkdir -p "$OUTPUT_DIR"

@@ -88,3 +88,45 @@ def test_plot_falls_back_to_text(server_url, tmp_path):
 
 def test_plot_empty_dir_errors(tmp_path):
     assert plot_mod.main(["--data-dir", str(tmp_path)]) == 1
+
+
+def test_loadgen_warmup_excluded_and_duration_mode(server_url):
+    from benchmarks.utils.loadgen import run_load_timed
+
+    # count mode: warmup requests never appear in results
+    results, wall = run_load_timed(LoadConfig(
+        endpoint_url=server_url, model=MODEL, num_requests=3, concurrency=2,
+        input_len=8, max_tokens=4, warmup_requests=2,
+    ))
+    assert len(results) == 3
+    assert wall > 0
+
+    # duration mode: sample size scales with the window, not a fixed count
+    results, wall = run_load_timed(LoadConfig(
+        endpoint_url=server_url, model=MODEL, concurrency=2,
+        input_len=8, max_tokens=4, warmup_requests=1, duration_s=3.0,
+    ))
+    assert results, "duration window produced no completed requests"
+    # in-flight requests at the deadline run to completion
+    assert all(r.ok or r.error for r in results)
+    assert wall >= 3.0
+
+
+def test_benchmark_cli_duration_mode(server_url, tmp_path):
+    rc = bench_mod.main([
+        "--benchmark-name", "dur",
+        "--endpoint-url", server_url,
+        "--model", MODEL,
+        "--output-dir", str(tmp_path),
+        "--concurrency", "2",
+        "--duration-s", "2",
+        "--warmup-requests", "1",
+        "--isl", "8",
+        "--osl", "4",
+    ])
+    assert rc == 0
+    with open(tmp_path / "dur_summary.json") as f:
+        rep = json.load(f)
+    lvl = rep["sweep"][0]
+    assert lvl["warmup_excluded"] == 1
+    assert lvl["successful"] >= 1

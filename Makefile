@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full image help
+.PHONY: k8s dynamo install benchmark-env test test-full image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -44,6 +44,11 @@ benchmark-env:
 image:
 	$(DOCKER) build --build-arg JAX_EXTRA=$(JAX_EXTRA) -t $(IMAGE) .
 	@echo "built $(IMAGE) — deploy with: DYNAMO_IMAGE=$(IMAGE) ./install-dynamo-1node.sh"
+
+# Versioned single-file install bundle (dist/dynamo-tpu-install-<ver>.yaml)
+# with image refs pinned — the artifact RELEASE_VERSION != local installs.
+release-manifests:
+	./scripts/build_release_manifests.sh $(RELEASE_VERSION)
 
 test:
 	python -m pytest tests/ -q -m "not slow and not compile_heavy"

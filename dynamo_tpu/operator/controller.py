@@ -175,105 +175,139 @@ class Controller:
 
     # ----------------------------------------------------------------- DGDR --
     def reconcile_dgdr(self, cr: Dict[str, Any]) -> None:
-        """SLA-driven deployment request: template + profiler -> DGD."""
-        name = cr["metadata"]["name"]
-        ns = self._ns(cr)
+        """SLA-driven deployment request: template + profiler -> DGD.
+
+        With `profilingConfig.profilerImage` set, the sweep runs as its OWN
+        pod (a Job in the DGDR's namespace — the reference's profiler-pod
+        topology, /root/reference/examples/dgdr/trtllm/dgdr.yaml:15); the
+        pod executes `python -m dynamo_tpu.profiler --dgdr <name>`, which is
+        run_dgdr() below — exactly the inline path. Without the field, the
+        sweep runs inline in the operator (simpler, same result)."""
         if (cr.get("status") or {}).get("state") in ("successful", "failed"):
             return  # one-shot: profiling requests don't re-run
-        spec = cr.get("spec", {})
-        prof = spec.get("profilingConfig") or {}
-        cm_ref = ((prof.get("config") or {}).get("configMapRef")) or {}
-        template: Optional[Dict[str, Any]] = None
-        if cm_ref.get("name"):
-            try:
-                cm = self.k8s.get("v1", "configmaps", ns, cm_ref["name"])
-            except ApiError as e:
-                if not e.not_found:
-                    raise
-                cm = {}
-            key = cm_ref.get("key") or next(iter(cm.get("data", {})), None)
-            if key and key in cm.get("data", {}):
-                template = _yaml_load(cm["data"][key])
-        if template is None:
-            # Transient: the user may create/fix the ConfigMap after the DGDR
-            # (run-dgdr.sh creates them together; ordering isn't guaranteed).
-            # "pending" is retried on every pass — only render success is
-            # terminal, matching the wholly-missing-ConfigMap (404) path.
-            self._set_dgdr_status(
-                ns, name, "pending", "waiting for template ConfigMap/key"
-            )
-            return
+        image = ((cr.get("spec", {}).get("profilingConfig") or {})
+                 .get("profilerImage"))
+        if image:
+            self._reconcile_profiler_job(cr, image)
+        else:
+            run_dgdr(self.k8s, cr)
 
-        sla = prof.get("sla") or {}
-        overrides = spec.get("deploymentOverrides") or {}
-        dgd = self._render_dgd(cr, template, sla, overrides)
-        if spec.get("autoApply", False):
-            try:
-                self.k8s.create(mat.API_VERSION, mat.DGD_PLURAL, ns, dgd)
-            except ApiError as e:
-                if not e.conflict:
-                    raise
-                self.k8s.merge_patch(
-                    mat.API_VERSION, mat.DGD_PLURAL, ns,
-                    dgd["metadata"]["name"], {"spec": dgd["spec"]},
-                )
-        self._set_dgdr_status(
-            ns, name, "successful", f"generated {dgd['metadata']['name']}",
-            generated=dgd,
-        )
+    def _reconcile_profiler_job(self, cr: Dict[str, Any], image: str) -> None:
+        """Drive the dispatched sweep Job through its lifecycle.
 
-    def _render_dgd(
-        self,
-        cr: Dict[str, Any],
-        template: Dict[str, Any],
-        sla: Dict[str, Any],
-        overrides: Dict[str, Any],
-    ) -> Dict[str, Any]:
-        dgd = json.loads(json.dumps(template))  # deep copy
-        dgd.setdefault("metadata", {})
-        dgd["metadata"]["namespace"] = self._ns(cr)
-        dgd["metadata"].setdefault("name", cr["metadata"]["name"] + "-generated")
-        dgd["metadata"].setdefault("labels", {})[
-            f"{mat.GROUP}/generated-by"
-        ] = cr["metadata"]["name"]
-        # SLA profiling sweep (the aiconfigurator analogue): pick mesh/batch
-        # for the request's isl/osl/ttft/itl on the target TPU system.
-        if sla:
-            try:
-                from dynamo_tpu.profiler.configurator import apply_sla_overrides
-
-                dgd = apply_sla_overrides(
-                    dgd, sla,
-                    system=(cr["spec"].get("profilingConfig") or {}).get(
-                        "tpuSystem", "v5e-8"
-                    ),
-                )
-            except Exception as e:  # warn-and-continue posture: an unknown
-                # model/system must not wedge the reconcile loop — the
-                # template still deploys as written.
-                log.warning("profiler skipped (%s); applying template unchanged", e)
-        workers_image = overrides.get("workersImage")
-        if workers_image:
-            for svc in (dgd.get("spec", {}).get("services") or {}).values():
-                if svc.get("componentType") != "frontend":
-                    svc.setdefault("extraPodSpec", {}).setdefault(
-                        "mainContainer", {}
-                    )["image"] = workers_image
-        return dgd
-
-    def _set_dgdr_status(
-        self, ns: str, name: str, state: str, message: str,
-        generated: Optional[Dict] = None,
-    ) -> None:
-        status: Dict[str, Any] = {"state": state, "message": message}
-        if generated is not None:
-            status["generatedDeployment"] = generated["metadata"]["name"]
+        No Job -> create it (plus the per-namespace profiler ServiceAccount
+        and a namespace-scoped Role: read DGDRs + configmaps, write DGDR
+        status, create DGDs — these are SHARED by every DGDR in the
+        namespace, so they carry no owner and are not deleted on DGDR
+        deletion; the Job itself is owned and cascades).
+        Job Failed (backoff exhausted) -> DGDR goes terminal 'failed'.
+        Job Complete but DGDR still non-terminal -> the pod exited in the
+        'pending' retry state (template ConfigMap missing); delete the Job
+        so the next pass re-dispatches — preserving the inline path's
+        retry-until-rendered contract at Job granularity."""
+        ns = self._ns(cr)
+        name = cr["metadata"]["name"]
         try:
-            self.k8s.patch_status(
-                mat.API_VERSION, mat.DGDR_PLURAL, ns, name, status
-            )
+            job = self.k8s.get("batch/v1", "jobs", ns, f"{name}-profiler")
         except ApiError as e:
-            log.warning("DGDR status update failed: %s", e)
+            if not e.not_found:
+                raise
+            job = None
+        if job is not None:
+            conds = {c.get("type"): c.get("status")
+                     for c in (job.get("status") or {}).get("conditions", [])}
+            if conds.get("Failed") == "True":
+                _set_dgdr_status(
+                    self.k8s, ns, name, "failed",
+                    f"profiler pod failed after retries (image {image}); "
+                    "see the Job's pod logs")
+            elif conds.get("Complete") == "True":
+                # pod ran but left the DGDR non-terminal: retryable state
+                self.k8s.delete("batch/v1", "jobs", ns, f"{name}-profiler")
+            return  # running (or just handled): nothing else to write
+        self._ensure_profiler_rbac(ns)
+        self._create_profiler_job(cr, image)
+
+    def _ensure_profiler_rbac(self, ns: str) -> None:
+        sa = "dynamo-tpu-profiler"
+        self.k8s.upsert("v1", "serviceaccounts", ns, {
+            "apiVersion": "v1", "kind": "ServiceAccount",
+            "metadata": {"name": sa, "namespace": ns},
+        })
+        self.k8s.upsert("rbac.authorization.k8s.io/v1", "roles", ns, {
+            "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+            "metadata": {"name": sa, "namespace": ns},
+            "rules": [
+                {"apiGroups": [mat.GROUP],
+                 "resources": [mat.DGDR_PLURAL],
+                 "verbs": ["get", "list"]},
+                {"apiGroups": [mat.GROUP],
+                 "resources": [f"{mat.DGDR_PLURAL}/status"],
+                 "verbs": ["get", "update", "patch"]},
+                {"apiGroups": [mat.GROUP],
+                 "resources": [mat.DGD_PLURAL],
+                 "verbs": ["get", "create", "update", "patch"]},
+                {"apiGroups": [""], "resources": ["configmaps"],
+                 "verbs": ["get", "list"]},
+            ],
+        })
+        self.k8s.upsert("rbac.authorization.k8s.io/v1", "rolebindings", ns, {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": sa, "namespace": ns},
+            "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                        "kind": "Role", "name": sa},
+            "subjects": [{"kind": "ServiceAccount", "name": sa,
+                          "namespace": ns}],
+        })
+
+    def _create_profiler_job(self, cr: Dict[str, Any], image: str) -> None:
+        ns = self._ns(cr)
+        name = cr["metadata"]["name"]
+        owner = [mat.owner_reference(cr)]
+        sa = "dynamo-tpu-profiler"
+        job = {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {
+                "name": f"{name}-profiler",
+                "namespace": ns,
+                "labels": {mat.MANAGED_BY_LABEL: mat.OPERATOR_NAME},
+                "ownerReferences": owner,
+            },
+            "spec": {
+                "backoffLimit": 2,
+                "ttlSecondsAfterFinished": 3600,
+                "template": {
+                    "metadata": {"labels": {
+                        mat.MANAGED_BY_LABEL: mat.OPERATOR_NAME,
+                        f"{mat.GROUP}/profiler-for": name,
+                    }},
+                    "spec": {
+                        "restartPolicy": "Never",
+                        "serviceAccountName": sa,
+                        "containers": [{
+                            "name": "profiler",
+                            "image": image,
+                            "command": [
+                                "python3", "-m", "dynamo_tpu.profiler",
+                                "--dgdr", name, "--namespace", ns,
+                            ],
+                        }],
+                    },
+                },
+            },
+        }
+        try:
+            self.k8s.create("batch/v1", "jobs", ns, job)
+            log.info("profiler Job %s/%s-profiler dispatched (image %s)",
+                     ns, name, image)
+        except ApiError as e:
+            if not e.conflict:  # Job pod specs are immutable: create-once
+                raise
+            return  # raced another pass: it already wrote the status
+        _set_dgdr_status(self.k8s, ns, name, "profiling",
+                         f"profiler pod running ({image})")
 
     # ----------------------------------------------------------------- loop --
     def reconcile_once(self) -> int:
@@ -395,3 +429,110 @@ class Controller:
                 # clean server-side close (timeoutSeconds): resume from the
                 # last seen rv without relisting
             # fell out of the watch: loop back to relist
+
+
+# --------------------------------------------------------------- DGDR core --
+# Module-level so the SAME pipeline serves both homes: inline in the
+# operator (no profilerImage) and inside the dispatched profiler pod
+# (`python -m dynamo_tpu.profiler --dgdr <name>`).
+
+
+def run_dgdr(k8s: K8sClient, cr: Dict[str, Any]) -> None:
+    """Render the DGD from the DGDR's template ConfigMap, apply the SLA
+    sweep, create the DGD (autoApply), and write terminal status."""
+    name = cr["metadata"]["name"]
+    ns = cr["metadata"].get("namespace") or "default"
+    spec = cr.get("spec", {})
+    prof = spec.get("profilingConfig") or {}
+    cm_ref = ((prof.get("config") or {}).get("configMapRef")) or {}
+    template: Optional[Dict[str, Any]] = None
+    if cm_ref.get("name"):
+        try:
+            cm = k8s.get("v1", "configmaps", ns, cm_ref["name"])
+        except ApiError as e:
+            if not e.not_found:
+                raise
+            cm = {}
+        key = cm_ref.get("key") or next(iter(cm.get("data", {})), None)
+        if key and key in cm.get("data", {}):
+            template = _yaml_load(cm["data"][key])
+    if template is None:
+        # Transient: the user may create/fix the ConfigMap after the DGDR
+        # (run-dgdr.sh creates them together; ordering isn't guaranteed).
+        # "pending" is retried on every pass — only render success is
+        # terminal, matching the wholly-missing-ConfigMap (404) path.
+        _set_dgdr_status(k8s, ns, name, "pending",
+                         "waiting for template ConfigMap/key")
+        return
+
+    sla = prof.get("sla") or {}
+    overrides = spec.get("deploymentOverrides") or {}
+    dgd = _render_dgd(cr, template, sla, overrides)
+    if spec.get("autoApply", False):
+        try:
+            k8s.create(mat.API_VERSION, mat.DGD_PLURAL, ns, dgd)
+        except ApiError as e:
+            if not e.conflict:
+                raise
+            k8s.merge_patch(
+                mat.API_VERSION, mat.DGD_PLURAL, ns,
+                dgd["metadata"]["name"], {"spec": dgd["spec"]},
+            )
+    _set_dgdr_status(
+        k8s, ns, name, "successful",
+        f"generated {dgd['metadata']['name']}", generated=dgd,
+    )
+
+
+def _render_dgd(
+    cr: Dict[str, Any],
+    template: Dict[str, Any],
+    sla: Dict[str, Any],
+    overrides: Dict[str, Any],
+) -> Dict[str, Any]:
+    dgd = json.loads(json.dumps(template))  # deep copy
+    dgd.setdefault("metadata", {})
+    dgd["metadata"]["namespace"] = cr["metadata"].get("namespace") or "default"
+    dgd["metadata"].setdefault("name", cr["metadata"]["name"] + "-generated")
+    dgd["metadata"].setdefault("labels", {})[
+        f"{mat.GROUP}/generated-by"
+    ] = cr["metadata"]["name"]
+    # SLA profiling sweep (the aiconfigurator analogue): pick mesh/batch
+    # for the request's isl/osl/ttft/itl on the target TPU system.
+    if sla:
+        try:
+            from dynamo_tpu.profiler.configurator import apply_sla_overrides
+
+            dgd = apply_sla_overrides(
+                dgd, sla,
+                system=(cr["spec"].get("profilingConfig") or {}).get(
+                    "tpuSystem", "v5e-8"
+                ),
+            )
+        except Exception as e:  # warn-and-continue posture: an unknown
+            # model/system must not wedge the reconcile loop — the
+            # template still deploys as written.
+            log.warning("profiler skipped (%s); applying template unchanged", e)
+    workers_image = overrides.get("workersImage")
+    if workers_image:
+        for svc in (dgd.get("spec", {}).get("services") or {}).values():
+            if svc.get("componentType") != "frontend":
+                svc.setdefault("extraPodSpec", {}).setdefault(
+                    "mainContainer", {}
+                )["image"] = workers_image
+    return dgd
+
+
+def _set_dgdr_status(
+    k8s: K8sClient, ns: str, name: str, state: str, message: str,
+    generated: Optional[Dict] = None,
+) -> None:
+    status: Dict[str, Any] = {"state": state, "message": message}
+    if generated is not None:
+        status["generatedDeployment"] = generated["metadata"]["name"]
+    try:
+        k8s.patch_status(
+            mat.API_VERSION, mat.DGDR_PLURAL, ns, name, status
+        )
+    except ApiError as e:
+        log.warning("DGDR status update failed: %s", e)

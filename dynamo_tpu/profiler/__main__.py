@@ -17,9 +17,32 @@ from dynamo_tpu.profiler import get_system, sweep
 from dynamo_tpu.profiler.configurator import disagg_split
 
 
+def run_dgdr_pod(name: str, namespace: str) -> None:
+    """Profiler-pod mode: execute one DGDR's sweep end-to-end against the
+    apiserver — fetch the CR, render + SLA-override + autoApply the DGD,
+    write terminal status. This is the command the operator's dispatched
+    Job runs when `profilingConfig.profilerImage` is set (the reference's
+    profiler-pod topology, /root/reference/examples/dgdr/trtllm/
+    dgdr.yaml:15); the operator's inline path calls the same run_dgdr()."""
+    from dynamo_tpu.operator import materialize as mat
+    from dynamo_tpu.operator.controller import run_dgdr
+    from dynamo_tpu.operator.k8s_client import K8sClient
+
+    k8s = K8sClient.from_env()
+    cr = k8s.get(mat.API_VERSION, mat.DGDR_PLURAL, namespace, name)
+    run_dgdr(k8s, cr)
+    state = (k8s.get(mat.API_VERSION, mat.DGDR_PLURAL, namespace, name)
+             .get("status") or {}).get("state")
+    print(f"dgdr {namespace}/{name}: {state}")
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="dynamo_tpu.profiler")
-    p.add_argument("--model", required=True)
+    p.add_argument("--dgdr", default=None,
+                   help="profiler-pod mode: run this DGDR's sweep against "
+                        "the apiserver and exit")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--model", default=None)
     p.add_argument("--system", default="v5e-8")
     p.add_argument("--isl", type=int, default=4000)
     p.add_argument("--osl", type=int, default=500)
@@ -28,6 +51,12 @@ def main(argv=None) -> None:
     p.add_argument("--top", type=int, default=8, help="candidates to print")
     p.add_argument("--json", action="store_true", help="machine-readable output")
     args = p.parse_args(argv)
+
+    if args.dgdr:
+        run_dgdr_pod(args.dgdr, args.namespace)
+        return
+    if not args.model:
+        p.error("--model is required (unless running --dgdr pod mode)")
 
     cfg = ModelConfig.from_model_name(args.model)
     system = get_system(args.system)

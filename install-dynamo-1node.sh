@@ -57,9 +57,42 @@ else
     '{"metadata":{"annotations":{"storageclass.kubernetes.io/is-default-class":"true"}}}'
 fi
 
+# ---- release resolution ------------------------------------------------------
+# RELEASE_VERSION=local applies deploy/ from this checkout (dev default).
+# Anything else installs a PINNED bundle — dist/dynamo-tpu-install-<ver>.yaml
+# built by `make release-manifests`, or fetched from the release mirror
+# (DYNAMO_RELEASE_BASE_URL) — the analogue of the reference's versioned
+# chart fetch (/root/reference/install-dynamo-1node.sh:182,198).
+RELEASE_BUNDLE=""
+GANG_MANIFEST="${REPO_ROOT}/deploy/gang-scheduler.yaml"
+resolve_release_artifact() {  # $1 = artifact file name; echoes a local path
+  local name="$1" local_path url tmp
+  local_path="${REPO_ROOT}/dist/${name}"
+  if [[ -f "$local_path" ]]; then
+    echo "$local_path"
+    return 0
+  fi
+  url="${DYNAMO_RELEASE_BASE_URL:-https://github.com/dynamo-tpu/dynamo-tpu/releases/download}/${RELEASE_VERSION}/${name}"
+  tmp="$(mktemp "/tmp/${name}.XXXX")"
+  log "fetching ${url}" >&2
+  curl -fsSL -o "$tmp" "$url" || die "release artifact fetch failed: ${url}
+(build it locally with: make release-manifests RELEASE_VERSION=${RELEASE_VERSION})"
+  echo "$tmp"
+}
+if [[ "$RELEASE_VERSION" != "local" ]]; then
+  RELEASE_BUNDLE="$(resolve_release_artifact "dynamo-tpu-install-${RELEASE_VERSION}.yaml")"
+  if [[ "$ENABLE_GANG_SCHEDULING" == "true" ]]; then
+    # pinned release must pin the gang scheduler too — a fetch miss is an
+    # error, not a silent fallback to the (possibly newer) checkout copy
+    GANG_MANIFEST="$(resolve_release_artifact "gang-scheduler-${RELEASE_VERSION}.yaml")"
+  fi
+fi
+
 # ---- step 2: CRDs ------------------------------------------------------------
-log "installing Dynamo-TPU CRDs (release: ${RELEASE_VERSION})"
-kubectl apply -f "${REPO_ROOT}/deploy/crds/"
+if [[ -z "$RELEASE_BUNDLE" ]]; then
+  log "installing Dynamo-TPU CRDs (release: ${RELEASE_VERSION})"
+  kubectl apply -f "${REPO_ROOT}/deploy/crds/"
+fi
 
 # ---- step 3: platform (operator + etcd + NATS) -------------------------------
 log "installing platform into namespace ${NAMESPACE}"
@@ -81,19 +114,29 @@ if [[ "$ENABLE_GANG_SCHEDULING" == "true" ]]; then
   # pods sit Pending forever. Grove/KAI analogue
   # (/root/reference/install-dynamo-1node.sh:207-212).
   log "installing gang (coscheduling) scheduler"
-  kubectl apply -f "${REPO_ROOT}/deploy/gang-scheduler.yaml"
+  kubectl apply -f "$GANG_MANIFEST"
   kubectl wait -n scheduler-plugins --for=condition=Available \
     deployment/scheduler-plugins-scheduler --timeout="$WAIT_TIMEOUT" \
     || log "WARN: gang scheduler not Available yet; gang pods stay Pending until it is"
 fi
 
-kubectl apply -n "$NAMESPACE" -f "${REPO_ROOT}/deploy/platform/"
-# operator.yaml carries its own namespace refs; apply then inject env config.
-# The image ref is parameterized: the checked-in manifest pins the :latest
-# dev tag, sed swaps in $DYNAMO_IMAGE for versioned installs.
-log "operator image: ${DYNAMO_IMAGE}"
-sed "s|dynamo-tpu/runtime:latest|${DYNAMO_IMAGE}|g" \
-  "${REPO_ROOT}/deploy/operator.yaml" | kubectl apply -f -
+if [[ -n "$RELEASE_BUNDLE" ]]; then
+  # pinned bundle: CRDs + platform + operator in one versioned stream;
+  # namespace-less docs land in $NAMESPACE, explicit ones keep their own.
+  # DYNAMO_IMAGE still wins (private-registry mirrors): swap the bundle's
+  # pinned ref the same way the local path swaps the dev tag.
+  log "applying pinned release bundle ${RELEASE_VERSION} (image ${DYNAMO_IMAGE})"
+  sed "s|dynamo-tpu/runtime:${RELEASE_VERSION}|${DYNAMO_IMAGE}|g" \
+    "$RELEASE_BUNDLE" | kubectl apply -n "$NAMESPACE" -f -
+else
+  kubectl apply -n "$NAMESPACE" -f "${REPO_ROOT}/deploy/platform/"
+  # operator.yaml carries its own namespace refs; apply then inject env
+  # config. The image ref is parameterized: the checked-in manifest pins
+  # the :latest dev tag, sed swaps in $DYNAMO_IMAGE.
+  log "operator image: ${DYNAMO_IMAGE}"
+  sed "s|dynamo-tpu/runtime:latest|${DYNAMO_IMAGE}|g" \
+    "${REPO_ROOT}/deploy/operator.yaml" | kubectl apply -f -
+fi
 kubectl set env -n "$OPERATOR_NAMESPACE" \
   deployment/dynamo-tpu-operator-controller-manager "${operator_env[@]}" >/dev/null
 
@@ -107,14 +150,26 @@ kubectl wait -n "$OPERATOR_NAMESPACE" --for=condition=Available \
   deployment/dynamo-tpu-operator-controller-manager --timeout="$WAIT_TIMEOUT"
 
 # ---- step 5: TPU device plugin + metrics exporter ----------------------------
+# Separate versioned artifacts in release mode, so these knobs keep working
+# against a pinned install exactly as they do against the checkout.
 if [[ "$INSTALL_TPU_PLUGIN" == "true" ]]; then
   log "installing TPU device plugin DaemonSet"
-  kubectl apply -f "${REPO_ROOT}/deploy/tpu-device-plugin.yaml"
+  if [[ -n "$RELEASE_BUNDLE" ]]; then
+    kubectl apply -f "$(resolve_release_artifact "tpu-device-plugin-${RELEASE_VERSION}.yaml")"
+  else
+    kubectl apply -f "${REPO_ROOT}/deploy/tpu-device-plugin.yaml"
+  fi
 fi
-if [[ "$INSTALL_TPU_EXPORTER" == "true" && -f "${REPO_ROOT}/deploy/tpu-metrics-exporter.yaml" ]]; then
+if [[ "$INSTALL_TPU_EXPORTER" == "true" ]]; then
   log "installing TPU metrics exporter DaemonSet"
-  sed "s|dynamo-tpu/runtime:latest|${DYNAMO_IMAGE}|g" \
-    "${REPO_ROOT}/deploy/tpu-metrics-exporter.yaml" | kubectl apply -f -
+  if [[ -n "$RELEASE_BUNDLE" ]]; then
+    sed "s|dynamo-tpu/runtime:${RELEASE_VERSION}|${DYNAMO_IMAGE}|g" \
+      "$(resolve_release_artifact "tpu-metrics-exporter-${RELEASE_VERSION}.yaml")" \
+      | kubectl apply -f -
+  else
+    sed "s|dynamo-tpu/runtime:latest|${DYNAMO_IMAGE}|g" \
+      "${REPO_ROOT}/deploy/tpu-metrics-exporter.yaml" | kubectl apply -f -
+  fi
 fi
 
 # ---- step 6: verify google.com/tpu allocatable -------------------------------

@@ -30,6 +30,13 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 8192
     tie_word_embeddings: bool = True
+    # MLP activation: "silu" (Llama/Qwen/Mixtral SwiGLU) or "gelu_tanh"
+    # (Gemma GeGLU)
+    hidden_act: str = "silu"
+    # Gemma conventions: norms scale by (1 + w) instead of w, and the
+    # embedding output is multiplied by sqrt(hidden_size)
+    rms_norm_unit_offset: bool = False
+    embed_scale: bool = False
     # qwen3-style per-head q/k RMSNorm
     qk_norm: bool = False
     # qwen2-style attention bias on q/k/v projections
@@ -101,6 +108,14 @@ class ModelConfig:
         MixtralForCausalLM config keys.
         """
         arch = (cfg.get("architectures") or [""])[0]
+        if arch.startswith(("Gemma2", "Gemma3")):
+            # Gemma 2/3 interleave sliding-window layers and soft-cap attn
+            # logits — neither fits the uniform lax.scan layer body yet
+            raise ValueError(
+                f"{arch} needs alternating sliding-window attention / logit "
+                "soft-capping, which the uniform layer stack doesn't model "
+                "yet; Gemma (v1) is supported")
+        is_gemma = arch.startswith("Gemma")
         num_heads = cfg["num_attention_heads"]
         hidden = cfg["hidden_size"]
         head_dim = cfg.get("head_dim") or hidden // num_heads
@@ -137,7 +152,12 @@ class ModelConfig:
             rope_theta=cfg.get("rope_theta", 10000.0),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
-            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
+            hidden_act="gelu_tanh" if (cfg.get("hidden_activation")
+                                       or cfg.get("hidden_act", "silu")
+                                       ).startswith("gelu") else "silu",
+            rms_norm_unit_offset=is_gemma,
+            embed_scale=is_gemma,
             qk_norm="Qwen3" in arch,
             attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
             num_experts=n_experts,
@@ -318,6 +338,50 @@ PRESETS = {
         v_head_dim=128,
         eos_token_id=100001,
         bos_token_id=100000,
+    ),
+    # Gemma (v1) family: GeGLU activation, (1+w) norms, sqrt(E)-scaled
+    # embeddings, tied head, head_dim 256 (public HF configs). The 2B is
+    # MQA (one KV head) — the smallest-KV serving point in the zoo.
+    "gemma-7b-it": ModelConfig(
+        name="gemma-7b-it",
+        vocab_size=256000,
+        hidden_size=3072,
+        intermediate_size=24576,
+        num_layers=28,
+        num_heads=16,
+        num_kv_heads=16,
+        head_dim=256,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
+        eos_token_id=1,
+        bos_token_id=2,
+    ),
+    "gemma-2b-it": ModelConfig(
+        name="gemma-2b-it",
+        vocab_size=256000,
+        hidden_size=2048,
+        intermediate_size=16384,
+        num_layers=18,
+        num_heads=8,
+        num_kv_heads=1,
+        head_dim=256,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
+        eos_token_id=1,
+        bos_token_id=2,
+    ),
+    "tiny-gemma-debug": ModelConfig(
+        name="tiny-gemma-debug",
+        num_kv_heads=1,  # exercise the MQA path in every engine test
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
     ),
 }
 # Aliases matching the ids used in the reference manifests

@@ -40,10 +40,28 @@ def _dtype(cfg: ModelConfig):
     return jnp.dtype(cfg.dtype)
 
 
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, w: jax.Array, eps: float,
+             unit_offset: bool = False) -> jax.Array:
+    """unit_offset: Gemma checkpoints store norm weights as w with the
+    model applying (1 + w) — zero-init means identity scale."""
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
-    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+    normed = (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return normed * (1.0 + w) if unit_offset else normed * w
+
+
+def _embed_rows(cfg: ModelConfig, params: Params, tokens: jax.Array) -> jax.Array:
+    x = quant.take_rows(params["embed"], tokens, _dtype(cfg))
+    if cfg.embed_scale:
+        # Gemma normalizer: embeddings scale by sqrt(E) (fp32, cast back)
+        x = (x.astype(jnp.float32) * (cfg.hidden_size ** 0.5)).astype(x.dtype)
+    return x
+
+
+def _act(cfg: ModelConfig, g: jax.Array) -> jax.Array:
+    if cfg.hidden_act == "gelu_tanh":  # Gemma GeGLU
+        return jax.nn.gelu(g, approximate=True)
+    return jax.nn.silu(g)
 
 
 def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float]]:
@@ -68,10 +86,12 @@ def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float
     # NOTE: insertion ORDER is load-bearing for existing configs —
     # init_params assigns PRNG subkeys positionally, so reordering names
     # would silently change every random-init weight
+    # Gemma's (1+w) norm convention makes ZERO the identity scale
+    nk = "zeros" if cfg.rms_norm_unit_offset else "ones"
     p = {
         "embed": w((cfg.vocab_size, e), 0.02),
-        "final_norm": ((e,), "ones", 0.0),
-        "attn_norm": ((l, e), "ones", 0.0),
+        "final_norm": ((e,), nk, 0.0),
+        "attn_norm": ((l, e), nk, 0.0),
     }
     if cfg.is_mla:
         # multi-head latent attention (DeepSeek-V2 family): queries project
@@ -90,7 +110,7 @@ def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float
         p["wk"] = w((l, e, kv, d))
         p["wv"] = w((l, e, kv, d))
         p["wo"] = w((l, h, d, e))
-    p["mlp_norm"] = ((l, e), "ones", 0.0)
+    p["mlp_norm"] = ((l, e), nk, 0.0)
     if not cfg.tie_word_embeddings:
         p["lm_head"] = w((e, cfg.vocab_size), 0.02)
     if cfg.attention_bias:
@@ -195,8 +215,8 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array):
         k = k + lp["bk"]
         v = v + lp["bv"]
     if cfg.qk_norm:
-        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v
@@ -223,7 +243,7 @@ def _qkv_mla(cfg: ModelConfig, lp: Params, x: jax.Array,
     q_nope, q_rope = q[..., :nope], q[..., nope:]
     q_rope = apply_rope(q_rope, positions, cfg.rope_theta)
     kv = qeinsum("te,er->tr", x, lp["w_kv_a"])  # [T, lora+rope]
-    c_kv = rms_norm(kv[:, :lora], lp["kv_a_norm"], cfg.rms_norm_eps)
+    c_kv = rms_norm(kv[:, :lora], lp["kv_a_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
     k_rope = apply_rope(kv[:, None, lora:], positions, cfg.rope_theta)[:, 0]
     q_lat = jnp.einsum("thn,hnr->thr", q_nope.astype(jnp.float32),
                        lp["w_uk"].astype(jnp.float32)).astype(q.dtype)
@@ -270,7 +290,7 @@ def _mlp(cfg: ModelConfig, lp: Params, x: jax.Array,
     def dense(x):
         g = qeinsum("te,ef->tf", x, lp["w_gate"])
         u = qeinsum("te,ef->tf", x, lp["w_up"])
-        return qeinsum("tf,fe->te", jax.nn.silu(g) * u, lp["w_down"])
+        return qeinsum("tf,fe->te", _act(cfg, g) * u, lp["w_down"])
 
     if not cfg.is_moe:
         return dense(x)
@@ -311,7 +331,7 @@ class PrefillOut(NamedTuple):
 
 
 def _logits(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
     if cfg.tie_word_embeddings:
         return quant.tied_head_einsum(x, params["embed"])
     return qeinsum("te,ev->tv", x, params["lm_head"])
@@ -336,17 +356,17 @@ def prefill(
     s = tokens.shape[0]
     positions = jnp.arange(s)
     token_mask = positions < seq_len  # padding rows past the true length
-    x = quant.take_rows(params["embed"], tokens, _dtype(cfg))
+    x = _embed_rows(cfg, params, tokens)
 
     def body(x, kp, vp, lp, page_off):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions)
         o = att.prefill_attention(q, k, v, seq_len)
         x = x + _attn_out(cfg, lp, o)
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, pages + page_off, page_size=page_size
         )
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
         return x, kp, vp
 
@@ -390,10 +410,10 @@ def prefill_chunk(
     chunk_pages = jax.lax.dynamic_slice(
         pages, (start // page_size,), (c // page_size,)
     )
-    x = quant.take_rows(params["embed"], tokens, _dtype(cfg))
+    x = _embed_rows(cfg, params, tokens)
 
     def body(x, kp, vp, lp, page_off):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions)
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, chunk_pages + page_off, page_size=page_size
@@ -403,7 +423,7 @@ def prefill_chunk(
             num_kv_heads=cfg.cache_kv_heads,
         )
         x = x + _attn_out(cfg, lp, o)
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
         return x, kp, vp
 
@@ -445,10 +465,10 @@ def prefill_batch(
     n, s = tokens.shape
     positions = jnp.tile(jnp.arange(s), n)  # [N*S] per-lane positions
     token_mask = (jnp.arange(s)[None, :] < seq_lens[:, None]).reshape(-1)
-    x = quant.take_rows(params["embed"], tokens.reshape(-1), _dtype(cfg))
+    x = _embed_rows(cfg, params, tokens.reshape(-1))
 
     def body(x, kp, vp, lp, page_off):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions)  # [N*S, H/KV, D]
         o = jax.vmap(
             lambda qq, kk, vv, sl: att.prefill_attention(qq, kk, vv, sl)
@@ -462,7 +482,7 @@ def prefill_batch(
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, pages.reshape(-1) + page_off, page_size=page_size
         )
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
         return x, kp, vp
 
@@ -523,10 +543,10 @@ def decode_verify(
     valid = (jnp.arange(b * k1) % k1 == 0) | jnp.repeat(room, k1)
     flat_pos = jnp.where(valid, flat_pos, 0)
     flat_tables = jnp.where(valid[:, None], flat_tables, 0)
-    x = quant.take_rows(params["embed"], tokens.reshape(b * k1), _dtype(cfg))
+    x = _embed_rows(cfg, params, tokens.reshape(b * k1))
 
     def body(x, kp, vp, lp, page_off):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, flat_pos)  # [B*K1, H, D], [B*K1, KV, D]
         kp, vp = att.write_kv_token(
             kp, vp, k, v, flat_tables + page_off, flat_pos,
@@ -538,7 +558,7 @@ def decode_verify(
             num_kv_heads=cfg.cache_kv_heads,
         )
         x = x + _attn_out(cfg, lp, o.reshape(b * k1, *o.shape[2:]))
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         x = x + _mlp(cfg, lp, h)
         return x, kp, vp
 
@@ -562,10 +582,10 @@ def decode_step(
     page_size: int,
 ) -> DecodeOut:
     """One continuous-batching decode step over all batch slots."""
-    x = quant.take_rows(params["embed"], tokens, _dtype(cfg))  # [B, E]
+    x = _embed_rows(cfg, params, tokens)  # [B, E]
 
     def body(x, kp, vp, lp, page_off):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions)  # [B,H,D],[B,KV,D]
         tables = block_tables + page_off
         kp, vp = att.write_kv_token(
@@ -576,7 +596,7 @@ def decode_step(
             num_kv_heads=cfg.cache_kv_heads,
         )
         x = x + _attn_out(cfg, lp, o)
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         x = x + _mlp(cfg, lp, h)
         return x, kp, vp
 

@@ -222,8 +222,11 @@ class Controller:
                     f"profiler pod failed after retries (image {image}); "
                     "see the Job's pod logs")
             elif conds.get("Complete") == "True":
-                # pod ran but left the DGDR non-terminal: retryable state
-                self.k8s.delete("batch/v1", "jobs", ns, f"{name}-profiler")
+                # pod ran but left the DGDR non-terminal: retryable state.
+                # Background propagation: a bare API delete would ORPHAN the
+                # Job's completed pod, leaking one pod per retry cycle
+                self.k8s.delete("batch/v1", "jobs", ns, f"{name}-profiler",
+                                propagation="Background")
             return  # running (or just handled): nothing else to write
         self._ensure_profiler_rbac(ns)
         self._create_profiler_job(cr, image)
@@ -441,7 +444,7 @@ def run_dgdr(k8s: K8sClient, cr: Dict[str, Any]) -> None:
     """Render the DGD from the DGDR's template ConfigMap, apply the SLA
     sweep, create the DGD (autoApply), and write terminal status."""
     name = cr["metadata"]["name"]
-    ns = cr["metadata"].get("namespace") or "default"
+    ns = Controller._ns(cr)
     spec = cr.get("spec", {})
     prof = spec.get("profilingConfig") or {}
     cm_ref = ((prof.get("config") or {}).get("configMapRef")) or {}
@@ -492,7 +495,7 @@ def _render_dgd(
 ) -> Dict[str, Any]:
     dgd = json.loads(json.dumps(template))  # deep copy
     dgd.setdefault("metadata", {})
-    dgd["metadata"]["namespace"] = cr["metadata"].get("namespace") or "default"
+    dgd["metadata"]["namespace"] = Controller._ns(cr)
     dgd["metadata"].setdefault("name", cr["metadata"]["name"] + "-generated")
     dgd["metadata"].setdefault("labels", {})[
         f"{mat.GROUP}/generated-by"

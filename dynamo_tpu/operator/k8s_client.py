@@ -231,11 +231,20 @@ class K8sClient:
         )
 
     def delete(
-        self, api_version: str, plural: str, namespace: Optional[str], name: str
+        self, api_version: str, plural: str, namespace: Optional[str],
+        name: str, propagation: Optional[str] = None,
     ) -> None:
+        """propagation: cascade policy ("Background"/"Foreground"). Raw API
+        deletes of batch/v1 Jobs default to ORPHANING their pods (kubectl
+        sets Background itself) — Job callers must pass it explicitly."""
+        body = None
+        if propagation:
+            body = {"kind": "DeleteOptions", "apiVersion": "v1",
+                    "propagationPolicy": propagation}
         try:
             self._request(
-                "DELETE", resource_path(api_version, plural, namespace, name)
+                "DELETE", resource_path(api_version, plural, namespace, name),
+                body=body,
             )
         except ApiError as e:
             if not e.not_found:

@@ -50,6 +50,24 @@ from dynamo_tpu.parallel import sharding as shd
 log = logging.getLogger("dynamo_tpu.engine")
 
 
+def _pack_logit_bias(req: GenRequest):
+    """Pack a request's {token_id: bias} map into fixed [BIAS_K] lanes
+    (-1 = empty) so the jitted sampler stays shape-static. Oversized maps
+    raise — the HTTP layer already rejects them; direct library callers
+    must not have bans silently dropped."""
+    ids = np.full((smp.BIAS_K,), -1, np.int32)
+    vals = np.zeros((smp.BIAS_K,), np.float32)
+    if req.logit_bias:
+        if len(req.logit_bias) > smp.BIAS_K:
+            raise ValueError(
+                f"logit_bias has {len(req.logit_bias)} entries; the engine "
+                f"supports at most {smp.BIAS_K}")
+        for i, (tok, b) in enumerate(req.logit_bias.items()):
+            ids[i] = int(tok)
+            vals[i] = float(b)
+    return ids, vals
+
+
 def _next_bucket(n: int, page_size: int, max_len: int) -> int:
     """Smallest power-of-two multiple of page_size >= n (capped at max_len
     rounded up to a page multiple, so the bucket always page-aligns)."""
@@ -301,6 +319,10 @@ class Engine:
         self.top_k = np.zeros((b,), dtype=np.int32)
         self.presence = np.zeros((b,), dtype=np.float32)
         self.frequency = np.zeros((b,), dtype=np.float32)
+        self.min_p = np.zeros((b,), dtype=np.float32)
+        # fixed-lane logit_bias packing (smp.BIAS_K per request; -1 = empty)
+        self.bias_ids = np.full((b, smp.BIAS_K), -1, dtype=np.int32)
+        self.bias_vals = np.zeros((b, smp.BIAS_K), dtype=np.float32)
         # per-slot PRNG chain roots (seeded requests are deterministic
         # regardless of batch composition; see engine/sampling.py)
         self.slot_keys = np.zeros((b, 2), dtype=np.uint32)
@@ -333,7 +355,8 @@ class Engine:
         # copy and it is rebuilt from mirrors before the next window.
         self._dev_state = None  # (cur_tokens, positions, context_lens, active)
         self._dev_tables = None
-        self._dev_sampling = None  # (temp, top_p, top_k, pres, freq, keys)
+        # (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals, keys)
+        self._dev_sampling = None
         # async scheduling: the decode window whose tokens have been
         # dispatched but not read back yet — (window, ys, want_lp, t0)
         self._pending_win = None
@@ -386,11 +409,13 @@ class Engine:
             )
             return rep(out.last_logits), out.k_pages, out.v_pages
 
-        def sample_first_batch(logits, temperature, top_p, top_k, keys,
-                               positions):
+        def sample_first_batch(logits, temperature, top_p, top_k, min_p,
+                               bias_ids, bias_vals, keys, positions):
             """First tokens for a batched prefill: [N, V] logits with
             per-lane sampling params and per-request key chains."""
-            state = smp.make_state(temperature, top_p, top_k)
+            state = smp.make_state(temperature, top_p, top_k,
+                                   min_p=min_p, bias_ids=bias_ids,
+                                   bias_vals=bias_vals)
             folded = smp.fold_positions(keys, positions)
             return rep(smp.sample_with_logprobs(logits, state, folded))
 
@@ -413,11 +438,12 @@ class Engine:
 
             def window_fn(
                 params, tokens, positions, context_lens, active, block_tables,
-                temperature, top_p, top_k, presence, frequency, slot_keys,
-                counts, k_pages, v_pages,
+                temperature, top_p, top_k, presence, frequency, min_p,
+                bias_ids, bias_vals, slot_keys, counts, k_pages, v_pages,
             ):
                 state = smp.SamplingState(
-                    temperature, top_p, top_k, presence, frequency
+                    temperature, top_p, top_k, presence, frequency,
+                    min_p, bias_ids, bias_vals,
                 )
                 step = active.astype(positions.dtype)  # inactive slots frozen
                 b = tokens.shape[0]
@@ -472,7 +498,8 @@ class Engine:
 
         def spec_fn(params, tokens, drafts, positions, context_lens, active,
                     block_tables, temperature, top_p, top_k, presence,
-                    frequency, slot_keys, counts, room, k_pages, v_pages):
+                    frequency, min_p, bias_ids, bias_vals, slot_keys, counts,
+                    room, k_pages, v_pages):
             """One speculative verify step: current + K draft tokens through
             a single forward, longest-prefix acceptance for pure-greedy
             slots, the normal sampler for the rest (they emit one token per
@@ -488,7 +515,8 @@ class Engine:
                 k_pages, v_pages, page_size=page_size,
             )
             state = smp.SamplingState(
-                temperature, top_p, top_k, presence, frequency
+                temperature, top_p, top_k, presence, frequency,
+                min_p, bias_ids, bias_vals,
             )
             keys = smp.fold_positions(slot_keys, positions)
             t0 = smp.sample(out.logits[:, 0], state, keys, counts)
@@ -498,8 +526,11 @@ class Engine:
             # acceptance only where sampling is pure greedy (no temperature,
             # no penalties): there sample() == argmax, so the accepted chain
             # reproduces sequential decoding exactly
+            # bias shifts argmax, so biased slots must not take the raw
+            # greedy-acceptance shortcut (min_p is moot at temperature 0)
             eligible = ((temperature <= 0.0) & (presence == 0.0)
-                        & (frequency == 0.0) & room & active)
+                        & (frequency == 0.0)
+                        & jnp.all(bias_ids < 0, axis=1) & room & active)
             match = drafts == greedy_all[:, :-1]
             acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
             n_acc = jnp.where(eligible, acc.sum(axis=1), 0)
@@ -516,11 +547,13 @@ class Engine:
             return (rep((emitted, n_acc)), tokens_new, positions + step,
                     context_lens + step, counts, out.k_pages, out.v_pages)
 
-        def sample_first(logits, temperature, top_p, top_k, req_key, pos):
+        def sample_first(logits, temperature, top_p, top_k, min_p,
+                         bias_ids, bias_vals, req_key, pos):
             """First-token sampling after prefill: logits [V] for one request.
-            Penalties don't apply (no output yet); logprobs always computed
-            (one [V] row — negligible)."""
-            state = smp.make_state(temperature, top_p, top_k)
+            Penalties don't apply (no output yet) but logit_bias and min_p
+            do; logprobs always computed (one [V] row — negligible)."""
+            state = smp.make_state(temperature, top_p, top_k, min_p=min_p,
+                                   bias_ids=bias_ids, bias_vals=bias_vals)
             key = jax.random.fold_in(req_key, pos)
             toks, chosen, tids, tvals = smp.sample_with_logprobs(
                 logits[None], state, key[None]
@@ -1012,12 +1045,18 @@ class Engine:
         temp = np.zeros((npad,), np.float32)
         top_p = np.ones((npad,), np.float32)
         top_k = np.zeros((npad,), np.int32)
+        min_p = np.zeros((npad,), np.float32)
+        bias_ids = np.full((npad, smp.BIAS_K), -1, np.int32)
+        bias_vals = np.zeros((npad, smp.BIAS_K), np.float32)
         for i, r in enumerate(reqs):
             keys[i] = np.asarray(self._request_key(r), np.uint32)
             temp[i], top_p[i], top_k[i] = r.temperature, r.top_p, r.top_k
+            min_p[i] = r.min_p
+            bias_ids[i], bias_vals[i] = _pack_logit_bias(r)
         toks, chosen, tids, tvals = self._sample_first_batch(
             logits, jnp.asarray(temp), jnp.asarray(top_p),
-            jnp.asarray(top_k), jnp.asarray(keys),
+            jnp.asarray(top_k), jnp.asarray(min_p), jnp.asarray(bias_ids),
+            jnp.asarray(bias_vals), jnp.asarray(keys),
             jnp.asarray(seq_lens - 1),
         )
         toks_np, chosen_np = np.asarray(toks), np.asarray(chosen)
@@ -1101,11 +1140,15 @@ class Engine:
         req_key = self._request_key(req)
         # the prediction made FROM position prompt_len-1; decode windows fold
         # positions >= prompt_len, so the chains never collide
+        bias_ids, bias_vals = _pack_logit_bias(req)
         tok, chosen, tids, tvals = self._sample_first(
             last_logits,
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_p], jnp.float32),
             jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.min_p], jnp.float32),
+            jnp.asarray(bias_ids[None]),
+            jnp.asarray(bias_vals[None]),
             req_key,
             jnp.int32(prompt_len - 1),
         )
@@ -1142,6 +1185,8 @@ class Engine:
         self.top_k[slot] = req.top_k
         self.presence[slot] = req.presence_penalty
         self.frequency[slot] = req.frequency_penalty
+        self.min_p[slot] = req.min_p
+        self.bias_ids[slot], self.bias_vals[slot] = _pack_logit_bias(req)
         self.slot_keys[slot] = np.asarray(req_key, dtype=np.uint32)
         self.token_counts = self._reset_count(
             self.token_counts, jnp.int32(slot), jnp.int32(first)
@@ -1369,7 +1414,8 @@ class Engine:
             # draft only for slots whose acceptance can be nonzero: pure
             # greedy (the device forces n_acc = 0 for everything else)
             greedy = (seq.temperature <= 0.0 and self.presence[slot] == 0.0
-                      and self.frequency[slot] == 0.0)
+                      and self.frequency[slot] == 0.0
+                      and self.bias_ids[slot].max() < 0)
             if (got == k1 and greedy and seq.num_tokens + k1 <= limit
                     and len(seq.pages) * cfg.page_size >= seq.num_tokens + k1):
                 room[slot] = True
@@ -1385,13 +1431,15 @@ class Engine:
         t0 = time.monotonic()
         self._ensure_dev_state()
         cur, pos, ctx_lens, active_dev = self._dev_state
-        temp, top_p, top_k, pres, freq, keys = self._dev_sampling
+        (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
+         keys) = self._dev_sampling
         d_drafts, d_room = self._upload(drafts, room)
         (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
          self.v_pages) = self._spec(
             self.params, cur, d_drafts, pos, ctx_lens, active_dev,
-            self._dev_tables, temp, top_p, top_k, pres, freq, keys,
-            self.token_counts, d_room, self.k_pages, self.v_pages,
+            self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
+            bias_ids, bias_vals, keys, self.token_counts, d_room,
+            self.k_pages, self.v_pages,
         )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
         slots = list(self.seqs)
@@ -1513,7 +1561,8 @@ class Engine:
         if self._dev_sampling is None:
             self._dev_sampling = self._upload(
                 self.temperature, self.top_p, self.top_k,
-                self.presence, self.frequency, self.slot_keys,
+                self.presence, self.frequency, self.min_p,
+                self.bias_ids, self.bias_vals, self.slot_keys,
             )
 
     def _dispatch_window(self, window: int) -> None:
@@ -1521,13 +1570,14 @@ class Engine:
         self._ensure_dev_state()
         want_lp = any(s.logprobs is not None for s in self.seqs.values())
         cur, pos, ctx_lens, active_dev = self._dev_state
-        temp, top_p, top_k, pres, freq, keys = self._dev_sampling
+        (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
+         keys) = self._dev_sampling
         fn = self._windows[(window > 1, want_lp)]
         (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
          self.v_pages) = fn(
             self.params, cur, pos, ctx_lens, active_dev, self._dev_tables,
-            temp, top_p, top_k, pres, freq, keys, self.token_counts,
-            self.k_pages, self.v_pages,
+            temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
+            keys, self.token_counts, self.k_pages, self.v_pages,
         )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
         # capture membership AT DISPATCH: a slot installed later (disagg
@@ -1611,6 +1661,9 @@ class Engine:
         self.top_k[slot] = 0
         self.presence[slot] = 0.0
         self.frequency[slot] = 0.0
+        self.min_p[slot] = 0.0
+        self.bias_ids[slot] = -1
+        self.bias_vals[slot] = 0.0
         self._free_slots.append(slot)
         self.metrics.num_finished += 1
         # the freed slot's device-side block-table row must stop pointing at

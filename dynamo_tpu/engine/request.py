@@ -4,7 +4,14 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+
+# max logit_bias entries per request (OpenAI caps the map at 300; the
+# engine packs the common small maps into fixed [B, BIAS_K] lanes so the
+# sampler stays shape-static under jit). Lives here — not in sampling.py —
+# so the jax-free frontend/protocol layer can validate against it.
+BIAS_K = 32
 
 
 @dataclasses.dataclass
@@ -22,6 +29,10 @@ class GenRequest:
     seed: Optional[int] = None  # deterministic per-request sampling chain
     presence_penalty: float = 0.0  # subtract if token appeared in output
     frequency_penalty: float = 0.0  # subtract per occurrence in output
+    min_p: float = 0.0  # drop tokens with prob < min_p * max prob (vLLM)
+    # OpenAI logit_bias: {token_id: bias in [-100, 100]} added to logits
+    # (affects greedy too); at most sampling.BIAS_K entries
+    logit_bias: Optional[Dict[int, float]] = None
     logprobs: Optional[int] = None  # None = off; N = return top-N alternatives
     # admission priority (vLLM semantics: LOWER value admits sooner, 0
     # default); FIFO within a priority level. Running sequences are never

@@ -26,33 +26,57 @@ import jax
 import jax.numpy as jnp
 
 
+from dynamo_tpu.engine.request import BIAS_K  # noqa: F401 (re-export)
+
+
 class SamplingState(NamedTuple):
     temperature: jax.Array  # [B] float32; 0 -> greedy
     top_p: jax.Array  # [B] float32 in (0, 1]
     top_k: jax.Array  # [B] int32; 0 -> disabled
     presence_penalty: jax.Array  # [B] float32; 0 -> off
     frequency_penalty: jax.Array  # [B] float32; 0 -> off
+    min_p: jax.Array  # [B] float32 in [0, 1); 0 -> disabled
+    bias_ids: jax.Array  # [B, BIAS_K] int32 token ids; -1 -> empty lane
+    bias_vals: jax.Array  # [B, BIAS_K] float32 logit biases
 
 
-def make_state(temperature, top_p, top_k, presence=None, frequency=None
-               ) -> SamplingState:
-    """Build a SamplingState, defaulting the penalty arrays to zeros."""
+def make_state(temperature, top_p, top_k, presence=None, frequency=None,
+               min_p=None, bias_ids=None, bias_vals=None) -> SamplingState:
+    """Build a SamplingState, defaulting penalties/min_p/bias to off."""
     b = temperature.shape[0]
     zeros = jnp.zeros((b,), jnp.float32)
     return SamplingState(
         temperature, top_p, top_k,
         zeros if presence is None else presence,
         zeros if frequency is None else frequency,
+        zeros if min_p is None else min_p,
+        (jnp.full((b, BIAS_K), -1, jnp.int32)
+         if bias_ids is None else bias_ids),
+        (jnp.zeros((b, BIAS_K), jnp.float32)
+         if bias_vals is None else bias_vals),
     )
 
 
 def _penalized(logits: jax.Array, state: SamplingState,
                counts: jax.Array | None) -> Tuple[jax.Array, jax.Array]:
-    """Apply presence/frequency penalties; return (logits32, greedy).
-
-    The [B, V] penalty arithmetic is skipped (lax.cond) when every slot's
-    penalties are zero — the overwhelmingly common case in the decode loop."""
+    """Apply logit_bias then presence/frequency penalties; return
+    (logits32, greedy). Bias lands BEFORE the greedy argmax — OpenAI's
+    logit_bias shifts the distribution itself, so it steers greedy decoding
+    too. Each [B, V] adjustment is skipped (lax.cond) when every slot has
+    it off — the overwhelmingly common case in the decode loop."""
     logits = logits.astype(jnp.float32)
+
+    def add_bias(lg):
+        rows = jnp.arange(lg.shape[0])[:, None]
+        ids = jnp.clip(state.bias_ids, 0, lg.shape[1] - 1)
+        # empty lanes (-1) AND out-of-vocab ids contribute nothing — a
+        # clamped out-of-range id must not bias the last vocab token
+        valid = (state.bias_ids >= 0) & (state.bias_ids < lg.shape[1])
+        vals = jnp.where(valid, state.bias_vals, 0.0)
+        return lg.at[rows, ids].add(vals)
+
+    any_bias = jnp.any(state.bias_ids >= 0)
+    logits = jax.lax.cond(any_bias, add_bias, lambda lg: lg, logits)
     if counts is not None:
         def apply(lg):
             cf = counts.astype(jnp.float32)
@@ -64,6 +88,15 @@ def _penalized(logits: jax.Array, state: SamplingState,
                           | (state.frequency_penalty != 0.0))
         logits = jax.lax.cond(any_pen, apply, lambda lg: lg, logits)
     return logits, jnp.argmax(logits, axis=-1)
+
+
+def _mask_min_p(scaled: jax.Array, state: SamplingState) -> jax.Array:
+    """min_p (vLLM semantics): keep tokens whose probability under the
+    temperature-scaled distribution is >= min_p * max probability. One
+    softmax, no sort — cheap relative to _mask_topk_topp."""
+    probs = jax.nn.softmax(scaled, axis=-1)
+    floor = state.min_p[:, None] * jnp.max(probs, axis=-1, keepdims=True)
+    return jnp.where(probs < floor, -jnp.inf, scaled)
 
 
 def _mask_topk_topp(scaled: jax.Array, state: SamplingState) -> jax.Array:
@@ -115,6 +148,12 @@ def sample(
         scaled = jax.lax.cond(
             needs_mask, lambda s: _mask_topk_topp(s, state), lambda s: s,
             scaled,
+        )
+        # after top-k/top-p, matching vLLM's filter order; separately
+        # gated so min_p-only batches never pay the sorts above
+        scaled = jax.lax.cond(
+            jnp.any(state.min_p > 0.0),
+            lambda s: _mask_min_p(s, state), lambda s: s, scaled,
         )
         gumbel = jax.vmap(lambda k, row: jax.random.gumbel(k, row.shape))(
             keys, scaled

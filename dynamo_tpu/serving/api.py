@@ -118,6 +118,8 @@ class GenerationHandle:
             top_k=params["top_k"],
             presence_penalty=params.get("presence_penalty", 0.0),
             frequency_penalty=params.get("frequency_penalty", 0.0),
+            min_p=params.get("min_p", 0.0),
+            logit_bias=params.get("logit_bias"),
             seed=None if seed is None else seed + index,
             logprobs=params.get("logprobs"),
             ignore_eos=params.get("ignore_eos", False),
@@ -453,6 +455,10 @@ class _Handler(JsonHTTPHandler):
             temperature=float(body.get("temperature", 0.0)),
             top_p=float(body.get("top_p", 1.0)),
             top_k=int(body.get("top_k", 0)),
+            min_p=float(body.get("min_p", 0.0)),
+            logit_bias={int(k): float(v)
+                        for k, v in (body.get("logit_bias") or {}).items()}
+            or None,
             seed=int(seed) if seed is not None else None,
             logprobs=int(lp) if lp is not None else None,
         )

@@ -170,6 +170,8 @@ class DisaggDecodeClient:
             "temperature": req.temperature,
             "top_p": req.top_p,
             "top_k": req.top_k,
+            "min_p": req.min_p,
+            "logit_bias": req.logit_bias,
             # seeded requests must sample the same first token the agg path
             # would (the prefill worker continues the request's key chain)
             "seed": req.seed,

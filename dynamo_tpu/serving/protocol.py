@@ -43,12 +43,17 @@ def _common_sampling(body: Dict[str, Any]) -> Dict[str, Any]:
     priority = body.get("priority", 0)
     if isinstance(priority, bool) or not isinstance(priority, int):
         raise BadRequest("'priority' must be an integer")
+    min_p = _num(body, "min_p", 0.0)
+    if not 0.0 <= min_p < 1.0:
+        raise BadRequest("'min_p' must be in [0, 1)")
     return {
         "temperature": temperature,
         "top_p": _num(body, "top_p", 1.0),
         "top_k": int(_num(body, "top_k", 0)),
         "presence_penalty": _num(body, "presence_penalty", 0.0),
         "frequency_penalty": _num(body, "frequency_penalty", 0.0),
+        "min_p": min_p,
+        "logit_bias": _parse_logit_bias(body),
         "seed": seed,
         "n": n,
         # admission-priority extension (vLLM semantics: lower = sooner)
@@ -58,6 +63,35 @@ def _common_sampling(body: Dict[str, Any]) -> Dict[str, Any]:
         "include_usage": _include_usage(body),
         "ignore_eos": bool(body.get("ignore_eos", False)),
     }
+
+
+def _parse_logit_bias(body: Dict[str, Any]):
+    """OpenAI logit_bias: {"<token_id>": bias in [-100, 100]}. The engine
+    packs at most BIAS_K entries into fixed lanes — reject larger maps
+    rather than silently dropping biases. {} is a no-op, per OpenAI."""
+    from dynamo_tpu.engine.request import BIAS_K
+
+    lb = body.get("logit_bias")
+    if lb is None or lb == {}:
+        return None
+    if not isinstance(lb, dict):
+        raise BadRequest("'logit_bias' must be an object")
+    if len(lb) > BIAS_K:
+        raise BadRequest(
+            f"'logit_bias' supports at most {BIAS_K} entries")
+    out = {}
+    for k, v in lb.items():
+        try:
+            tok = int(k)
+        except (TypeError, ValueError):
+            raise BadRequest("'logit_bias' keys must be token ids")
+        if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                or not -100.0 <= float(v) <= 100.0:
+            raise BadRequest("'logit_bias' values must be in [-100, 100]")
+        if tok < 0:
+            raise BadRequest("'logit_bias' token ids must be >= 0")
+        out[tok] = float(v)
+    return out
 
 
 def _parse_stop(body: Dict[str, Any]) -> List[str]:

@@ -57,9 +57,11 @@ _SLA_ENV = {"BENCH_PROMPT_LEN": 4000, "BENCH_STEPS": 500, "BENCH_BATCH": 8,
 # (tag, kind, env, timeout_s). kind "bench" runs bench.py; kind "case" runs
 # this file with --case tag in a fresh process.
 MATRIX = [
+    # chip-free prediction row FIRST: it must land even if the tunnel
+    # never comes up this session (the calibration test reads it)
+    ("sla_roofline", "case", {"JAX_PLATFORMS": "cpu"}, 300),
     ("chunk_kernel_parity", "case", {}, 1200),
     ("int8_decode_parity", "case", {}, 1200),
-    ("sla_roofline", "case", {"JAX_PLATFORMS": "cpu"}, 300),
     ("headline", "bench", {}, 5400),
     ("multistep_16", "bench", {"BENCH_MULTISTEP": 16}, 2400),
     ("multistep_32", "bench", {"BENCH_MULTISTEP": 32}, 2400),

@@ -24,7 +24,7 @@ from dynamo_tpu.engine.request import GenRequest
 
 
 def _state(b, temperature=1.0, presence=0.0, frequency=0.0):
-    return smp.SamplingState(
+    return smp.make_state(
         jnp.full((b,), temperature, jnp.float32),
         jnp.ones((b,), jnp.float32),
         jnp.zeros((b,), jnp.int32),

@@ -232,6 +232,12 @@ class Engine:
                 raise ValueError(
                     "sequence_parallel composes with tensor_parallel only "
                     "(set --dp/--ep to 1)")
+            if (model_cfg.sliding_window > 0
+                    or model_cfg.attn_logit_softcapping > 0):
+                raise ValueError(
+                    "sequence_parallel does not support sliding-window/"
+                    "softcap (gemma-2-family) models yet — the ring/Ulysses "
+                    "prefill has neither a window mask nor score capping")
             # fail fast on a bad strategy: the env var is read at trace
             # time inside the jitted prefill (baked into the compiled
             # executable — a process-start setting, not a live knob), so

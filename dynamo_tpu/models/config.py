@@ -37,6 +37,27 @@ class ModelConfig:
     # embedding output is multiplied by sqrt(hidden_size)
     rms_norm_unit_offset: bool = False
     embed_scale: bool = False
+    # Gemma-2 family:
+    # sliding_window > 0 interleaves local-attention layers — layer i is
+    # GLOBAL iff (i+1) % sliding_window_pattern == 0 (gemma-2: pattern 2 =
+    # even layers local, matching HF's `not bool(layer_idx % 2)`), else
+    # attends only to the last `sliding_window` positions. KV pages are
+    # kept in full (masking enforces the window), and sliding models run
+    # the XLA attention paths (the Pallas kernels don't window yet).
+    sliding_window: int = 0
+    sliding_window_pattern: int = 2
+    # soft caps: cap * tanh(x / cap) on attention scores / final logits
+    attn_logit_softcapping: float = 0.0
+    final_logit_softcapping: float = 0.0
+    # query scaling override: attention scales by query_pre_attn_scalar
+    # ^-0.5 instead of head_dim^-0.5 when > 0 (gemma-2 uses 256 even
+    # where head_dim is 128)
+    query_pre_attn_scalar: float = 0.0
+    # gemma-2/3 sandwich norms: extra RMSNorms on the attention and MLP
+    # OUTPUTS (post_attention_layernorm / post_feedforward_layernorm in HF
+    # naming — note HF llama's "post_attention_layernorm" is the PRE-MLP
+    # norm; gemma-2's is genuinely post-attention)
+    post_norms: bool = False
     # qwen3-style per-head q/k RMSNorm
     qk_norm: bool = False
     # qwen2-style attention bias on q/k/v projections
@@ -108,14 +129,15 @@ class ModelConfig:
         MixtralForCausalLM config keys.
         """
         arch = (cfg.get("architectures") or [""])[0]
-        if arch.startswith(("Gemma2", "Gemma3")):
-            # Gemma 2/3 interleave sliding-window layers and soft-cap attn
-            # logits — neither fits the uniform lax.scan layer body yet
+        if arch.startswith("Gemma3"):
+            # Gemma 3 mixes per-layer rope bases (local 10k / global 1M
+            # with scaling) — not modeled by the single-theta rope yet
             raise ValueError(
-                f"{arch} needs alternating sliding-window attention / logit "
-                "soft-capping, which the uniform layer stack doesn't model "
-                "yet; Gemma (v1) is supported")
+                f"{arch} needs per-layer rope bases, which the single-theta "
+                "rope doesn't model yet; Gemma (v1) and Gemma-2 are "
+                "supported")
         is_gemma = arch.startswith("Gemma")
+        is_gemma2 = arch.startswith("Gemma2")
         num_heads = cfg["num_attention_heads"]
         hidden = cfg["hidden_size"]
         head_dim = cfg.get("head_dim") or hidden // num_heads
@@ -158,6 +180,15 @@ class ModelConfig:
                                        ).startswith("gelu") else "silu",
             rms_norm_unit_offset=is_gemma,
             embed_scale=is_gemma,
+            sliding_window=(int(cfg.get("sliding_window") or 0)
+                            if is_gemma2 else 0),
+            attn_logit_softcapping=float(
+                cfg.get("attn_logit_softcapping") or 0.0),
+            final_logit_softcapping=float(
+                cfg.get("final_logit_softcapping") or 0.0),
+            query_pre_attn_scalar=float(
+                cfg.get("query_pre_attn_scalar") or 0.0),
+            post_norms=is_gemma2,
             qk_norm="Qwen3" in arch,
             attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
             num_experts=n_experts,
@@ -382,6 +413,63 @@ PRESETS = {
         hidden_act="gelu_tanh",
         rms_norm_unit_offset=True,
         embed_scale=True,
+    ),
+    # Gemma-2 family: sandwich norms, interleaved sliding-window layers,
+    # attn/final logit soft-caps, query_pre_attn_scalar (public HF configs)
+    "gemma-2-9b-it": ModelConfig(
+        name="gemma-2-9b-it",
+        vocab_size=256000,
+        hidden_size=3584,
+        intermediate_size=14336,
+        num_layers=42,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=256,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
+        sliding_window=4096,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        query_pre_attn_scalar=256.0,
+        post_norms=True,
+        eos_token_id=1,
+        bos_token_id=2,
+    ),
+    "gemma-2-2b-it": ModelConfig(
+        name="gemma-2-2b-it",
+        vocab_size=256000,
+        hidden_size=2304,
+        intermediate_size=9216,
+        num_layers=26,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=256,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
+        sliding_window=4096,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        query_pre_attn_scalar=256.0,
+        post_norms=True,
+        eos_token_id=1,
+        bos_token_id=2,
+    ),
+    "tiny-gemma2-debug": ModelConfig(
+        name="tiny-gemma2-debug",
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
+        sliding_window=8,  # tiny: windows engage within test prompts
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        query_pre_attn_scalar=64.0,  # != head_dim 32: scaling exercised
+        post_norms=True,
     ),
 }
 # Aliases matching the ids used in the reference manifests

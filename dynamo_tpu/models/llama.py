@@ -64,6 +64,33 @@ def _act(cfg: ModelConfig, g: jax.Array) -> jax.Array:
     return jax.nn.silu(g)
 
 
+def _attn_kwargs(cfg: ModelConfig, page_off, pages_per_layer: int) -> dict:
+    """window/logit_cap kwargs for the attention ops (gemma-2 family).
+
+    Sliding-window models derive THIS layer's window from the scanned
+    body's page offset (its only layer handle): layer (i+1) %
+    sliding_window_pattern == 0 is global (window 0 = unbounded through
+    the same traced scalar). Dense models return {} so the Pallas
+    dispatch path is untouched."""
+    kw = {}
+    if cfg.attn_logit_softcapping > 0.0:
+        kw["logit_cap"] = cfg.attn_logit_softcapping
+    if cfg.sliding_window > 0:
+        layer = page_off // pages_per_layer
+        is_global = (layer + 1) % cfg.sliding_window_pattern == 0
+        kw["window"] = jnp.where(is_global, 0,
+                                 cfg.sliding_window).astype(jnp.int32)
+    return kw
+
+
+def _post(cfg: ModelConfig, lp: Params, name: str, y: jax.Array) -> jax.Array:
+    """Gemma-2 sandwich norm on a residual-branch OUTPUT (post_attn_norm /
+    post_mlp_norm); identity for every other family."""
+    if not cfg.post_norms:
+        return y
+    return rms_norm(y, lp[name], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
+
+
 def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float]]:
     """Shape/init spec for every parameter: name -> (shape, kind, sigma).
 
@@ -111,6 +138,9 @@ def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float
         p["wv"] = w((l, e, kv, d))
         p["wo"] = w((l, h, d, e))
     p["mlp_norm"] = ((l, e), nk, 0.0)
+    if cfg.post_norms:  # gemma-2 sandwich norms on branch outputs
+        p["post_attn_norm"] = ((l, e), nk, 0.0)
+        p["post_mlp_norm"] = ((l, e), nk, 0.0)
     if not cfg.tie_word_embeddings:
         p["lm_head"] = w((e, cfg.vocab_size), 0.02)
     if cfg.attention_bias:
@@ -219,6 +249,12 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array):
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
+    if cfg.query_pre_attn_scalar > 0:
+        # the attention ops scale scores by head_dim^-0.5; gemma-2 wants
+        # query_pre_attn_scalar^-0.5 — pre-scale q by the ratio so the
+        # ops stay signature-free of it
+        q = q * jnp.asarray(
+            (cfg.head_dim / cfg.query_pre_attn_scalar) ** 0.5, q.dtype)
     return q, k, v
 
 
@@ -333,8 +369,13 @@ class PrefillOut(NamedTuple):
 def _logits(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
     if cfg.tie_word_embeddings:
-        return quant.tied_head_einsum(x, params["embed"])
-    return qeinsum("te,ev->tv", x, params["lm_head"])
+        out = quant.tied_head_einsum(x, params["embed"])
+    else:
+        out = qeinsum("te,ev->tv", x, params["lm_head"])
+    if cfg.final_logit_softcapping > 0.0:  # gemma-2
+        cap = cfg.final_logit_softcapping
+        out = cap * jnp.tanh(out / cap)
+    return out
 
 
 def prefill(
@@ -361,13 +402,17 @@ def prefill(
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions)
-        o = att.prefill_attention(q, k, v, seq_len)
-        x = x + _attn_out(cfg, lp, o)
+        o = att.prefill_attention(
+            q, k, v, seq_len,
+            **_attn_kwargs(cfg, page_off, k_pages.shape[1]))
+        x = x + _post(cfg, lp, "post_attn_norm", _attn_out(cfg, lp, o))
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, pages + page_off, page_size=page_size
         )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
+        x = x + _post(cfg, lp, "post_mlp_norm",
+                  _mlp(cfg, lp, h, token_mask=token_mask,
+                       allow_capacity=True))
         return x, kp, vp
 
     x, k_pages, v_pages = _scan_layers_paged(
@@ -421,10 +466,13 @@ def prefill_chunk(
         o = att.chunk_attention(
             q, kp, vp, pages + page_off, start, page_size=page_size,
             num_kv_heads=cfg.cache_kv_heads,
+            **_attn_kwargs(cfg, page_off, k_pages.shape[1]),
         )
-        x = x + _attn_out(cfg, lp, o)
+        x = x + _post(cfg, lp, "post_attn_norm", _attn_out(cfg, lp, o))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
+        x = x + _post(cfg, lp, "post_mlp_norm",
+                  _mlp(cfg, lp, h, token_mask=token_mask,
+                       allow_capacity=True))
         return x, kp, vp
 
     x, k_pages, v_pages = _scan_layers_paged(
@@ -470,20 +518,25 @@ def prefill_batch(
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions)  # [N*S, H/KV, D]
+        akw = _attn_kwargs(cfg, page_off, k_pages.shape[1])
         o = jax.vmap(
-            lambda qq, kk, vv, sl: att.prefill_attention(qq, kk, vv, sl)
+            lambda qq, kk, vv, sl: att.prefill_attention(
+                qq, kk, vv, sl, **akw)
         )(
             q.reshape(n, s, *q.shape[1:]),
             k.reshape(n, s, *k.shape[1:]),
             v.reshape(n, s, *v.shape[1:]),
             seq_lens,
         )
-        x = x + _attn_out(cfg, lp, o.reshape(n * s, *o.shape[2:]))
+        x = x + _post(cfg, lp, "post_attn_norm",
+                  _attn_out(cfg, lp, o.reshape(n * s, *o.shape[2:])))
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, pages.reshape(-1) + page_off, page_size=page_size
         )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        x = x + _mlp(cfg, lp, h, token_mask=token_mask, allow_capacity=True)
+        x = x + _post(cfg, lp, "post_mlp_norm",
+                  _mlp(cfg, lp, h, token_mask=token_mask,
+                       allow_capacity=True))
         return x, kp, vp
 
     x, k_pages, v_pages = _scan_layers_paged(
@@ -556,10 +609,12 @@ def decode_verify(
             q.reshape(b, k1, *q.shape[1:]), kp, vp,
             block_tables + page_off, positions, page_size=page_size,
             num_kv_heads=cfg.cache_kv_heads,
+            **_attn_kwargs(cfg, page_off, k_pages.shape[1]),
         )
-        x = x + _attn_out(cfg, lp, o.reshape(b * k1, *o.shape[2:]))
+        x = x + _post(cfg, lp, "post_attn_norm",
+                  _attn_out(cfg, lp, o.reshape(b * k1, *o.shape[2:])))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        x = x + _mlp(cfg, lp, h)
+        x = x + _post(cfg, lp, "post_mlp_norm", _mlp(cfg, lp, h))
         return x, kp, vp
 
     x, k_pages, v_pages = _scan_layers_paged(
@@ -594,10 +649,11 @@ def decode_step(
         o = att.paged_attention_decode(
             q, kp, vp, tables, context_lens, page_size=page_size,
             num_kv_heads=cfg.cache_kv_heads,
+            **_attn_kwargs(cfg, page_off, k_pages.shape[1]),
         )
-        x = x + _attn_out(cfg, lp, o)
+        x = x + _post(cfg, lp, "post_attn_norm", _attn_out(cfg, lp, o))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        x = x + _mlp(cfg, lp, h)
+        x = x + _post(cfg, lp, "post_mlp_norm", _mlp(cfg, lp, h))
         return x, kp, vp
 
     x, k_pages, v_pages = _scan_layers_paged(

@@ -159,9 +159,24 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
     p["attn_norm"] = stack(
         "model.layers.{i}.input_layernorm.weight", lambda w: to_dt(w)
     )
-    p["mlp_norm"] = stack(
-        "model.layers.{i}.post_attention_layernorm.weight", lambda w: to_dt(w)
-    )
+    if cfg.post_norms:
+        # gemma-2 sandwich norms: HF's post_attention_layernorm here is
+        # genuinely post-attention (llama's same-named key is the PRE-MLP
+        # norm), the pre-MLP norm is pre_feedforward_layernorm
+        p["mlp_norm"] = stack(
+            "model.layers.{i}.pre_feedforward_layernorm.weight", to_dt
+        )
+        p["post_attn_norm"] = stack(
+            "model.layers.{i}.post_attention_layernorm.weight", to_dt
+        )
+        p["post_mlp_norm"] = stack(
+            "model.layers.{i}.post_feedforward_layernorm.weight", to_dt
+        )
+    else:
+        p["mlp_norm"] = stack(
+            "model.layers.{i}.post_attention_layernorm.weight",
+            lambda w: to_dt(w)
+        )
     if cfg.is_mla:
         # DeepSeek-V2-family MLA names: q_proj, kv_a_proj_with_mqa (latent
         # down-projection + shared rope key), kv_a_layernorm, and

@@ -299,6 +299,14 @@ def write_kv_prefill(
     return k_pages, v_pages
 
 
+def _softcap(scores: jax.Array, logit_cap: float) -> jax.Array:
+    """Gemma-2-style score capping: cap * tanh(x / cap). Applied BEFORE
+    masking (tanh of the mask's -inf would be nan)."""
+    if logit_cap and logit_cap > 0.0:
+        return logit_cap * jnp.tanh(scores / logit_cap)
+    return scores
+
+
 def paged_attention_decode_xla(
     q: jax.Array,  # [B, H, D] — one query token per sequence
     k_pages: jax.Array,  # [P, ps, KV*D] (or int8 packed rows)
@@ -309,6 +317,8 @@ def paged_attention_decode_xla(
     page_size: int,
     num_kv_heads=None,
     lane_blocks=None,
+    window=None,  # traced scalar: attend only the last `window` positions
+    logit_cap: float = 0.0,
 ) -> jax.Array:
     """Reference paged decode attention (gather + masked softmax).
 
@@ -331,8 +341,14 @@ def paged_attention_decode_xla(
     v = repeat_kv(v, n_heads // n_kv, axis=1)
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
     scores = jnp.einsum("bhd,bhsd->bhs", q * scale, k)
+    scores = _softcap(scores, logit_cap)
     span = jnp.arange(pmax * page_size)[None, None, :]
     mask = span < context_lens[:, None, None]
+    if window is not None:
+        # sliding window (gemma-2 local layers): a GLOBAL layer passes
+        # window=0 through the same traced value — no lower bound then
+        lower = jnp.where(window > 0, context_lens - window, 0)
+        mask &= span >= lower[:, None, None]
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhs,bhsd->bhd", probs, v)
@@ -343,6 +359,9 @@ def prefill_attention_xla(
     k: jax.Array,  # [S, KV, D]
     v: jax.Array,
     seq_len,  # int or scalar array: true (unpadded) length
+    *,
+    window=None,
+    logit_cap: float = 0.0,
 ) -> jax.Array:
     """Causal self-attention over a single padded prompt."""
     s, n_heads, head_dim = q.shape
@@ -351,9 +370,12 @@ def prefill_attention_xla(
     v = repeat_kv(v, n_heads // n_kv, axis=1)
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
     scores = jnp.einsum("qhd,khd->hqk", q * scale, k)
+    scores = _softcap(scores, logit_cap)
     qi = jnp.arange(s)[:, None]
     ki = jnp.arange(s)[None, :]
     mask = (ki <= qi) & (ki < seq_len)
+    if window is not None:
+        mask &= jnp.where(window > 0, ki > qi - window, True)
     scores = jnp.where(mask[None], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("hqk,khd->qhd", probs, v)
@@ -368,6 +390,8 @@ def chunk_attention(
     *,
     page_size: int,
     num_kv_heads=None,
+    window=None,
+    logit_cap: float = 0.0,
 ) -> jax.Array:
     """Chunked-prefill attention: C chunk queries over the sequence's cached
     pages (prefix + the chunk itself, already written) with a causal mask in
@@ -396,6 +420,8 @@ def chunk_attention(
 
         backend = (_resolve_backend() if _pa.CHUNK_KERNEL_HW_VALIDATED
                    else "xla")
+    if window is not None or logit_cap:
+        backend = "xla"  # sliding window / softcap: kernel doesn't model them
     if backend in ("pallas", "pallas_interpret") \
             and _seq_parallel_mesh() is not None:
         # see the decode dispatch's seq-mesh note
@@ -460,9 +486,13 @@ def chunk_attention(
     v = repeat_kv(v, n_heads // n_kv, axis=1)
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
     scores = jnp.einsum("chd,shd->hcs", q * scale, k)
+    scores = _softcap(scores, logit_cap)
     qpos = start + jnp.arange(c)[None, :, None]
     kpos = jnp.arange(s_ctx)[None, None, :]
-    scores = jnp.where(kpos <= qpos, scores, jnp.finfo(scores.dtype).min)
+    mask = kpos <= qpos
+    if window is not None:
+        mask &= jnp.where(window > 0, kpos > qpos - window, True)
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("hcs,shd->chd", probs, v)
 
@@ -476,6 +506,8 @@ def verify_attention(
     *,
     page_size: int,
     num_kv_heads=None,
+    window=None,
+    logit_cap: float = 0.0,
 ) -> jax.Array:
     """Speculative-verification attention: query j of sequence b sits at
     absolute position `positions[b] + j` and attends causally over the
@@ -501,9 +533,13 @@ def verify_attention(
     v = repeat_kv(v, n_heads // n_kv, axis=2)
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
     scores = jnp.einsum("bqhd,bshd->bhqs", q * scale, k)
+    scores = _softcap(scores, logit_cap)
     qpos = positions[:, None, None, None] + jnp.arange(k1)[None, None, :, None]
     spos = jnp.arange(s_ctx)[None, None, None, :]
-    scores = jnp.where(spos <= qpos, scores, jnp.finfo(scores.dtype).min)
+    mask = spos <= qpos
+    if window is not None:
+        mask &= jnp.where(window > 0, spos > qpos - window, True)
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqs,bshd->bqhd", probs, v)
 
@@ -555,8 +591,13 @@ def paged_attention_decode(
     *,
     page_size: int,
     num_kv_heads=None,
+    window=None,
+    logit_cap: float = 0.0,
 ) -> jax.Array:
     backend = _resolve_backend()
+    windowed = window is not None or bool(logit_cap)
+    if windowed:
+        backend = "xla"  # sliding window / softcap: kernel doesn't model them
     if backend != "xla" and _seq_parallel_mesh() is not None:
         # long-context (seq) mesh: the pool is GSPMD-sharded on `model`,
         # and an unannotated pallas_call would force an all-gather of the
@@ -569,6 +610,10 @@ def paged_attention_decode(
                 "mesh; using the XLA gather path")
         backend = "xla"
     mesh = _mesh_for_shard_map()
+    if windowed:
+        # the traced per-layer `window` scalar can't be closed over by an
+        # explicit shard_map body — let GSPMD place the windowed op
+        mesh = None
     n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
     tp = _mesh_tp(mesh)
     quantized = k_pages.dtype == jnp.int8
@@ -611,6 +656,7 @@ def paged_attention_decode(
             return paged_attention_decode_xla(
                 q, kp, vp, bt, cl, page_size=page_size,
                 num_kv_heads=n_kv_call, lane_blocks=lb_call,
+                window=window, logit_cap=logit_cap,
             )
     else:
         from dynamo_tpu.ops import pallas_attention as pa
@@ -649,8 +695,20 @@ def prefill_attention(
     k: jax.Array,  # [S, KV, D]
     v: jax.Array,
     seq_len,  # int or scalar array: true (unpadded) length
+    *,
+    window=None,
+    logit_cap: float = 0.0,
 ) -> jax.Array:
     sp_mesh = _seq_parallel_mesh()
+    if (window is not None or logit_cap) and sp_mesh is not None:
+        # the ring/Ulysses paths don't model windows/caps; the Engine
+        # rejects --sp for sliding-window models before we ever get here
+        raise ValueError(
+            "sequence-parallel prefill does not support sliding-window/"
+            "softcap models")
+    if window is not None or logit_cap:
+        return prefill_attention_xla(q, k, v, seq_len, window=window,
+                                     logit_cap=logit_cap)
     if sp_mesh is not None:
         # Long-context path: sequence sharded over the `seq` axis (the
         # reference has no analogue — SURVEY.md §5). Strategy via

@@ -47,12 +47,6 @@ def test_from_hf_config_maps_gemma():
         assert getattr(cfg, f) == getattr(preset, f), f
 
 
-def test_gemma2_rejected_loudly():
-    with pytest.raises(ValueError, match="sliding-window"):
-        ModelConfig.from_hf_config(
-            {**GEMMA_HF, "architectures": ["Gemma2ForCausalLM"]})
-
-
 def test_unit_offset_norm_and_zero_identity_init():
     x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32)), jnp.float32)
     base = llama.rms_norm(x, jnp.ones((32,)), 1e-6)
@@ -88,3 +82,152 @@ def test_gemma_engine_serves_mqa_end_to_end():
     out2 = eng.generate(GenRequest("g2", prompt, max_tokens=8,
                                    temperature=0.0, ignore_eos=True))
     assert len(out1) == 8 and out1 == out2
+
+
+# ------------------------------------------------------------- gemma-2 ----
+
+GEMMA2_HF = {
+    "architectures": ["Gemma2ForCausalLM"],
+    "model_type": "gemma2",
+    "vocab_size": 256000,
+    "hidden_size": 3584,
+    "intermediate_size": 14336,
+    "num_hidden_layers": 42,
+    "num_attention_heads": 16,
+    "num_key_value_heads": 8,
+    "head_dim": 256,
+    "hidden_activation": "gelu_pytorch_tanh",
+    "rms_norm_eps": 1e-6,
+    "rope_theta": 10000.0,
+    "max_position_embeddings": 8192,
+    "sliding_window": 4096,
+    "attn_logit_softcapping": 50.0,
+    "final_logit_softcapping": 30.0,
+    "query_pre_attn_scalar": 256,
+    "eos_token_id": 1,
+    "bos_token_id": 2,
+}
+
+
+def test_from_hf_config_maps_gemma2():
+    cfg = ModelConfig.from_hf_config(GEMMA2_HF, name="gemma-2-9b-it")
+    preset = PRESETS["gemma-2-9b-it"]
+    for f in ("hidden_size", "intermediate_size", "num_layers", "num_heads",
+              "num_kv_heads", "head_dim", "hidden_act", "sliding_window",
+              "attn_logit_softcapping", "final_logit_softcapping",
+              "query_pre_attn_scalar", "post_norms", "rms_norm_unit_offset",
+              "embed_scale", "tie_word_embeddings"):
+        assert getattr(cfg, f) == getattr(preset, f), f
+
+
+def test_gemma3_still_rejected():
+    with pytest.raises(ValueError, match="per-layer rope"):
+        ModelConfig.from_hf_config(
+            {**GEMMA2_HF, "architectures": ["Gemma3ForCausalLM"]})
+
+
+def test_gemma2_param_specs_have_sandwich_norms():
+    cfg = PRESETS["tiny-gemma2-debug"]
+    specs = llama.param_specs(cfg)
+    assert "post_attn_norm" in specs and "post_mlp_norm" in specs
+    assert specs["post_attn_norm"][1] == "zeros"  # (1+w) identity init
+
+
+def test_gemma2_sliding_window_actually_masks():
+    """Same weights, same long prompt: a distant-token perturbation must
+    change logits on a GLOBAL-attention variant but NOT on the local
+    (sliding-window) variant — proof the window mask is real."""
+    import dataclasses
+
+    import jax
+
+    base = dataclasses.replace(
+        PRESETS["tiny-gemma2-debug"], num_layers=1, dtype="float32",
+        sliding_window=4, sliding_window_pattern=2)  # layer 0: LOCAL (w=4)
+    glob = dataclasses.replace(base, sliding_window=0, post_norms=True)
+    params = llama.init_params(base, jax.random.PRNGKey(0))
+
+    page_size, n_pages = 4, 16
+    kv_shape = (1, n_pages, page_size, base.num_kv_heads * base.head_dim)
+    toks = jnp.asarray([9, 8, 7, 6, 5, 4, 3, 2, 1, 2, 3, 4], jnp.int32)
+    toks2 = toks.at[1].set(100)  # perturb a token >window positions back
+    pages = jnp.arange(1, 4, dtype=jnp.int32)
+
+    def last_logits(cfg, t):
+        out = llama.prefill(cfg, params, t, jnp.int32(12),
+                            jnp.zeros(kv_shape, jnp.float32),
+                            jnp.zeros(kv_shape, jnp.float32),
+                            pages, page_size=page_size)
+        return np.asarray(out.last_logits)
+
+    # windowed: position 1 is outside the last-4 window of position 11
+    np.testing.assert_allclose(last_logits(base, toks),
+                               last_logits(base, toks2), atol=1e-5)
+    # global attention DOES see it
+    assert np.abs(last_logits(glob, toks)
+                  - last_logits(glob, toks2)).max() > 1e-4
+
+
+def test_gemma2_engine_end_to_end_deterministic():
+    """tiny-gemma2-debug (sandwich norms + window + caps + qpas) serves
+    through the whole engine: prefill, paged decode crossing the window,
+    chunked prefill — greedy deterministic across runs."""
+    eng = Engine(EngineConfig(model="tiny-gemma2-debug", page_size=4,
+                              num_pages=64, max_num_seqs=2, max_seq_len=48,
+                              seed=5))
+    prompt = list(range(3, 19))  # 16 tokens: > sliding_window (8)
+    a = eng.generate(GenRequest("a", prompt, max_tokens=10, temperature=0.0,
+                                ignore_eos=True))
+    b = eng.generate(GenRequest("b", prompt, max_tokens=10, temperature=0.0,
+                                ignore_eos=True))
+    assert a == b and len(a) == 10
+
+    # chunked prefill path must agree with whole-prompt prefill
+    eng2 = Engine(EngineConfig(model="tiny-gemma2-debug", page_size=4,
+                               num_pages=64, max_num_seqs=2, max_seq_len=48,
+                               seed=5, prefill_chunk_tokens=8),
+                  params=eng.params)
+    c = eng2.generate(GenRequest("c", prompt, max_tokens=10, temperature=0.0,
+                                 ignore_eos=True))
+    assert c == a, "chunked prefill diverged from whole-prompt on gemma-2"
+
+
+def test_gemma2_decode_window_matches_prefill():
+    """Decode-side window parity: the last-token logits from a WHOLE
+    prefill of n tokens must equal prefill(n-1) + one paged decode_step of
+    token n — on a config where the window actually bites. Catches
+    decode-only off-by-ones in the `context_lens - window` lower bound
+    that the prefill-only mask test cannot see."""
+    import dataclasses
+
+    import jax
+
+    cfg = dataclasses.replace(
+        PRESETS["tiny-gemma2-debug"], num_layers=2, dtype="float32",
+        sliding_window=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(1))
+    page_size, n_pages = 4, 16
+    kv_shape = (cfg.num_layers, n_pages, page_size,
+                cfg.num_kv_heads * cfg.head_dim)
+    toks = jnp.asarray([9, 8, 7, 6, 5, 4, 3, 2, 1, 2, 3, 4], jnp.int32)
+    pages = jnp.arange(1, 4, dtype=jnp.int32)
+
+    whole = llama.prefill(cfg, params, toks, jnp.int32(12),
+                          jnp.zeros(kv_shape, jnp.float32),
+                          jnp.zeros(kv_shape, jnp.float32),
+                          pages, page_size=page_size)
+
+    pre = llama.prefill(cfg, params, toks, jnp.int32(11),
+                        jnp.zeros(kv_shape, jnp.float32),
+                        jnp.zeros(kv_shape, jnp.float32),
+                        pages, page_size=page_size)
+    # prefill wrote all 12 K/V rows (padded write) but only attended 11;
+    # decode token 12 at position 11 over the same pages
+    bt = jnp.zeros((1, 4), jnp.int32).at[0, :3].set(pages)
+    out = llama.decode_step(cfg, params,
+                            toks[11:12], jnp.asarray([11], jnp.int32),
+                            bt, jnp.asarray([12], jnp.int32),
+                            pre.k_pages, pre.v_pages, page_size=page_size)
+    np.testing.assert_allclose(np.asarray(out.logits[0]),
+                               np.asarray(whole.last_logits),
+                               rtol=2e-4, atol=2e-4)

@@ -53,6 +53,12 @@ class ModelConfig:
     # ^-0.5 instead of head_dim^-0.5 when > 0 (gemma-2 uses 256 even
     # where head_dim is 128)
     query_pre_attn_scalar: float = 0.0
+    # Gemma-3: per-layer rope bases — local (sliding) layers use
+    # rope_local_theta, GLOBAL layers use rope_theta with positions
+    # divided by rope_scaling_factor (HF linear rope scaling). 0 disables
+    # (single rope_theta everywhere).
+    rope_local_theta: float = 0.0
+    rope_scaling_factor: float = 1.0
     # gemma-2/3 sandwich norms: extra RMSNorms on the attention and MLP
     # OUTPUTS (post_attention_layernorm / post_feedforward_layernorm in HF
     # naming — note HF llama's "post_attention_layernorm" is the PRE-MLP
@@ -129,15 +135,27 @@ class ModelConfig:
         MixtralForCausalLM config keys.
         """
         arch = (cfg.get("architectures") or [""])[0]
-        if arch.startswith("Gemma3"):
-            # Gemma 3 mixes per-layer rope bases (local 10k / global 1M
-            # with scaling) — not modeled by the single-theta rope yet
+        if arch.startswith("Gemma3n"):
+            # Gemma-3n's altup/laurel/per-layer-embedding structure is a
+            # different architecture, not a config variation of Gemma-3
             raise ValueError(
-                f"{arch} needs per-layer rope bases, which the single-theta "
-                "rope doesn't model yet; Gemma (v1) and Gemma-2 are "
-                "supported")
+                f"{arch} (MatFormer/altup) is not supported; Gemma v1/2/3 "
+                "dense text models are")
+        if arch == "Gemma3ForConditionalGeneration":
+            # multimodal wrapper: serve the nested TEXT config (this is
+            # what the released gemma-3-4b+ checkpoints' config.json is;
+            # vision towers are out of scope)
+            text = cfg.get("text_config")
+            if not text:
+                raise ValueError(
+                    "Gemma3ForConditionalGeneration config has no "
+                    "text_config to serve")
+            return ModelConfig.from_hf_config(
+                {**text, "architectures": ["Gemma3ForCausalLM"]},
+                name=name, dtype=dtype)
         is_gemma = arch.startswith("Gemma")
         is_gemma2 = arch.startswith("Gemma2")
+        is_gemma3 = arch.startswith("Gemma3")
         num_heads = cfg["num_attention_heads"]
         hidden = cfg["hidden_size"]
         head_dim = cfg.get("head_dim") or hidden // num_heads
@@ -181,15 +199,23 @@ class ModelConfig:
             rms_norm_unit_offset=is_gemma,
             embed_scale=is_gemma,
             sliding_window=(int(cfg.get("sliding_window") or 0)
-                            if is_gemma2 else 0),
+                            if (is_gemma2 or is_gemma3) else 0),
+            sliding_window_pattern=int(
+                cfg.get("sliding_window_pattern")
+                or (6 if is_gemma3 else 2)),
             attn_logit_softcapping=float(
                 cfg.get("attn_logit_softcapping") or 0.0),
             final_logit_softcapping=float(
                 cfg.get("final_logit_softcapping") or 0.0),
             query_pre_attn_scalar=float(
                 cfg.get("query_pre_attn_scalar") or 0.0),
-            post_norms=is_gemma2,
-            qk_norm="Qwen3" in arch,
+            post_norms=is_gemma2 or is_gemma3,
+            rope_local_theta=float(
+                cfg.get("rope_local_base_freq") or 0.0),
+            rope_scaling_factor=float(
+                ((cfg.get("rope_scaling") or {}).get("factor"))
+                or 1.0),
+            qk_norm="Qwen3" in arch or is_gemma3,
             attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
             num_experts=n_experts,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
@@ -459,6 +485,76 @@ PRESETS = {
         post_norms=True,
         eos_token_id=1,
         bos_token_id=2,
+    ),
+    # Gemma-3 (text): 5-local:1-global sliding pattern, per-layer rope
+    # bases (local 10k / global 1M, linear position scaling on global
+    # layers), gemma-style qk-norm, no soft-caps (public HF text configs;
+    # from_hf_config stays authoritative for real checkpoints)
+    "gemma-3-4b-it": ModelConfig(
+        name="gemma-3-4b-it",
+        vocab_size=262208,
+        hidden_size=2560,
+        intermediate_size=10240,
+        num_layers=34,
+        num_heads=8,
+        num_kv_heads=4,
+        head_dim=256,
+        rms_norm_eps=1e-6,
+        max_position_embeddings=131072,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
+        qk_norm=True,
+        sliding_window=1024,
+        sliding_window_pattern=6,
+        query_pre_attn_scalar=256.0,
+        post_norms=True,
+        rope_theta=1_000_000.0,
+        rope_local_theta=10_000.0,
+        rope_scaling_factor=8.0,
+        eos_token_id=1,
+        bos_token_id=2,
+    ),
+    "gemma-3-1b-it": ModelConfig(
+        name="gemma-3-1b-it",
+        vocab_size=262144,
+        hidden_size=1152,
+        intermediate_size=6912,
+        num_layers=26,
+        num_heads=4,
+        num_kv_heads=1,
+        head_dim=256,
+        rms_norm_eps=1e-6,
+        max_position_embeddings=32768,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
+        qk_norm=True,
+        sliding_window=512,
+        sliding_window_pattern=6,
+        query_pre_attn_scalar=256.0,
+        post_norms=True,
+        rope_theta=1_000_000.0,
+        rope_local_theta=10_000.0,
+        eos_token_id=1,
+        bos_token_id=2,
+    ),
+    "tiny-gemma3-debug": ModelConfig(
+        name="tiny-gemma3-debug",
+        num_layers=3,  # pattern 3: layers 0,1 local, layer 2 GLOBAL
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        embed_scale=True,
+        qk_norm=True,
+        sliding_window=8,
+        sliding_window_pattern=3,
+        query_pre_attn_scalar=64.0,
+        post_norms=True,
+        rope_theta=1_000_000.0,
+        rope_local_theta=10_000.0,
+        rope_scaling_factor=8.0,
     ),
     "tiny-gemma2-debug": ModelConfig(
         name="tiny-gemma2-debug",

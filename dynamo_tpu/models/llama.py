@@ -76,11 +76,31 @@ def _attn_kwargs(cfg: ModelConfig, page_off, pages_per_layer: int) -> dict:
     if cfg.attn_logit_softcapping > 0.0:
         kw["logit_cap"] = cfg.attn_logit_softcapping
     if cfg.sliding_window > 0:
-        layer = page_off // pages_per_layer
-        is_global = (layer + 1) % cfg.sliding_window_pattern == 0
-        kw["window"] = jnp.where(is_global, 0,
-                                 cfg.sliding_window).astype(jnp.int32)
+        kw["window"] = jnp.where(
+            _is_global_layer(cfg, page_off, pages_per_layer), 0,
+            cfg.sliding_window).astype(jnp.int32)
     return kw
+
+
+def _is_global_layer(cfg: ModelConfig, page_off, pages_per_layer: int):
+    """THE local/global predicate (traced): layer (i+1) %
+    sliding_window_pattern == 0 is global. Shared by the window mask and
+    the per-layer rope so the two can never desynchronize."""
+    layer = page_off // pages_per_layer
+    return (layer + 1) % cfg.sliding_window_pattern == 0
+
+
+def _layer_rope(cfg: ModelConfig, page_off, pages_per_layer: int):
+    """Gemma-3 per-layer rope: local (sliding) layers use
+    rope_local_theta; GLOBAL layers use rope_theta with positions divided
+    by rope_scaling_factor (HF linear scaling). None for single-theta
+    models — the common path stays untouched."""
+    if cfg.rope_local_theta <= 0:
+        return None
+    is_global = _is_global_layer(cfg, page_off, pages_per_layer)
+    theta = jnp.where(is_global, cfg.rope_theta, cfg.rope_local_theta)
+    scale = jnp.where(is_global, cfg.rope_scaling_factor, 1.0)
+    return theta, scale
 
 
 def _post(cfg: ModelConfig, lp: Params, name: str, y: jax.Array) -> jax.Array:
@@ -148,8 +168,8 @@ def param_specs(cfg: ModelConfig) -> Dict[str, Tuple[Tuple[int, ...], str, float
         p["bk"] = ((l, kv, d), "zeros", 0.0)
         p["bv"] = ((l, kv, d), "zeros", 0.0)
     if cfg.qk_norm:
-        p["q_norm"] = ((l, d), "ones", 0.0)
-        p["k_norm"] = ((l, d), "ones", 0.0)
+        p["q_norm"] = ((l, d), nk, 0.0)
+        p["k_norm"] = ((l, d), nk, 0.0)
     if cfg.is_moe:
         x = cfg.num_experts
         p["router"] = w((l, e, x), 0.02)
@@ -228,8 +248,12 @@ def _scan_layers_paged(params: Params, body, x, k_pages, v_pages,
     return x, kpf.reshape(k_pages.shape), vpf.reshape(v_pages.shape)
 
 
-def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array):
+def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array,
+         rope=None):
     """x: [T, E] -> q [T, H, D], k/v [T, KV, D] with rope applied.
+
+    `rope`: optional per-layer (theta, position_scale) from _layer_rope
+    (gemma-3's interleaved rope bases); None = cfg.rope_theta everywhere.
 
     MLA models route through _qkv_mla: the returned "k"/"v" are the SHARED
     latent rows [T, 1, lora+rope] (what the paged cache stores) and q is
@@ -247,8 +271,12 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array):
     if cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    theta, pos = cfg.rope_theta, positions
+    if rope is not None:
+        theta, scale = rope
+        pos = positions.astype(jnp.float32) / scale
+    q = apply_rope(q, pos, theta)
+    k = apply_rope(k, pos, theta)
     if cfg.query_pre_attn_scalar > 0:
         # the attention ops scale scores by head_dim^-0.5; gemma-2 wants
         # query_pre_attn_scalar^-0.5 — pre-scale q by the ratio so the
@@ -401,7 +429,9 @@ def prefill(
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        q, k, v = _qkv(cfg, lp, h, positions)
+        q, k, v = _qkv(cfg, lp, h, positions,
+                       rope=_layer_rope(cfg, page_off,
+                                        k_pages.shape[1]))
         o = att.prefill_attention(
             q, k, v, seq_len,
             **_attn_kwargs(cfg, page_off, k_pages.shape[1]))
@@ -459,7 +489,9 @@ def prefill_chunk(
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        q, k, v = _qkv(cfg, lp, h, positions)
+        q, k, v = _qkv(cfg, lp, h, positions,
+                       rope=_layer_rope(cfg, page_off,
+                                        k_pages.shape[1]))
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, chunk_pages + page_off, page_size=page_size
         )
@@ -517,7 +549,9 @@ def prefill_batch(
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        q, k, v = _qkv(cfg, lp, h, positions)  # [N*S, H/KV, D]
+        q, k, v = _qkv(cfg, lp, h, positions,
+                       rope=_layer_rope(cfg, page_off,
+                                        k_pages.shape[1]))  # [N*S,...]
         akw = _attn_kwargs(cfg, page_off, k_pages.shape[1])
         o = jax.vmap(
             lambda qq, kk, vv, sl: att.prefill_attention(
@@ -600,7 +634,9 @@ def decode_verify(
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        q, k, v = _qkv(cfg, lp, h, flat_pos)  # [B*K1, H, D], [B*K1, KV, D]
+        q, k, v = _qkv(cfg, lp, h, flat_pos,
+                       rope=_layer_rope(cfg, page_off,
+                                        k_pages.shape[1]))
         kp, vp = att.write_kv_token(
             kp, vp, k, v, flat_tables + page_off, flat_pos,
             page_size=page_size,
@@ -641,7 +677,9 @@ def decode_step(
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-        q, k, v = _qkv(cfg, lp, h, positions)  # [B,H,D],[B,KV,D]
+        q, k, v = _qkv(cfg, lp, h, positions,
+                       rope=_layer_rope(cfg, page_off,
+                                        k_pages.shape[1]))
         tables = block_tables + page_off
         kp, vp = att.write_kv_token(
             kp, vp, k, v, tables, positions, page_size=page_size

@@ -120,12 +120,6 @@ def test_from_hf_config_maps_gemma2():
         assert getattr(cfg, f) == getattr(preset, f), f
 
 
-def test_gemma3_still_rejected():
-    with pytest.raises(ValueError, match="per-layer rope"):
-        ModelConfig.from_hf_config(
-            {**GEMMA2_HF, "architectures": ["Gemma3ForCausalLM"]})
-
-
 def test_gemma2_param_specs_have_sandwich_norms():
     cfg = PRESETS["tiny-gemma2-debug"]
     specs = llama.param_specs(cfg)
@@ -231,3 +225,109 @@ def test_gemma2_decode_window_matches_prefill():
     np.testing.assert_allclose(np.asarray(out.logits[0]),
                                np.asarray(whole.last_logits),
                                rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------------------------------------- gemma-3 ----
+
+GEMMA3_HF = {
+    "architectures": ["Gemma3ForCausalLM"],
+    "model_type": "gemma3_text",
+    "vocab_size": 262208,
+    "hidden_size": 2560,
+    "intermediate_size": 10240,
+    "num_hidden_layers": 34,
+    "num_attention_heads": 8,
+    "num_key_value_heads": 4,
+    "head_dim": 256,
+    "hidden_activation": "gelu_pytorch_tanh",
+    "rms_norm_eps": 1e-6,
+    "rope_theta": 1000000.0,
+    "rope_local_base_freq": 10000.0,
+    "rope_scaling": {"factor": 8.0, "rope_type": "linear"},
+    "max_position_embeddings": 131072,
+    "sliding_window": 1024,
+    "sliding_window_pattern": 6,
+    "query_pre_attn_scalar": 256,
+    "eos_token_id": 1,
+    "bos_token_id": 2,
+}
+
+
+def test_from_hf_config_maps_gemma3():
+    cfg = ModelConfig.from_hf_config(GEMMA3_HF, name="gemma-3-4b-it")
+    preset = PRESETS["gemma-3-4b-it"]
+    for f in ("hidden_size", "intermediate_size", "num_layers", "num_heads",
+              "num_kv_heads", "head_dim", "hidden_act", "sliding_window",
+              "sliding_window_pattern", "rope_theta", "rope_local_theta",
+              "rope_scaling_factor", "qk_norm", "post_norms",
+              "query_pre_attn_scalar", "tie_word_embeddings"):
+        assert getattr(cfg, f) == getattr(preset, f), f
+    assert cfg.attn_logit_softcapping == 0.0  # gemma-3 dropped the caps
+
+
+def test_gemma3_multimodal_wrapper_serves_text_config():
+    """The released gemma-3-4b+ checkpoints' config.json is the multimodal
+    wrapper: from_hf_config must auto-descend into text_config."""
+    wrapped = {"architectures": ["Gemma3ForConditionalGeneration"],
+               "model_type": "gemma3",
+               "text_config": {k: v for k, v in GEMMA3_HF.items()
+                               if k != "architectures"}}
+    cfg = ModelConfig.from_hf_config(wrapped, name="gemma-3-4b-it")
+    direct = ModelConfig.from_hf_config(GEMMA3_HF, name="gemma-3-4b-it")
+    assert cfg == direct
+
+
+def test_gemma3n_rejected_loudly():
+    with pytest.raises(ValueError, match="Gemma3n"):
+        ModelConfig.from_hf_config(
+            {**GEMMA3_HF, "architectures": ["Gemma3nForCausalLM"]})
+
+
+def test_gemma3_per_layer_rope_is_real():
+    """Local vs global layers must use DIFFERENT rope bases: with identical
+    weights, forcing rope_local_theta == rope_theta changes the logits of
+    a model whose pattern mixes both layer kinds."""
+    import dataclasses
+
+    import jax
+
+    cfg = dataclasses.replace(PRESETS["tiny-gemma3-debug"], dtype="float32")
+    same = dataclasses.replace(cfg, rope_local_theta=cfg.rope_theta,
+                               rope_scaling_factor=1.0)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    page_size, n_pages = 4, 16
+    kv_shape = (cfg.num_layers, n_pages, page_size,
+                cfg.num_kv_heads * cfg.head_dim)
+    toks = jnp.asarray([9, 8, 7, 6, 5, 4, 3, 2, 1, 2, 3, 4], jnp.int32)
+    pages = jnp.arange(1, 4, dtype=jnp.int32)
+
+    def last_logits(c):
+        out = llama.prefill(c, params, toks, jnp.int32(12),
+                            jnp.zeros(kv_shape, jnp.float32),
+                            jnp.zeros(kv_shape, jnp.float32),
+                            pages, page_size=page_size)
+        return np.asarray(out.last_logits)
+
+    assert np.abs(last_logits(cfg) - last_logits(same)).max() > 1e-4
+
+
+def test_gemma3_engine_end_to_end():
+    """tiny-gemma3-debug (per-layer rope + window + qk-norm + sandwich
+    norms, MQA-free GQA) serves end to end, greedy deterministic, and the
+    chunked-prefill path agrees with whole-prompt."""
+    eng = Engine(EngineConfig(model="tiny-gemma3-debug", page_size=4,
+                              num_pages=64, max_num_seqs=2, max_seq_len=48,
+                              seed=7))
+    prompt = list(range(3, 19))
+    a = eng.generate(GenRequest("a", prompt, max_tokens=10, temperature=0.0,
+                                ignore_eos=True))
+    b = eng.generate(GenRequest("b", prompt, max_tokens=10, temperature=0.0,
+                                ignore_eos=True))
+    assert a == b and len(a) == 10
+    eng2 = Engine(EngineConfig(model="tiny-gemma3-debug", page_size=4,
+                               num_pages=64, max_num_seqs=2, max_seq_len=48,
+                               seed=7, prefill_chunk_tokens=8),
+                  params=eng.params)
+    c = eng2.generate(GenRequest("c", prompt, max_tokens=10, temperature=0.0,
+                                 ignore_eos=True))
+    assert c == a

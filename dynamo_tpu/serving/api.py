@@ -221,6 +221,11 @@ class ServingContext:
         self.kv_gauge = Gauge(
             "dynamo_worker_kv_free_pages", "Free KV pages", self.metrics.registry
         )
+        self.staged_kv_gauge = Gauge(
+            "dynamo_worker_staged_kv_gathers",
+            "Device-plane staged KV gathers by state (leaked = expired "
+            "un-released, still pinning HBM)", self.metrics.registry,
+        )
         self.start_time = time.time()
         self._trace_lock = threading.Lock()  # one profiler capture at a time
 
@@ -342,6 +347,15 @@ class _Handler(JsonHTTPHandler):
         if path == "/v1/models":
             self._json(200, proto.models_response([self.ctx.served_model]))
         elif path == "/metrics":
+            ds = self.ctx.kv_device_source
+            if ds is not None:
+                # scrape-time refresh: leaked > 0 flags a decode peer that
+                # stages and crashes before pulling (HBM pinned until
+                # /disagg/release) — alertable without log spelunking
+                self.ctx.staged_kv_gauge.set(ds.staged_count,
+                                             state="staged")
+                self.ctx.staged_kv_gauge.set(ds.leaked_count,
+                                             state="leaked")
             self._raw(200, self.ctx.metrics.registry.expose().encode(),
                       "text/plain; version=0.0.4")
         elif path in ("/health", "/live", "/ready"):

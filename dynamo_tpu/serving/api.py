@@ -221,11 +221,7 @@ class ServingContext:
         self.kv_gauge = Gauge(
             "dynamo_worker_kv_free_pages", "Free KV pages", self.metrics.registry
         )
-        self.staged_kv_gauge = Gauge(
-            "dynamo_worker_staged_kv_gathers",
-            "Device-plane staged KV gathers by state (leaked = expired "
-            "un-released, still pinning HBM)", self.metrics.registry,
-        )
+        self.staged_kv_gauge = None  # registered with DeviceKVSource below
         self.start_time = time.time()
         self._trace_lock = threading.Lock()  # one profiler capture at a time
 
@@ -246,6 +242,14 @@ class ServingContext:
                 # cross-process leg of the ici plane: stage parked KV for
                 # device-buffer pulls (TCP KVSource stays as the fallback)
                 self.kv_device_source = DeviceKVSource(engine)
+                # registered only alongside the source: workers without the
+                # device plane must not expose a label-less zero series
+                self.staged_kv_gauge = Gauge(
+                    "dynamo_worker_staged_kv_gathers",
+                    "Device-plane staged KV gathers by state (leaked = "
+                    "expired un-released, still pinning HBM)",
+                    self.metrics.registry,
+                )
         elif mode == "decode":
             from dynamo_tpu.serving.disagg import DisaggDecodeClient, PrefillPool
 
@@ -352,10 +356,9 @@ class _Handler(JsonHTTPHandler):
                 # scrape-time refresh: leaked > 0 flags a decode peer that
                 # stages and crashes before pulling (HBM pinned until
                 # /disagg/release) — alertable without log spelunking
-                self.ctx.staged_kv_gauge.set(ds.staged_count,
-                                             state="staged")
-                self.ctx.staged_kv_gauge.set(ds.leaked_count,
-                                             state="leaked")
+                live, leaked = ds.counts()  # one lock/sweep: no double count
+                self.ctx.staged_kv_gauge.set(live, state="staged")
+                self.ctx.staged_kv_gauge.set(leaked, state="leaked")
             self._raw(200, self.ctx.metrics.registry.expose().encode(),
                       "text/plain; version=0.0.4")
         elif path in ("/health", "/live", "/ready"):
@@ -408,8 +411,8 @@ class _Handler(JsonHTTPHandler):
             if ds is not None:
                 # stage ledger health: leaked > 0 means a decode peer is
                 # staging and crashing before pull/release, pinning HBM
-                out["staged_kv"] = {"live": ds.staged_count,
-                                    "leaked": ds.leaked_count}
+                live, leaked = ds.counts()
+                out["staged_kv"] = {"live": live, "leaked": leaked}
             self._json(200, out)
         else:
             self._error(404, f"no route {path}")

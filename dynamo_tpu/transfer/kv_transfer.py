@@ -241,25 +241,26 @@ class DeviceKVSource:
         never pays the export gather only to discard it)."""
         return len(self.engine.k_pages.sharding.device_set) == 1
 
-    @property
-    def staged_count(self) -> int:
+    def counts(self) -> tuple:
+        """(live, leaked) under ONE lock and sweep — a two-property read
+        could sweep between them and count an expiring entry twice. The
+        sweep on read keeps expiry observable in /worker/stats and
+        /metrics even when no new stage traffic arrives."""
         import time as _time
 
         with self._lock:
-            # sweep here too: expiry must be observable in /worker/stats
-            # even when no new stage traffic arrives to trigger it
             self._sweep_locked(_time.monotonic())
-            return len(self._staged)
+            return len(self._staged), len(self._leaked)
+
+    @property
+    def staged_count(self) -> int:
+        return self.counts()[0]
 
     @property
     def leaked_count(self) -> int:
         """Expired un-released stages whose gathers the transfer server
         still pins (surfaced in /worker/stats for operators)."""
-        import time as _time
-
-        with self._lock:
-            self._sweep_locked(_time.monotonic())
-            return len(self._leaked)
+        return self.counts()[1]
 
     def _sweep_locked(self, now: float) -> None:
         dead = [rid for rid, (ts, _, _) in self._staged.items()

@@ -91,6 +91,12 @@ class _FrontendHandler(JsonHTTPHandler):
                     body.get("mode", "agg"), body.get("stats"),
                 )
                 self._json(200, {"ok": True})
+            elif path == "/internal/deregister":
+                # graceful worker drain (SIGTERM): stop routing to it NOW
+                # instead of waiting out the heartbeat TTL
+                body = self._read_json_body()
+                self.ctx.router.deregister(body["url"])
+                self._json(200, {"ok": True})
             elif path in ("/v1/chat/completions", "/v1/completions"):
                 self._proxy(path)
             else:

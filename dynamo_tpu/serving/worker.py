@@ -234,18 +234,77 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
             log.warning("NATS plane unavailable (%s); HTTP only", e)
 
     stop = threading.Event()
+    hb_thread = None
+    self_url = _self_url(args.host, args.port)
     if args.frontend_url:
-        self_url = _self_url(args.host, args.port)
-        t = threading.Thread(
+        hb_thread = threading.Thread(
             target=heartbeat_loop,
             args=(ctx, args.frontend_url, self_url, args.heartbeat_interval, stop),
             daemon=True, name="heartbeat",
         )
-        t.start()
+        hb_thread.start()
 
     def shutdown(*_):
+        """Graceful drain (pod termination): deregister from the frontend
+        so no new requests route here, keep serving until in-flight work
+        finishes (bounded by DRAIN_TIMEOUT_S — align terminationGracePeriod
+        with it), then stop the server. A second signal skips the drain."""
+        if stop.is_set():  # impatient second SIGTERM/SIGINT
+            threading.Thread(target=srv.shutdown, daemon=True).start()
+            return
         stop.set()
-        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+        def _drain():
+            try:
+                try:
+                    drain_s = float(os.environ.get("DRAIN_TIMEOUT_S", "30"))
+                except ValueError:
+                    log.warning("invalid DRAIN_TIMEOUT_S %r; using 30s",
+                                os.environ.get("DRAIN_TIMEOUT_S"))
+                    drain_s = 30.0
+                if nats_plane is not None:
+                    # stop consuming the NATS request plane NOW — new
+                    # subjects must not refill the queue mid-drain
+                    try:
+                        nats_plane.close()
+                    except Exception:
+                        pass
+                if args.frontend_url:
+                    if hb_thread is not None:
+                        # an IN-FLIGHT heartbeat register must land before
+                        # the deregister, or it re-adds this worker
+                        hb_thread.join(timeout=6.0)
+                    try:
+                        urllib.request.urlopen(
+                            urllib.request.Request(
+                                args.frontend_url.rstrip("/")
+                                + "/internal/deregister",
+                                data=json.dumps({"url": self_url}).encode(),
+                                headers={"Content-Type": "application/json"},
+                                method="POST",
+                            ),
+                            timeout=3,
+                        ).close()
+                    except Exception as e:
+                        log.warning("deregister failed (%s); frontend will "
+                                    "expire the heartbeat", e)
+                # grace: a request routed a moment before the deregister may
+                # be accepted but not yet submitted — let it reach the
+                # engine before the first empty check
+                time.sleep(1.0)
+                deadline = time.monotonic() + drain_s
+                while time.monotonic() < deadline and (
+                        engine.num_active or engine.pending):
+                    time.sleep(0.25)
+                if engine.num_active or engine.pending:
+                    log.warning(
+                        "drain timeout with %d active / %d pending; "
+                        "stopping anyway", engine.num_active,
+                        len(engine.pending))
+            finally:
+                srv.shutdown()  # must run even if the drain itself blew up
+
+        threading.Thread(target=_drain, daemon=True, name="drain").start()
 
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)

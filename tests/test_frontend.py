@@ -178,3 +178,20 @@ def test_failover_to_live_worker_on_unreachable(stack):
     urls = [w["url"] for w in json.loads(
         get(stack["frontend"], "/internal/workers"))["workers"]]
     assert dead_url not in urls
+
+
+def test_deregister_removes_worker_immediately(stack):
+    """Graceful drain (SIGTERM): a worker's /internal/deregister must stop
+    routing NOW, not after the heartbeat TTL expires."""
+    register(stack)
+    workers = json.loads(get(stack["frontend"], "/internal/workers"))["workers"]
+    assert any(w["url"] == stack["worker"] for w in workers)
+    post(stack["frontend"], "/internal/deregister", {"url": stack["worker"]})
+    workers = json.loads(get(stack["frontend"], "/internal/workers"))["workers"]
+    assert not any(w["url"] == stack["worker"] for w in workers)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["frontend"], "/v1/chat/completions", {
+            "model": MODEL, "messages": [{"role": "user", "content": "x"}],
+        })
+    assert ei.value.code == 503
+    register(stack)  # restore for later tests in the module

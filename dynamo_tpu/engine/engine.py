@@ -155,6 +155,7 @@ class EngineMetrics:
         self.prefill_time_s = 0.0
         self.decode_time_s = 0.0
         self.kv_oom = 0
+        self.num_preempted = 0  # recompute preemptions under page pressure
         # speculative decoding: drafts offered vs accepted (acceptance rate
         # = accepted / drafted; bonus tokens not counted in either)
         self.spec_draft_tokens = 0
@@ -809,8 +810,9 @@ class Engine:
         """Enqueue a request (raises like validate_request).
 
         Priority admission (vLLM semantics: lower value = sooner, stable
-        FIFO within a level); running sequences are never preempted, so
-        priority only reorders the queue."""
+        FIFO within a level). Priority also picks preemption victims under
+        KV page pressure (see _preempt_for): the worst-priority youngest
+        sequence is recomputed, never killed."""
         self.validate_request(req)
         with self._lock:
             self._insert_pending(req)
@@ -1054,11 +1056,21 @@ class Engine:
         min_p = np.zeros((npad,), np.float32)
         bias_ids = np.full((npad, smp.BIAS_K), -1, np.int32)
         bias_vals = np.zeros((npad, smp.BIAS_K), np.float32)
+        pen_rows = None
         for i, r in enumerate(reqs):
             keys[i] = np.asarray(self._request_key(r), np.uint32)
             temp[i], top_p[i], top_k[i] = r.temperature, r.top_p, r.top_k
             min_p[i] = r.min_p
             bias_ids[i], bias_vals[i] = _pack_logit_bias(r)
+            pen = self._penalty_row(r)
+            if pen is not None:  # preempted continuation in the batch
+                if pen_rows is None:
+                    pen_rows = np.zeros(
+                        (npad, self.model_cfg.vocab_size), np.float32)
+                pen_rows[i] = pen
+        raw_logits = logits
+        if pen_rows is not None:
+            logits = logits - jnp.asarray(pen_rows)
         toks, chosen, tids, tvals = self._sample_first_batch(
             logits, jnp.asarray(temp), jnp.asarray(top_p),
             jnp.asarray(top_k), jnp.asarray(min_p), jnp.asarray(bias_ids),
@@ -1067,6 +1079,17 @@ class Engine:
         )
         toks_np, chosen_np = np.asarray(toks), np.asarray(chosen)
         tids_np, tvals_np = np.asarray(tids), np.asarray(tvals)
+        if pen_rows is not None:
+            # penalized lanes requesting logprobs: re-derive them from the
+            # raw distribution (the sampler saw the penalized one)
+            chosen_np, tids_np, tvals_np = (
+                chosen_np.copy(), tids_np.copy(), tvals_np.copy())
+            for i, r in enumerate(reqs):
+                if r.logprobs is not None and pen_rows[i].any():
+                    c, ti, tv = self._lp_from_raw(raw_logits[i],
+                                                  int(toks_np[i]))
+                    chosen_np[i] = c
+                    tids_np[i], tvals_np[i] = ti, tv
         dt = time.monotonic() - t0
         self.metrics.prefill_time_s += dt
         self.metrics.observe_phase("prefill", dt, weight=len(reqs))
@@ -1140,10 +1163,35 @@ class Engine:
         self.metrics.prompt_tokens += prompt_len
         return first, pages, prompt_len, req_key, lp
 
+    def _penalty_row(self, req: GenRequest):
+        """Presence/frequency penalty vector for a preempted continuation's
+        FIRST token: 'penalties don't apply at prefill' assumes no output
+        yet, which is false after preemption — the tokens in
+        prior_output_token_ids are this request's own output."""
+        if not req.prior_output_token_ids or not (req.presence_penalty
+                                                  or req.frequency_penalty):
+            return None
+        row = np.zeros((self.model_cfg.vocab_size,), np.float32)
+        np.add.at(row, np.asarray(req.prior_output_token_ids, np.int64), 1.0)
+        return (req.presence_penalty * (row > 0).astype(np.float32)
+                + req.frequency_penalty * row)
+
+    @staticmethod
+    def _lp_from_raw(raw_row, tok: int, k: int = 5):
+        """Logprob fields from UNPENALIZED logits (the OpenAI contract:
+        logprobs describe the model, not the sampler)."""
+        logp = jax.nn.log_softmax(raw_row.astype(jnp.float32))
+        tvals, tids = jax.lax.top_k(logp, k)
+        return (float(logp[tok]), np.asarray(tids), np.asarray(tvals))
+
     def _first_token(self, req: GenRequest, last_logits, prompt_len: int):
         """Sample the first token from prefill logits (shared by the full and
         chunked prefill paths). Returns (first, req_key, lp)."""
         req_key = self._request_key(req)
+        raw_logits = last_logits
+        pen = self._penalty_row(req)
+        if pen is not None:
+            last_logits = last_logits - jnp.asarray(pen)
         # the prediction made FROM position prompt_len-1; decode windows fold
         # positions >= prompt_len, so the chains never collide
         bias_ids, bias_vals = _pack_logit_bias(req)
@@ -1158,6 +1206,10 @@ class Engine:
             req_key,
             jnp.int32(prompt_len - 1),
         )
+        if pen is not None and req.logprobs is not None:
+            # report logprobs from the raw distribution, not the penalized
+            # one the continuation sampled from
+            return int(tok), req_key, self._lp_from_raw(raw_logits, int(tok))
         return int(tok), req_key, (float(chosen), np.asarray(tids),
                                    np.asarray(tvals))
 
@@ -1181,6 +1233,7 @@ class Engine:
             logprobs=req.logprobs,
         )
         seq.prompt_ids = list(req.prompt_token_ids)
+        seq.req = req
         seq.output_tokens.append(first)
         self.seqs[slot] = seq
         self.block_tables[slot, :] = 0
@@ -1197,6 +1250,16 @@ class Engine:
         self.token_counts = self._reset_count(
             self.token_counts, jnp.int32(slot), jnp.int32(first)
         )
+        if req.prior_output_token_ids and (req.presence_penalty
+                                           or req.frequency_penalty):
+            # preempted continuation: tokens emitted before preemption ride
+            # in the prompt for recompute but are still OUTPUT for penalty
+            # purposes — re-seed the count row on top of the reset
+            row = np.zeros((self.model_cfg.vocab_size,), np.int32)
+            np.add.at(row, np.asarray(req.prior_output_token_ids,
+                                      np.int64), 1)
+            self.token_counts = self.token_counts.at[slot].add(
+                jnp.asarray(row))
         self.metrics.output_tokens += 1
         self._invalidate_dev()  # new membership -> rebuild device batch state
         return seq
@@ -1355,6 +1418,11 @@ class Engine:
                 window = 1
 
         for slot, seq in list(self.seqs.items()):
+            if self.seqs.get(slot) is not seq:
+                # preempted by an earlier iteration's _preempt_for: the
+                # snapshot entry is dead — allocating into it would leak
+                # pages into a detached SeqState forever
+                continue
             last_page = min(
                 (seq.num_tokens + offset + window - 1) // cfg.page_size, pcap)
             need = max(0, last_page + 1 - len(seq.pages))
@@ -1363,19 +1431,93 @@ class Engine:
             if not self._ensure_pages(need):
                 if not allow_kill:
                     return 0
-                self.metrics.kv_oom += 1
-                events.append(
-                    TokenEvent(
-                        seq.request_id, -1, len(seq.output_tokens), True, "kv_oom"
+                # vLLM posture under page pressure: PREEMPT (recompute)
+                # before killing — requeue the worst victim(s) so every
+                # request eventually completes; kv_oom is the last resort
+                # when even an empty batch couldn't hold this sequence
+                self._preempt_for(need, protect=slot)
+                if not self._ensure_pages(need):
+                    # no worse-or-equal victim could free enough. If this
+                    # sequence alone fits an empty pool and others are
+                    # running, SELF-preempt (it is the worst remaining) —
+                    # kv_oom only when the pool could never hold it
+                    if (len(self.seqs) > 1
+                            and len(seq.pages) + need
+                            <= self.cfg.num_pages - 1):
+                        self._preempt_slot(slot)
+                        continue
+                    self.metrics.kv_oom += 1
+                    events.append(
+                        TokenEvent(
+                            seq.request_id, -1, len(seq.output_tokens), True,
+                            "kv_oom"
+                        )
                     )
-                )
-                self._finish_slot(slot, "kv_oom")
-                continue
+                    self._finish_slot(slot, "kv_oom")
+                    continue
             for page in self.allocator.alloc(need):
                 seq.pages.append(page)
                 self.block_tables[slot, len(seq.pages) - 1] = page
             self._invalidate_dev(tables_only=True)
         return window
+
+    def _preempt_for(self, need: int, protect: int) -> None:
+        """Free >= `need` pages by preempting victims (worst priority,
+        then youngest arrival — vLLM's order), never the protected slot.
+
+        Preemption is BY RECOMPUTE: the victim's pages are freed and a
+        continuation request (prompt := prompt + output so far, max_tokens
+        reduced) re-enters the queue AT THE FRONT of its priority level.
+        Correctness across the preempt/recompute boundary:
+        - sampling: per-slot key chains fold by POSITION, so a seeded
+          continuation samples the identical tokens the un-preempted run
+          would have (tests/test_preemption.py proves it);
+        - penalties: emitted-before-preemption tokens ride in
+          prior_output_token_ids and re-seed the count row at re-admission;
+        - streams: the serving layer keys on request_id and counts tokens
+          itself, so the continuation's events append seamlessly."""
+        def rank(q):  # vLLM order: WORSE = higher priority value, younger
+            return (q.req.priority if q.req else 0,
+                    q.req.arrival_time if q.req else 0.0)
+
+        protected = self.seqs.get(protect)
+        floor = rank(protected) if protected is not None else (-(1 << 30),)
+        while not self._ensure_pages(need):
+            # never preempt a BETTER-priority sequence to feed a worse one
+            # (priority inversion); the caller self-preempts instead
+            victims = [(s, q) for s, q in self.seqs.items()
+                       if s != protect and rank(q) >= floor]
+            if not victims:
+                return
+            slot, _ = max(victims, key=lambda kv: rank(kv[1]))
+            self._preempt_slot(slot)
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Preempt ONE sequence by recompute: free its pages, requeue the
+        continuation at the front of its priority level."""
+        import dataclasses as _dc
+
+        seq = self.seqs.get(slot)
+        if seq is None:
+            return
+        old = seq.req
+        cont = _dc.replace(
+            old,
+            prompt_token_ids=list(seq.prompt_ids)
+            + list(seq.output_tokens),
+            max_tokens=seq.max_tokens - len(seq.output_tokens),
+            prior_output_token_ids=list(old.prior_output_token_ids)
+            + list(seq.output_tokens),
+        )
+        log.info(
+            "preempting %s under page pressure (%d output tokens "
+            "recompute; priority %d)", seq.request_id,
+            len(seq.output_tokens), old.priority)
+        self._finish_slot(slot, None)
+        self.metrics.num_finished -= 1  # preempted, not finished
+        self.metrics.num_preempted += 1
+        with self._lock:
+            self._insert_pending(cont, requeue=True)
 
     def _propose_ngram(self, seq: SeqState) -> List[int]:
         """Prompt-lookup drafts: match the last `ngram_lookup` tokens of the

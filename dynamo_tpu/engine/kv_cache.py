@@ -276,6 +276,7 @@ class SeqState:
         "request_id", "slot", "pages", "num_tokens", "output_tokens",
         "max_tokens", "temperature", "top_p", "top_k", "stop_token_ids",
         "prompt_len", "logprobs", "prompt_ids",
+        "req",  # originating GenRequest (preemption rebuilds a continuation)
     )
 
     def __init__(

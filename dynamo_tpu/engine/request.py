@@ -35,10 +35,14 @@ class GenRequest:
     logit_bias: Optional[Dict[int, float]] = None
     logprobs: Optional[int] = None  # None = off; N = return top-N alternatives
     # admission priority (vLLM semantics: LOWER value admits sooner, 0
-    # default); FIFO within a priority level. Running sequences are never
-    # preempted.
+    # default); FIFO within a priority level
     priority: int = 0
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+    # preemption-by-recompute continuation (engine-internal): tokens this
+    # REQUEST already emitted before being preempted — they ride in the
+    # prompt for recompute, but penalties must still count them as output
+    prior_output_token_ids: List[int] = dataclasses.field(
+        default_factory=list)
 
 
 @dataclasses.dataclass

@@ -13,7 +13,21 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Optional
+from typing import Optional, Tuple
+
+
+def _llama3_rope_scaling(cfg: dict):
+    """HF rope_scaling with rope_type "llama3" (Llama-3.1+) ->
+    (factor, low_freq_factor, high_freq_factor, original_max_pos)."""
+    rs = cfg.get("rope_scaling") or {}
+    if (rs.get("rope_type") or rs.get("type")) != "llama3":
+        return None
+    return (
+        float(rs.get("factor", 8.0)),
+        float(rs.get("low_freq_factor", 1.0)),
+        float(rs.get("high_freq_factor", 4.0)),
+        int(rs.get("original_max_position_embeddings", 8192)),
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +73,11 @@ class ModelConfig:
     # (single rope_theta everywhere).
     rope_local_theta: float = 0.0
     rope_scaling_factor: float = 1.0
+    # Llama-3.1+ frequency-dependent rope scaling (HF rope_type "llama3"):
+    # (factor, low_freq_factor, high_freq_factor, original_max_position
+    # _embeddings), or None. Applied to inv_freq once — affects every
+    # position, so omitting it diverges from HF at ANY length.
+    rope_llama3_scaling: Optional[Tuple[float, float, float, int]] = None
     # gemma-2/3 sandwich norms: extra RMSNorms on the attention and MLP
     # OUTPUTS (post_attention_layernorm / post_feedforward_layernorm in HF
     # naming — note HF llama's "post_attention_layernorm" is the PRE-MLP
@@ -214,7 +233,8 @@ class ModelConfig:
                 cfg.get("rope_local_base_freq") or 0.0),
             rope_scaling_factor=float(
                 ((cfg.get("rope_scaling") or {}).get("factor"))
-                or 1.0),
+                or 1.0) if is_gemma3 else 1.0,
+            rope_llama3_scaling=_llama3_rope_scaling(cfg),
             qk_norm="Qwen3" in arch or is_gemma3,
             attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
             num_experts=n_experts,
@@ -282,6 +302,10 @@ PRESETS = {
         num_kv_heads=8,
         head_dim=64,
         rope_theta=500000.0,
+        max_position_embeddings=131072,
+        # Llama-3.2 ships rope_type "llama3" scaling — part of the model,
+        # not a long-context add-on (it reshapes inv_freq at every length)
+        rope_llama3_scaling=(32.0, 1.0, 4.0, 8192),
         tie_word_embeddings=True,
         eos_token_id=128009,
         bos_token_id=128000,

@@ -275,8 +275,9 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array,
     if rope is not None:
         theta, scale = rope
         pos = positions.astype(jnp.float32) / scale
-    q = apply_rope(q, pos, theta)
-    k = apply_rope(k, pos, theta)
+    l3 = cfg.rope_llama3_scaling
+    q = apply_rope(q, pos, theta, llama3_scaling=l3)
+    k = apply_rope(k, pos, theta, llama3_scaling=l3)
     if cfg.query_pre_attn_scalar > 0:
         # the attention ops scale scores by head_dim^-0.5; gemma-2 wants
         # query_pre_attn_scalar^-0.5 — pre-scale q by the ratio so the
@@ -305,10 +306,12 @@ def _qkv_mla(cfg: ModelConfig, lp: Params, x: jax.Array,
     lora = cfg.kv_lora_rank
     q = qeinsum("te,ehd->thd", x, lp["wq_mla"])  # [T, H, nope+rope]
     q_nope, q_rope = q[..., :nope], q[..., nope:]
-    q_rope = apply_rope(q_rope, positions, cfg.rope_theta)
+    q_rope = apply_rope(q_rope, positions, cfg.rope_theta,
+                        llama3_scaling=cfg.rope_llama3_scaling)
     kv = qeinsum("te,er->tr", x, lp["w_kv_a"])  # [T, lora+rope]
     c_kv = rms_norm(kv[:, :lora], lp["kv_a_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
-    k_rope = apply_rope(kv[:, None, lora:], positions, cfg.rope_theta)[:, 0]
+    k_rope = apply_rope(kv[:, None, lora:], positions, cfg.rope_theta,
+                        llama3_scaling=cfg.rope_llama3_scaling)[:, 0]
     q_lat = jnp.einsum("thn,hnr->thr", q_nope.astype(jnp.float32),
                        lp["w_uk"].astype(jnp.float32)).astype(q.dtype)
     # generic ops scale scores by 1/sqrt(q.shape[-1]) — the PADDED cache

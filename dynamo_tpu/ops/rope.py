@@ -10,14 +10,39 @@ def rope_freqs(head_dim: int, theta: float) -> jax.Array:
     return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def llama3_scale_freqs(inv: jax.Array, factor: float, low_freq_factor: float,
+                       high_freq_factor: float, original_max_pos: int
+                       ) -> jax.Array:
+    """Llama-3.1+ frequency-dependent rope scaling (HF rope_type "llama3").
+
+    Low-frequency components (long wavelengths) are divided by `factor`,
+    high-frequency ones kept, with a smooth ramp between — applied to the
+    inverse frequencies ONCE, so it affects every position (ignoring it
+    diverges from HF at any sequence length, not just past the original
+    context)."""
+    low_wavelen = original_max_pos / low_freq_factor
+    high_wavelen = original_max_pos / high_freq_factor
+    wavelen = 2.0 * jnp.pi / inv
+    smooth = (original_max_pos / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = (1.0 - smooth) * inv / factor + smooth * inv
+    out = jnp.where(wavelen > low_wavelen, inv / factor, scaled)
+    return jnp.where(wavelen < high_wavelen, inv, out)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
+               llama3_scaling=None) -> jax.Array:
     """x: [..., seq?, heads, head_dim]; positions broadcastable to x's token dims.
 
     Accepts [S, H, D] with positions [S], or [B, H, D] with positions [B]
-    (decode: one token per sequence).
+    (decode: one token per sequence). `llama3_scaling`: optional
+    (factor, low_freq_factor, high_freq_factor, original_max_pos) tuple.
     """
     head_dim = x.shape[-1]
     inv = rope_freqs(head_dim, theta)  # [D/2]
+    if llama3_scaling is not None:
+        inv = llama3_scale_freqs(inv, *llama3_scaling)
     angles = positions.astype(jnp.float32)[..., None] * inv  # [..., D/2]
     cos = jnp.cos(angles)[..., None, :]  # [..., 1, D/2]
     sin = jnp.sin(angles)[..., None, :]

@@ -1,0 +1,95 @@
+"""Llama-3.1+ rope scaling (HF rope_type "llama3"): frequency-dependent
+inv_freq reshaping that is part of the MODEL (it changes outputs at every
+position, not just past the original context)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS, ModelConfig
+from dynamo_tpu.ops.rope import llama3_scale_freqs, rope_freqs
+
+SCALING = (8.0, 1.0, 4.0, 8192)
+
+
+def test_llama3_freq_math_matches_reference():
+    """Hand-computed HF semantics: wavelen < orig/high kept; wavelen >
+    orig/low divided by factor; smooth ramp between."""
+    inv = np.asarray(rope_freqs(128, 500000.0))
+    out = np.asarray(llama3_scale_freqs(jnp.asarray(inv), *SCALING))
+    factor, low, high, orig = SCALING
+    wavelen = 2 * np.pi / inv
+    for i in range(len(inv)):
+        if wavelen[i] < orig / high:
+            expect = inv[i]
+        elif wavelen[i] > orig / low:
+            expect = inv[i] / factor
+        else:
+            s = (orig / wavelen[i] - low) / (high - low)
+            expect = (1 - s) * inv[i] / factor + s * inv[i]
+        np.testing.assert_allclose(out[i], expect, rtol=1e-6, err_msg=str(i))
+    # the scaling actually does something on both ends
+    assert out[0] == inv[0]           # highest frequency untouched
+    assert out[-1] < inv[-1] / 2      # lowest frequency strongly scaled
+
+
+def test_from_hf_config_parses_llama3_rope_scaling():
+    cfg = ModelConfig.from_hf_config({
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 128256, "hidden_size": 2048,
+        "intermediate_size": 8192, "num_hidden_layers": 16,
+        "num_attention_heads": 32, "num_key_value_heads": 8,
+        "rope_theta": 500000.0,
+        "rope_scaling": {"rope_type": "llama3", "factor": 32.0,
+                         "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                         "original_max_position_embeddings": 8192},
+    })
+    assert cfg.rope_llama3_scaling == (32.0, 1.0, 4.0, 8192)
+    assert cfg.rope_llama3_scaling == \
+        PRESETS["llama-3.2-1b-instruct"].rope_llama3_scaling
+    # non-llama3 rope_scaling (e.g. yarn) maps to None, not garbage
+    cfg2 = ModelConfig.from_hf_config({
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 512, "hidden_size": 128, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+    })
+    assert cfg2.rope_llama3_scaling is None
+
+
+def test_llama3_scaling_changes_model_output_and_serves():
+    base = dataclasses.replace(PRESETS["tiny-debug"], dtype="float32")
+    scaled = dataclasses.replace(base, rope_llama3_scaling=(8.0, 1.0, 4.0, 16))
+    params = llama.init_params(base, jax.random.PRNGKey(0))
+    page_size, n_pages = 4, 16
+    kv = (base.num_layers, n_pages, page_size,
+          base.num_kv_heads * base.head_dim)
+    toks = jnp.asarray(list(range(3, 15)), jnp.int32)
+    pages = jnp.arange(1, 4, dtype=jnp.int32)
+
+    def run(cfg):
+        out = llama.prefill(cfg, params, toks, jnp.int32(12),
+                            jnp.zeros(kv, jnp.float32),
+                            jnp.zeros(kv, jnp.float32),
+                            pages, page_size=page_size)
+        return np.asarray(out.last_logits)
+
+    assert np.abs(run(base) - run(scaled)).max() > 1e-4
+
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4, num_pages=64,
+                              max_num_seqs=2, max_seq_len=48, seed=2),
+                 model_cfg=dataclasses.replace(
+                     PRESETS["tiny-debug"],
+                     rope_llama3_scaling=(8.0, 1.0, 4.0, 16)))
+    prompt = [5, 9, 2, 6]
+    a = eng.generate(GenRequest("a", prompt, max_tokens=8, temperature=0.0,
+                                ignore_eos=True))
+    b = eng.generate(GenRequest("b", prompt, max_tokens=8, temperature=0.0,
+                                ignore_eos=True))
+    assert a == b and len(a) == 8

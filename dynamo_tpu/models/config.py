@@ -18,9 +18,21 @@ from typing import Optional, Tuple
 
 def _llama3_rope_scaling(cfg: dict):
     """HF rope_scaling with rope_type "llama3" (Llama-3.1+) ->
-    (factor, low_freq_factor, high_freq_factor, original_max_pos)."""
+    (factor, low_freq_factor, high_freq_factor, original_max_pos).
+
+    Other scaling kinds: "linear" is modeled for gemma-3 (per-layer) only,
+    "yarn"/"dynamic" are NOT modeled — warn loudly rather than silently
+    serving frequencies the checkpoint wasn't trained with."""
     rs = cfg.get("rope_scaling") or {}
-    if (rs.get("rope_type") or rs.get("type")) != "llama3":
+    kind = rs.get("rope_type") or rs.get("type")
+    if kind != "llama3":
+        if kind in ("yarn", "dynamic", "longrope"):
+            import logging
+
+            logging.getLogger("dynamo_tpu.models").warning(
+                "rope_scaling type %r is not modeled — serving with "
+                "UNSCALED rope; outputs will diverge from the checkpoint's "
+                "training distribution beyond its original context", kind)
         return None
     return (
         float(rs.get("factor", 8.0)),

@@ -20,13 +20,14 @@ def _llama3_rope_scaling(cfg: dict):
     """HF rope_scaling with rope_type "llama3" (Llama-3.1+) ->
     (factor, low_freq_factor, high_freq_factor, original_max_pos).
 
-    Other scaling kinds: "linear" is modeled for gemma-3 (per-layer) only,
-    "yarn"/"dynamic" are NOT modeled — warn loudly rather than silently
-    serving frequencies the checkpoint wasn't trained with."""
+    Other scaling kinds: "linear" is modeled for gemma-3 (per-layer),
+    "yarn" by _yarn_rope_scaling below; "dynamic"/"longrope" are NOT
+    modeled — warn loudly rather than silently serving frequencies the
+    checkpoint wasn't trained with."""
     rs = cfg.get("rope_scaling") or {}
     kind = rs.get("rope_type") or rs.get("type")
     if kind != "llama3":
-        if kind in ("yarn", "dynamic", "longrope"):
+        if kind in ("dynamic", "longrope"):
             import logging
 
             logging.getLogger("dynamo_tpu.models").warning(
@@ -39,6 +40,30 @@ def _llama3_rope_scaling(cfg: dict):
         float(rs.get("low_freq_factor", 1.0)),
         float(rs.get("high_freq_factor", 4.0)),
         int(rs.get("original_max_position_embeddings", 8192)),
+    )
+
+
+def _yarn_rope_scaling(cfg: dict):
+    """HF rope_scaling with type "yarn" (DeepSeek-V2's default) ->
+    (factor, beta_fast, beta_slow, original_max_pos, mscale,
+    mscale_all_dim, attention_factor).
+
+    mscale_all_dim=0 flows through AS zero — yarn_get_mscale(f, 0) == 1,
+    HF's softmax-neutral default. attention_factor=-1 means "derive from
+    mscale"; an explicit value (generic HF yarn) overrides the rotary
+    magnitude and suppresses the DeepSeek softmax mscale^2."""
+    rs = cfg.get("rope_scaling") or {}
+    if (rs.get("rope_type") or rs.get("type")) != "yarn":
+        return None
+    af = rs.get("attention_factor")
+    return (
+        float(rs.get("factor", 1.0)),
+        float(rs.get("beta_fast", 32.0)),
+        float(rs.get("beta_slow", 1.0)),
+        int(rs.get("original_max_position_embeddings", 4096)),
+        float(rs.get("mscale", 1.0)),
+        float(rs.get("mscale_all_dim", 0.0)),
+        float(af) if af is not None else -1.0,
     )
 
 
@@ -90,6 +115,15 @@ class ModelConfig:
     # _embeddings), or None. Applied to inv_freq once — affects every
     # position, so omitting it diverges from HF at ANY length.
     rope_llama3_scaling: Optional[Tuple[float, float, float, int]] = None
+    # YaRN rope scaling (HF type "yarn"; DeepSeek-V2's default):
+    # (factor, beta_fast, beta_slow, original_max_pos, mscale,
+    # mscale_all_dim, attention_factor). Frequencies remap via the
+    # correction-dim ramp; the attention softmax scale gains
+    # yarn_get_mscale(factor, mscale_all_dim)^2 (applied as a q
+    # pre-scale) unless an explicit attention_factor (>= 0) overrides
+    # the rotary magnitude instead (generic HF yarn).
+    rope_yarn_scaling: Optional[
+        Tuple[float, float, float, int, float, float, float]] = None
     # gemma-2/3 sandwich norms: extra RMSNorms on the attention and MLP
     # OUTPUTS (post_attention_layernorm / post_feedforward_layernorm in HF
     # naming — note HF llama's "post_attention_layernorm" is the PRE-MLP
@@ -247,6 +281,7 @@ class ModelConfig:
                 ((cfg.get("rope_scaling") or {}).get("factor"))
                 or 1.0) if is_gemma3 else 1.0,
             rope_llama3_scaling=_llama3_rope_scaling(cfg),
+            rope_yarn_scaling=_yarn_rope_scaling(cfg),
             qk_norm="Qwen3" in arch or is_gemma3,
             attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
             num_experts=n_experts,
@@ -429,6 +464,11 @@ PRESETS = {
         qk_nope_head_dim=128,
         qk_rope_head_dim=64,
         v_head_dim=128,
+        # DeepSeek-V2 ships with YaRN on by default (32k over a 4k
+        # original context, mscale 0.707 both — rotary ratio 1, softmax
+        # scale x yarn_get_mscale(40, .707)^2)
+        rope_yarn_scaling=(40.0, 32.0, 1.0, 4096, 0.707, 0.707, -1.0),
+        max_position_embeddings=163840,
         eos_token_id=100001,
         bos_token_id=100000,
     ),

@@ -90,6 +90,24 @@ def _is_global_layer(cfg: ModelConfig, page_off, pages_per_layer: int):
     return (layer + 1) % cfg.sliding_window_pattern == 0
 
 
+def _yarn_softmax_scale(cfg: ModelConfig, q: jax.Array) -> jax.Array:
+    """YaRN's attention-magnitude correction: the softmax scale gains
+    yarn_get_mscale(factor, mscale_all_dim)^2 (HF DeepSeek-V2 semantics) —
+    folded into q like query_pre_attn_scalar so the attention ops stay
+    signature-free of it."""
+    if cfg.rope_yarn_scaling is None:
+        return q
+    from dynamo_tpu.ops.rope import yarn_get_mscale
+
+    factor, _, _, _, _, msad, af = cfg.rope_yarn_scaling
+    if af >= 0.0:
+        return q  # explicit attention_factor lives on cos/sin instead
+    m = yarn_get_mscale(factor, msad)
+    if m == 1.0:
+        return q
+    return q * jnp.asarray(m * m, q.dtype)
+
+
 def _layer_rope(cfg: ModelConfig, page_off, pages_per_layer: int):
     """Gemma-3 per-layer rope: local (sliding) layers use
     rope_local_theta; GLOBAL layers use rope_theta with positions divided
@@ -275,9 +293,10 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array,
     if rope is not None:
         theta, scale = rope
         pos = positions.astype(jnp.float32) / scale
-    l3 = cfg.rope_llama3_scaling
-    q = apply_rope(q, pos, theta, llama3_scaling=l3)
-    k = apply_rope(k, pos, theta, llama3_scaling=l3)
+    l3, yarn = cfg.rope_llama3_scaling, cfg.rope_yarn_scaling
+    q = apply_rope(q, pos, theta, llama3_scaling=l3, yarn_scaling=yarn)
+    k = apply_rope(k, pos, theta, llama3_scaling=l3, yarn_scaling=yarn)
+    q = _yarn_softmax_scale(cfg, q)
     if cfg.query_pre_attn_scalar > 0:
         # the attention ops scale scores by head_dim^-0.5; gemma-2 wants
         # query_pre_attn_scalar^-0.5 — pre-scale q by the ratio so the
@@ -307,11 +326,13 @@ def _qkv_mla(cfg: ModelConfig, lp: Params, x: jax.Array,
     q = qeinsum("te,ehd->thd", x, lp["wq_mla"])  # [T, H, nope+rope]
     q_nope, q_rope = q[..., :nope], q[..., nope:]
     q_rope = apply_rope(q_rope, positions, cfg.rope_theta,
-                        llama3_scaling=cfg.rope_llama3_scaling)
+                        llama3_scaling=cfg.rope_llama3_scaling,
+                        yarn_scaling=cfg.rope_yarn_scaling)
     kv = qeinsum("te,er->tr", x, lp["w_kv_a"])  # [T, lora+rope]
     c_kv = rms_norm(kv[:, :lora], lp["kv_a_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
     k_rope = apply_rope(kv[:, None, lora:], positions, cfg.rope_theta,
-                        llama3_scaling=cfg.rope_llama3_scaling)[:, 0]
+                        llama3_scaling=cfg.rope_llama3_scaling,
+                        yarn_scaling=cfg.rope_yarn_scaling)[:, 0]
     q_lat = jnp.einsum("thn,hnr->thr", q_nope.astype(jnp.float32),
                        lp["w_uk"].astype(jnp.float32)).astype(q.dtype)
     # generic ops scale scores by 1/sqrt(q.shape[-1]) — the PADDED cache
@@ -322,6 +343,7 @@ def _qkv_mla(cfg: ModelConfig, lp: Params, x: jax.Array,
     fix = (width / (nope + rope)) ** 0.5
     q_eff = jnp.concatenate([q_lat, q_rope], axis=-1) * jnp.asarray(
         fix, q.dtype)
+    q_eff = _yarn_softmax_scale(cfg, q_eff)  # DeepSeek yarn mscale^2
     row = jnp.concatenate([c_kv, k_rope], axis=-1)[:, None, :]  # [T, 1, W]
     pad = width - (lora + rope)
     if pad:

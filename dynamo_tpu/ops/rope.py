@@ -31,8 +31,45 @@ def llama3_scale_freqs(inv: jax.Array, factor: float, low_freq_factor: float,
     return jnp.where(wavelen < high_wavelen, inv, out)
 
 
+def yarn_scale_freqs(inv: jax.Array, theta: float, head_dim: int,
+                     factor: float, beta_fast: float, beta_slow: float,
+                     original_max_pos: int) -> jax.Array:
+    """YaRN frequency remap (HF rope_type "yarn"; DeepSeek-V2's default).
+
+    Dims rotating >= beta_fast times over the original context keep their
+    extrapolated frequencies; dims rotating <= beta_slow times interpolate
+    (inv/factor); a linear-in-dim ramp blends between — the canonical
+    correction-dim formulation, matching HF."""
+    import math
+
+    def corr_dim(n_rot: float) -> float:
+        return (head_dim * math.log(original_max_pos
+                                    / (n_rot * 2 * math.pi))
+                ) / (2 * math.log(theta))
+
+    low = max(math.floor(corr_dim(beta_fast)), 0)
+    # HF clamps against the FULL rotary dim, not dim/2 — a very large
+    # original context can push `high` past the frequency array, meaning
+    # the slowest dims never fully interpolate (ramp < 1 everywhere)
+    high = min(math.ceil(corr_dim(beta_slow)), head_dim - 1)
+    idx = jnp.arange(head_dim // 2, dtype=jnp.float32)
+    ramp = jnp.clip((idx - low) / max(high - low, 1), 0.0, 1.0)
+    extrapolation_mask = 1.0 - ramp  # 1 on fast-rotating (low) dims
+    return (inv * extrapolation_mask
+            + (inv / factor) * (1.0 - extrapolation_mask))
+
+
+def yarn_get_mscale(scale: float, mscale: float = 1.0) -> float:
+    """YaRN attention-magnitude correction (HF/DeepSeek formula)."""
+    if scale <= 1.0:
+        return 1.0
+    import math
+
+    return 0.1 * mscale * math.log(scale) + 1.0
+
+
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
-               llama3_scaling=None) -> jax.Array:
+               llama3_scaling=None, yarn_scaling=None) -> jax.Array:
     """x: [..., seq?, heads, head_dim]; positions broadcastable to x's token dims.
 
     Accepts [S, H, D] with positions [S], or [B, H, D] with positions [B]
@@ -43,9 +80,29 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
     inv = rope_freqs(head_dim, theta)  # [D/2]
     if llama3_scaling is not None:
         inv = llama3_scale_freqs(inv, *llama3_scaling)
+    out_scale = None
+    if yarn_scaling is not None:
+        # (factor, beta_fast, beta_slow, orig_max, mscale, mscale_all_dim,
+        #  attention_factor)
+        factor, bf, bs, orig, ms, msad, af = yarn_scaling
+        inv = yarn_scale_freqs(inv, theta, head_dim, factor, bf, bs, orig)
+        if af >= 0.0:
+            # generic HF yarn: an explicit attention_factor IS the rotary
+            # magnitude (no separate softmax mscale)
+            ratio = af
+        else:
+            # DeepSeek variant: rotary carries mscale/mscale_all_dim; the
+            # attention-softmax mscale^2 is applied by the caller on q
+            ratio = (yarn_get_mscale(factor, ms)
+                     / yarn_get_mscale(factor, msad))
+        if ratio != 1.0:
+            out_scale = ratio
     angles = positions.astype(jnp.float32)[..., None] * inv  # [..., D/2]
     cos = jnp.cos(angles)[..., None, :]  # [..., 1, D/2]
     sin = jnp.sin(angles)[..., None, :]
+    if out_scale is not None:  # yarn rotary magnitude correction
+        cos = cos * out_scale
+        sin = sin * out_scale
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)

@@ -93,3 +93,115 @@ def test_llama3_scaling_changes_model_output_and_serves():
     b = eng.generate(GenRequest("b", prompt, max_tokens=8, temperature=0.0,
                                 ignore_eos=True))
     assert a == b and len(a) == 8
+
+
+# ---------------------------------------------------------------- yarn ----
+
+
+def test_yarn_freq_math_properties():
+    from dynamo_tpu.ops.rope import yarn_get_mscale, yarn_scale_freqs
+
+    inv = np.asarray(rope_freqs(64, 10000.0))
+    out = np.asarray(yarn_scale_freqs(
+        jnp.asarray(inv), 10000.0, 64, 40.0, 32.0, 1.0, 4096))
+    # highest-frequency dims (rotating >= beta_fast times over the
+    # original context) keep their extrapolated frequencies
+    assert out[0] == inv[0]
+    # lowest-frequency dims fully interpolate: inv / factor
+    np.testing.assert_allclose(out[-1], inv[-1] / 40.0, rtol=1e-6)
+    # the blend is monotonic between the ends
+    ratio = out / inv
+    assert (np.diff(ratio) <= 5e-9).all()
+    # factor=1 is identity (and mscale collapses to 1)
+    same = np.asarray(yarn_scale_freqs(
+        jnp.asarray(inv), 10000.0, 64, 1.0, 32.0, 1.0, 4096))
+    np.testing.assert_allclose(same, inv, rtol=1e-7)
+    assert yarn_get_mscale(1.0, 0.707) == 1.0
+    # the DeepSeek-V2 softmax multiplier: (0.1*0.707*ln(40)+1)^2
+    m = yarn_get_mscale(40.0, 0.707)
+    np.testing.assert_allclose(m, 0.1 * 0.707 * np.log(40.0) + 1.0)
+
+
+def test_from_hf_config_parses_yarn():
+    cfg = ModelConfig.from_hf_config({
+        "architectures": ["DeepseekV2ForCausalLM"],
+        "vocab_size": 102400, "hidden_size": 2048,
+        "intermediate_size": 10944, "moe_intermediate_size": 1408,
+        "num_hidden_layers": 27, "num_attention_heads": 16,
+        "num_key_value_heads": 16,
+        "n_routed_experts": 64, "num_experts_per_tok": 6,
+        "n_shared_experts": 2, "norm_topk_prob": False,
+        "kv_lora_rank": 512, "qk_nope_head_dim": 128,
+        "qk_rope_head_dim": 64, "v_head_dim": 128,
+        "rope_scaling": {"type": "yarn", "factor": 40,
+                         "beta_fast": 32, "beta_slow": 1,
+                         "mscale": 0.707, "mscale_all_dim": 0.707,
+                         "original_max_position_embeddings": 4096},
+    })
+    assert cfg.rope_yarn_scaling == (40.0, 32.0, 1.0, 4096, 0.707, 0.707,
+                                     -1.0)
+    assert cfg.rope_yarn_scaling == \
+        PRESETS["deepseek-v2-lite"].rope_yarn_scaling
+
+
+def test_yarn_changes_mla_output_and_serves():
+    """YaRN must actually alter the MLA forward (freqs + softmax mscale),
+    and the engine must serve a yarn MLA config deterministically."""
+    base = dataclasses.replace(PRESETS["tiny-mla-debug"], dtype="float32")
+    yarn = dataclasses.replace(
+        base, rope_yarn_scaling=(40.0, 32.0, 1.0, 64, 0.707, 0.707, -1.0))
+    params = llama.init_params(base, jax.random.PRNGKey(0))
+    page_size, n_pages = 4, 16
+    kv = (base.num_layers, n_pages, page_size,
+          base.cache_kv_heads * base.cache_head_dim)
+    toks = jnp.asarray(list(range(3, 15)), jnp.int32)
+    pages = jnp.arange(1, 4, dtype=jnp.int32)
+
+    def run(cfg):
+        out = llama.prefill(cfg, params, toks, jnp.int32(12),
+                            jnp.zeros(kv, jnp.float32),
+                            jnp.zeros(kv, jnp.float32),
+                            pages, page_size=page_size)
+        return np.asarray(out.last_logits)
+
+    assert np.abs(run(base) - run(yarn)).max() > 1e-4
+
+    eng = Engine(EngineConfig(model="tiny-mla-debug", page_size=4,
+                              num_pages=64, max_num_seqs=2, max_seq_len=48,
+                              seed=4),
+                 model_cfg=dataclasses.replace(
+                     PRESETS["tiny-mla-debug"],
+                     rope_yarn_scaling=(40.0, 32.0, 1.0, 64, 0.707, 0.707,
+                                        -1.0)))
+    prompt = [5, 9, 2, 6]
+    a = eng.generate(GenRequest("a", prompt, max_tokens=8, temperature=0.0,
+                                ignore_eos=True))
+    b = eng.generate(GenRequest("b", prompt, max_tokens=8, temperature=0.0,
+                                ignore_eos=True))
+    assert a == b and len(a) == 8
+
+
+def test_yarn_attention_factor_override():
+    """Generic HF yarn: an explicit attention_factor replaces the
+    mscale-derived rotary magnitude AND suppresses the softmax mscale^2."""
+    cfg = ModelConfig.from_hf_config({
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 512, "hidden_size": 128, "intermediate_size": 256,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "rope_scaling": {"rope_type": "yarn", "factor": 4.0,
+                         "attention_factor": 1.0,
+                         "original_max_position_embeddings": 2048},
+    })
+    assert cfg.rope_yarn_scaling[-1] == 1.0
+    base = dataclasses.replace(PRESETS["tiny-debug"], dtype="float32")
+    q = jnp.ones((3, 4, 32), jnp.float32)
+    with_af = dataclasses.replace(
+        base, rope_yarn_scaling=(4.0, 32.0, 1.0, 2048, 1.0, 1.0, 1.0))
+    # af=1.0 -> softmax mscale suppressed: q untouched
+    np.testing.assert_array_equal(
+        np.asarray(llama._yarn_softmax_scale(with_af, q)), np.asarray(q))
+    without_af = dataclasses.replace(
+        base, rope_yarn_scaling=(4.0, 32.0, 1.0, 2048, 1.0, 1.0, -1.0))
+    scaled = np.asarray(llama._yarn_softmax_scale(without_af, q))
+    m = 0.1 * 1.0 * np.log(4.0) + 1.0
+    np.testing.assert_allclose(scaled, np.asarray(q) * m * m, rtol=1e-6)

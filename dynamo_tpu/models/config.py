@@ -264,10 +264,14 @@ class ModelConfig:
             rms_norm_unit_offset=is_gemma,
             embed_scale=is_gemma,
             sliding_window=(int(cfg.get("sliding_window") or 0)
-                            if (is_gemma2 or is_gemma3) else 0),
-            sliding_window_pattern=int(
-                cfg.get("sliding_window_pattern")
-                or (6 if is_gemma3 else 2)),
+                            if (is_gemma2 or is_gemma3
+                                or "Mistral" in arch) else 0),
+            # Mistral applies its window on EVERY layer (pattern 0 = no
+            # global layers); gemma-2/3 interleave
+            sliding_window_pattern=(
+                0 if "Mistral" in arch else int(
+                    cfg.get("sliding_window_pattern")
+                    or (6 if is_gemma3 else 2))),
             attn_logit_softcapping=float(
                 cfg.get("attn_logit_softcapping") or 0.0),
             final_logit_softcapping=float(

@@ -84,8 +84,12 @@ def _attn_kwargs(cfg: ModelConfig, page_off, pages_per_layer: int) -> dict:
 
 def _is_global_layer(cfg: ModelConfig, page_off, pages_per_layer: int):
     """THE local/global predicate (traced): layer (i+1) %
-    sliding_window_pattern == 0 is global. Shared by the window mask and
-    the per-layer rope so the two can never desynchronize."""
+    sliding_window_pattern == 0 is global; pattern <= 0 means EVERY layer
+    is local (Mistral-v0.1-style uniform sliding window). Shared by the
+    window mask and the per-layer rope so the two can never
+    desynchronize."""
+    if cfg.sliding_window_pattern <= 0:
+        return jnp.bool_(False)
     layer = page_off // pages_per_layer
     return (layer + 1) % cfg.sliding_window_pattern == 0
 

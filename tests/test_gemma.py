@@ -331,3 +331,53 @@ def test_gemma3_engine_end_to_end():
     c = eng2.generate(GenRequest("c", prompt, max_tokens=10, temperature=0.0,
                                  ignore_eos=True))
     assert c == a
+
+
+# ------------------------------------------------- mistral sliding window --
+
+
+def test_mistral_uniform_sliding_window():
+    """MistralForCausalLM (v0.1-style): the window applies on EVERY layer
+    (pattern 0 = no global layers); v0.3-style configs with
+    sliding_window: null map to no window at all."""
+    import dataclasses
+
+    import jax
+
+    hf = {
+        "architectures": ["MistralForCausalLM"],
+        "vocab_size": 32000, "hidden_size": 4096,
+        "intermediate_size": 14336, "num_hidden_layers": 32,
+        "num_attention_heads": 32, "num_key_value_heads": 8,
+        "rope_theta": 10000.0, "sliding_window": 4096,
+    }
+    cfg = ModelConfig.from_hf_config(hf)
+    assert cfg.sliding_window == 4096 and cfg.sliding_window_pattern == 0
+    assert ModelConfig.from_hf_config(
+        {**hf, "sliding_window": None}).sliding_window == 0
+
+    # every layer local: a distant perturbation is invisible even with
+    # MULTIPLE layers (an interleaved pattern would leak it via a global
+    # layer)
+    base = dataclasses.replace(
+        PRESETS["tiny-debug"], dtype="float32", num_layers=2,
+        sliding_window=4, sliding_window_pattern=0)
+    params = llama.init_params(base, jax.random.PRNGKey(0))
+    page_size, n_pages = 4, 16
+    kv = (2, n_pages, page_size, base.num_kv_heads * base.head_dim)
+    toks = jnp.asarray([9, 8, 7, 6, 5, 4, 3, 2, 1, 2, 3, 4], jnp.int32)
+    toks2 = toks.at[1].set(100)
+    pages = jnp.arange(1, 4, dtype=jnp.int32)
+
+    def last(cfg_, t):
+        out = llama.prefill(cfg_, params, t, jnp.int32(12),
+                            jnp.zeros(kv, jnp.float32),
+                            jnp.zeros(kv, jnp.float32),
+                            pages, page_size=page_size)
+        return np.asarray(out.last_logits)
+
+    np.testing.assert_allclose(last(base, toks), last(base, toks2),
+                               atol=1e-5)
+    # with an interleaved pattern the global layer DOES see it
+    mixed = dataclasses.replace(base, sliding_window_pattern=2)
+    assert np.abs(last(mixed, toks) - last(mixed, toks2)).max() > 1e-4

@@ -654,3 +654,4 @@ PRESETS["meta-llama/Llama-3.2-1B-Instruct".lower().split("/")[-1]] = PRESETS[
     "llama-3.2-1b-instruct"
 ]
 PRESETS["qwen/qwen3-0.6b".split("/")[-1]] = PRESETS["qwen3-0.6b"]
+PRESETS["deepseek-v2-lite-chat"] = PRESETS["deepseek-v2-lite"]

@@ -375,6 +375,41 @@ PRESETS = {
         eos_token_id=128009,
         bos_token_id=128000,
     ),
+    # Llama-3.1: same architecture as 3.0-8B plus llama3 rope scaling and
+    # the 128k window (public HF config)
+    "llama-3.1-8b-instruct": ModelConfig(
+        name="llama-3.1-8b-instruct",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        max_position_embeddings=131072,
+        rope_llama3_scaling=(8.0, 1.0, 4.0, 8192),
+        tie_word_embeddings=False,
+        eos_token_id=128009,
+        bos_token_id=128000,
+    ),
+    # Qwen2.5: Qwen2 architecture (attention bias, no qk-norm)
+    "qwen2.5-7b-instruct": ModelConfig(
+        name="qwen2.5-7b-instruct",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1000000.0,
+        max_position_embeddings=32768,
+        tie_word_embeddings=False,
+        attention_bias=True,
+        eos_token_id=151645,
+        bos_token_id=151643,
+    ),
     "meta-llama-3-70b-instruct": ModelConfig(
         name="meta-llama-3-70b-instruct",
         vocab_size=128256,

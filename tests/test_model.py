@@ -146,3 +146,30 @@ def test_batched_decode_independent_sequences():
     )
     np.testing.assert_allclose(np.asarray(dec.logits[0]), ref0, rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(dec.logits[1]), ref1, rtol=2e-4, atol=2e-4)
+
+
+def test_every_preset_has_shardable_param_specs():
+    """Model-zoo drift guard: every (non-debug) preset's parameter tree
+    must resolve PartitionSpecs whose rank matches the param rank — a new
+    family whose params don't fit PARAM_RULES would otherwise surface as
+    an opaque NamedSharding rank error at first TP deployment."""
+    from dynamo_tpu.models.config import PRESETS
+    from dynamo_tpu.parallel import sharding as shd
+
+    seen = set()
+    for name, cfg in PRESETS.items():
+        if id(cfg) in seen:  # aliases point at the same config object
+            continue
+        seen.add(id(cfg))
+        specs = llama.param_specs(cfg)
+        rules = shd.param_specs(
+            {k: type("L", (), {"ndim": len(shape)})()
+             for k, (shape, _, _) in specs.items()})
+        for k, (shape, _, _) in specs.items():
+            rule = rules[k]
+            assert len(rule) <= len(shape), (
+                f"{name}.{k}: spec rank {len(rule)} > param rank "
+                f"{len(shape)}")
+            for axis in rule:
+                assert axis is None or axis in shd.KNOWN_MESH_AXES, (
+                    name, k, axis)

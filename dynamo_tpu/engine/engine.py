@@ -1228,7 +1228,9 @@ class Engine:
             top_k=req.top_k,
             stop_token_ids=(
                 [] if req.ignore_eos
-                else (req.stop_token_ids or [self.model_cfg.eos_token_id])
+                else (req.stop_token_ids
+                      or [self.model_cfg.eos_token_id,
+                          *self.model_cfg.extra_stop_token_ids])
             ),
             logprobs=req.logprobs,
         )
@@ -1931,7 +1933,9 @@ class Engine:
                 f"per TP shard)")
         stop_ids = (
             [] if req.ignore_eos
-            else (req.stop_token_ids or [self.model_cfg.eos_token_id])
+            else (req.stop_token_ids
+                  or [self.model_cfg.eos_token_id,
+                      *self.model_cfg.extra_stop_token_ids])
         )
         if first_token in stop_ids:
             return True, "stop"

@@ -164,6 +164,10 @@ class ModelConfig:
     dtype: str = "bfloat16"
     eos_token_id: int = 2
     bos_token_id: int = 1
+    # additional end-of-generation tokens (HF generation_config's eos
+    # LIST): gemma-it models end chat turns with <end_of_turn>=107, which
+    # they emit BEFORE <eos> — without it generations run to max_tokens
+    extra_stop_token_ids: Tuple[int, ...] = ()
 
     @property
     def is_moe(self) -> bool:
@@ -529,6 +533,7 @@ PRESETS = {
         rms_norm_unit_offset=True,
         embed_scale=True,
         eos_token_id=1,
+        extra_stop_token_ids=(107,),  # <end_of_turn>
         bos_token_id=2,
     ),
     "gemma-2b-it": ModelConfig(
@@ -546,6 +551,7 @@ PRESETS = {
         rms_norm_unit_offset=True,
         embed_scale=True,
         eos_token_id=1,
+        extra_stop_token_ids=(107,),  # <end_of_turn>
         bos_token_id=2,
     ),
     "tiny-gemma-debug": ModelConfig(
@@ -577,6 +583,7 @@ PRESETS = {
         query_pre_attn_scalar=256.0,
         post_norms=True,
         eos_token_id=1,
+        extra_stop_token_ids=(107,),  # <end_of_turn>
         bos_token_id=2,
     ),
     "gemma-2-2b-it": ModelConfig(
@@ -599,6 +606,7 @@ PRESETS = {
         query_pre_attn_scalar=256.0,
         post_norms=True,
         eos_token_id=1,
+        extra_stop_token_ids=(107,),  # <end_of_turn>
         bos_token_id=2,
     ),
     # Gemma-3 (text): 5-local:1-global sliding pattern, per-layer rope
@@ -629,6 +637,7 @@ PRESETS = {
         rope_local_theta=10_000.0,
         rope_scaling_factor=8.0,
         eos_token_id=1,
+        extra_stop_token_ids=(107,),  # <end_of_turn>
         bos_token_id=2,
     ),
     "gemma-3-1b-it": ModelConfig(
@@ -654,6 +663,7 @@ PRESETS = {
         rope_theta=1_000_000.0,
         rope_local_theta=10_000.0,
         eos_token_id=1,
+        extra_stop_token_ids=(107,),  # <end_of_turn>
         bos_token_id=2,
     ),
     "tiny-gemma3-debug": ModelConfig(

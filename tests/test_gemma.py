@@ -381,3 +381,25 @@ def test_mistral_uniform_sliding_window():
     # with an interleaved pattern the global layer DOES see it
     mixed = dataclasses.replace(base, sliding_window_pattern=2)
     assert np.abs(last(mixed, toks) - last(mixed, toks2)).max() > 1e-4
+
+
+def test_extra_stop_token_ends_generation():
+    """gemma-it's <end_of_turn> (107) must end generation like <eos>:
+    force its emission via logit_bias and assert the 'stop' finish."""
+    import dataclasses
+
+    cfg = dataclasses.replace(PRESETS["tiny-gemma-debug"],
+                              extra_stop_token_ids=(107,))
+    eng = Engine(EngineConfig(model="tiny-gemma-debug", page_size=4,
+                              num_pages=64, max_num_seqs=2, max_seq_len=48,
+                              seed=3), model_cfg=cfg)
+    eng.add_request(GenRequest("s", [5, 9, 2, 6], max_tokens=16,
+                               temperature=0.0,
+                               logit_bias={107: 100.0}))
+    events = []
+    while eng.has_work:
+        events.extend(eng.step())
+    fin = [e for e in events if e.finished]
+    assert fin and fin[0].finish_reason == "stop"
+    toks = [e.token_id for e in events if e.token_id >= 0]
+    assert toks[-1] == 107 and len(toks) < 16

@@ -403,3 +403,25 @@ def test_extra_stop_token_ends_generation():
     assert fin and fin[0].finish_reason == "stop"
     toks = [e.token_id for e in events if e.token_id >= 0]
     assert toks[-1] == 107 and len(toks) < 16
+
+
+def test_gemma2_speculative_decode_token_identical():
+    """n-gram speculative decoding must stay token-identical to sequential
+    decoding on a sliding-window + softcap model (the verify attention
+    applies the same per-layer window as the step-by-step path)."""
+    seq_eng = Engine(EngineConfig(model="tiny-gemma2-debug", page_size=4,
+                                  num_pages=64, max_num_seqs=2,
+                                  max_seq_len=64, seed=9))
+    prompt = [4, 7, 4, 7, 4, 7, 4, 7, 4, 7, 4, 7]  # repetitive: drafts hit
+    ref = seq_eng.generate(GenRequest("r", prompt, max_tokens=14,
+                                      temperature=0.0, ignore_eos=True))
+    spec_eng = Engine(EngineConfig(model="tiny-gemma2-debug", page_size=4,
+                                   num_pages=64, max_num_seqs=2,
+                                   max_seq_len=64, seed=9,
+                                   speculative_mode="ngram"),
+                      params=seq_eng.params)
+    out = spec_eng.generate(GenRequest("s", prompt, max_tokens=14,
+                                       temperature=0.0, ignore_eos=True))
+    assert out == ref, "spec decode diverged on a sliding-window model"
+    assert spec_eng.metrics.spec_accepted_tokens > 0, (
+        "repetitive prompt should accept drafts")

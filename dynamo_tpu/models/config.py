@@ -169,6 +169,14 @@ class ModelConfig:
     # they emit BEFORE <eos> — without it generations run to max_tokens
     extra_stop_token_ids: Tuple[int, ...] = ()
 
+    def __post_init__(self):
+        if self.is_moe and self.hidden_act != "silu":
+            # the MoE dispatch kernels (ops/moe.py) contract with SwiGLU;
+            # a GeGLU MoE config would silently serve the wrong activation
+            raise ValueError(
+                f"MoE models are SwiGLU-only (hidden_act={self.hidden_act!r}"
+                " requested); ops/moe.py would need the activation plumbed")
+
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0

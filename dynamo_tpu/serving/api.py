@@ -222,6 +222,11 @@ class ServingContext:
             "dynamo_worker_kv_free_pages", "Free KV pages", self.metrics.registry
         )
         self.staged_kv_gauge = None  # registered with DeviceKVSource below
+        self.preempt_gauge = Gauge(
+            "dynamo_worker_preemptions_total",
+            "Sequences preempted (recompute) under KV page pressure",
+            self.metrics.registry,
+        )
         self.start_time = time.time()
         self._trace_lock = threading.Lock()  # one profiler capture at a time
 
@@ -351,6 +356,8 @@ class _Handler(JsonHTTPHandler):
         if path == "/v1/models":
             self._json(200, proto.models_response([self.ctx.served_model]))
         elif path == "/metrics":
+            self.ctx.preempt_gauge.set(
+                self.ctx.engine.metrics.num_preempted)
             ds = self.ctx.kv_device_source
             if ds is not None:
                 # scrape-time refresh: leaked > 0 flags a decode peer that

@@ -425,3 +425,18 @@ def test_gemma2_speculative_decode_token_identical():
     assert out == ref, "spec decode diverged on a sliding-window model"
     assert spec_eng.metrics.spec_accepted_tokens > 0, (
         "repetitive prompt should accept drafts")
+
+
+def test_gemma2_int8_kv_serves():
+    """int8 KV pages + sliding-window XLA decode compose: the windowed
+    gather path dequantizes lane-blocked rows, masks the window, and stays
+    greedy-deterministic."""
+    eng = Engine(EngineConfig(model="tiny-gemma2-debug", page_size=4,
+                              num_pages=64, max_num_seqs=2, max_seq_len=48,
+                              seed=6, kv_cache_dtype="int8"))
+    prompt = list(range(3, 19))
+    a = eng.generate(GenRequest("a", prompt, max_tokens=10, temperature=0.0,
+                                ignore_eos=True))
+    b = eng.generate(GenRequest("b", prompt, max_tokens=10, temperature=0.0,
+                                ignore_eos=True))
+    assert a == b and len(a) == 10

@@ -440,3 +440,33 @@ def test_gemma2_int8_kv_serves():
     b = eng.generate(GenRequest("b", prompt, max_tokens=10, temperature=0.0,
                                 ignore_eos=True))
     assert a == b and len(a) == 10
+
+
+def test_gemma2_disagg_handoff_matches_agg():
+    """Sliding-window model across disaggregated roles: prefill -> KV
+    handoff -> decode continuation equals aggregated serving (the window
+    mask must hold over IMPORTED pages and continued positions)."""
+    from dynamo_tpu.transfer.kv_transfer import ICIHandoff
+
+    kw = dict(model="tiny-gemma2-debug", page_size=4, num_pages=64,
+              max_num_seqs=2, max_seq_len=64, seed=8)
+    agg = Engine(EngineConfig(**kw))
+    prompt = list(range(5, 21))  # 16 tokens > window 8
+    ref = agg.generate(GenRequest("r", prompt, max_tokens=10,
+                                  temperature=0.0, ignore_eos=True))
+
+    pe = Engine(EngineConfig(**{**kw, "disaggregation_mode": "prefill"}),
+                params=agg.params)
+    de = Engine(EngineConfig(**{**kw, "disaggregation_mode": "decode"}),
+                params=agg.params)
+    req = GenRequest("d", prompt, max_tokens=10, temperature=0.0,
+                     ignore_eos=True)
+    first, n, _ = pe.prefill_only(req)
+    assert first == ref[0]
+    ICIHandoff(pe, de).transfer(req, first)
+    rest = []
+    while de.has_work:
+        for ev in de.step():
+            if ev.request_id == "d" and ev.token_id >= 0:
+                rest.append(ev.token_id)
+    assert [first] + rest == ref

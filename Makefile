@@ -20,6 +20,8 @@ help:
 	@echo "  dynamo         install Dynamo-TPU platform (CRDs, operator, etcd, NATS, TPU device plugin)"
 	@echo "  install        k8s + dynamo"
 	@echo "  benchmark-env  create benchmark virtualenv + deps"
+	@echo "  image          build the runtime container image (IMAGE=, JAX_EXTRA=)"
+	@echo "  release-manifests  pinned install bundle in dist/ (RELEASE_VERSION=)"
 	@echo "  test           fast test tier (skips compile-heavy/slow; CI-grade, <5 min on 1 CPU)"
 	@echo "  test-full      full suite (compile-heavy + slow included)"
 	@echo ""

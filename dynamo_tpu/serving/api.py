@@ -223,7 +223,7 @@ class ServingContext:
         )
         self.staged_kv_gauge = None  # registered with DeviceKVSource below
         self.preempt_gauge = Gauge(
-            "dynamo_worker_preemptions_total",
+            "dynamo_worker_preempted_sequences",
             "Sequences preempted (recompute) under KV page pressure",
             self.metrics.registry,
         )

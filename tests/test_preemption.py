@@ -142,3 +142,45 @@ def test_no_priority_inversion():
     assert eng.metrics.num_preempted >= 1
     assert not preempted_best, "priority-0 seq was preempted for priority-9"
     assert len(out["best"]) == 24 and len(out["worst"]) == 24
+
+
+def test_scheduler_stress_tight_pool_deterministic():
+    """Randomized (seeded) mix of lengths/priorities/sampling under a
+    tight pool: every request completes in full (preemption absorbs all
+    pressure), and the whole run is token-deterministic across repeats."""
+    import random
+
+    rng = random.Random(7)
+    reqs = []
+    for i in range(8):
+        plen = rng.randint(3, 14)
+        reqs.append(dict(
+            request_id=f"r{i}",
+            prompt_token_ids=[rng.randint(1, 400) for _ in range(plen)],
+            max_tokens=rng.randint(4, 20),
+            temperature=rng.choice([0.0, 0.8]),
+            seed=rng.randint(0, 999),
+            priority=rng.choice([0, 0, 3, 9]),
+            ignore_eos=True,
+        ))
+
+    def run(params=None):
+        eng = Engine(EngineConfig(**{**KW, "num_pages": 14,
+                                     "max_num_seqs": 3}), params=params)
+        for r in reqs:
+            eng.add_request(GenRequest(**r))
+        out = {r["request_id"]: [] for r in reqs}
+        while eng.has_work:
+            for ev in eng.step():
+                if ev.token_id >= 0:
+                    out[ev.request_id].append(ev.token_id)
+                assert ev.finish_reason in (None, "stop", "length"), (
+                    ev.finish_reason)
+        return eng, out
+
+    eng1, out1 = run()
+    assert eng1.metrics.num_preempted >= 1, "stress never hit pressure"
+    for r in reqs:
+        assert len(out1[r["request_id"]]) == r["max_tokens"], r["request_id"]
+    eng2, out2 = run(params=eng1.params)
+    assert out1 == out2, "scheduler stress run is not deterministic"

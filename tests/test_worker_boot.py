@@ -1,0 +1,76 @@
+"""Worker process lifecycle e2e: boot `python -m dynamo_tpu.jetstream`,
+serve a real completion, then SIGTERM — the graceful drain must
+deregister, finish, and exit 0 (the pod-termination contract)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_worker_boot_serve_sigterm_drain():
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update(JAX_PLATFORMS="cpu", DRAIN_TIMEOUT_S="20",
+               DYNAMO_TPU_MODEL="tiny-debug")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.jetstream",
+         "--model", "tiny-debug", "--host", "127.0.0.1",
+         "--port", str(port), "--page-size", "4", "--num-pages", "64",
+         "--max-num-seqs", "2", "--max-seq-len", "64"],
+        env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    url = f"http://127.0.0.1:{port}"
+    try:
+        deadline = time.monotonic() + 240  # first CPU compile is slow
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "worker died during boot:\n"
+                    + proc.stderr.read().decode()[-2000:])
+            try:
+                with urllib.request.urlopen(url + "/ready", timeout=2):
+                    break
+            except Exception:
+                time.sleep(0.5)
+        else:
+            raise AssertionError("worker never became ready")
+
+        body = json.dumps({
+            "model": "tiny-debug",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 4, "temperature": 0,
+        }).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                url + "/v1/chat/completions", data=body,
+                headers={"Content-Type": "application/json"}), timeout=60
+                ) as r:
+            out = json.loads(r.read())
+        assert out["choices"][0]["message"]["content"] is not None
+        assert out["usage"]["completion_tokens"] >= 1
+
+        # pod termination: SIGTERM -> graceful drain -> clean exit
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        assert rc == 0, (
+            f"drain exit code {rc}:\n" + proc.stderr.read().decode()[-2000:])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)

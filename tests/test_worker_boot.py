@@ -74,3 +74,88 @@ def test_worker_boot_serve_sigterm_drain():
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_frontend_boot_register_proxy_sigterm():
+    """Frontend process lifecycle: boot `python -m dynamo_tpu.frontend`,
+    register an in-test fake worker, proxy a completion through it, and
+    exit clean on SIGTERM."""
+    import http.server
+    import threading
+
+    # minimal fake worker the frontend can proxy to
+    class W(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(n)
+            body = json.dumps({
+                "id": "x", "object": "chat.completion",
+                "choices": [{"index": 0, "message": {
+                    "role": "assistant", "content": "ok"},
+                    "finish_reason": "stop"}],
+                "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                          "total_tokens": 2},
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    wsrv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), W)
+    threading.Thread(target=wsrv.serve_forever, daemon=True).start()
+    wurl = f"http://127.0.0.1:{wsrv.server_address[1]}"
+
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.frontend",
+         "--host", "127.0.0.1", "--port", str(port)],
+        env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    url = f"http://127.0.0.1:{port}"
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError("frontend died:\n"
+                                     + proc.stderr.read().decode()[-1500:])
+            try:
+                urllib.request.urlopen(url + "/v1/models", timeout=2).close()
+                break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            raise AssertionError("frontend never came up")
+
+        reg = json.dumps({"url": wurl, "model": "m", "mode": "agg",
+                          "stats": {"max_num_seqs": 4, "free_pages": 10,
+                                    "total_pages": 16}}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            url + "/internal/register", data=reg,
+            headers={"Content-Type": "application/json"}), timeout=10
+        ).close()
+        body = json.dumps({"model": "m", "messages": [
+            {"role": "user", "content": "hi"}]}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                url + "/v1/chat/completions", data=body,
+                headers={"Content-Type": "application/json"}), timeout=30
+                ) as r:
+            out = json.loads(r.read())
+        assert out["choices"][0]["message"]["content"] == "ok"
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        wsrv.shutdown()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)

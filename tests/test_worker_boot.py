@@ -159,3 +159,67 @@ def test_frontend_boot_register_proxy_sigterm():
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_worker_boot_with_nats_plane_drains_clean():
+    """Worker boot with --nats-url (embedded broker): the NATS request
+    plane comes up, serves a chat completion over its subject, and the
+    SIGTERM drain closes the plane before exiting 0."""
+    from dynamo_tpu.serving.nats import MiniNatsBroker, NatsClient
+    from dynamo_tpu.serving.nats_plane import nats_request, worker_subject
+
+    broker = MiniNatsBroker()
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update(JAX_PLATFORMS="cpu", DRAIN_TIMEOUT_S="20")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.jetstream",
+         "--model", "tiny-debug", "--host", "127.0.0.1",
+         "--port", str(port), "--page-size", "4", "--num-pages", "64",
+         "--max-num-seqs", "2", "--max-seq-len", "64",
+         "--nats-url", broker.url],
+        env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    url = f"http://127.0.0.1:{port}"
+    nc = None
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError("worker died:\n"
+                                     + proc.stderr.read().decode()[-2000:])
+            try:
+                with urllib.request.urlopen(url + "/ready", timeout=2):
+                    break
+            except Exception:
+                time.sleep(0.5)
+        else:
+            raise AssertionError("worker never ready")
+
+        nc = NatsClient(broker.url)
+        worker_url = f"http://127.0.0.1:{port}"
+        status, ctype, chunks = nats_request(
+            nc, worker_subject(worker_url),
+            "/v1/chat/completions",
+            {"model": "tiny-debug",
+             "messages": [{"role": "user", "content": "hi"}],
+             "max_tokens": 3, "temperature": 0},
+            timeout=120,
+        )
+        assert status == 200, status
+        payload = json.loads(b"".join(chunks))
+        assert payload["usage"]["completion_tokens"] >= 1
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if nc is not None:
+            nc.close()
+        broker.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)

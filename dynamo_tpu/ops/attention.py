@@ -401,25 +401,40 @@ def chunk_attention(
     decode op, whose per-row tables would duplicate the prefix C times).
 
     Two implementations:
-    - XLA (default): the gather feeds a masked-softmax attention; simple,
-      correct everywhere, but materializes [H, C, S] scores per layer.
-    - Pallas flash (env DYNAMO_TPU_CHUNK_ATTENTION=pallas, TPU only): the
-      decode kernel's superblock DMA ring with a query BLOCK per grid row —
-      no score materialization, each KV byte fetched once per query block.
-      Gated off by default until validated on hardware (interpret-mode
-      tests cover semantics; Mosaic lowering needs a real chip).
+    - XLA: the gather feeds a masked-softmax attention; simple, correct
+      everywhere, but materializes [H, C, S] scores per layer.
+    - Pallas flash (default on TPU for bf16 pools since the round-5 on-chip
+      parity pass; DYNAMO_TPU_CHUNK_ATTENTION overrides): the decode
+      kernel's superblock DMA ring with a query BLOCK per grid row — no
+      score materialization, each KV byte fetched once per query block.
+      The int8-KV dequant-in-chunk path stays env-opt-in until its own
+      on-chip parity case passes (CHUNK_KERNEL_INT8_HW_VALIDATED).
     """
     # Selection: the DYNAMO_TPU_CHUNK_ATTENTION env var wins when set;
     # otherwise, once the kernel is hardware-validated
     # (pallas_attention.CHUNK_KERNEL_HW_VALIDATED — flipped by the battery's
     # chunk_kernel_parity case), selection follows _resolve_backend() like
-    # the decode/prefill ops. Until then the default stays the XLA path.
+    # the decode/prefill ops.
     backend = os.environ.get("DYNAMO_TPU_CHUNK_ATTENTION")
     if not backend:
         from dynamo_tpu.ops import pallas_attention as _pa
 
         backend = (_resolve_backend() if _pa.CHUNK_KERNEL_HW_VALIDATED
                    else "xla")
+        # the on-chip parity case that flipped the flag ran bf16 pages;
+        # int8 dequant-in-chunk has its own gate (battery case
+        # chunk_kernel_int8_parity)
+        if backend in ("pallas", "pallas_interpret") \
+                and k_pages.dtype == jnp.int8 \
+                and not _pa.CHUNK_KERNEL_INT8_HW_VALIDATED:
+            if _explicit_backend() is not None:
+                import logging
+
+                logging.getLogger("dynamo_tpu.ops").warning(
+                    "pallas chunk attention on int8 KV is not yet "
+                    "hardware-validated; using the XLA gather path (set "
+                    "DYNAMO_TPU_CHUNK_ATTENTION=pallas to force)")
+            backend = "xla"
     if window is not None or logit_cap:
         backend = "xla"  # sliding window / softcap: kernel doesn't model them
     if backend in ("pallas", "pallas_interpret") \

@@ -76,11 +76,18 @@ def _env_int(name: str, default: int, lo: int) -> int:
 
 # Chunk-prefill kernel hardware-validation flag: while False, chunked
 # prefill defaults to the XLA gather path unless DYNAMO_TPU_CHUNK_ATTENTION
-# explicitly selects the kernel. Flip to True once the battery's
-# chunk_kernel_parity case passes on a real chip (interpret mode cannot
-# validate Mosaic lowering) — selection then follows the engine's
-# attention backend like the decode/prefill ops.
-CHUNK_KERNEL_HW_VALIDATED = False
+# explicitly selects the kernel. Flipped True after the round-5 battery's
+# chunk_kernel_parity case passed on a real chip (interpret mode cannot
+# validate Mosaic lowering): bench_results/tpu_battery_r05.jsonl,
+# 2026-07-31T03:48:20Z, max_abs_err 0.0098 (bf16 tolerance) vs the XLA
+# gather path. Selection now follows the engine's attention backend like
+# the decode/prefill ops.
+CHUNK_KERNEL_HW_VALIDATED = True
+
+# The chunk kernel's int8-KV dequant path was NOT covered by that bf16
+# parity case; it stays env-opt-in (DYNAMO_TPU_CHUNK_ATTENTION=pallas)
+# until the battery's chunk_kernel_int8_parity case passes on chip.
+CHUNK_KERNEL_INT8_HW_VALIDATED = False
 
 # pages per decode superblock (tokens per block = this * page_size);
 # DYNAMO_TPU_DECODE_BLOCK_PAGES / _NUM_BUFS override for hardware tuning

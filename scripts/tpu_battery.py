@@ -61,6 +61,7 @@ MATRIX = [
     # never comes up this session (the calibration test reads it)
     ("sla_roofline", "case", {"JAX_PLATFORMS": "cpu"}, 300),
     ("chunk_kernel_parity", "case", {}, 1200),
+    ("chunk_kernel_int8_parity", "case", {}, 1200),
     ("int8_decode_parity", "case", {}, 1200),
     ("headline", "bench", {}, 5400),
     ("multistep_16", "bench", {"BENCH_MULTISTEP": 16}, 2400),
@@ -201,23 +202,56 @@ def _case_chunk_parity() -> dict:
 
     import jax.numpy as jnp
 
-    from dynamo_tpu.ops import attention as att
-    from dynamo_tpu.ops import pallas_attention as pa
-
     rng = np.random.default_rng(5)
     ps, n_kv, d, h = 16, 8, 128, 32
     kp = jnp.asarray(rng.normal(size=(64, ps, n_kv * d)), jnp.bfloat16)
     vp = jnp.asarray(rng.normal(size=(64, ps, n_kv * d)), jnp.bfloat16)
-    pages = jnp.asarray(list(range(1, 17)) + [0] * 4, jnp.int32)
     q = jnp.asarray(rng.normal(size=(256, h, d)), jnp.bfloat16)
-    saved = os.environ.pop("DYNAMO_TPU_CHUNK_ATTENTION", None)
-    try:
+    return _chunk_parity_verdict(q, kp, vp)
+
+
+def _case_chunk_int8_parity() -> dict:
+    """int8-KV chunk-prefill parity on chip: the dequant-in-chunk path was
+    NOT covered by chunk_kernel_parity (bf16 pages); this is the gate for
+    CHUNK_KERNEL_INT8_HW_VALIDATED."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops import attention as att
+
+    rng = np.random.default_rng(13)
+    ps, n_kv, d, h = 16, 8, 128, 32
+    kf = jnp.asarray(rng.normal(size=(64 * ps, n_kv, d)), jnp.float32)
+    vf = jnp.asarray(rng.normal(size=(64 * ps, n_kv, d)), jnp.float32)
+    w = att.kv_lane_width(n_kv, d, True)
+    k8 = att.pack_kv_rows(kf, w).reshape(64, ps, w)
+    v8 = att.pack_kv_rows(vf, w).reshape(64, ps, w)
+    q = jnp.asarray(rng.normal(size=(256, h, d)), jnp.bfloat16)
+    # both paths dequant identically so cross-path disagreement stays small
+    # even though int8 quantization error dominates vs float KV
+    return _chunk_parity_verdict(q, k8, v8)
+
+
+def _chunk_parity_verdict(q, kp, vp, ps: int = 16, n_kv: int = 8) -> dict:
+    """Kernel-vs-XLA parity over a 16-page prompt. The oracle is PINNED to
+    the XLA path: with CHUNK_KERNEL_HW_VALIDATED defaulting True, an
+    unpinned att.chunk_attention would resolve to the Pallas kernel itself
+    on TPU and the case would compare the kernel to itself."""
+    from unittest import mock
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops import pallas_attention as pa
+
+    pages = jnp.asarray(list(range(1, 17)) + [0] * 4, jnp.int32)
+    with mock.patch.dict(os.environ, {"DYNAMO_TPU_CHUNK_ATTENTION": "xla"}):
         ref = np.asarray(att.chunk_attention(
             q, kp, vp, pages, 64, page_size=ps,
             num_kv_heads=n_kv).astype(jnp.float32))
-    finally:
-        if saved is not None:
-            os.environ["DYNAMO_TPU_CHUNK_ATTENTION"] = saved
     out = np.asarray(pa.chunk_prefill_attention(
         q, kp, vp, pages, 64, page_size=ps,
         num_kv_heads=n_kv).astype(jnp.float32))
@@ -292,6 +326,7 @@ def run_single_case(tag: str) -> None:
                           "error": "accelerator unreachable"}), flush=True)
         raise SystemExit(1)
     fn = {"chunk_kernel_parity": _case_chunk_parity,
+          "chunk_kernel_int8_parity": _case_chunk_int8_parity,
           "int8_decode_parity": _case_int8_decode_parity}[tag]
     out = fn()
     out["backend"] = backend
@@ -302,10 +337,21 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--budget-s", type=float, default=10 * 3600)
     ap.add_argument("--case", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated case tags: run a targeted subset "
+                         "(e.g. a follow-up pass for cases added or failed "
+                         "after the main battery)")
     args = ap.parse_args()
     if args.case:
         run_single_case(args.case)
         return
+    matrix = MATRIX
+    if args.only:
+        want = {t.strip() for t in args.only.split(",")}
+        unknown = want - {t for t, _, _, _ in MATRIX}
+        if unknown:
+            ap.error(f"unknown case tags: {sorted(unknown)}")
+        matrix = [m for m in MATRIX if m[0] in want]
 
     os.environ.setdefault(
         "JAX_COMPILATION_CACHE_DIR",
@@ -313,8 +359,8 @@ def main() -> None:
                      "jax-comp-cache"))
     deadline = time.time() + args.budget_s
     emit({"case": "start", "budget_s": args.budget_s,
-          "matrix": [t for t, _, _, _ in MATRIX]})
-    for tag, kind, env_over, timeout_s in MATRIX:
+          "matrix": [t for t, _, _, _ in matrix]})
+    for tag, kind, env_over, timeout_s in matrix:
         if env_over.get("JAX_PLATFORMS") == "cpu":
             run_case(tag, kind, env_over, timeout_s)  # chip-free case
             continue

@@ -142,8 +142,49 @@ def test_chunk_backend_follows_engine_backend_once_validated(monkeypatch):
 
     monkeypatch.setattr(pa, "chunk_prefill_attention", spy)
     with att.attention_context("pallas_interpret", None):
+        monkeypatch.setattr(pa, "CHUNK_KERNEL_HW_VALIDATED", False)
         att.chunk_attention(q, kp, kp, pages, 16, page_size=ps)
         assert not calls  # not validated: XLA path even under pallas ctx
         monkeypatch.setattr(pa, "CHUNK_KERNEL_HW_VALIDATED", True)
         att.chunk_attention(q, kp, kp, pages, 16, page_size=ps)
         assert calls  # validated: follows the engine backend
+
+
+def test_chunk_kernel_int8_pools_stay_gated_until_validated(monkeypatch):
+    """The bf16 on-chip parity pass flipped CHUNK_KERNEL_HW_VALIDATED, but
+    the int8 dequant-in-chunk path has its own gate: int8 pools keep the
+    XLA path under default selection until CHUNK_KERNEL_INT8_HW_VALIDATED
+    flips (battery case chunk_kernel_int8_parity)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops import pallas_attention as pa
+
+    rng = np.random.default_rng(13)
+    ps, n_kv, d, h = 4, 2, 64, 4
+    kf = jnp.asarray(rng.normal(size=(16 * ps, n_kv, d)), jnp.float32)
+    w = att.kv_lane_width(n_kv, d, True)
+    k8 = att.pack_kv_rows(kf, w).reshape(16, ps, w)
+    pages = jnp.asarray([1, 2, 3, 4], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(16, h, d)), jnp.float32)
+    monkeypatch.delenv("DYNAMO_TPU_CHUNK_ATTENTION", raising=False)
+    monkeypatch.setattr(pa, "CHUNK_KERNEL_HW_VALIDATED", True)
+
+    calls = []
+    real = pa.chunk_prefill_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(pa, "chunk_prefill_attention", spy)
+    with att.attention_context("pallas_interpret", None):
+        monkeypatch.setattr(pa, "CHUNK_KERNEL_INT8_HW_VALIDATED", False)
+        att.chunk_attention(q, k8, k8, pages, 16, page_size=ps,
+                            num_kv_heads=n_kv)
+        assert not calls  # int8 not validated: XLA path
+        monkeypatch.setattr(pa, "CHUNK_KERNEL_INT8_HW_VALIDATED", True)
+        att.chunk_attention(q, k8, k8, pages, 16, page_size=ps,
+                            num_kv_heads=n_kv)
+        assert calls  # int8 validated: kernel follows the backend

@@ -43,6 +43,7 @@ from dynamo_tpu.engine.kv_cache import (
 from dynamo_tpu.engine.request import GenRequest, TokenEvent
 from dynamo_tpu.engine import sampling as smp
 from dynamo_tpu.models import llama
+from dynamo_tpu.ops import json_guide
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
 from dynamo_tpu.parallel import sharding as shd
@@ -367,6 +368,15 @@ class Engine:
         # async scheduling: the decode window whose tokens have been
         # dispatched but not read back yet — (window, ys, want_lp, t0)
         self._pending_win = None
+        # JSON-guided decoding (ops/json_guide.py): vocab byte table (host +
+        # device), lazily-compiled guided window variants, and the
+        # device-resident grammar state (gmode, gdepth, gbits, gactive) —
+        # invalidated with _dev_state and rebuilt from seq.guide mirrors
+        self._guide_table = None
+        self._guide_dev = None
+        self._guided_windows: Dict = {}
+        self._guide_row_cache: Dict = {}
+        self._dev_guide = None
         # output-token counts for presence/frequency penalties: [B, V] int32,
         # PERSISTENTLY device-resident (never re-uploaded on membership
         # changes — rows are zeroed in-place by the tiny _reset_count jit)
@@ -384,6 +394,7 @@ class Engine:
         if not tables_only:
             self._dev_state = None
             self._dev_sampling = None
+            self._dev_guide = None
 
     # ------------------------------------------------------------------ jit --
 
@@ -434,19 +445,32 @@ class Engine:
             )
             return rep(out.last_logits), out.k_pages, out.v_pages
 
-        def make_decode_window(n_steps: int, with_logprobs: bool):
+        def make_decode_window(n_steps: int, with_logprobs: bool,
+                               guide_tables=None):
             """n_steps fused decode iterations in one dispatch: lax.scan over
             the step body with on-device sampling AND the batch state carried
             on device, so a steady-state window costs one dispatch + one
             token download instead of ~9 host round-trips. The logprobs
             variant additionally streams back the chosen-token logprob and
             top-5 alternatives per step (compiled lazily — costs nothing
-            unless a request asks for logprobs)."""
+            unless a request asks for logprobs).
+
+            With guide_tables=(token_bytes, token_len, eos_mask) the window
+            becomes the JSON-guided variant: three extra int32 [B] args
+            carry the grammar automaton state (ops/json_guide.py), each scan
+            step masks the logits with the allowed-token set BEFORE sampling
+            and folds the sampled token's bytes through the automaton — the
+            grammar keeps up with 16/32/64-step fused windows entirely
+            on-device (compiled lazily on the first guided request)."""
+            guided = guide_tables is not None
+            if guided:
+                g_tb, g_tl, g_eos = guide_tables
 
             def window_fn(
                 params, tokens, positions, context_lens, active, block_tables,
                 temperature, top_p, top_k, presence, frequency, min_p,
                 bias_ids, bias_vals, slot_keys, counts, k_pages, v_pages,
+                *guide_state,
             ):
                 state = smp.SamplingState(
                     temperature, top_p, top_k, presence, frequency,
@@ -454,42 +478,68 @@ class Engine:
                 )
                 step = active.astype(positions.dtype)  # inactive slots frozen
                 b = tokens.shape[0]
+                if guided:
+                    gmode0, gdepth0, gbits0, gactive = guide_state
+                    gact = gactive & active
 
                 def body(carry, _):
-                    toks, pos, ctx_lens, cnts, kp, vp = carry
+                    if guided:
+                        toks, pos, ctx_lens, cnts, gm, gd, gb, kp, vp = carry
+                    else:
+                        toks, pos, ctx_lens, cnts, kp, vp = carry
                     out = llama.decode_step(
                         mcfg, params, toks, pos, block_tables, ctx_lens,
                         kp, vp, page_size=page_size,
                     )
+                    logits = out.logits
+                    if guided:
+                        allow = json_guide.token_mask(
+                            jnp, gm, gd, gb, g_tb, g_tl, g_eos)
+                        logits = jnp.where(
+                            gact[:, None] & ~allow,
+                            jnp.asarray(-1e9, logits.dtype), logits)
                     keys = smp.fold_positions(slot_keys, pos)
                     if with_logprobs:
                         nxt, chosen, tids, tvals = smp.sample_with_logprobs(
-                            out.logits, state, keys, cnts
+                            logits, state, keys, cnts
                         )
                         y = (nxt, chosen, tids, tvals)
                     else:
-                        nxt = smp.sample(out.logits, state, keys, cnts)
+                        nxt = smp.sample(logits, state, keys, cnts)
                         y = (nxt,)
                     # count only active slots' emissions; inactive rows are
                     # zeroed at (re)admission anyway
                     cnts = cnts.at[jnp.arange(b), nxt].add(
                         step.astype(cnts.dtype)
                     )
-                    # inactive slots stay pinned at position 0 / context 1 so
-                    # their trash-page work never grows between rebuilds
-                    return (
-                        nxt, pos + step, ctx_lens + step, cnts,
-                        out.k_pages, out.v_pages,
-                    ), y
+                    if guided:
+                        nm, nd, nb, _ = json_guide.fold_bytes(
+                            jnp, gm, gd, gb, g_tb[nxt], g_tl[nxt])
+                        gm = jnp.where(gact, nm, gm)
+                        gd = jnp.where(gact, nd, gd)
+                        gb = jnp.where(gact, nb, gb)
+                        new_carry = (nxt, pos + step, ctx_lens + step, cnts,
+                                     gm, gd, gb, out.k_pages, out.v_pages)
+                    else:
+                        # inactive slots stay pinned at position 0 / context
+                        # 1 so their trash-page work never grows
+                        new_carry = (nxt, pos + step, ctx_lens + step, cnts,
+                                     out.k_pages, out.v_pages)
+                    return new_carry, y
 
-                carry, ys = jax.lax.scan(
-                    body,
+                init = ((tokens, positions, context_lens, counts,
+                         gmode0, gdepth0, gbits0, k_pages, v_pages)
+                        if guided else
+                        (tokens, positions, context_lens, counts,
+                         k_pages, v_pages))
+                carry, ys = jax.lax.scan(body, init, None, length=n_steps)
+                if guided:
                     (tokens, positions, context_lens, counts,
-                     k_pages, v_pages),
-                    None, length=n_steps,
-                )
+                     gm, gd, gb, k_pages, v_pages) = carry
+                    # ys: (toks [n_steps, B], [logprob extras...])
+                    return (rep(ys), tokens, positions, context_lens, counts,
+                            gm, gd, gb, k_pages, v_pages)
                 tokens, positions, context_lens, counts, k_pages, v_pages = carry
-                # ys: (toks [n_steps, B], [logprob extras...])
                 return (rep(ys), tokens, positions, context_lens, counts,
                         k_pages, v_pages)
 
@@ -606,18 +656,33 @@ class Engine:
             self._import = ctx(import_fn)
             self._upload = lambda *xs: tuple(jnp.asarray(x) for x in xs)
             self._jit_handles = {}
+
+            def _build_guided_window_eager(multi: bool, lp: bool):
+                return ctx(make_decode_window(
+                    n_multi if multi else 1, lp,
+                    guide_tables=self._guide_dev))
+
+            self._build_guided_window = _build_guided_window_eager
         else:
             # donate KV pools + carried decode state: XLA updates in place
             # (active mask, block tables, sampling params and slot keys are
-            # reused across windows). tokens/pos/ctx/counts/k/v donated.
-            window_donate = (1, 2, 3, 12, 13, 14)
+            # reused across windows). tokens/pos/ctx/counts/k/v donated —
+            # positions 1, 2, 3, 15, 16, 17 of window_fn. (A previous tuple
+            # mistakenly donated the REUSED bias_ids/bias_vals/slot_keys at
+            # 12-14; on TPU at B=64/window=32 XLA aliased bias_ids onto the
+            # int32[32, 64] token output and deleted it, crashing the next
+            # dispatch with 'Array has been deleted' — the battery's
+            # multistep_32/int8kv_pallas failures.)
+            window_donate = (1, 2, 3, 15, 16, 17)
             jp = jax.jit(prefill_fn, donate_argnums=(3, 4))
             jpb = jax.jit(prefill_batch_fn, donate_argnums=(3, 4))
             jsb = jax.jit(sample_first_batch)
             jc = jax.jit(chunk_fn, donate_argnums=(4, 5))
             jw = {k: jax.jit(f, donate_argnums=window_donate)
                   for k, f in window_fns.items()}
-            jspec = jax.jit(spec_fn, donate_argnums=(1, 3, 4, 13, 15, 16))
+            # same intent as window_donate: tokens/pos/ctx/counts/k/v (the
+            # reused bias/key arrays at 13-15 must NOT be donated)
+            jspec = jax.jit(spec_fn, donate_argnums=(1, 3, 4, 16, 18, 19))
             js = jax.jit(sample_first)
             jr = jax.jit(reset_count_fn, donate_argnums=(0,))
             ji = jax.jit(import_fn, donate_argnums=(0, 1))
@@ -630,6 +695,20 @@ class Engine:
             self._sample_first_batch = ctx(jsb)
             self._reset_count = ctx(jr)
             self._import = ctx(ji)
+
+            def _build_guided_window(multi: bool, lp: bool):
+                """Guided decode-window variant, compiled on first guided
+                request (warmup does not cover it — a few seconds once).
+                The carried grammar state (gmode/gdepth/gbits at 18-20) is
+                donated like the other carry; gactive (21) is reused."""
+                fn = make_decode_window(n_multi if multi else 1, lp,
+                                        guide_tables=self._guide_dev)
+                j = jax.jit(fn,
+                            donate_argnums=window_donate + (18, 19, 20))
+                self._jit_handles[f"window_guided_{multi}_{lp}"] = j
+                return ctx(j)
+
+            self._build_guided_window = _build_guided_window
             # jitted upload whose outputs share the sharding provenance of
             # other jit outputs over the engine mesh (see _decode_once).
             # optimization_barrier defeats jit's pass-through fast path for
@@ -1063,11 +1142,15 @@ class Engine:
             min_p[i] = r.min_p
             bias_ids[i], bias_vals[i] = _pack_logit_bias(r)
             pen = self._penalty_row(r)
-            if pen is not None:  # preempted continuation in the batch
+            grow = self._guide_first_row(r)
+            if pen is not None or grow is not None:
                 if pen_rows is None:
                     pen_rows = np.zeros(
                         (npad, self.model_cfg.vocab_size), np.float32)
-                pen_rows[i] = pen
+                if pen is not None:  # preempted continuation in the batch
+                    pen_rows[i] = pen
+                if grow is not None:  # JSON-guided: mask the first token
+                    pen_rows[i] += grow
         raw_logits = logits
         if pen_rows is not None:
             logits = logits - jnp.asarray(pen_rows)
@@ -1163,6 +1246,93 @@ class Engine:
         self.metrics.prompt_tokens += prompt_len
         return first, pages, prompt_len, req_key, lp
 
+    # ------------------------------------------------------- JSON guide --
+
+    def _ensure_guide_table(self) -> json_guide.VocabTable:
+        """Vocab byte table for JSON-guided decoding, built once per engine
+        (host numpy + device copies). HF tokenizers decompose per-token;
+        otherwise ids < 256 are literal bytes (ByteTokenizer layout), sized
+        to the model vocab."""
+        if self._guide_table is None:
+            from dynamo_tpu.engine.tokenizer import get_tokenizer
+
+            mcfg = self.model_cfg
+            eos = [mcfg.eos_token_id, *mcfg.extra_stop_token_ids]
+            tok = get_tokenizer(self.cfg.model, self.cfg.model_path)
+            if hasattr(tok, "tok"):
+                # real tokenizer: table sized to the MODEL vocab (padded
+                # embedding ids decode to nothing, never legal mid-JSON)
+                table = json_guide.VocabTable.for_tokenizer(
+                    tok, eos, vocab_size=mcfg.vocab_size)
+            else:
+                table = json_guide.VocabTable.for_byte_vocab(
+                    mcfg.vocab_size, eos)
+            self._guide_dev = (jnp.asarray(table.token_bytes),
+                               jnp.asarray(table.token_len),
+                               jnp.asarray(table.eos_mask))
+            self._guide_table = table
+        return self._guide_table
+
+    def _stop_ids_for(self, req: GenRequest) -> List[int]:
+        """Effective stop-token set. For guided requests the MODEL eos ids
+        are always included even when the user supplied custom stops: at
+        JSON completion the grammar mask only allows model eos ids, so
+        dropping them would burn a completed object to finish 'length'."""
+        if req.ignore_eos:
+            return []
+        ids = list(req.stop_token_ids
+                   or [self.model_cfg.eos_token_id,
+                       *self.model_cfg.extra_stop_token_ids])
+        if req.guided_json:
+            for t in (self.model_cfg.eos_token_id,
+                      *self.model_cfg.extra_stop_token_ids):
+                if t not in ids:
+                    ids.append(t)
+        return ids
+
+    def _guide_first_row(self, req: GenRequest):
+        """First-token grammar mask as a penalty row (+1e9 on disallowed
+        tokens, subtracted from the prefill logits — same hook as
+        _penalty_row). Preempted continuations replay their prior output
+        so the mask picks up mid-stream. Rows are cached by grammar state
+        (the full-vocab host fold is ~10^8 numpy ops on a 128k vocab; the
+        common fresh-request state is always START)."""
+        if not req.guided_json:
+            return None
+        t = self._ensure_guide_table()
+        state = json_guide.replay(t, req.prior_output_token_ids)
+        row = self._guide_row_cache.get(state)
+        if row is None:
+            allow = json_guide.mask_row(t, *state)
+            row = np.where(allow, 0.0, 1e9).astype(np.float32)
+            if len(self._guide_row_cache) < 64:
+                self._guide_row_cache[state] = row
+        return row
+
+    def _get_guided_window(self, multi: bool, lp: bool):
+        key = (multi, lp)
+        if key not in self._guided_windows:
+            self._ensure_guide_table()
+            self._guided_windows[key] = self._build_guided_window(multi, lp)
+        return self._guided_windows[key]
+
+    def _ensure_dev_guide(self) -> None:
+        """(Re)build the device grammar-state arrays from the seq.guide
+        host mirrors (same invalidate/rebuild protocol as _dev_state)."""
+        if self._dev_guide is not None:
+            return
+        self._ensure_guide_table()
+        b = self.cfg.max_num_seqs
+        gm = np.zeros((b,), np.int32)
+        gd = np.zeros((b,), np.int32)
+        gb = np.zeros((b,), np.int32)
+        ga = np.zeros((b,), np.bool_)
+        for slot, seq in self.seqs.items():
+            if seq.guide is not None:
+                gm[slot], gd[slot], gb[slot] = seq.guide
+                ga[slot] = True
+        self._dev_guide = self._upload(gm, gd, gb, ga)
+
     def _penalty_row(self, req: GenRequest):
         """Presence/frequency penalty vector for a preempted continuation's
         FIRST token: 'penalties don't apply at prefill' assumes no output
@@ -1192,6 +1362,9 @@ class Engine:
         pen = self._penalty_row(req)
         if pen is not None:
             last_logits = last_logits - jnp.asarray(pen)
+        grow = self._guide_first_row(req)
+        if grow is not None:  # JSON-guided: mask the first token
+            last_logits = last_logits - jnp.asarray(grow)
         # the prediction made FROM position prompt_len-1; decode windows fold
         # positions >= prompt_len, so the chains never collide
         bias_ids, bias_vals = _pack_logit_bias(req)
@@ -1226,17 +1399,16 @@ class Engine:
             temperature=req.temperature,
             top_p=req.top_p,
             top_k=req.top_k,
-            stop_token_ids=(
-                [] if req.ignore_eos
-                else (req.stop_token_ids
-                      or [self.model_cfg.eos_token_id,
-                          *self.model_cfg.extra_stop_token_ids])
-            ),
+            stop_token_ids=self._stop_ids_for(req),
             logprobs=req.logprobs,
         )
         seq.prompt_ids = list(req.prompt_token_ids)
         seq.req = req
         seq.output_tokens.append(first)
+        if req.guided_json:
+            seq.guide = json_guide.replay(
+                self._ensure_guide_table(),
+                [*req.prior_output_token_ids, first])
         self.seqs[slot] = seq
         self.block_tables[slot, :] = 0
         self.block_tables[slot, : len(pages)] = pages
@@ -1546,8 +1718,11 @@ class Engine:
         """Speculative decode step: one verify dispatch emits 1..K+1 tokens
         per greedy sequence (vLLM/TRT-LLM's n-gram speculation analogue).
         Logprobs requests fall back to the classic window path for the step
-        (per-position logprob extraction is not wired through verify)."""
-        if any(s.logprobs is not None for s in self.seqs.values()):
+        (per-position logprob extraction is not wired through verify);
+        JSON-guided requests likewise — the verify forward samples from
+        unmasked logits, which would let drafts escape the grammar."""
+        if any(s.logprobs is not None or s.guide is not None
+               for s in self.seqs.values()):
             return self._decode_once()
         events: List[TokenEvent] = []
         cfg = self.cfg
@@ -1722,13 +1897,27 @@ class Engine:
         cur, pos, ctx_lens, active_dev = self._dev_state
         (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
          keys) = self._dev_sampling
-        fn = self._windows[(window > 1, want_lp)]
-        (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
-         self.v_pages) = fn(
-            self.params, cur, pos, ctx_lens, active_dev, self._dev_tables,
-            temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
-            keys, self.token_counts, self.k_pages, self.v_pages,
-        )
+        if any(s.guide is not None for s in self.seqs.values()):
+            self._ensure_dev_guide()
+            gm, gd, gb, ga = self._dev_guide
+            fn = self._get_guided_window(window > 1, want_lp)
+            (ys, cur, pos, ctx_lens, self.token_counts, gm, gd, gb,
+             self.k_pages, self.v_pages) = fn(
+                self.params, cur, pos, ctx_lens, active_dev,
+                self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
+                bias_ids, bias_vals, keys, self.token_counts,
+                self.k_pages, self.v_pages, gm, gd, gb, ga,
+            )
+            self._dev_guide = (gm, gd, gb, ga)
+        else:
+            fn = self._windows[(window > 1, want_lp)]
+            (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
+             self.v_pages) = fn(
+                self.params, cur, pos, ctx_lens, active_dev,
+                self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
+                bias_ids, bias_vals, keys, self.token_counts,
+                self.k_pages, self.v_pages,
+            )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
         # capture membership AT DISPATCH: a slot installed later (disagg
         # import) must not consume this window's rows. The stored duration
@@ -1769,6 +1958,11 @@ class Engine:
                 seq.num_tokens += 1  # the attended token is now cached
                 seq.output_tokens.append(tok)
                 self.cur_tokens[slot] = tok
+                if seq.guide is not None:
+                    # host grammar mirror keeps up with the device carry, so
+                    # membership-change rebuilds resume mid-stream exactly
+                    seq.guide = json_guide.advance_host(
+                        self._guide_table, seq.guide, tok)
                 self.metrics.output_tokens += 1
                 finished, reason = self._check_stop(seq, tok)
                 ev = TokenEvent(
@@ -1931,12 +2125,7 @@ class Engine:
                 f"roles must use the same --kv-cache-dtype (and, for int8 "
                 f"KV, the same --tensor-parallel: the rows are lane-blocked "
                 f"per TP shard)")
-        stop_ids = (
-            [] if req.ignore_eos
-            else (req.stop_token_ids
-                  or [self.model_cfg.eos_token_id,
-                      *self.model_cfg.extra_stop_token_ids])
-        )
+        stop_ids = self._stop_ids_for(req)
         if first_token in stop_ids:
             return True, "stop"
         if req.max_tokens <= 1 or n_prompt + 1 >= cfg.max_seq_len:

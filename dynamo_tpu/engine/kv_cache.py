@@ -277,6 +277,7 @@ class SeqState:
         "max_tokens", "temperature", "top_p", "top_k", "stop_token_ids",
         "prompt_len", "logprobs", "prompt_ids",
         "req",  # originating GenRequest (preemption rebuilds a continuation)
+        "guide",  # (mode, depth, bits) JSON-guide host mirror, or None
     )
 
     def __init__(
@@ -304,6 +305,7 @@ class SeqState:
         self.top_k = top_k
         self.stop_token_ids = stop_token_ids or []
         self.logprobs = logprobs
+        self.guide = None
         # prompt token ids, retained for the n-gram speculative proposer
         # (engine._propose_ngram fills it at slot installation)
         self.prompt_ids: List[int] = []

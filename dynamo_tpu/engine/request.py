@@ -34,6 +34,10 @@ class GenRequest:
     # (affects greedy too); at most sampling.BIAS_K entries
     logit_bias: Optional[Dict[int, float]] = None
     logprobs: Optional[int] = None  # None = off; N = return top-N alternatives
+    # OpenAI response_format {"type": "json_object"}: constrain generation
+    # to one complete JSON object via the device-side grammar automaton
+    # (ops/json_guide.py); composes with multistep decode windows
+    guided_json: bool = False
     # admission priority (vLLM semantics: LOWER value admits sooner, 0
     # default); FIFO within a priority level
     priority: int = 0

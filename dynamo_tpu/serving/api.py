@@ -124,6 +124,7 @@ class GenerationHandle:
             logprobs=params.get("logprobs"),
             ignore_eos=params.get("ignore_eos", False),
             priority=params.get("priority", 0),
+            guided_json=params.get("guided_json", False),
         )
         if ctx.disagg_client is not None:
             # decode role: prefill remotely, pull KV, continue locally
@@ -171,8 +172,15 @@ class GenerationHandle:
             lp_entry = None
             if ev.token_id >= 0:
                 n_out += 1
-                delta = detok.push(ev.token_id)
-                lp_entry = self._lp_entry(ev)
+                if ev.finished and ev.finish_reason == "stop":
+                    # the finishing stop TOKEN is not content: HF decode
+                    # skips specials, but the byte tokenizer cannot (a
+                    # stop id < 256 would leak as a control byte), and
+                    # logprobs must describe the returned text
+                    pass
+                else:
+                    delta = detok.push(ev.token_id)
+                    lp_entry = self._lp_entry(ev)
             stopped = False
             if matcher is not None and (delta or ev.finished):
                 delta, stopped = matcher.push(delta)
@@ -485,6 +493,7 @@ class _Handler(JsonHTTPHandler):
             or None,
             seed=int(seed) if seed is not None else None,
             logprobs=int(lp) if lp is not None else None,
+            guided_json=bool(body.get("guided_json", False)),
         )
         t0 = time.monotonic()
         first, n_tokens, extras = ctx.engine.prefill_only(req)

@@ -176,6 +176,9 @@ class DisaggDecodeClient:
             # would (the prefill worker continues the request's key chain)
             "seed": req.seed,
             "logprobs": req.logprobs,
+            # the prefill worker samples the FIRST token, so the grammar
+            # mask must apply there too
+            "guided_json": req.guided_json,
         }).encode()
         t0 = time.monotonic()
         # phase 1 — the prefill RPC. ONLY connection-phase failures here

@@ -146,8 +146,31 @@ def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
         "max_tokens": mt,
         # engine logprobs: None = off; N = chosen + top-N alternatives
         "logprobs": top_lp if lp else None,
+        "guided_json": _parse_response_format(body),
         **_common_sampling(body),
     }
+
+
+def _parse_response_format(body: Dict[str, Any]) -> bool:
+    """OpenAI response_format: {"type": "json_object"} constrains the
+    completion to one JSON object (device-side grammar —
+    ops/json_guide.py); "text"/absent is unconstrained; "json_schema" is
+    explicitly unsupported (schema-level constraints are not wired)."""
+    rf = body.get("response_format")
+    if rf is None:
+        return False
+    if not isinstance(rf, dict) or "type" not in rf:
+        raise BadRequest("'response_format' must be an object with 'type'")
+    kind = rf["type"]
+    if kind == "text":
+        return False
+    if kind == "json_object":
+        return True
+    if kind == "json_schema":
+        raise BadRequest(
+            "response_format type 'json_schema' is not supported; use "
+            "'json_object'")
+    raise BadRequest(f"unknown response_format type {kind!r}")
 
 
 def _include_usage(body: Dict[str, Any]) -> bool:

@@ -18,6 +18,7 @@ transiently-down tunnel.
 
 Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_STEPS, BENCH_PROMPT_LEN,
 BENCH_MULTISTEP (fused decode steps per dispatch; 1 disables),
+BENCH_GUIDED (1 = JSON-guided requests; measures grammar-mask overhead),
 BENCH_QUANT (with BENCH_MODEL: none|int8|w8a8 — w8a8 is the fast
 quantized mode and the v5e headline default; int8 is weight-only),
 BENCH_TRACE=DIR (capture a jax.profiler/XProf trace of the timed loop),
@@ -161,6 +162,10 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
         extra["prefill_chunk_tokens"] = int(os.environ["BENCH_PREFILL_CHUNK"])
     if os.environ.get("BENCH_SPEC"):
         extra["speculative_mode"] = os.environ["BENCH_SPEC"]
+    # BENCH_GUIDED=1: run every request JSON-guided (response_format
+    # json_object) — measures the on-device grammar-mask overhead against
+    # an identical unguided run (ignore_eos keeps token counts equal)
+    guided = bool(os.environ.get("BENCH_GUIDED"))
     eng = Engine(
         EngineConfig(
             model=model,
@@ -197,7 +202,8 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     for i, p in enumerate(prompts):
         eng.add_request(
             GenRequest(f"warm{i}", p, max_tokens=max(4, 2 * multistep),
-                       temperature=0.0, ignore_eos=True)
+                       temperature=0.0, ignore_eos=True,
+                       guided_json=guided)
         )
     while eng.has_work:
         eng.step()
@@ -211,11 +217,16 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     for i, p in enumerate(timed_prompts):
         eng.add_request(
             GenRequest(f"b{i}", p, max_tokens=steps, temperature=0.0,
-                       ignore_eos=True)
+                       ignore_eos=True, guided_json=guided)
         )
     # drain prefills so the timed section is pure decode steady-state
+    guided_outs = {} if guided else None
     while eng.pending:
-        eng.step()
+        for ev in eng.step():
+            # pre-timed tokens still belong to the guided grammar audit
+            # (a replay missing the opening tokens would start mid-JSON)
+            if guided_outs is not None and ev.token_id >= 0:
+                guided_outs.setdefault(ev.request_id, []).append(ev.token_id)
     jax.block_until_ready(eng.k_pages)
     # TTFT (prefill phase) was measured during the drain; re-zero only the
     # decode phases so ITL percentiles exclude the batch ramp-up steps
@@ -233,6 +244,9 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
         for ev in eng.step():
             if ev.token_id >= 0:
                 tokens += 1
+                if guided_outs is not None:
+                    guided_outs.setdefault(ev.request_id, []).append(
+                        ev.token_id)
     dt = time.perf_counter() - t0
     if trace_dir:
         jax.profiler.stop_trace()
@@ -254,6 +268,18 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     }
     if quant != "none":
         out["quantization"] = quant
+    if guided:
+        # grammar audit via the ENGINE's own vocab table (handles byte and
+        # HF layouts alike): DEAD absorbs, so a stream is legal iff the
+        # full replay ends anywhere but DEAD (stop ids fold as no-ops, so
+        # ignore_eos's post-completion eos spam is fine)
+        from dynamo_tpu.ops import json_guide as jg
+
+        table = eng._ensure_guide_table()
+        out["guided"] = True
+        out["guided_legal"] = all(
+            jg.replay(table, toks)[0] != jg.DEAD
+            for toks in guided_outs.values())
     if eng.metrics.spec_draft_tokens:
         out["spec_drafted"] = eng.metrics.spec_draft_tokens
         out["spec_accepted"] = eng.metrics.spec_accepted_tokens
@@ -306,7 +332,7 @@ def main() -> None:
     }
     for k in ("mfu", "mbu", "quantization", "ttft_p50_ms", "itl_p50_ms",
               "itl_p95_ms", "spec_drafted", "spec_accepted",
-              "spec_acceptance"):
+              "spec_acceptance", "guided", "guided_legal"):
         if k in res:
             line[k] = res[k]
     forced = bool(os.environ.get("BENCH_FORCE_CPU"))

@@ -89,6 +89,8 @@ MATRIX = [
      {**_SLA_ENV, "DYNAMO_TPU_CHUNK_ATTENTION": "pallas"}, 5400),
     ("sla4k_int8kv", "bench", {**_SLA_ENV, "BENCH_KV": "int8"}, 5400),
     ("spec_off_b8", "bench", {"BENCH_BATCH": 8}, 2400),
+    # JSON-guided overhead: compare against spec_off_b8 (same B, unguided)
+    ("guided_on_b8", "bench", {"BENCH_BATCH": 8, "BENCH_GUIDED": 1}, 2400),
     ("spec_ngram_b8", "bench",
      {"BENCH_BATCH": 8, "BENCH_SPEC": "ngram"}, 2400),
     ("spec_ngram_rep_b8", "bench",

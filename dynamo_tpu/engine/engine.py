@@ -461,7 +461,8 @@ class Engine:
             step masks the logits with the allowed-token set BEFORE sampling
             and folds the sampled token's bytes through the automaton — the
             grammar keeps up with 16/32/64-step fused windows entirely
-            on-device (compiled lazily on the first guided request)."""
+            on-device (warmup() pre-compiles all four guided variants
+            before /ready)."""
             guided = guide_tables is not None
             if guided:
                 g_tb, g_tl, g_eos = guide_tables
@@ -697,10 +698,11 @@ class Engine:
             self._import = ctx(ji)
 
             def _build_guided_window(multi: bool, lp: bool):
-                """Guided decode-window variant, compiled on first guided
-                request (warmup does not cover it — a few seconds once).
-                The carried grammar state (gmode/gdepth/gbits at 18-20) is
-                donated like the other carry; gactive (21) is reused."""
+                """Guided decode-window variant, built lazily on first use
+                (warmup()'s __warm_guided/__warm_guided_lp requests trigger
+                all four variants before /ready). The carried grammar state
+                (gmode/gdepth/gbits at 18-20) is donated like the other
+                carry; gactive (21) is reused."""
                 fn = make_decode_window(n_multi if multi else 1, lp,
                                         guide_tables=self._guide_dev)
                 j = jax.jit(fn,
@@ -794,6 +796,18 @@ class Engine:
                                temperature=0.0, ignore_eos=True))
         reqs.append(GenRequest("__warm_lp", [1, 2, 3], max_tokens=2 * k + 2,
                                temperature=0.0, ignore_eos=True, logprobs=1))
+        # JSON-guided windows are reachable by ANY request
+        # (response_format json_object), so /ready must cover them too —
+        # both the 1-step and fused variants, with and without the
+        # logprobs twin (want_lp is batch-wide, so one guided+logprobs
+        # request anywhere selects the lp=True guided programs)
+        reqs.append(GenRequest("__warm_guided", [1, 2, 3],
+                               max_tokens=2 * k + 2, temperature=0.0,
+                               ignore_eos=True, guided_json=True))
+        reqs.append(GenRequest("__warm_guided_lp", [1, 2, 3],
+                               max_tokens=2 * k + 2, temperature=0.0,
+                               ignore_eos=True, guided_json=True,
+                               logprobs=1))
         if cfg.disaggregation_mode == "prefill":
             # the prefill role serves prompts via prefill_only -> FULL
             # prefill at every bucket; routing warm traffic through

@@ -23,6 +23,16 @@ def test_warmup_precompiles_everything():
                                temperature=0.0, ignore_eos=True))
     eng.add_request(GenRequest("w2", [1, 2, 3, 4, 5, 6, 7], max_tokens=12,
                                temperature=0.7, seed=7, ignore_eos=True))
+    # guided windows are reachable by any request (response_format) and
+    # must be warm too — ignore_eos keeps the request alive past JSON
+    # completion so the FUSED guided window actually dispatches, and the
+    # logprobs variant selects the lp=True guided programs
+    eng.add_request(GenRequest("w3", [1, 2, 3], max_tokens=12,
+                               temperature=0.0, ignore_eos=True,
+                               guided_json=True))
+    eng.add_request(GenRequest("w4", [1, 2, 3], max_tokens=12,
+                               temperature=0.0, ignore_eos=True,
+                               guided_json=True, logprobs=1))
     while eng.has_work:
         eng.step()
     assert eng.compiled_program_count() == n, "traffic caused fresh compiles"

@@ -386,3 +386,26 @@ def test_stage_then_tcp_fallback_releases_stage_ledger(monkeypatch):
         psrv.shutdown()
         dctx.close()
         pctx.close()
+
+
+def test_guided_json_across_disagg_matches_aggregated(engines):
+    """guided_json must survive the prefill->decode handoff: the prefill
+    side masks the FIRST token (its _run_prefill applies the grammar row)
+    and the decode side resumes the grammar from the replayed state at
+    import — the full stream equals the aggregated engine's and stays
+    grammar-legal."""
+    from dynamo_tpu.ops import json_guide as jg
+
+    agg, prefill, decode = engines
+    prompt = [6, 2, 8, 3, 1, 8, 5, 3]
+    kw = dict(max_tokens=10, temperature=1.4, top_p=1.0, seed=33,
+              ignore_eos=True, guided_json=True)
+    ref = agg.generate(GenRequest("gref", prompt, **kw))
+
+    req = GenRequest("gd1", prompt, **kw)
+    first, n, _lp = prefill.prefill_only(req)
+    assert first == ref[0], "guided first token diverged at prefill worker"
+    ICIHandoff(prefill, decode).transfer(req, first)
+    rest = drain(decode, "gd1")
+    assert [first] + rest == ref, "guided disagg stream diverged from agg"
+    assert jg.replay(agg._ensure_guide_table(), ref)[0] != jg.DEAD

@@ -32,14 +32,14 @@ def _run_pair(num_pages, reqs, params=None):
     return eng, out
 
 
-def _reqs(temperature=0.0, seed=None, max_tokens=24):
+def _reqs(temperature=0.0, seed=None, max_tokens=24, **extra):
     return [
         GenRequest("keep", [3, 1, 4, 1, 5, 9, 2, 6], max_tokens=max_tokens,
                    temperature=temperature, seed=seed, ignore_eos=True,
-                   priority=0),
+                   priority=0, **extra),
         GenRequest("victim", [2, 7, 1, 8, 2, 8, 1, 8], max_tokens=max_tokens,
                    temperature=temperature, seed=None if seed is None
-                   else seed + 1, ignore_eos=True, priority=5),
+                   else seed + 1, ignore_eos=True, priority=5, **extra),
     ]
 
 
@@ -192,21 +192,10 @@ def test_preemption_preserves_guided_json_grammar():
     _guide_first_row) and the rebuilt device state resumes from the seq
     mirrors — outputs stay token-identical to the abundant-pool run and
     grammar-legal."""
-    import numpy as np
-
     from dynamo_tpu.ops import json_guide as jg
 
-    def reqs(temperature=1.3, seed=21, max_tokens=24):
-        return [
-            GenRequest("keep", [3, 1, 4, 1, 5, 9, 2, 6],
-                       max_tokens=max_tokens, temperature=temperature,
-                       seed=seed, ignore_eos=True, guided_json=True,
-                       priority=0),
-            GenRequest("victim", [2, 7, 1, 8, 2, 8, 1, 8],
-                       max_tokens=max_tokens, temperature=temperature,
-                       seed=seed + 1, ignore_eos=True, guided_json=True,
-                       priority=5),
-        ]
+    def reqs():
+        return _reqs(temperature=1.3, seed=21, guided_json=True)
 
     ref_eng, ref = _run_pair(64, reqs())
     assert ref_eng.metrics.num_preempted == 0

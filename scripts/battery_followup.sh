@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Follow-up battery pass: waits for the main round-5 battery to finish its
+# matrix (the "done" row), then re-runs the cases that crashed on the
+# decode-window donation bug (fixed in-round) plus the cases added after
+# the orchestrator started (int8 chunk parity, guided overhead).
+set -u
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+RESULTS="$REPO/bench_results/tpu_battery_r05.jsonl"
+CASES="chunk_kernel_int8_parity,multistep_32,int8kv_pallas,int8kv_pallas_b128,guided_on_b8"
+DEADLINE=$(( $(date +%s) + ${FOLLOWUP_WAIT_S:-28800} ))
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if tail -5 "$RESULTS" 2>/dev/null | grep -q '"case": "done"'; then
+    echo "main battery done; starting follow-up: $CASES"
+    exec python "$REPO/scripts/tpu_battery.py" \
+      --budget-s "${FOLLOWUP_BUDGET_S:-7200}" --only "$CASES"
+  fi
+  sleep 60
+done
+echo "follow-up watcher timed out waiting for the main battery"

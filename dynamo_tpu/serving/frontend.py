@@ -21,7 +21,7 @@ import socket
 import time
 import urllib.error
 import urllib.request
-from typing import Optional
+from typing import List, Optional
 
 from dynamo_tpu.serving import protocol as proto
 from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
@@ -159,14 +159,13 @@ class _FrontendHandler(JsonHTTPHandler):
             raise proto.BadRequest(f"invalid JSON: {e}")
         if path.endswith("chat/completions"):
             parsed = proto.parse_chat_request(body)
-            affinity = prefix_key(
-                json.dumps(parsed["messages"])[:512]
-            )
+            prompt_text = json.dumps(parsed["messages"])
         else:
             parsed = proto.parse_completion_request(body)
-            affinity = prefix_key(parsed["prompt"])
+            prompt_text = parsed["prompt"]
+        affinity = prefix_key(prompt_text)
         model = parsed["model"]
-        worker = ctx.router.pick(model, affinity)
+        worker = ctx.router.pick(model, affinity, prompt_text=prompt_text)
         if worker is None:
             self._error(503, f"no live worker for model {model!r}",
                         "service_unavailable")
@@ -193,9 +192,15 @@ class _FrontendHandler(JsonHTTPHandler):
         # terminal (504). 502 only when no live worker accepts.
         resp = None
         last_err: Optional[str] = None
+        tried: List[str] = []
         for attempt in range(3):
             if attempt:
-                worker = ctx.router.pick(model, affinity)
+                # exclude workers that already refused: the ledger and HRW
+                # are deterministic, so an unexcluded re-pick would bounce
+                # off the same dead worker three times
+                worker = ctx.router.pick(model, affinity,
+                                         prompt_text=prompt_text,
+                                         exclude=tried)
                 if worker is None:
                     break
             req = urllib.request.Request(
@@ -239,6 +244,9 @@ class _FrontendHandler(JsonHTTPHandler):
                 log.warning("worker %s unreachable (%s); failing over",
                             worker.url, e)
                 ctx.router.deregister(worker.url)
+                # belt and braces with the deregister: a racing heartbeat
+                # could re-register the dead worker before the re-pick
+                tried.append(worker.url)
                 last_err = str(e)
         if resp is None:
             self._error(

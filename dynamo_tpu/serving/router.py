@@ -22,7 +22,7 @@ import dataclasses
 import hashlib
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -84,11 +84,71 @@ def prefix_key(text: str, prefix_chars: int = 256) -> str:
     return text[:prefix_chars]
 
 
+def text_block_chain(text: str, block_chars: int = 64,
+                     max_blocks: int = 32) -> List[str]:
+    """Rolling hash chain over fixed-size TEXT blocks of the prompt — the
+    frontend-side analogue of the engine's page-block hash chain
+    (engine/kv_cache.py PrefixCache). The frontend is tokenizer-free, so
+    the chain is over canonical prompt text: a conversation continuation
+    extends its previous turns' text, so its leading blocks hash
+    identically and the deepest known block locates the worker whose
+    paged-KV prefix cache already holds the shared turns (exact token
+    matching stays the worker's job)."""
+    out: List[str] = []
+    prev = b""
+    for i in range(0, min(len(text), block_chars * max_blocks), block_chars):
+        block = text[i:i + block_chars]
+        if len(block) < block_chars:
+            break  # partial tail block can't be stable across turns
+        h = hashlib.sha256(prev)
+        h.update(block.encode("utf-8", "surrogatepass"))
+        prev = h.digest()
+        out.append(prev.hex())
+    return out
+
+
+class PrefixLedger:
+    """block-hash -> worker url, LRU-capped: remembers where each prefix
+    chain was routed so follow-up turns land on the worker that already
+    holds the KV — the passive form of the reference router's KV-event
+    tracking (SURVEY.md §2b: the Dynamo router scores workers by cached-
+    block overlap from worker KV events; here the routing decision itself
+    is the event, so frontends stay shared-nothing)."""
+
+    def __init__(self, cap: int = 65536):
+        import collections
+
+        self.cap = cap
+        self._m: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict())
+
+    def record(self, model: str, chain: List[str], url: str) -> None:
+        for h in chain:
+            key = model + "|" + h  # namespace: models sharing a prompt
+            if key in self._m:     # template must not clobber each other
+                self._m.move_to_end(key)
+            self._m[key] = url
+        while len(self._m) > self.cap:
+            self._m.popitem(last=False)
+
+    def lookup(self, model: str, chain: List[str],
+               live_urls) -> Tuple[Optional[str], int]:
+        """Deepest block whose recorded worker is still live.
+        Returns (url, depth); (None, 0) when nothing matches."""
+        for depth in range(len(chain), 0, -1):
+            url = self._m.get(model + "|" + chain[depth - 1])
+            if url is not None and url in live_urls:
+                return url, depth
+        return None, 0
+
+
 class Router:
     def __init__(self, heartbeat_ttl: float = 15.0):
         self.ttl = heartbeat_ttl
         self._workers: Dict[str, WorkerInfo] = {}
         self._lock = threading.Lock()
+        self._ledger = PrefixLedger()
+        self.ledger_hits = 0  # observability: KV-overlap routed requests
 
     # ---------------------------------------------------------- membership --
     def register(self, url: str, model: str, mode: str = "agg",
@@ -136,27 +196,56 @@ class Router:
 
     # ------------------------------------------------------------- routing --
     def pick(self, model: str, affinity_key: str,
-             roles=("agg", "decode")) -> Optional[WorkerInfo]:
-        cands = self.alive(roles, model)
+             roles=("agg", "decode"),
+             prompt_text: Optional[str] = None,
+             exclude=()) -> Optional[WorkerInfo]:
+        cands = [w for w in self.alive(roles, model)
+                 if w.url not in exclude]
         if not cands:
             # no worker serves this model -> let the frontend 503 rather than
             # bouncing the request off a wrong-model worker's 400
             return None
-        native = _pick_native(affinity_key, cands)
-        if native is not None:
-            return native
-        best, best_score = None, -1.0
-        for w in cands:
-            h = hashlib.sha256(
-                (affinity_key + "|" + w.url).encode()
-            ).digest()
-            hash_score = int.from_bytes(h[:8], "big") / 2**64
-            # weighted rendezvous: capacity scales the hash draw; a worker
-            # with zero headroom can still win if it is the only candidate
-            score = hash_score * (0.25 + 0.75 * w.headroom)
-            if score > best_score:
-                best, best_score = w, score
-        return best
+        # KV-overlap pass: follow the deepest prefix block we have routed
+        # before, so multi-turn conversations keep landing on the worker
+        # whose prefix cache holds their shared turns — even when HRW
+        # load-shading diverted an earlier turn off the hash winner.
+        # Guardrails against template-herding (every request sharing a
+        # system prompt piling onto one worker): a hit needs >= 2 shared
+        # blocks (128+ chars), and the holder must clear a headroom bar
+        # that RELAXES with depth — shallow (mostly-template) overlap
+        # sheds to HRW while the holder is even moderately busy, deep
+        # (real conversation) overlap sticks until near saturation.
+        chain = text_block_chain(prompt_text) if prompt_text else []
+        if chain:
+            live = {w.url: w for w in cands}
+            with self._lock:
+                url, depth = self._ledger.lookup(model, chain, live)
+            if (url is not None and depth >= 2
+                    and live[url].headroom
+                    >= max(0.05, 0.35 - 0.05 * depth)):
+                with self._lock:
+                    self.ledger_hits += 1
+                    self._ledger.record(model, chain, url)
+                return live[url]
+        picked = _pick_native(affinity_key, cands)
+        if picked is None:
+            best, best_score = None, -1.0
+            for w in cands:
+                h = hashlib.sha256(
+                    (affinity_key + "|" + w.url).encode()
+                ).digest()
+                hash_score = int.from_bytes(h[:8], "big") / 2**64
+                # weighted rendezvous: capacity scales the hash draw; a
+                # worker with zero headroom can still win if it is the
+                # only candidate
+                score = hash_score * (0.25 + 0.75 * w.headroom)
+                if score > best_score:
+                    best, best_score = w, score
+            picked = best
+        if chain and picked is not None:
+            with self._lock:
+                self._ledger.record(model, chain, picked.url)
+        return picked
 
     def pick_prefill(self, model: str, affinity_key: str) -> Optional[WorkerInfo]:
         return self.pick(model, affinity_key, roles=("prefill",))

@@ -154,14 +154,14 @@ def test_failover_to_live_worker_on_unreachable(stack):
     real_pick = fctx.router.pick
     state = {"first": True}
 
-    def pick_dead_first(model, affinity, roles=("agg", "decode")):
+    def pick_dead_first(model, affinity, roles=("agg", "decode"), **kw):
         if state["first"]:
             state["first"] = False
             w = next((w for w in fctx.router.alive(roles, model)
                       if w.url == dead_url), None)
             if w is not None:
                 return w
-        return real_pick(model, affinity, roles)
+        return real_pick(model, affinity, roles, **kw)
 
     fctx.router.pick = pick_dead_first
     try:

@@ -61,3 +61,120 @@ def test_load_shedding_prefers_headroom():
         r.pick("m", prefix_key(f"p{i}")).url == "http://idle:1" for i in range(100)
     )
     assert wins > 60, f"idle worker only won {wins}/100"
+
+
+def _stats(busy=False):
+    if busy:
+        return dict(active_seqs=8, pending=8, max_num_seqs=8,
+                    free_pages=0, total_pages=100)
+    return dict(active_seqs=0, pending=0, max_num_seqs=8,
+                free_pages=100, total_pages=100)
+
+
+def test_ledger_follows_previous_routing_for_prefix_extension():
+    """KV-overlap routing: a conversation continuation (text that extends a
+    previously routed prompt) lands on the SAME worker even when the HRW
+    winner for the longer text would differ."""
+    r = Router()
+    for i in range(4):
+        reg(r, f"http://w{i}:8000", **_stats())
+    turn1 = "system: be helpful\nuser: tell me about TPUs" + "x" * 160
+    w1 = r.pick("m", prefix_key(turn1[:512]), prompt_text=turn1)
+    for growth in range(1, 4):  # three follow-up turns, each longer
+        turn = turn1 + ("\nassistant: ...\nuser: more!" + "y" * 64) * growth
+        w = r.pick("m", prefix_key(turn[:512]), prompt_text=turn)
+        assert w.url == w1.url, "continuation left the KV-holding worker"
+    assert r.ledger_hits >= 3
+
+
+def test_ledger_sheds_saturated_holder_and_recovers():
+    """A saturated prefix-holder sheds the continuation to HRW; once the
+    diverted worker serves it, FURTHER turns follow the diverted worker
+    (the ledger records the actual routing, not the hash winner)."""
+    r = Router()
+    reg(r, "http://a:1", **_stats())
+    reg(r, "http://b:1", **_stats())
+    text = "shared conversation prefix " * 8
+    first = r.pick("m", prefix_key(text[:512]), prompt_text=text)
+    other = "http://b:1" if first.url == "http://a:1" else "http://a:1"
+    # saturate the holder: the next turn must shed to the other worker
+    reg(r, first.url, **_stats(busy=True))
+    turn2 = text + " second turn " * 8
+    w2 = r.pick("m", prefix_key(turn2[:512]), prompt_text=turn2)
+    assert w2.url == other, "saturated holder was not shed"
+    # holder recovers, but turn 3 extends turn 2 whose deepest blocks now
+    # live on the diverted worker
+    reg(r, first.url, **_stats())
+    turn3 = turn2 + " third turn " * 8
+    w3 = r.pick("m", prefix_key(turn3[:512]), prompt_text=turn3)
+    assert w3.url == other, "follow-up abandoned the worker holding the KV"
+
+
+def test_ledger_ignores_dead_workers():
+    r = Router(heartbeat_ttl=0.05)
+    reg(r, "http://a:1", **_stats())
+    reg(r, "http://b:1", **_stats())
+    text = "dead worker conversation " * 8
+    first = r.pick("m", prefix_key(text[:512]), prompt_text=text)
+    time.sleep(0.08)
+    # only the other worker still heartbeats
+    other = "http://b:1" if first.url == "http://a:1" else "http://a:1"
+    reg(r, other, **_stats())
+    w = r.pick("m", prefix_key(text[:512]), prompt_text=text)
+    assert w.url == other
+
+
+def test_pick_exclude_skips_failed_worker():
+    r = Router()
+    reg(r, "http://a:1", **_stats())
+    reg(r, "http://b:1", **_stats())
+    text = "failover conversation " * 8
+    first = r.pick("m", prefix_key(text[:512]), prompt_text=text)
+    other = "http://b:1" if first.url == "http://a:1" else "http://a:1"
+    w = r.pick("m", prefix_key(text[:512]), prompt_text=text,
+               exclude=[first.url])
+    assert w.url == other
+
+
+def test_short_prompts_skip_the_ledger():
+    r = Router()
+    for i in range(4):
+        reg(r, f"http://w{i}:8000", **_stats())
+    # below one 64-char block: pure HRW, no ledger recording
+    w = r.pick("m", prefix_key("hi"), prompt_text="hi")
+    assert w is not None
+    assert r.ledger_hits == 0
+
+
+def test_single_template_block_does_not_herd():
+    """A shared leading block that is ONLY a system-prompt template (< 2
+    full blocks of overlap) must not funnel unrelated conversations onto
+    one worker — that is HRW's job to spread."""
+    r = Router()
+    for i in range(4):
+        reg(r, f"http://w{i}:8000", **_stats())
+    template = "You are a helpful assistant. Answer concisely. "  # 48 chars
+    picks = set()
+    for i in range(48):
+        text = template + f"user question number {i}: " + ("z%d " % i) * 20
+        picks.add(r.pick("m", prefix_key(text), prompt_text=text).url)
+    assert len(picks) >= 3, f"template herded everything onto {picks}"
+
+
+def test_ledger_is_model_namespaced():
+    """Two models sharing a prompt template route independently: m2's
+    workers never inherit m1's ledger entries (and vice versa)."""
+    r = Router()
+    reg(r, "http://m1a:1", model="m1", **_stats())
+    reg(r, "http://m1b:1", model="m1", **_stats())
+    reg(r, "http://m2a:1", model="m2", **_stats())
+    reg(r, "http://m2b:1", model="m2", **_stats())
+    text = "identical shared long prompt template " * 8
+    w1 = r.pick("m1", prefix_key(text), prompt_text=text)
+    w2 = r.pick("m2", prefix_key(text), prompt_text=text)
+    assert w1.url.startswith("http://m1")
+    assert w2.url.startswith("http://m2")
+    # continuations stay within their model's workers
+    turn2 = text + " and a follow-up turn " * 6
+    assert r.pick("m1", prefix_key(turn2), prompt_text=turn2).url == w1.url
+    assert r.pick("m2", prefix_key(turn2), prompt_text=turn2).url == w2.url

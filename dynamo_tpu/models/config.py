@@ -21,13 +21,14 @@ def _llama3_rope_scaling(cfg: dict):
     (factor, low_freq_factor, high_freq_factor, original_max_pos).
 
     Other scaling kinds: "linear" is modeled for gemma-3 (per-layer),
-    "yarn" by _yarn_rope_scaling below; "dynamic"/"longrope" are NOT
-    modeled — warn loudly rather than silently serving frequencies the
-    checkpoint wasn't trained with."""
+    "yarn" by _yarn_rope_scaling below, "longrope" (Phi-3) by
+    _longrope_rope_scaling; "dynamic" is NOT modeled — warn loudly rather
+    than silently serving frequencies the checkpoint wasn't trained
+    with."""
     rs = cfg.get("rope_scaling") or {}
     kind = rs.get("rope_type") or rs.get("type")
     if kind != "llama3":
-        if kind in ("dynamic", "longrope"):
+        if kind in ("dynamic",):
             import logging
 
             logging.getLogger("dynamo_tpu.models").warning(
@@ -65,6 +66,29 @@ def _yarn_rope_scaling(cfg: dict):
         float(rs.get("mscale_all_dim", 0.0)),
         float(af) if af is not None else -1.0,
     )
+
+
+def _longrope_rope_scaling(cfg: dict):
+    """HF rope_scaling with type "longrope" (Phi-3) ->
+    (per_dim_factors, original_max_position_embeddings).
+
+    HF picks short_factor when the runtime context fits the original
+    window and long_factor beyond it; a static-shape serving engine picks
+    ONCE from the checkpoint's advertised max_position_embeddings (the
+    config a deployment selects IS its context-window choice — Phi-3
+    ships separate 4k/128k checkpoints). The attention magnitude factor
+    sqrt(1 + ln(s)/ln(orig)) is derived at apply time
+    (ops/rope.longrope_attention_factor)."""
+    rs = cfg.get("rope_scaling") or {}
+    if (rs.get("rope_type") or rs.get("type")) != "longrope":
+        return None
+    orig = int(rs.get("original_max_position_embeddings",
+                      cfg.get("original_max_position_embeddings", 4096)))
+    max_pos = int(cfg.get("max_position_embeddings", orig))
+    factors = rs.get("long_factor" if max_pos > orig else "short_factor")
+    if not factors:
+        return None
+    return tuple(float(f) for f in factors), orig
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,6 +148,12 @@ class ModelConfig:
     # the rotary magnitude instead (generic HF yarn).
     rope_yarn_scaling: Optional[
         Tuple[float, float, float, int, float, float, float]] = None
+    # Phi-3 longrope (HF type "longrope"): (per-dim inv_freq divisors
+    # [head_dim/2], original_max_position_embeddings). The factor set
+    # (short vs long) is chosen at parse time from the checkpoint's
+    # max_position_embeddings; cos/sin are multiplied by
+    # sqrt(1 + ln(max/orig)/ln(orig)) when extending.
+    rope_longrope_scaling: Optional[Tuple[Tuple[float, ...], int]] = None
     # gemma-2/3 sandwich norms: extra RMSNorms on the attention and MLP
     # OUTPUTS (post_attention_layernorm / post_feedforward_layernorm in HF
     # naming — note HF llama's "post_attention_layernorm" is the PRE-MLP
@@ -277,11 +307,12 @@ class ModelConfig:
             embed_scale=is_gemma,
             sliding_window=(int(cfg.get("sliding_window") or 0)
                             if (is_gemma2 or is_gemma3
-                                or "Mistral" in arch) else 0),
-            # Mistral applies its window on EVERY layer (pattern 0 = no
-            # global layers); gemma-2/3 interleave
+                                or "Mistral" in arch
+                                or "Phi3" in arch) else 0),
+            # Mistral and Phi-3 apply their window on EVERY layer
+            # (pattern 0 = no global layers); gemma-2/3 interleave
             sliding_window_pattern=(
-                0 if "Mistral" in arch else int(
+                0 if ("Mistral" in arch or "Phi3" in arch) else int(
                     cfg.get("sliding_window_pattern")
                     or (6 if is_gemma3 else 2))),
             attn_logit_softcapping=float(
@@ -298,6 +329,7 @@ class ModelConfig:
                 or 1.0) if is_gemma3 else 1.0,
             rope_llama3_scaling=_llama3_rope_scaling(cfg),
             rope_yarn_scaling=_yarn_rope_scaling(cfg),
+            rope_longrope_scaling=_longrope_rope_scaling(cfg),
             qk_norm="Qwen3" in arch or is_gemma3,
             attention_bias=cfg.get("attention_bias", "Qwen2" in arch),
             num_experts=n_experts,
@@ -404,6 +436,32 @@ PRESETS = {
         tie_word_embeddings=False,
         eos_token_id=128009,
         bos_token_id=128000,
+    ),
+    # Phi-3-mini 4k (public HF config): llama-family decoder with FUSED
+    # qkv_proj / gate_up_proj checkpoints (split by the loader), MHA
+    # (kv_heads == heads), head_dim 96. The 128k variants add longrope
+    # rope_scaling, parsed exactly from a local checkpoint's config.json
+    # (from_model_name on the checkpoint dir) — the per-dim factor arrays
+    # are checkpoint data, not preset constants.
+    "phi-3-mini-4k-instruct": ModelConfig(
+        name="phi-3-mini-4k-instruct",
+        vocab_size=32064,
+        hidden_size=3072,
+        intermediate_size=8192,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=32,
+        head_dim=96,
+        rope_theta=10000.0,
+        max_position_embeddings=4096,
+        # Phi-3 trains with a 2047-token window on EVERY layer (HF
+        # config.sliding_window; pattern 0 = no global layers)
+        sliding_window=2047,
+        sliding_window_pattern=0,
+        tie_word_embeddings=False,
+        eos_token_id=32000,
+        extra_stop_token_ids=(32007,),  # <|end|>
+        bos_token_id=1,
     ),
     # Qwen2.5: Qwen2 architecture (attention bias, no qk-norm)
     "qwen2.5-7b-instruct": ModelConfig(

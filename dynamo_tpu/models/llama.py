@@ -112,6 +112,19 @@ def _yarn_softmax_scale(cfg: ModelConfig, q: jax.Array) -> jax.Array:
     return q * jnp.asarray(m * m, q.dtype)
 
 
+def _longrope_args(cfg: ModelConfig):
+    """Phi-3 longrope apply_rope argument: (per-dim factors, attention
+    magnitude) or None. The magnitude is HF's sqrt(1 + ln(s)/ln(orig))
+    over the checkpoint's advertised context extension."""
+    if cfg.rope_longrope_scaling is None:
+        return None
+    from dynamo_tpu.ops.rope import longrope_attention_factor
+
+    factors, orig = cfg.rope_longrope_scaling
+    return factors, longrope_attention_factor(
+        cfg.max_position_embeddings, orig)
+
+
 def _layer_rope(cfg: ModelConfig, page_off, pages_per_layer: int):
     """Gemma-3 per-layer rope: local (sliding) layers use
     rope_local_theta; GLOBAL layers use rope_theta with positions divided
@@ -298,8 +311,11 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array,
         theta, scale = rope
         pos = positions.astype(jnp.float32) / scale
     l3, yarn = cfg.rope_llama3_scaling, cfg.rope_yarn_scaling
-    q = apply_rope(q, pos, theta, llama3_scaling=l3, yarn_scaling=yarn)
-    k = apply_rope(k, pos, theta, llama3_scaling=l3, yarn_scaling=yarn)
+    lr = _longrope_args(cfg)
+    q = apply_rope(q, pos, theta, llama3_scaling=l3, yarn_scaling=yarn,
+                   longrope_scaling=lr)
+    k = apply_rope(k, pos, theta, llama3_scaling=l3, yarn_scaling=yarn,
+                   longrope_scaling=lr)
     q = _yarn_softmax_scale(cfg, q)
     if cfg.query_pre_attn_scalar > 0:
         # the attention ops scale scores by head_dim^-0.5; gemma-2 wants

@@ -223,6 +223,21 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
             "model.layers.{i}.self_attn.o_proj.weight",
             lambda w: to_dt(w).T.reshape(h, vd, e),
         )
+    elif has("model.layers.0.self_attn.qkv_proj.weight"):
+        # Phi-3 fuses q/k/v rows into one projection: [(H+2KV)*D, E] with
+        # q first, then k, then v (same split in HF's Phi3Attention);
+        # each fused tensor is read ONCE per layer (stack() consumes)
+        qkv = [to_dt(g(f"model.layers.{i}.self_attn.qkv_proj.weight"))
+               for i in range(l)]
+        p["wq"] = jnp.stack([w[: h * d].T.reshape(e, h, d) for w in qkv])
+        p["wk"] = jnp.stack(
+            [w[h * d: (h + kv) * d].T.reshape(e, kv, d) for w in qkv])
+        p["wv"] = jnp.stack(
+            [w[(h + kv) * d:].T.reshape(e, kv, d) for w in qkv])
+        p["wo"] = stack(
+            "model.layers.{i}.self_attn.o_proj.weight",
+            lambda w: to_dt(w).T.reshape(h, d, e),
+        )
     else:
         p["wq"] = stack(
             "model.layers.{i}.self_attn.q_proj.weight",
@@ -301,6 +316,15 @@ def load_hf_safetensors(cfg: ModelConfig, files) -> Dict[str, jax.Array]:
             p["w_down"] = stack(
                 f"model.layers.{{i}}.{moe_base}.shared_experts"
                 ".down_proj.weight", lambda w: to_dt(w).T)
+    elif has("model.layers.0.mlp.gate_up_proj.weight"):
+        # Phi-3 fuses gate/up rows: [2F, E], gate first (read once/layer)
+        gu = [to_dt(g(f"model.layers.{i}.mlp.gate_up_proj.weight"))
+              for i in range(l)]
+        p["w_gate"] = jnp.stack([w[:f].T for w in gu])
+        p["w_up"] = jnp.stack([w[f:].T for w in gu])
+        p["w_down"] = stack(
+            "model.layers.{i}.mlp.down_proj.weight", lambda w: to_dt(w).T
+        )
     else:
         p["w_gate"] = stack(
             "model.layers.{i}.mlp.gate_proj.weight", lambda w: to_dt(w).T

@@ -59,6 +59,18 @@ def yarn_scale_freqs(inv: jax.Array, theta: float, head_dim: int,
             + (inv / factor) * (1.0 - extrapolation_mask))
 
 
+def longrope_attention_factor(max_pos: int, original_max_pos: int) -> float:
+    """Phi-3 longrope attention-magnitude correction (HF Phi3 formula):
+    sqrt(1 + ln(scale)/ln(original)) when extending past the original
+    context, 1.0 otherwise. Multiplies cos/sin."""
+    import math
+
+    scale = max_pos / max(original_max_pos, 1)
+    if scale <= 1.0:
+        return 1.0
+    return math.sqrt(1.0 + math.log(scale) / math.log(original_max_pos))
+
+
 def yarn_get_mscale(scale: float, mscale: float = 1.0) -> float:
     """YaRN attention-magnitude correction (HF/DeepSeek formula)."""
     if scale <= 1.0:
@@ -69,18 +81,27 @@ def yarn_get_mscale(scale: float, mscale: float = 1.0) -> float:
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
-               llama3_scaling=None, yarn_scaling=None) -> jax.Array:
+               llama3_scaling=None, yarn_scaling=None,
+               longrope_scaling=None) -> jax.Array:
     """x: [..., seq?, heads, head_dim]; positions broadcastable to x's token dims.
 
     Accepts [S, H, D] with positions [S], or [B, H, D] with positions [B]
     (decode: one token per sequence). `llama3_scaling`: optional
     (factor, low_freq_factor, high_freq_factor, original_max_pos) tuple.
+    `longrope_scaling`: optional (per_dim_factors [D/2], attention_factor)
+    — Phi-3's HF longrope: inv_freq divided per-dim, cos/sin multiplied by
+    the attention factor.
     """
     head_dim = x.shape[-1]
     inv = rope_freqs(head_dim, theta)  # [D/2]
     if llama3_scaling is not None:
         inv = llama3_scale_freqs(inv, *llama3_scaling)
     out_scale = None
+    if longrope_scaling is not None:
+        factors, attn_factor = longrope_scaling
+        inv = inv / jnp.asarray(factors, jnp.float32)
+        if attn_factor != 1.0:
+            out_scale = attn_factor
     if yarn_scaling is not None:
         # (factor, beta_fast, beta_slow, orig_max, mscale, mscale_all_dim,
         #  attention_factor)

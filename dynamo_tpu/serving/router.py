@@ -209,20 +209,21 @@ class Router:
         # before, so multi-turn conversations keep landing on the worker
         # whose prefix cache holds their shared turns — even when HRW
         # load-shading diverted an earlier turn off the hash winner.
-        # Guardrails against template-herding (every request sharing a
-        # system prompt piling onto one worker): a hit needs >= 2 shared
-        # blocks (128+ chars), and the holder must clear a headroom bar
-        # that RELAXES with depth — shallow (mostly-template) overlap
-        # sheds to HRW while the holder is even moderately busy, deep
-        # (real conversation) overlap sticks until near saturation.
+        # Guardrail against template-herding (every request sharing a
+        # system prompt piling onto one worker): the overlap must be
+        # RELATIVE — a true continuation shares most of its own chain
+        # (its history IS the previous prompt), while an unrelated
+        # request sharing only a system template matches a small leading
+        # fraction however long the template is. Saturated holders still
+        # shed to HRW (recompute beats queueing).
         chain = text_block_chain(prompt_text) if prompt_text else []
         if chain:
             live = {w.url: w for w in cands}
             with self._lock:
                 url, depth = self._ledger.lookup(model, chain, live)
             if (url is not None and depth >= 2
-                    and live[url].headroom
-                    >= max(0.05, 0.35 - 0.05 * depth)):
+                    and depth * 10 >= 6 * len(chain)
+                    and live[url].headroom >= 0.05):
                 with self._lock:
                     self.ledger_hits += 1
                     self._ledger.record(model, chain, url)

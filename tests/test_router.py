@@ -146,19 +146,34 @@ def test_short_prompts_skip_the_ledger():
     assert r.ledger_hits == 0
 
 
-def test_single_template_block_does_not_herd():
-    """A shared leading block that is ONLY a system-prompt template (< 2
-    full blocks of overlap) must not funnel unrelated conversations onto
-    one worker — that is HRW's job to spread."""
-    r = Router()
-    for i in range(4):
-        reg(r, f"http://w{i}:8000", **_stats())
-    template = "You are a helpful assistant. Answer concisely. "  # 48 chars
-    picks = set()
-    for i in range(48):
-        text = template + f"user question number {i}: " + ("z%d " % i) * 20
-        picks.add(r.pick("m", prefix_key(text), prompt_text=text).url)
-    assert len(picks) >= 3, f"template herded everything onto {picks}"
+def test_shared_template_does_not_herd():
+    """UNRELATED conversations sharing only a system-prompt template must
+    spread across workers (HRW), however long the template: the ledger
+    requires RELATIVE overlap (>= 60% of the request's own chain), which
+    a template-only match cannot reach once the unique user text
+    dominates. Covers sub-block (48 char) AND multi-block (256 char)
+    templates — the latter regressed under an absolute-depth rule."""
+    # NOTE: a template that fills the whole 256-char AFFINITY key makes
+    # every request hash identically — co-locating those is the HRW
+    # prefix-affinity design (the shared 256-char prefix is real KV
+    # reuse), softened by headroom scaling as the winner fills. The
+    # ledger guardrail is about MULTI-BLOCK templates that still leave
+    # unique text inside the affinity window.
+    for template in (
+        "You are a helpful assistant. Answer concisely. ",  # 48 chars
+        ("You are a meticulous enterprise support agent. Follow policy. "
+         * 4)[:200],  # 3 full 64-char blocks, affinity still distinct
+    ):
+        r = Router()
+        for i in range(4):
+            reg(r, f"http://w{i}:8000", **_stats())
+        picks = set()
+        for i in range(48):
+            text = (template + f"user question number {i}: "
+                    + ("z%d " % i) * 110)  # unique text dominates
+            picks.add(r.pick("m", prefix_key(text), prompt_text=text).url)
+        assert len(picks) >= 3, (
+            f"{len(template)}-char template herded everything onto {picks}")
 
 
 def test_ledger_is_model_namespaced():

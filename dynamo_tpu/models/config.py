@@ -70,25 +70,34 @@ def _yarn_rope_scaling(cfg: dict):
 
 def _longrope_rope_scaling(cfg: dict):
     """HF rope_scaling with type "longrope" (Phi-3) ->
-    (per_dim_factors, original_max_position_embeddings).
+    (short_factors, long_factors, original_max_position_embeddings).
 
-    HF picks short_factor when the runtime context fits the original
-    window and long_factor beyond it; a static-shape serving engine picks
-    ONCE from the checkpoint's advertised max_position_embeddings (the
-    config a deployment selects IS its context-window choice — Phi-3
-    ships separate 4k/128k checkpoints). The attention magnitude factor
-    sqrt(1 + ln(s)/ln(orig)) is derived at apply time
-    (ops/rope.longrope_attention_factor)."""
+    Factor selection is PER POSITION at apply time (ops/rope.apply_rope):
+    positions inside the original window rotate with short-factor
+    frequencies, positions beyond with long-factor ones — vLLM's
+    su-rope serving semantics, which keep short prompts on the
+    frequencies the base model trained with. (HF torch instead switches
+    the WHOLE forward to long factors once total length exceeds the
+    window; the two agree on every request that fits the original
+    window.) The attention magnitude sqrt(1 + ln(s)/ln(orig)) applies
+    globally when the checkpoint extends the window, as in vLLM."""
     rs = cfg.get("rope_scaling") or {}
     if (rs.get("rope_type") or rs.get("type")) != "longrope":
         return None
     orig = int(rs.get("original_max_position_embeddings",
                       cfg.get("original_max_position_embeddings", 4096)))
-    max_pos = int(cfg.get("max_position_embeddings", orig))
-    factors = rs.get("long_factor" if max_pos > orig else "short_factor")
-    if not factors:
+    short = rs.get("short_factor")
+    long = rs.get("long_factor")
+    if not short or not long:
+        import logging
+
+        logging.getLogger("dynamo_tpu.models").warning(
+            "rope_scaling type 'longrope' is missing short_factor/"
+            "long_factor arrays — serving with UNSCALED rope; outputs "
+            "will diverge from the checkpoint's training distribution")
         return None
-    return tuple(float(f) for f in factors), orig
+    return (tuple(float(f) for f in short),
+            tuple(float(f) for f in long), orig)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,12 +157,14 @@ class ModelConfig:
     # the rotary magnitude instead (generic HF yarn).
     rope_yarn_scaling: Optional[
         Tuple[float, float, float, int, float, float, float]] = None
-    # Phi-3 longrope (HF type "longrope"): (per-dim inv_freq divisors
-    # [head_dim/2], original_max_position_embeddings). The factor set
-    # (short vs long) is chosen at parse time from the checkpoint's
-    # max_position_embeddings; cos/sin are multiplied by
-    # sqrt(1 + ln(max/orig)/ln(orig)) when extending.
-    rope_longrope_scaling: Optional[Tuple[Tuple[float, ...], int]] = None
+    # Phi-3 longrope (HF type "longrope"): (short_factors, long_factors,
+    # original_max_position_embeddings) — per-dim inv_freq divisors
+    # selected PER POSITION at apply time (short inside the original
+    # window, long beyond; vLLM su-rope semantics). cos/sin are
+    # multiplied by sqrt(1 + ln(max/orig)/ln(orig)) when the checkpoint
+    # extends the window.
+    rope_longrope_scaling: Optional[
+        Tuple[Tuple[float, ...], Tuple[float, ...], int]] = None
     # gemma-2/3 sandwich norms: extra RMSNorms on the attention and MLP
     # OUTPUTS (post_attention_layernorm / post_feedforward_layernorm in HF
     # naming — note HF llama's "post_attention_layernorm" is the PRE-MLP

@@ -113,15 +113,16 @@ def _yarn_softmax_scale(cfg: ModelConfig, q: jax.Array) -> jax.Array:
 
 
 def _longrope_args(cfg: ModelConfig):
-    """Phi-3 longrope apply_rope argument: (per-dim factors, attention
-    magnitude) or None. The magnitude is HF's sqrt(1 + ln(s)/ln(orig))
-    over the checkpoint's advertised context extension."""
+    """Phi-3 longrope apply_rope argument: (short_factors, long_factors,
+    original_max_pos, attention magnitude) or None. The magnitude is
+    sqrt(1 + ln(s)/ln(orig)) over the checkpoint's advertised context
+    extension; factor selection is per position inside apply_rope."""
     if cfg.rope_longrope_scaling is None:
         return None
     from dynamo_tpu.ops.rope import longrope_attention_factor
 
-    factors, orig = cfg.rope_longrope_scaling
-    return factors, longrope_attention_factor(
+    short, long, orig = cfg.rope_longrope_scaling
+    return short, long, orig, longrope_attention_factor(
         cfg.max_position_embeddings, orig)
 
 

@@ -88,18 +88,25 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
     Accepts [S, H, D] with positions [S], or [B, H, D] with positions [B]
     (decode: one token per sequence). `llama3_scaling`: optional
     (factor, low_freq_factor, high_freq_factor, original_max_pos) tuple.
-    `longrope_scaling`: optional (per_dim_factors [D/2], attention_factor)
-    — Phi-3's HF longrope: inv_freq divided per-dim, cos/sin multiplied by
-    the attention factor.
+    `longrope_scaling`: optional (short_factors [D/2], long_factors [D/2],
+    original_max_pos, attention_factor) — Phi-3's longrope with vLLM
+    su-rope semantics: positions below original_max_pos divide inv_freq
+    by the short factors, positions beyond by the long ones (per-position
+    select, so short prompts keep base-model frequencies); cos/sin are
+    multiplied by the attention factor.
     """
     head_dim = x.shape[-1]
     inv = rope_freqs(head_dim, theta)  # [D/2]
     if llama3_scaling is not None:
         inv = llama3_scale_freqs(inv, *llama3_scaling)
     out_scale = None
+    lr_long_mask = None
     if longrope_scaling is not None:
-        factors, attn_factor = longrope_scaling
-        inv = inv / jnp.asarray(factors, jnp.float32)
+        short, long, orig, attn_factor = longrope_scaling
+        inv_short = inv / jnp.asarray(short, jnp.float32)
+        inv_long = inv / jnp.asarray(long, jnp.float32)
+        # per-position factor select happens at the angle computation
+        lr_long_mask = orig
         if attn_factor != 1.0:
             out_scale = attn_factor
     if yarn_scaling is not None:
@@ -118,7 +125,12 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
                      / yarn_get_mscale(factor, msad))
         if ratio != 1.0:
             out_scale = ratio
-    angles = positions.astype(jnp.float32)[..., None] * inv  # [..., D/2]
+    pos_f = positions.astype(jnp.float32)[..., None]
+    if lr_long_mask is not None:
+        use_long = pos_f >= lr_long_mask  # [..., 1]
+        angles = pos_f * jnp.where(use_long, inv_long, inv_short)
+    else:
+        angles = pos_f * inv  # [..., D/2]
     cos = jnp.cos(angles)[..., None, :]  # [..., 1, D/2]
     sin = jnp.sin(angles)[..., None, :]
     if out_scale is not None:  # yarn rotary magnitude correction

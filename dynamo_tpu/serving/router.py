@@ -85,7 +85,7 @@ def prefix_key(text: str, prefix_chars: int = 256) -> str:
 
 
 def text_block_chain(text: str, block_chars: int = 64,
-                     max_blocks: int = 32) -> List[str]:
+                     max_blocks: int = 64) -> List[str]:
     """Rolling hash chain over fixed-size TEXT blocks of the prompt — the
     frontend-side analogue of the engine's page-block hash chain
     (engine/kv_cache.py PrefixCache). The frontend is tokenizer-free, so
@@ -221,8 +221,15 @@ class Router:
             live = {w.url: w for w in cands}
             with self._lock:
                 url, depth = self._ledger.lookup(model, chain, live)
+            # the ratio denominator uses the TRUE prompt length (capped at
+            # the chain window) so a prompt longer than the hashed window
+            # cannot make a long shared template look like majority
+            # overlap; only a request whose entire hashed window is known
+            # history clears the bar there
+            denom = max(len(chain),
+                        min(len(prompt_text) // 64, 64))
             if (url is not None and depth >= 2
-                    and depth * 10 >= 6 * len(chain)
+                    and depth * 10 >= 6 * denom
                     and live[url].headroom >= 0.05):
                 with self._lock:
                     self.ledger_hits += 1

@@ -48,14 +48,41 @@ def _longrope_cfg():
 def test_from_hf_config_parses_longrope():
     cfg = ModelConfig.from_hf_config(_longrope_cfg())
     assert cfg.rope_longrope_scaling is not None
-    factors, orig = cfg.rope_longrope_scaling
-    assert factors == (1.0, 1.1, 1.2, 1.5, 2.0, 2.5, 3.0, 4.0)
+    short, long, orig = cfg.rope_longrope_scaling
+    assert short == (1.0,) * 8
+    assert long == (1.0, 1.1, 1.2, 1.5, 2.0, 2.5, 3.0, 4.0)
     assert orig == 16
-    # within the original window -> short factors
-    short = dict(_longrope_cfg())
-    short["max_position_embeddings"] = 16
-    cfg_s = ModelConfig.from_hf_config(short)
-    assert cfg_s.rope_longrope_scaling[0] == (1.0,) * 8
+    # malformed factor arrays must fall back LOUDLY to unscaled rope
+    bad = dict(_longrope_cfg())
+    bad["rope_scaling"] = {"type": "longrope", "short_factor": []}
+    assert ModelConfig.from_hf_config(bad).rope_longrope_scaling is None
+
+
+def test_longrope_selects_factors_per_position():
+    """vLLM su-rope semantics: positions inside the original window
+    rotate with short-factor frequencies, positions beyond with
+    long-factor ones — asserted directly against the closed-form rotation
+    with a 64x factor contrast (a logits-level test cannot see this:
+    tiny-model logit deltas sit below any honest tolerance)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.rope import apply_rope, rope_freqs
+
+    d, theta, orig = 8, 10000.0, 16
+    short = (1.0,) * 4
+    long = (64.0,) * 4
+    x = jnp.ones((2, 1, d), jnp.float32)  # positions 4 (inside), 40 (beyond)
+    pos = jnp.asarray([4, 40], jnp.int32)
+    got = apply_rope(x, pos, theta,
+                     longrope_scaling=(short, long, orig, 1.0))
+
+    inv = np.asarray(rope_freqs(d, theta))
+    for row, (p, factors) in enumerate([(4, short), (40, long)]):
+        ang = p * (inv / np.asarray(factors))
+        cos, sin = np.cos(ang), np.sin(ang)
+        want = np.concatenate([cos - sin, cos + sin])  # x==1 everywhere
+        np.testing.assert_allclose(np.asarray(got)[row, 0], want,
+                                   rtol=1e-5, atol=1e-5)
 
 
 def test_longrope_attention_factor_formula():

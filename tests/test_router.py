@@ -193,3 +193,33 @@ def test_ledger_is_model_namespaced():
     turn2 = text + " and a follow-up turn " * 6
     assert r.pick("m1", prefix_key(turn2), prompt_text=turn2).url == w1.url
     assert r.pick("m2", prefix_key(turn2), prompt_text=turn2).url == w2.url
+
+
+def test_long_template_beyond_chain_cap_never_rides_the_ledger():
+    """A shared template (here 21 blocks) inside prompts LONGER than the
+    hashed chain window: the overlap ratio uses the TRUE prompt length,
+    so template-only overlap can never clear the 60% bar even though the
+    chain itself saturates at the cap — every such request must go
+    through HRW scoring (whose headroom weighting is the load valve),
+    never the ledger fast path."""
+    r = Router()
+    for i in range(4):
+        reg(r, f"http://w{i}:8000", **_stats())
+    template = ("policy preamble for the enterprise assistant. " * 32)[:1400]
+    for i in range(24):
+        text = template + f" req {i} " + (f"unique{i} " * 400)  # >4096 chars
+        assert r.pick("m", prefix_key(text), prompt_text=text) is not None
+    assert r.ledger_hits == 0, (
+        "template-only overlap rode the ledger past HRW load scoring")
+
+
+def test_true_continuation_beyond_chain_cap_still_follows():
+    """When the whole hashed window is shared history, the ledger must
+    still follow — only template-fraction overlap sheds."""
+    r = Router()
+    for i in range(4):
+        reg(r, f"http://w{i}:8000", **_stats())
+    turn1 = "conversation history block " * 200  # > 4096 chars
+    w1 = r.pick("m", prefix_key(turn1), prompt_text=turn1)
+    turn2 = turn1 + "next question " * 30
+    assert r.pick("m", prefix_key(turn2), prompt_text=turn2).url == w1.url

@@ -30,12 +30,50 @@ class ByteTokenizer:
     def decode(self, ids: List[int]) -> str:
         return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
 
-    def apply_chat_template(self, messages: List[dict]) -> str:
+    def apply_chat_template(self, messages: List[dict],
+                            tools: Optional[List[dict]] = None) -> str:
+        import json as _json
+
         parts = []
+        if tools:
+            # tool schemas ride a leading system-style block (the byte
+            # template's analogue of HF templates' tools rendering)
+            parts.append("<|tools|>\n"
+                         + _json.dumps(tools, sort_keys=True) + "\n")
         for m in messages:
-            parts.append(f"<|{m['role']}|>\n{m['content']}\n")
+            content = m.get("content")
+            if content is None and m.get("tool_calls"):
+                content = _json.dumps(m["tool_calls"])
+            parts.append(f"<|{m['role']}|>\n{content or ''}\n")
         parts.append("<|assistant|>\n")
         return "".join(parts)
+
+
+def _hf_template_messages(messages: List[dict]) -> List[dict]:
+    """OpenAI wire format -> HF template convention: tool-call arguments
+    arrive as JSON STRINGS on the wire, but HF chat templates `tojson`
+    dict arguments — passing the wire form through would double-encode
+    them in the rendered prompt."""
+    import json as _json
+
+    out = []
+    for m in messages:
+        calls = m.get("tool_calls")
+        if not calls:
+            out.append(m)
+            continue
+        fixed = []
+        for c in calls:
+            fn = dict(c.get("function") or {})
+            args = fn.get("arguments")
+            if isinstance(args, str):
+                try:
+                    fn["arguments"] = _json.loads(args)
+                except Exception:
+                    pass  # leave malformed strings as-is
+            fixed.append({**c, "function": fn})
+        out.append({**m, "tool_calls": fixed})
+    return out
 
 
 class HFTokenizer:
@@ -55,13 +93,23 @@ class HFTokenizer:
     def decode(self, ids: List[int]) -> str:
         return self.tok.decode(ids, skip_special_tokens=True)
 
-    def apply_chat_template(self, messages: List[dict]) -> str:
+    def apply_chat_template(self, messages: List[dict],
+                            tools: Optional[List[dict]] = None) -> str:
         try:
             return self.tok.apply_chat_template(
-                messages, tokenize=False, add_generation_prompt=True
+                _hf_template_messages(messages), tools=tools,
+                tokenize=False, add_generation_prompt=True
             )
         except Exception:
-            return ByteTokenizer.apply_chat_template(self, messages)  # type: ignore
+            import logging
+
+            logging.getLogger("dynamo_tpu.engine").warning(
+                "HF chat template failed%s; falling back to the byte "
+                "template — the model will see a prompt format it was "
+                "not trained on", " (with tools)" if tools else "",
+                exc_info=True)
+            return ByteTokenizer.apply_chat_template(  # type: ignore
+                self, messages, tools=tools)
 
 
 def get_tokenizer(model: str, model_path: Optional[str] = None):

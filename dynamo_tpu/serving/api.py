@@ -560,7 +560,17 @@ class _Handler(JsonHTTPHandler):
     def _chat(self, body):
         p = proto.parse_chat_request(body)
         self._check_model(p["model"])
-        prompt_text = self.ctx.tokenizer.apply_chat_template(p["messages"])
+        tools, tc = p["tools"], p["tool_choice"]
+        forced_tool = isinstance(tc, tuple)  # ("function", name)
+        if forced_tool:
+            if p["stream"]:
+                raise proto.BadRequest(
+                    "streaming is not supported with a forced tool_choice")
+            # the forced call's arguments are produced by the JSON-guided
+            # decoder: one complete JSON object
+            p["guided_json"] = True
+        prompt_text = self.ctx.tokenizer.apply_chat_template(
+            p["messages"], tools=tools if tc != "none" else None)
         prompt_ids = self.ctx.tokenizer.encode(prompt_text)
         rid = proto.new_id("chatcmpl")
         handles = self.ctx.start_choices(rid, prompt_ids, p)  # may raise -> 400
@@ -608,10 +618,23 @@ class _Handler(JsonHTTPHandler):
         else:
             results = run_choices(handles,
                                   lambda h: (lambda d, f, lp: True))
+
+            def tool_call_for(text, finish):
+                # forced: only a stop-finished object is a candidate (a
+                # length cutoff stays honest text), and extract_tool_call
+                # re-validates the JSON so a user stop-string truncation
+                # can never ship unparseable arguments
+                if tc == "none" or tools is None:
+                    return None
+                if forced_tool and finish != "stop":
+                    return None
+                return proto.extract_tool_call(text, tools, tc)
+
             choices = [
                 proto.chat_choice(
                     h.index, text, finish,
                     h.lp_entries if h.want_logprobs else None,
+                    tool_call=tool_call_for(text, finish),
                 )
                 for h, (text, finish, _) in zip(handles, results)
             ]

@@ -140,6 +140,7 @@ def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
         )
     if top_lp and not lp:
         raise BadRequest("'top_logprobs' requires 'logprobs': true")
+    tools, tool_choice = _parse_tools(body)
     return {
         "model": model,
         "messages": messages,
@@ -147,8 +148,90 @@ def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
         # engine logprobs: None = off; N = chosen + top-N alternatives
         "logprobs": top_lp if lp else None,
         "guided_json": _parse_response_format(body),
+        "tools": tools,
+        "tool_choice": tool_choice,
         **_common_sampling(body),
     }
+
+
+def _parse_tools(body: Dict[str, Any]):
+    """OpenAI `tools` + `tool_choice`. Returns (tools, tool_choice) where
+    tool_choice is "none", "auto", or the forced function NAME.
+
+    A forced function rides the JSON-guided decoder: the completion is
+    constrained to one JSON object, returned as the call's arguments.
+    "auto" serves text and surfaces a tool call only when the model emits
+    the canonical {"name": ..., "arguments": {...}} object (the reference
+    stack's engines likewise need a model-specific parser for free-form
+    tool syntax)."""
+    tools = body.get("tools")
+    if tools is None:
+        if body.get("tool_choice") not in (None, "none"):
+            raise BadRequest("'tool_choice' requires 'tools'")
+        return None, "none"
+    if not isinstance(tools, list) or not tools:
+        raise BadRequest("'tools' must be a non-empty array")
+    names = []
+    for t in tools:
+        fn = t.get("function") if isinstance(t, dict) else None
+        if (not isinstance(t, dict) or t.get("type") != "function"
+                or not isinstance(fn, dict)
+                or not isinstance(fn.get("name"), str)):
+            raise BadRequest(
+                "each tool must be {'type': 'function', 'function': "
+                "{'name': ..., ...}}")
+        names.append(fn["name"])
+    tc = body.get("tool_choice")
+    if tc is None:  # explicit null == absent (OpenAI default)
+        tc = "auto"
+    if tc in ("auto", "none"):
+        return tools, tc
+    if (isinstance(tc, dict) and tc.get("type") == "function"
+            and isinstance(tc.get("function"), dict)):
+        name = tc["function"].get("name")
+        if name not in names:
+            raise BadRequest(f"tool_choice names unknown function {name!r}")
+        # tagged so a tool literally named "auto"/"none" can be forced
+        return tools, ("function", name)
+    raise BadRequest(
+        "'tool_choice' must be 'auto', 'none', or "
+        "{'type': 'function', 'function': {'name': ...}}")
+
+
+def extract_tool_call(text: str, tools, tool_choice):
+    """Map generated text to an OpenAI tool_calls entry, or None.
+
+    Forced choice (("function", name) tag): the guided decoder produced
+    one JSON object — it IS the arguments, re-validated here so a
+    stop-string truncation can never ship unparseable arguments under
+    the grammar guarantee. Auto: accept only the canonical
+    {"name": <known tool>, "arguments": <object>} shape."""
+    import json as _json
+
+    if tool_choice == "none" or not tools:
+        return None
+    if isinstance(tool_choice, tuple):  # ("function", name)
+        try:
+            if not isinstance(_json.loads(text), dict):
+                return None
+        except Exception:
+            return None
+        return {"id": new_id("call"), "type": "function",
+                "function": {"name": tool_choice[1], "arguments": text}}
+    try:
+        obj = _json.loads(text)
+    except Exception:
+        return None
+    if not isinstance(obj, dict) or set(obj) != {"name", "arguments"}:
+        return None
+    known = {t["function"]["name"] for t in tools}
+    if obj["name"] not in known:
+        return None
+    args = obj["arguments"]
+    return {"id": new_id("call"), "type": "function",
+            "function": {"name": obj["name"],
+                         "arguments": (args if isinstance(args, str)
+                                       else _json.dumps(args))}}
 
 
 def _parse_response_format(body: Dict[str, Any]) -> bool:
@@ -265,12 +348,17 @@ def chat_logprob_entry(token_text: str, logprob: float,
 
 
 def chat_choice(index: int, text: str, finish_reason: str,
-                logprob_entries: Optional[List[Dict]] = None) -> Dict[str, Any]:
+                logprob_entries: Optional[List[Dict]] = None,
+                tool_call: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     out = {
         "index": index,
         "message": {"role": "assistant", "content": text},
         "finish_reason": finish_reason,
     }
+    if tool_call is not None:
+        out["message"] = {"role": "assistant", "content": None,
+                          "tool_calls": [tool_call]}
+        out["finish_reason"] = "tool_calls"
     if logprob_entries is not None:
         out["logprobs"] = {"content": logprob_entries}
     return out

@@ -1,0 +1,149 @@
+"""OpenAI tool calling: tools/tool_choice parsing, template injection,
+and forced tool_choice riding the JSON-guided decoder.
+
+Reference parity: the reference stack's OpenAI frontend serves `tools`
+through its engines (vLLM-style); free-form "auto" tool syntax needs a
+model-specific parser there too, so this implementation surfaces auto
+calls only for the canonical {"name", "arguments"} object and makes
+FORCED calls grammar-guaranteed via ops/json_guide.py."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.serving import protocol as proto
+
+TOOLS = [{"type": "function",
+          "function": {"name": "get_weather",
+                       "description": "look up weather",
+                       "parameters": {"type": "object",
+                                      "properties": {
+                                          "city": {"type": "string"}}}}}]
+BASE = {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+
+
+def test_parse_tools_and_choices():
+    p = proto.parse_chat_request({**BASE, "tools": TOOLS})
+    assert p["tool_choice"] == "auto" and p["tools"] == TOOLS
+    p = proto.parse_chat_request({**BASE, "tools": TOOLS,
+                                  "tool_choice": "none"})
+    assert p["tool_choice"] == "none"
+    p = proto.parse_chat_request(
+        {**BASE, "tools": TOOLS,
+         "tool_choice": {"type": "function",
+                         "function": {"name": "get_weather"}}})
+    assert p["tool_choice"] == ("function", "get_weather")
+    # explicit null == absent (OpenAI default)
+    p = proto.parse_chat_request({**BASE, "tools": TOOLS,
+                                  "tool_choice": None})
+    assert p["tool_choice"] == "auto"
+    # a tool literally named "auto" can still be FORCED (tagged choice)
+    weird = [{"type": "function", "function": {"name": "auto"}}]
+    p = proto.parse_chat_request(
+        {**BASE, "tools": weird,
+         "tool_choice": {"type": "function", "function": {"name": "auto"}}})
+    assert p["tool_choice"] == ("function", "auto")
+    with pytest.raises(proto.BadRequest):
+        proto.parse_chat_request(
+            {**BASE, "tools": TOOLS,
+             "tool_choice": {"type": "function",
+                             "function": {"name": "nope"}}})
+    with pytest.raises(proto.BadRequest):
+        proto.parse_chat_request({**BASE, "tool_choice": "auto"})
+    with pytest.raises(proto.BadRequest):
+        proto.parse_chat_request({**BASE, "tools": [{"type": "function"}]})
+
+
+def test_extract_tool_call_shapes():
+    # forced: text IS the arguments (re-validated)
+    call = proto.extract_tool_call('{"city": "Oslo"}', TOOLS,
+                                   ("function", "get_weather"))
+    assert call["function"] == {"name": "get_weather",
+                                "arguments": '{"city": "Oslo"}'}
+    # a stop-string truncation can never ship unparseable arguments
+    assert proto.extract_tool_call('{"city": "Os', TOOLS,
+                                   ("function", "get_weather")) is None
+    # auto: canonical object only
+    good = json.dumps({"name": "get_weather",
+                       "arguments": {"city": "Oslo"}})
+    call = proto.extract_tool_call(good, TOOLS, "auto")
+    assert call["function"]["name"] == "get_weather"
+    assert json.loads(call["function"]["arguments"]) == {"city": "Oslo"}
+    assert proto.extract_tool_call("plain text", TOOLS, "auto") is None
+    assert proto.extract_tool_call(
+        json.dumps({"name": "unknown", "arguments": {}}), TOOLS,
+        "auto") is None
+    assert proto.extract_tool_call(good, TOOLS, "none") is None
+
+
+def test_template_injects_tools_and_tool_messages():
+    from dynamo_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    msgs = [
+        {"role": "user", "content": "weather?"},
+        {"role": "assistant", "content": None,
+         "tool_calls": [{"id": "call_1", "type": "function",
+                         "function": {"name": "get_weather",
+                                      "arguments": "{}"}}]},
+        {"role": "tool", "content": '{"temp": 3}'},
+    ]
+    text = tok.apply_chat_template(msgs, tools=TOOLS)
+    assert "get_weather" in text  # schema block present
+    assert '{"temp": 3}' in text  # tool result rendered
+    assert "None" not in text  # null content never prints as 'None'
+    # without tools: no schema block
+    assert "get_weather" not in tok.apply_chat_template(
+        [{"role": "user", "content": "hi"}])
+
+
+def test_forced_tool_call_http_end_to_end():
+    """Forced tool_choice through the real HTTP frontend: the guided
+    decoder guarantees the arguments parse; the choice carries
+    tool_calls with finish_reason tool_calls."""
+    from dynamo_tpu.engine.engine import Engine, EngineConfig
+    from dynamo_tpu.serving.api import ServingContext, make_server
+
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4,
+                              num_pages=256, max_num_seqs=4,
+                              max_seq_len=512, num_scheduler_steps=8))
+    ctx = ServingContext(eng, served_model="tiny-debug")
+    srv = make_server(ctx, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        body = {"model": "tiny-debug",
+                "messages": [{"role": "user", "content": "Oslo weather"}],
+                "max_tokens": 300, "temperature": 1.5, "top_p": 1.0,
+                "tools": TOOLS,
+                "tool_choice": {"type": "function",
+                                "function": {"name": "get_weather"}}}
+        got_call = False
+        for seed in (1, 4, 5, 9):
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json.dumps({**body, "seed": seed}).encode(),
+                {"Content-Type": "application/json"}))
+            ch = json.loads(r.read())["choices"][0]
+            if ch["finish_reason"] == "tool_calls":
+                call = ch["message"]["tool_calls"][0]
+                assert call["function"]["name"] == "get_weather"
+                assert isinstance(
+                    json.loads(call["function"]["arguments"]), dict)
+                assert ch["message"]["content"] is None
+                got_call = True
+            else:  # length cutoff: stays honest text
+                assert ch["finish_reason"] == "length"
+        assert got_call, "no seed produced a complete forced call"
+        # forced + stream must 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json.dumps({**body, "stream": True}).encode(),
+                {"Content-Type": "application/json"}))
+        assert ei.value.code == 400
+    finally:
+        srv.shutdown()

@@ -586,19 +586,42 @@ class _Handler(JsonHTTPHandler):
                                      index=h.index)
                 )
 
+            # tool_choice "auto": gate each choice's stream so a leading
+            # '{' buffers until finish and can become ONE tool_calls
+            # delta; anything else streams as before
+            gating = tools is not None and tc == "auto"
+
             def emit_for(h):
+                gate = proto.AutoToolStreamGate() if gating else None
+
                 def emit(delta, finish, lp_entry) -> bool:
                     with lock:
                         ok = True
-                        if delta or lp_entry is not None:
+                        entries = ([lp_entry] if lp_entry is not None
+                                   else [])
+                        if gate is not None:
+                            delta, entries = gate.feed(delta, lp_entry)
+                            if finish is not None:
+                                call, held, held_lp = gate.finish(tools, tc)
+                                if call is not None:
+                                    finish = "tool_calls"
+                                    ok = self._sse_chunk(proto.chat_chunk(
+                                        rid, p["model"],
+                                        proto.tool_call_chunk_delta(call),
+                                        None, with_usage_null=with_null,
+                                        index=h.index)) and ok
+                                else:
+                                    delta += held
+                                    entries = entries + held_lp
+                        if delta or entries:
                             ok = self._sse_chunk(proto.chat_chunk(
                                 rid, p["model"], {"content": delta}, None,
                                 with_usage_null=with_null, index=h.index,
                                 logprob_entries=(
-                                    [lp_entry] if lp_entry is not None
+                                    entries if entries
                                     else (None if not h.want_logprobs else [])
                                 ),
-                            ))
+                            )) and ok
                         if finish is not None:
                             ok = self._sse_chunk(proto.chat_chunk(
                                 rid, p["model"], {}, finish,

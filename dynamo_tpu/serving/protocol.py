@@ -115,8 +115,13 @@ def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
     if not isinstance(messages, list) or not messages:
         raise BadRequest("'messages' must be a non-empty array")
     for m in messages:
-        if not isinstance(m, dict) or "role" not in m or "content" not in m:
-            raise BadRequest("each message needs 'role' and 'content'")
+        if not isinstance(m, dict) or "role" not in m:
+            raise BadRequest("each message needs 'role'")
+        # content is optional exactly when the assistant turn carries
+        # tool_calls (OpenAI multi-turn tool conversations)
+        if "content" not in m and not m.get("tool_calls"):
+            raise BadRequest("each message needs 'content' (or "
+                             "'tool_calls' on assistant turns)")
     model = body.get("model")
     if not isinstance(model, str) or not model:
         raise BadRequest("'model' is required")
@@ -198,6 +203,66 @@ def _parse_tools(body: Dict[str, Any]):
         "{'type': 'function', 'function': {'name': ...}}")
 
 
+class AutoToolStreamGate:
+    """Streaming gate for tool_choice "auto": decide per choice whether
+    the stream is a tool call without giving up streaming for plain text.
+
+    The only auto shape this stack surfaces is the canonical
+    {"name", "arguments"} object, which must START with '{' — so the
+    gate probes the first non-whitespace character: anything else flushes
+    the held text (verbatim, leading whitespace included) and streams
+    normally from then on; a '{' buffers the whole choice and, at
+    finish, either emits one tool_calls delta (the text parsed as a
+    canonical call) or flushes the buffered text. Logprob entries ride
+    WITH their text: held entries are released on flush so token/logprob
+    alignment survives, and dropped only when the text itself becomes a
+    tool call (content is null there).
+
+    feed(delta, lp_entry) -> (text to emit now, lp entries to emit now).
+    finish(tools, tool_choice) -> (tool_call | None, leftover_text,
+    leftover lp entries)."""
+
+    def __init__(self):
+        self._mode = "probe"  # probe -> buffer | stream
+        self._parts: List[str] = []
+        self._lp: List[Dict] = []
+
+    def feed(self, delta: str, lp_entry: Optional[Dict] = None):
+        if self._mode == "stream":
+            return delta, ([lp_entry] if lp_entry is not None else [])
+        self._parts.append(delta)
+        if lp_entry is not None:
+            self._lp.append(lp_entry)
+        if self._mode == "probe":
+            stripped = "".join(self._parts).lstrip()
+            if stripped:
+                if stripped[0] == "{":
+                    self._mode = "buffer"
+                else:
+                    self._mode = "stream"
+                    held, entries = "".join(self._parts), self._lp
+                    self._parts, self._lp = [], []
+                    return held, entries
+        return "", []
+
+    def finish(self, tools, tool_choice):
+        held, entries = "".join(self._parts), self._lp
+        self._parts, self._lp = [], []
+        if self._mode != "buffer":
+            self._mode = "stream"
+            return None, held, entries  # whitespace-only probe flushes too
+        self._mode = "stream"
+        call = extract_tool_call(held, tools, tool_choice)
+        if call is not None:
+            return call, "", []  # content is null: entries describe nothing
+        return None, held, entries
+
+
+def tool_call_chunk_delta(call: Dict[str, Any]) -> Dict[str, Any]:
+    """delta payload carrying a complete streamed tool call (index 0)."""
+    return {"tool_calls": [{"index": 0, **call}]}
+
+
 def extract_tool_call(text: str, tools, tool_choice):
     """Map generated text to an OpenAI tool_calls entry, or None.
 
@@ -228,6 +293,16 @@ def extract_tool_call(text: str, tools, tool_choice):
     if obj["name"] not in known:
         return None
     args = obj["arguments"]
+    if isinstance(args, str):
+        # string arguments must themselves parse to an object, or a
+        # client's json.loads(arguments) would crash on our output
+        try:
+            if not isinstance(_json.loads(args), dict):
+                return None
+        except Exception:
+            return None
+    elif not isinstance(args, dict):
+        return None  # scalar arguments are not a canonical call
     return {"id": new_id("call"), "type": "function",
             "function": {"name": obj["name"],
                          "arguments": (args if isinstance(args, str)

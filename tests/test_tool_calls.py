@@ -147,3 +147,103 @@ def test_forced_tool_call_http_end_to_end():
         assert ei.value.code == 400
     finally:
         srv.shutdown()
+
+
+def test_auto_stream_gate_unit():
+    """The streaming gate: non-'{' text streams through after the probe
+    (flushed VERBATIM, leading whitespace intact, logprob entries
+    riding with their text); a '{' start buffers the whole choice and
+    converts to ONE tool call at finish iff canonical; otherwise the
+    held text+entries flush."""
+    g = proto.AutoToolStreamGate()
+    lp1, lp2 = {"token": "  \n"}, {"token": " Hel"}
+    assert g.feed("  \n", lp1) == ("", [])  # whitespace keeps probing
+    text, entries = g.feed(" Hel", lp2)  # probe resolves: stream
+    assert text == "  \n Hel"  # verbatim, not lstripped
+    assert entries == [lp1, lp2]  # alignment survives the probe
+    assert g.feed("lo", None) == ("lo", [])
+    call, held, held_lp = g.finish(TOOLS, "auto")
+    assert call is None and held == "" and held_lp == []
+
+    g = proto.AutoToolStreamGate()
+    obj = json.dumps({"name": "get_weather", "arguments": {"city": "Oslo"}})
+    for ch in (obj[:5], obj[5:12], obj[12:]):
+        assert g.feed(ch, {"token": ch}) == ("", [])  # buffered
+    call, held, held_lp = g.finish(TOOLS, "auto")
+    assert held == "" and held_lp == []
+    assert call["function"]["name"] == "get_weather"
+
+    g = proto.AutoToolStreamGate()
+    assert g.feed('{"not": "a call"}', {"token": "x"}) == ("", [])
+    call, held, held_lp = g.finish(TOOLS, "auto")
+    assert call is None and held == '{"not": "a call"}'
+    assert held_lp == [{"token": "x"}]  # entries flush with their text
+
+
+def test_auto_stream_passthrough_http():
+    """Streamed auto request whose output is not a canonical call must
+    stream as plain text with a normal finish."""
+    import threading
+    import urllib.request
+
+    from dynamo_tpu.engine.engine import Engine, EngineConfig
+    from dynamo_tpu.serving.api import ServingContext, make_server
+
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4,
+                              num_pages=192, max_num_seqs=2,
+                              max_seq_len=512))
+    ctx = ServingContext(eng, served_model="tiny-debug")
+    srv = make_server(ctx, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            json.dumps({"model": "tiny-debug", "stream": True,
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 12, "temperature": 0.0,
+                        "tools": TOOLS, "tool_choice": "auto"}).encode(),
+            {"Content-Type": "application/json"})
+        finishes, text = [], []
+        with urllib.request.urlopen(req) as r:
+            for line in r:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    d = json.loads(line[6:])["choices"][0]
+                    text.append(d["delta"].get("content") or "")
+                    if d.get("finish_reason"):
+                        finishes.append(d["finish_reason"])
+        assert finishes and finishes[-1] in ("stop", "length")
+    finally:
+        srv.shutdown()
+
+
+def test_tool_messages_without_content_key_accepted():
+    """OpenAI multi-turn tool conversations: assistant turns may carry
+    tool_calls with NO content key; plain turns still require content."""
+    msgs = [
+        {"role": "user", "content": "weather?"},
+        {"role": "assistant",
+         "tool_calls": [{"id": "c1", "type": "function",
+                         "function": {"name": "get_weather",
+                                      "arguments": "{}"}}]},
+        {"role": "tool", "content": '{"temp": 3}'},
+    ]
+    p = proto.parse_chat_request({**BASE, "messages": msgs, "tools": TOOLS})
+    assert p["messages"] == msgs
+    with pytest.raises(proto.BadRequest):
+        proto.parse_chat_request({**BASE, "messages": [{"role": "user"}]})
+
+
+def test_auto_rejects_non_object_arguments():
+    """Scalar or unparseable-string arguments are not a canonical call —
+    a client's json.loads(arguments) must never crash on our output."""
+    for args in (5, [1], "not json", json.dumps([1, 2])):
+        t = json.dumps({"name": "get_weather", "arguments": args}) \
+            if not isinstance(args, str) else json.dumps(
+                {"name": "get_weather", "arguments": args})
+        assert proto.extract_tool_call(t, TOOLS, "auto") is None, args
+    # string-encoded OBJECT arguments pass through
+    t = json.dumps({"name": "get_weather", "arguments": '{"city": "x"}'})
+    call = proto.extract_tool_call(t, TOOLS, "auto")
+    assert json.loads(call["function"]["arguments"]) == {"city": "x"}

@@ -1,0 +1,88 @@
+"""Summarize a TPU battery jsonl into the docs/perf.md table shape and
+flag the follow-up actions the measurements gate (kernel-flag flips,
+block_pages/num_bufs defaults, SLA verdicts, roofline calibration).
+
+Usage: python scripts/summarize_battery.py [bench_results/tpu_battery_r05.jsonl]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def latest_rows(path: str):
+    """Last successful row per case (reruns supersede; errors kept only
+    when no success exists)."""
+    rows, errs = {}, {}
+    with open(path) as f:
+        for ln in f:
+            try:
+                r = json.loads(ln)
+            except Exception:
+                continue
+            case = r.get("case")
+            if case in (None, "start", "done"):
+                continue
+            if "error" in r:
+                errs.setdefault(case, r)
+            else:
+                rows[case] = r
+    for c, e in errs.items():
+        rows.setdefault(c, e)
+    return rows
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else \
+        "bench_results/tpu_battery_r05.jsonl"
+    rows = latest_rows(path)
+    print(f"{'case':26} {'value':>10}  notes")
+    for case in sorted(rows):
+        r = rows[case]
+        if "error" in r:
+            print(f"{case:26} {'ERROR':>10}  {str(r['error'])[:70]}")
+            continue
+        val = r.get("value", r.get("ok", r.get("predicted_tok_s_per_chip")))
+        notes = []
+        for k in ("itl_ms", "mbu", "mfu", "ttft_p50_ms", "spec_acceptance",
+                  "guided_legal", "max_abs_err", "wall_s"):
+            if k in r:
+                v = r[k]
+                notes.append(f"{k}={v:.3g}" if isinstance(v, float)
+                             else f"{k}={v}")
+        print(f"{case:26} {val!s:>10}  {' '.join(notes)}")
+
+    print("\n-- gated follow-ups --")
+    p = rows.get("chunk_kernel_int8_parity")
+    if p and p.get("ok") and p.get("backend") == "tpu":
+        print("* flip CHUNK_KERNEL_INT8_HW_VALIDATED -> True "
+              "(ops/pallas_attention.py)")
+    mbu = {c: rows[c] for c in rows
+           if c.startswith("mbu_") and "value" in rows[c]}
+    if mbu:
+        best = max(mbu, key=lambda c: mbu[c]["value"])
+        print(f"* best decode-kernel knob case: {best} "
+              f"({mbu[best]['value']} tok/s, mbu={mbu[best].get('mbu')}) — "
+              "set DEFAULT_BLOCK_PAGES/NUM_BUFS accordingly")
+    for c in ("sla4k_xla", "sla4k_pallas", "sla4k_int8kv"):
+        r = rows.get(c)
+        if r and "ttft_p50_ms" in r:
+            ok_ttft = r["ttft_p50_ms"] <= r.get("ttft_target_ms", 600)
+            ok_itl = r.get("itl_p50_ms", 1e9) <= r.get("itl_target_ms", 25)
+            print(f"* {c}: TTFT {r['ttft_p50_ms']:.0f}ms "
+                  f"({'PASS' if ok_ttft else 'MISS'} vs "
+                  f"{r.get('ttft_target_ms')}), ITL "
+                  f"{r.get('itl_p50_ms', float('nan')):.1f}ms "
+                  f"({'PASS' if ok_itl else 'MISS'} vs "
+                  f"{r.get('itl_target_ms')})")
+    pred = rows.get("sla_roofline")
+    meas = rows.get("sla4k_xla") or rows.get("sla4k_pallas")
+    if pred and meas and "ttft_p50_ms" in meas:
+        ratio = meas["ttft_p50_ms"] / max(pred["predicted_ttft_ms"], 1e-9)
+        print(f"* roofline calibration: measured/predicted TTFT = "
+              f"{ratio:.2f} (tests/test_profiler.py asserts the band)")
+
+
+if __name__ == "__main__":
+    main()

@@ -359,7 +359,7 @@ SNAPSHOT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_TPU_SNAPSHOT.json")
 
 
-def _load_snapshot():
+def _read_snapshot_file():
     try:
         with open(SNAPSHOT_PATH) as f:
             return json.load(f)
@@ -367,8 +367,43 @@ def _load_snapshot():
         return None
 
 
+def _load_snapshot():
+    """The standing north-star entry: best value across models (legacy
+    single-entry files read as-is)."""
+    data = _read_snapshot_file()
+    if not data:
+        return None
+    if "models" in data:
+        entries = [e for e in data["models"].values() if "value" in e]
+        return max(entries, key=lambda e: e["value"]) if entries else None
+    return data
+
+
 def _save_snapshot(line: dict) -> None:
-    """Persist a TPU-measured result in-repo (committed by the build loop)."""
+    """Persist a TPU-measured result in-repo (committed by the build loop).
+
+    PER-MODEL best-wins: a knob-sweep case (e.g. an intentionally-
+    degraded window size) must not overwrite a better headline for the
+    same model, and benching a different model never clobbers another
+    model's evidence. Ties refresh provenance (captured_at/git_commit);
+    BENCH_SNAPSHOT_FORCE=1 records unconditionally — the operator's
+    escape for acknowledging a genuine regression. A skip is reported on
+    stderr, never silent. (Regression VISIBILITY lives in the per-round
+    BENCH_r*.json driver records; the snapshot is best-evidence.)"""
+    data = _read_snapshot_file() or {}
+    if "models" in data:
+        models = data["models"]
+    elif "value" in data:  # migrate a legacy single-entry file
+        models = {data.get("model", "unknown"): data}
+    else:
+        models = {}
+    prev = models.get(line.get("model"))
+    if (prev and prev.get("value", 0) > line.get("value", 0)
+            and not os.environ.get("BENCH_SNAPSHOT_FORCE")):
+        print(f"snapshot keep: standing {prev.get('value')} tok/s beats "
+              f"this run's {line.get('value')} for {line.get('model')} "
+              "(BENCH_SNAPSHOT_FORCE=1 overrides)", file=sys.stderr)
+        return
     snap = dict(line)
     snap["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     try:
@@ -380,9 +415,10 @@ def _save_snapshot(line: dict) -> None:
         ).stdout.strip() or None
     except Exception:
         snap["git_commit"] = None
+    models[line.get("model", "unknown")] = snap
     try:
         with open(SNAPSHOT_PATH, "w") as f:
-            json.dump(snap, f, indent=1)
+            json.dump({"models": models}, f, indent=1)
             f.write("\n")
     except Exception as e:  # snapshotting must never break the bench output
         print(f"snapshot save failed: {e}", file=sys.stderr)

@@ -28,3 +28,45 @@ def test_snapshot_roundtrip(tmp_path, monkeypatch):
 def test_load_snapshot_missing_is_none(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "SNAPSHOT_PATH", str(tmp_path / "absent.json"))
     assert bench._load_snapshot() is None
+
+
+def test_snapshot_per_model_best_wins(tmp_path, monkeypatch):
+    """A knob-sweep case measuring WORSE than the standing snapshot must
+    not overwrite it; a better run replaces it; a DIFFERENT model's
+    measurement lands without clobbering the headline's evidence; ties
+    refresh provenance; BENCH_SNAPSHOT_FORCE records unconditionally."""
+    monkeypatch.setattr(bench, "SNAPSHOT_PATH",
+                        str(tmp_path / "snap.json"))
+    monkeypatch.delenv("BENCH_SNAPSHOT_FORCE", raising=False)
+    bench._save_snapshot({"value": 3000.0, "backend": "tpu", "model": "m"})
+    bench._save_snapshot({"value": 1800.0, "backend": "tpu", "model": "m"})
+    assert bench._load_snapshot()["value"] == 3000.0
+    bench._save_snapshot({"value": 3200.0, "backend": "tpu", "model": "m"})
+    assert bench._load_snapshot()["value"] == 3200.0
+    # another model records under its own key; the best entry stays m's
+    bench._save_snapshot({"value": 10.0, "backend": "tpu", "model": "m2"})
+    data = bench._read_snapshot_file()
+    assert data["models"]["m2"]["value"] == 10.0
+    assert bench._load_snapshot()["value"] == 3200.0
+    # equal value refreshes provenance (captured_at restamped)
+    bench._save_snapshot({"value": 3200.0, "backend": "tpu", "model": "m"})
+    assert "captured_at" in bench._read_snapshot_file()["models"]["m"]
+    # forced regression acknowledgement
+    monkeypatch.setenv("BENCH_SNAPSHOT_FORCE", "1")
+    bench._save_snapshot({"value": 1500.0, "backend": "tpu", "model": "m"})
+    assert bench._read_snapshot_file()["models"]["m"]["value"] == 1500.0
+
+
+def test_snapshot_migrates_legacy_single_entry(tmp_path, monkeypatch):
+    import json
+
+    monkeypatch.setattr(bench, "SNAPSHOT_PATH",
+                        str(tmp_path / "snap.json"))
+    monkeypatch.delenv("BENCH_SNAPSHOT_FORCE", raising=False)
+    (tmp_path / "snap.json").write_text(json.dumps(
+        {"value": 3000.0, "backend": "tpu", "model": "m"}))
+    assert bench._load_snapshot()["value"] == 3000.0  # legacy read
+    bench._save_snapshot({"value": 50.0, "backend": "tpu", "model": "m2"})
+    data = bench._read_snapshot_file()
+    assert data["models"]["m"]["value"] == 3000.0  # migrated, preserved
+    assert data["models"]["m2"]["value"] == 50.0

@@ -41,6 +41,11 @@ class FrontendContext:
             "dynamo_frontend_workers", "Registered live workers",
             self.metrics.registry,
         )
+        self.ledger_gauge = Gauge(
+            "dynamo_frontend_kv_overlap_routed",
+            "Requests routed by the KV-overlap prefix ledger",
+            self.metrics.registry,
+        )
         self.start_time = time.time()
         # NATS request plane (the reference's frontend<->worker transport,
         # /root/reference/install-dynamo-1node.sh:241-242); HTTP remains the
@@ -63,6 +68,7 @@ class _FrontendHandler(JsonHTTPHandler):
             self._json(200, proto.models_response(ctx.router.models()))
         elif path == "/metrics":
             ctx.worker_gauge.set(len(ctx.router.alive(("agg", "prefill", "decode"))))
+            ctx.ledger_gauge.set(ctx.router.ledger_hits)
             self._raw(200, ctx.metrics.registry.expose().encode(),
                       "text/plain; version=0.0.4")
         elif path in ("/health", "/live", "/ready"):

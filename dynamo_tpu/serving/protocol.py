@@ -388,6 +388,9 @@ def parse_completion_request(body: Dict[str, Any]) -> Dict[str, Any]:
         "prompt": prompt,
         "max_tokens": mt,
         "logprobs": lp,
+        # vLLM's OpenAI server accepts response_format on completions
+        # too; same device-side grammar as chat
+        "guided_json": _parse_response_format(body),
         **_common_sampling(body),
     }
 

@@ -213,3 +213,15 @@ def test_chat_endpoint_response_format(monkeypatch):
             {**base, "response_format": {"type": "json_schema"}})
     with pytest.raises(proto.BadRequest):
         proto.parse_chat_request({**base, "response_format": "json_object"})
+
+
+def test_completions_endpoint_response_format():
+    """response_format works on legacy completions too (vLLM-compatible)."""
+    from dynamo_tpu.serving import protocol as proto
+
+    p = proto.parse_completion_request(
+        {"model": "m", "prompt": "x",
+         "response_format": {"type": "json_object"}})
+    assert p["guided_json"] is True
+    assert proto.parse_completion_request(
+        {"model": "m", "prompt": "x"})["guided_json"] is False

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 from dynamo_tpu.operator import materialize as mat
@@ -52,10 +53,20 @@ class Controller:
         self.namespace = namespace
         self.gang = gang
         self.gang_scheduler = gang_scheduler
+        # live-metrics planner (the Dynamo planner analogue): per-service
+        # replica decisions + scale-down hysteresis bookkeeping, keyed
+        # (namespace, dgd, service). Flows into materialize() as
+        # replica_overrides so reconciles never revert a scale.
+        self._planner: Dict[tuple, Dict[str, Any]] = {}
 
     @staticmethod
     def _ns(cr: Dict[str, Any]) -> str:
         return cr["metadata"].get("namespace") or "default"
+
+    def _planner_overrides(self, ns: str, name: str) -> Dict[str, int]:
+        return {svc: st["replicas"] for (n, d, svc), st
+                in self._planner.items()
+                if n == ns and d == name and st.get("replicas")}
 
     # ------------------------------------------------------------- children --
     def _owned(self, api_version: str, plural: str, ns: str,
@@ -68,7 +79,9 @@ class Controller:
         ns = self._ns(cr)
         ns_label = mat.discovery_label_value(ns, name)
         desired = mat.materialize(cr, gang=self.gang,
-                                  gang_scheduler=self.gang_scheduler)
+                                  gang_scheduler=self.gang_scheduler,
+                                  replica_overrides=self._planner_overrides(
+                                      ns, name))
 
         # PodGroups first: the gang scheduler must see the group before the
         # Deployment's pods arrive, or they schedule ungated. A cluster with
@@ -152,10 +165,15 @@ class Controller:
             total += int(dep.get("spec", {}).get("replicas", 1))
             ready += int(dep.get("status", {}).get("readyReplicas") or 0)
         state = "successful" if total > 0 and ready >= total else "pending"
+        planner = self._planner_overrides(
+            ns, cr["metadata"]["name"])
         status = {
             "state": state,
             "readyReplicas": ready,
             "desiredReplicas": total,
+            # persisted planner decisions: a restarted/failover operator
+            # seeds its in-memory planner from here (planner_tick)
+            **({"plannerReplicas": planner} if planner else {}),
             "conditions": [
                 {
                     "type": "Ready",
@@ -312,6 +330,104 @@ class Controller:
         _set_dgdr_status(self.k8s, ns, name, "profiling",
                          f"profiler pod running ({image})")
 
+    # -------------------------------------------------------------- planner --
+    def planner_tick(self, now: Optional[float] = None) -> int:
+        """Live-metrics autoscaling pass (the Dynamo planner analogue,
+        beyond the reference repo's static DGDR sizing): for every DGD
+        service with an `autoscaling` block, read the graph frontend's
+        queued-requests gauge and resize toward
+        ceil(queued / targetQueuedPerReplica), clamped to
+        [minReplicas, maxReplicas]. Scale-UP applies immediately;
+        scale-DOWN waits out scaleDownDelaySeconds of sustained low load
+        (flapping costs real TPU warmup time). Returns the number of
+        services whose decision changed; reconcile applies the decisions
+        via materialize(replica_overrides=...)."""
+        now = time.monotonic() if now is None else now
+        changed = 0
+        try:
+            dgds = self.k8s.list(mat.API_VERSION, mat.DGD_PLURAL,
+                                 self.namespace)
+        except ApiError:
+            return 0
+        live = set()
+        scrapes: Dict[str, Optional[float]] = {}
+        for cr in dgds:
+            ns, name = self._ns(cr), cr["metadata"]["name"]
+            services = cr.get("spec", {}).get("services") or {}
+            for svc_name, spec in services.items():
+                auto = spec.get("autoscaling") or {}
+                if not auto.get("enabled"):
+                    continue
+                live.add((ns, name, svc_name))
+                lo = max(1, int(auto.get("minReplicas", 1)))
+                hi = max(lo, int(auto.get("maxReplicas",
+                                          spec.get("replicas", 1))))
+                target = max(1, int(auto.get("targetQueuedPerReplica", 4)))
+                delay = float(auto.get("scaleDownDelaySeconds", 120))
+                key = (ns, name, svc_name)
+                st = self._planner.get(key)
+                if st is None:
+                    # seed from the DGD status (written by the reconcile's
+                    # rollup) so an operator restart or leader failover
+                    # resumes the standing scale instead of snapping back
+                    # to the CR baseline mid-load
+                    persisted = ((cr.get("status") or {})
+                                 .get("plannerReplicas") or {}).get(svc_name)
+                    st = self._planner[key] = {
+                        "replicas": int(persisted
+                                        or spec.get("replicas", 1)),
+                        "low_since": None}
+                url = auto.get("metricsUrl") or (
+                    f"http://{mat.frontend_host(cr)}.{ns}:"
+                    f"{mat.FRONTEND_PORT}/metrics")
+                if url not in scrapes:  # one scrape per URL per tick
+                    scrapes[url] = self._scrape_queued(url)
+                queued = scrapes[url]
+                if queued is None:
+                    continue  # unreachable metrics: hold the last decision
+                st["replicas"] = max(lo, min(hi, st["replicas"]))
+                want = max(lo, min(hi, -(-int(queued) // target)))
+                if want > st["replicas"]:
+                    log.info("planner: %s/%s.%s %d -> %d (queued=%d)",
+                             ns, name, svc_name, st["replicas"], want,
+                             queued)
+                    st["replicas"] = want
+                    st["low_since"] = None
+                    changed += 1
+                elif want < st["replicas"]:
+                    if st["low_since"] is None:
+                        st["low_since"] = now
+                    elif now - st["low_since"] >= delay:
+                        log.info("planner: %s/%s.%s %d -> %d after %.0fs "
+                                 "low load", ns, name, svc_name,
+                                 st["replicas"], want, now - st["low_since"])
+                        st["replicas"] = want
+                        st["low_since"] = None
+                        changed += 1
+                else:
+                    st["low_since"] = None
+        for key in [k for k in self._planner if k not in live]:
+            del self._planner[key]  # DGD/service removed or autoscaling off
+        return changed
+
+    @staticmethod
+    def _scrape_queued(url: str) -> Optional[float]:
+        """dynamo_frontend_queued_requests from a Prometheus text page."""
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url, timeout=1.5) as r:
+                text = r.read().decode("utf-8", "replace")
+        except Exception:
+            return None
+        for ln in text.splitlines():
+            if ln.startswith("dynamo_frontend_queued_requests"):
+                try:
+                    return float(ln.split()[-1])
+                except ValueError:
+                    return None
+        return None
+
     # ----------------------------------------------------------------- loop --
     def reconcile_once(self) -> int:
         """One full pass over both CRD kinds; returns number of CRs seen."""
@@ -337,7 +453,8 @@ class Controller:
         return n
 
     def run(self, interval: float = 3.0, stop=None, watch: bool = False,
-            resync_s: float = 30.0, leader=None) -> None:
+            resync_s: float = 30.0, leader=None,
+            planner_interval: float = 15.0) -> None:
         """Reconcile until `stop`.
 
         watch=False: plain poll every `interval` (single-node dev default —
@@ -352,6 +469,7 @@ class Controller:
 
         stop = stop or threading.Event()
         trigger = threading.Event()
+        last_plan = 0.0
         if watch:
             for plural in (mat.DGD_PLURAL, mat.DGDR_PLURAL):
                 threading.Thread(
@@ -368,6 +486,15 @@ class Controller:
             # waiting out a full resync period
             trigger.clear()
             if leader is None or leader.is_leader:
+                now = time.monotonic()
+                if now - last_plan >= planner_interval:
+                    last_plan = now
+                    try:
+                        # BEFORE reconcile so fresh decisions apply in the
+                        # same pass
+                        self.planner_tick(now)
+                    except Exception:
+                        log.exception("planner tick failed")
                 try:
                     self.reconcile_once()
                 except Exception:

@@ -512,14 +512,22 @@ def build_pvcs(cr: Dict[str, Any]) -> List[Dict[str, Any]]:
 def materialize(
     cr: Dict[str, Any], gang: bool = False,
     gang_scheduler: str = DEFAULT_GANG_SCHEDULER,
+    replica_overrides: Optional[Dict[str, int]] = None,
 ) -> Dict[str, List[Dict[str, Any]]]:
-    """CR -> {deployments, statefulsets, services, pvcs, podgroups}."""
+    """CR -> {deployments, statefulsets, services, pvcs, podgroups}.
+
+    `replica_overrides` ({service_name: replicas}) is the autoscaler's
+    channel: the controller passes its current per-service decision so a
+    reconcile pass never reverts a scale the planner made (the CR's own
+    `replicas` stays the operator-independent baseline)."""
     services = cr.get("spec", {}).get("services") or {}
     deployments = []
     statefulsets = []
     svcs = []
     podgroups = []
     for svc_name, spec in services.items():
+        if replica_overrides and svc_name in replica_overrides:
+            spec = {**spec, "replicas": int(replica_overrides[svc_name])}
         if hosts_per_replica(spec) > 1:
             # multi-host slice: StatefulSet gang + headless coordinator svc
             statefulsets.append(

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import socket
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -41,11 +42,18 @@ class FrontendContext:
             "dynamo_frontend_workers", "Registered live workers",
             self.metrics.registry,
         )
-        self.ledger_gauge = Gauge(
-            "dynamo_frontend_kv_overlap_routed",
+        from dynamo_tpu.serving.metrics import Counter
+
+        self.ledger_counter = Counter(
+            "dynamo_frontend_kv_overlap_routed_total",
             "Requests routed by the KV-overlap prefix ledger",
             self.metrics.registry,
         )
+        self._ledger_seen = 0
+        # in-flight request tracking feeds the queued-requests gauge the
+        # operator's planner scrapes for autoscaling
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self.start_time = time.time()
         # NATS request plane (the reference's frontend<->worker transport,
         # /root/reference/install-dynamo-1node.sh:241-242); HTTP remains the
@@ -68,7 +76,12 @@ class _FrontendHandler(JsonHTTPHandler):
             self._json(200, proto.models_response(ctx.router.models()))
         elif path == "/metrics":
             ctx.worker_gauge.set(len(ctx.router.alive(("agg", "prefill", "decode"))))
-            ctx.ledger_gauge.set(ctx.router.ledger_hits)
+            with ctx._inflight_lock:
+                ctx.metrics.queued.set(ctx._inflight)
+            hits = ctx.router.ledger_hits
+            if hits > ctx._ledger_seen:  # counter semantics: inc by delta
+                ctx.ledger_counter.inc(hits - ctx._ledger_seen)
+                ctx._ledger_seen = hits
             self._raw(200, ctx.metrics.registry.expose().encode(),
                       "text/plain; version=0.0.4")
         elif path in ("/health", "/live", "/ready"):
@@ -157,6 +170,19 @@ class _FrontendHandler(JsonHTTPHandler):
 
     # ----------------------------------------------------------------- proxy
     def _proxy(self, path: str):
+        # in-flight accounting spans the WHOLE proxied exchange (SSE
+        # passthrough included) — it is the queued-requests signal the
+        # operator's planner autoscales on
+        ctx = self.ctx
+        with ctx._inflight_lock:
+            ctx._inflight += 1
+        try:
+            self._proxy_inner(path)
+        finally:
+            with ctx._inflight_lock:
+                ctx._inflight -= 1
+
+    def _proxy_inner(self, path: str):
         ctx = self.ctx
         raw = self._read_raw_body()
         try:

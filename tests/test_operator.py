@@ -817,3 +817,140 @@ def test_profiler_job_failure_marks_dgdr_failed():
         ctrl.reconcile_once()  # re-dispatches
         assert fake.get_object("batch/v1", "dynamo", "jobs",
                                "prof-retry-profiler") is not None
+
+
+# --------------------------------------------------------------- planner --
+class _FakeMetrics:
+    """Tiny HTTP server exposing a settable queued-requests gauge."""
+
+    def __init__(self):
+        import http.server
+        import threading
+
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = ("dynamo_frontend_queued_requests "
+                        f"{outer.queued}\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.queued = 0.0
+        self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}/metrics"
+
+    def close(self):
+        self.srv.shutdown()
+
+
+def _autoscaled_dgd(metrics_url: str):
+    import copy
+
+    cr = copy.deepcopy(DGD)
+    cr["metadata"]["name"] = "scale-demo"
+    cr["spec"]["services"]["JetstreamDecodeWorker"]["autoscaling"] = {
+        "enabled": True,
+        "minReplicas": 1,
+        "maxReplicas": 4,
+        "targetQueuedPerReplica": 4,
+        "scaleDownDelaySeconds": 60,
+        "metricsUrl": metrics_url,
+    }
+    cr["spec"]["services"]["JetstreamDecodeWorker"]["replicas"] = 1
+    return cr
+
+
+def test_planner_scales_worker_replicas_from_live_metrics():
+    """The Dynamo-planner analogue: queued-requests pressure scales the
+    worker deployment up immediately; scale-down waits out the hysteresis
+    window; reconcile passes never revert the planner's decision."""
+    metrics = _FakeMetrics()
+    try:
+        with FakeK8s() as fake:
+            client = K8sClient(fake.url)
+            ctrl = Controller(client, namespace=None)
+            client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                          _autoscaled_dgd(metrics.url))
+
+            def worker_replicas():
+                dep = client.get("apps/v1", "deployments", "dynamo",
+                                 "scale-demo-jetstreamdecodeworker")
+                return dep["spec"]["replicas"]
+
+            ctrl.reconcile_once()
+            assert worker_replicas() == 1
+
+            # pressure: 14 queued / target 4 -> 4 (capped at max)
+            metrics.queued = 14
+            assert ctrl.planner_tick(now=1000.0) == 1
+            ctrl.reconcile_once()
+            assert worker_replicas() == 4
+
+            # load drops: no immediate scale-down (hysteresis)...
+            metrics.queued = 0
+            assert ctrl.planner_tick(now=1010.0) == 0
+            ctrl.reconcile_once()
+            assert worker_replicas() == 4
+            # ...until the delay elapses
+            assert ctrl.planner_tick(now=1075.0) == 1
+            ctrl.reconcile_once()
+            assert worker_replicas() == 1
+
+            # unreachable metrics: decision holds, no crash
+            metrics.close()
+            assert ctrl.planner_tick(now=1100.0) == 0
+            ctrl.reconcile_once()
+            assert worker_replicas() == 1
+    finally:
+        try:
+            metrics.close()
+        except Exception:
+            pass
+
+
+def test_planner_ignores_services_without_autoscaling():
+    with FakeK8s() as fake:
+        client = K8sClient(fake.url)
+        ctrl = Controller(client, namespace=None)
+        client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo", DGD)
+        assert ctrl.planner_tick(now=1.0) == 0
+        ctrl.reconcile_once()
+        dep = client.get("apps/v1", "deployments", "dynamo",
+                         "agg-demo-jetstreamdecodeworker")
+        assert dep["spec"]["replicas"] == 2  # CR value untouched
+
+
+def test_planner_survives_operator_restart():
+    """A fresh Controller (restart / leader failover) seeds its planner
+    from the DGD status rollup, so the standing scale is not reverted."""
+    metrics = _FakeMetrics()
+    try:
+        with FakeK8s() as fake:
+            client = K8sClient(fake.url)
+            ctrl = Controller(client, namespace=None)
+            client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                          _autoscaled_dgd(metrics.url))
+            metrics.queued = 14
+            ctrl.planner_tick(now=100.0)
+            ctrl.reconcile_once()  # applies 4 AND persists to status
+
+            fresh = Controller(client, namespace=None)  # "restarted"
+            metrics.srv.shutdown()  # metrics briefly unreachable too
+            fresh.planner_tick(now=200.0)
+            fresh.reconcile_once()
+            dep = client.get("apps/v1", "deployments", "dynamo",
+                             "scale-demo-jetstreamdecodeworker")
+            assert dep["spec"]["replicas"] == 4, (
+                "restart reverted the planner's standing scale")
+    finally:
+        try:
+            metrics.close()
+        except Exception:
+            pass

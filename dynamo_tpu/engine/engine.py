@@ -1293,7 +1293,9 @@ class Engine:
         JSON completion the grammar mask only allows model eos ids, so
         dropping them would burn a completed object to finish 'length'."""
         if req.ignore_eos:
-            return []
+            # ignore_eos exempts MODEL eos only (vLLM semantics):
+            # explicit user stop ids keep stopping
+            return list(req.stop_token_ids or [])
         ids = list(req.stop_token_ids
                    or [self.model_cfg.eos_token_id,
                        *self.model_cfg.extra_stop_token_ids])

@@ -172,8 +172,11 @@ class Controller:
             "readyReplicas": ready,
             "desiredReplicas": total,
             # persisted planner decisions: a restarted/failover operator
-            # seeds its in-memory planner from here (planner_tick)
-            **({"plannerReplicas": planner} if planner else {}),
+            # seeds its in-memory planner from here (planner_tick).
+            # Explicit null when empty — patch_status is an RFC 7386
+            # merge-patch, so OMITTING the key would retain a stale map
+            # (and resurrect an old scale when autoscaling is re-enabled)
+            "plannerReplicas": planner or None,
             "conditions": [
                 {
                     "type": "Ready",
@@ -350,7 +353,11 @@ class Controller:
         except ApiError:
             return 0
         live = set()
-        scrapes: Dict[str, Optional[float]] = {}
+        # gather first, then scrape every unique URL CONCURRENTLY: the
+        # tick runs on the reconcile thread, and N serially-unreachable
+        # frontends (exactly the state during an initial rollout) must
+        # not stall reconciles by N x timeout
+        work = []
         for cr in dgds:
             ns, name = self._ns(cr), cr["metadata"]["name"]
             services = cr.get("spec", {}).get("services") or {}
@@ -359,53 +366,62 @@ class Controller:
                 if not auto.get("enabled"):
                     continue
                 live.add((ns, name, svc_name))
-                lo = max(1, int(auto.get("minReplicas", 1)))
-                hi = max(lo, int(auto.get("maxReplicas",
-                                          spec.get("replicas", 1))))
-                target = max(1, int(auto.get("targetQueuedPerReplica", 4)))
-                delay = float(auto.get("scaleDownDelaySeconds", 120))
-                key = (ns, name, svc_name)
-                st = self._planner.get(key)
-                if st is None:
-                    # seed from the DGD status (written by the reconcile's
-                    # rollup) so an operator restart or leader failover
-                    # resumes the standing scale instead of snapping back
-                    # to the CR baseline mid-load
-                    persisted = ((cr.get("status") or {})
-                                 .get("plannerReplicas") or {}).get(svc_name)
-                    st = self._planner[key] = {
-                        "replicas": int(persisted
-                                        or spec.get("replicas", 1)),
-                        "low_since": None}
-                url = auto.get("metricsUrl") or (
-                    f"http://{mat.frontend_host(cr)}.{ns}:"
-                    f"{mat.FRONTEND_PORT}/metrics")
-                if url not in scrapes:  # one scrape per URL per tick
-                    scrapes[url] = self._scrape_queued(url)
-                queued = scrapes[url]
-                if queued is None:
-                    continue  # unreachable metrics: hold the last decision
-                st["replicas"] = max(lo, min(hi, st["replicas"]))
-                want = max(lo, min(hi, -(-int(queued) // target)))
-                if want > st["replicas"]:
-                    log.info("planner: %s/%s.%s %d -> %d (queued=%d)",
-                             ns, name, svc_name, st["replicas"], want,
-                             queued)
+                work.append((cr, ns, name, svc_name, spec, auto))
+        urls = {}
+        for cr, ns, name, svc_name, spec, auto in work:
+            urls[(ns, name, svc_name)] = auto.get("metricsUrl") or (
+                f"http://{mat.frontend_host(cr)}.{ns}:"
+                f"{mat.FRONTEND_PORT}/metrics")
+        scrapes: Dict[str, Optional[float]] = {}
+        unique = sorted(set(urls.values()))
+        if unique:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(unique))) as ex:
+                for url, val in zip(unique,
+                                    ex.map(self._scrape_queued, unique)):
+                    scrapes[url] = val
+        for cr, ns, name, svc_name, spec, auto in work:
+            lo = max(1, int(auto.get("minReplicas", 1)))
+            hi = max(lo, int(auto.get("maxReplicas",
+                                      spec.get("replicas", 1))))
+            target = max(1, int(auto.get("targetQueuedPerReplica", 4)))
+            delay = float(auto.get("scaleDownDelaySeconds", 120))
+            key = (ns, name, svc_name)
+            st = self._planner.get(key)
+            if st is None:
+                # seed from the DGD status (written by the reconcile's
+                # rollup) so an operator restart or leader failover
+                # resumes the standing scale instead of snapping back to
+                # the CR baseline mid-load
+                persisted = ((cr.get("status") or {})
+                             .get("plannerReplicas") or {}).get(svc_name)
+                st = self._planner[key] = {
+                    "replicas": int(persisted or spec.get("replicas", 1)),
+                    "low_since": None}
+            queued = scrapes.get(urls[key])
+            if queued is None:
+                continue  # unreachable metrics: hold the last decision
+            st["replicas"] = max(lo, min(hi, st["replicas"]))
+            want = max(lo, min(hi, -(-int(queued) // target)))
+            if want > st["replicas"]:
+                log.info("planner: %s/%s.%s %d -> %d (queued=%d)",
+                         ns, name, svc_name, st["replicas"], want, queued)
+                st["replicas"] = want
+                st["low_since"] = None
+                changed += 1
+            elif want < st["replicas"]:
+                if st["low_since"] is None:
+                    st["low_since"] = now
+                elif now - st["low_since"] >= delay:
+                    log.info("planner: %s/%s.%s %d -> %d after %.0fs "
+                             "low load", ns, name, svc_name,
+                             st["replicas"], want, now - st["low_since"])
                     st["replicas"] = want
                     st["low_since"] = None
                     changed += 1
-                elif want < st["replicas"]:
-                    if st["low_since"] is None:
-                        st["low_since"] = now
-                    elif now - st["low_since"] >= delay:
-                        log.info("planner: %s/%s.%s %d -> %d after %.0fs "
-                                 "low load", ns, name, svc_name,
-                                 st["replicas"], want, now - st["low_since"])
-                        st["replicas"] = want
-                        st["low_since"] = None
-                        changed += 1
-                else:
-                    st["low_since"] = None
+            else:
+                st["low_since"] = None
         for key in [k for k in self._planner if k not in live]:
             del self._planner[key]  # DGD/service removed or autoscaling off
         return changed

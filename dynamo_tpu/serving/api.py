@@ -109,6 +109,14 @@ class GenerationHandle:
         self.want_logprobs = params.get("logprobs") is not None
         # each choice of an n>1 request gets its own deterministic chain
         seed = params.get("seed")
+        stop_ids = list(params.get("stop_token_ids") or [])
+        if stop_ids:
+            # vLLM semantics: stop_token_ids are ADDITIONAL — model EOS
+            # keeps stopping (the engine treats a non-empty list as the
+            # full set, so append the model's ids here)
+            mc = ctx.engine.model_cfg
+            stop_ids = list(dict.fromkeys(
+                [*stop_ids, mc.eos_token_id, *mc.extra_stop_token_ids]))
         self.req = GenRequest(
             rid,
             list(prompt_ids),
@@ -125,6 +133,7 @@ class GenerationHandle:
             ignore_eos=params.get("ignore_eos", False),
             priority=params.get("priority", 0),
             guided_json=params.get("guided_json", False),
+            stop_token_ids=stop_ids,
         )
         if ctx.disagg_client is not None:
             # decode role: prefill remotely, pull KV, continue locally

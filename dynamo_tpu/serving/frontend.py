@@ -49,7 +49,7 @@ class FrontendContext:
             "Requests routed by the KV-overlap prefix ledger",
             self.metrics.registry,
         )
-        self._ledger_seen = 0
+        self.router.ledger_counter = self.ledger_counter
         # in-flight request tracking feeds the queued-requests gauge the
         # operator's planner scrapes for autoscaling
         self._inflight = 0
@@ -78,10 +78,6 @@ class _FrontendHandler(JsonHTTPHandler):
             ctx.worker_gauge.set(len(ctx.router.alive(("agg", "prefill", "decode"))))
             with ctx._inflight_lock:
                 ctx.metrics.queued.set(ctx._inflight)
-            hits = ctx.router.ledger_hits
-            if hits > ctx._ledger_seen:  # counter semantics: inc by delta
-                ctx.ledger_counter.inc(hits - ctx._ledger_seen)
-                ctx._ledger_seen = hits
             self._raw(200, ctx.metrics.registry.expose().encode(),
                       "text/plain; version=0.0.4")
         elif path in ("/health", "/live", "/ready"):

@@ -59,10 +59,25 @@ def _common_sampling(body: Dict[str, Any]) -> Dict[str, Any]:
         # admission-priority extension (vLLM semantics: lower = sooner)
         "priority": priority,
         "stop": _parse_stop(body),
+        "stop_token_ids": _parse_stop_token_ids(body),
         "stream": bool(body.get("stream", False)),
         "include_usage": _include_usage(body),
         "ignore_eos": bool(body.get("ignore_eos", False)),
     }
+
+
+def _parse_stop_token_ids(body: Dict[str, Any]) -> List[int]:
+    """vLLM extension: stop on exact token ids (no detokenize round trip);
+    model EOS ids still stop generation as usual."""
+    ids = body.get("stop_token_ids")
+    if ids is None:
+        return []
+    if (not isinstance(ids, list) or len(ids) > 16
+            or not all(isinstance(i, int) and not isinstance(i, bool)
+                       and i >= 0 for i in ids)):
+        raise BadRequest(
+            "'stop_token_ids' must be up to 16 non-negative integers")
+    return ids
 
 
 def _parse_logit_bias(body: Dict[str, Any]):

@@ -149,6 +149,10 @@ class Router:
         self._lock = threading.Lock()
         self._ledger = PrefixLedger()
         self.ledger_hits = 0  # observability: KV-overlap routed requests
+        # optional metrics Counter, inc'd at the routing decision itself
+        # (under the router lock — scrape-time delta math would race
+        # concurrent /metrics requests)
+        self.ledger_counter = None
 
     # ---------------------------------------------------------- membership --
     def register(self, url: str, model: str, mode: str = "agg",
@@ -233,6 +237,8 @@ class Router:
                     and live[url].headroom >= 0.05):
                 with self._lock:
                     self.ledger_hits += 1
+                    if self.ledger_counter is not None:
+                        self.ledger_counter.inc()
                     self._ledger.record(model, chain, url)
                 return live[url]
         picked = _pick_native(affinity_key, cands)

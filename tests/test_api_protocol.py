@@ -220,3 +220,21 @@ def test_stop_matcher_holdback_flush():
     out2, s2 = m.push("w")  # XYw is not a stop; safe to release up to holdback
     assert not s2
     assert out1 + out2 + m.flush() == "abcXYw"
+
+
+def test_stop_token_ids_parse_and_validate():
+    """vLLM extension: stop_token_ids on both endpoints."""
+    import pytest
+
+    from dynamo_tpu.serving import protocol as proto
+
+    base = {"model": "m", "messages": [{"role": "user", "content": "x"}]}
+    p = proto.parse_chat_request({**base, "stop_token_ids": [7, 9]})
+    assert p["stop_token_ids"] == [7, 9]
+    assert proto.parse_chat_request(base)["stop_token_ids"] == []
+    p = proto.parse_completion_request(
+        {"model": "m", "prompt": "x", "stop_token_ids": [3]})
+    assert p["stop_token_ids"] == [3]
+    for bad in ("x", [True], [-1], list(range(20))):
+        with pytest.raises(proto.BadRequest):
+            proto.parse_chat_request({**base, "stop_token_ids": bad})

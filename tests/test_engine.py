@@ -219,3 +219,21 @@ def test_priority_requeue_preserves_sorted_queue():
         for r in reversed(popped):
             eng._insert_pending(r, requeue=True)
     assert [r.request_id for r in eng.pending] == ["urgent", "a", "b"]
+
+
+def test_ignore_eos_keeps_user_stop_token_ids():
+    """vLLM semantics: ignore_eos exempts MODEL eos only — explicit
+    stop_token_ids still stop generation."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4,
+                              num_pages=64, max_num_seqs=2, max_seq_len=64))
+    ref = eng.generate(GenRequest("a", [3, 1, 4], max_tokens=12,
+                                  temperature=0.0, ignore_eos=True))
+    stop_on = ref[3]
+    out = eng.generate(GenRequest("b", [3, 1, 4], max_tokens=12,
+                                  temperature=0.0, ignore_eos=True,
+                                  stop_token_ids=[stop_on]))
+    assert out == ref[:4], (out, ref)

@@ -954,3 +954,41 @@ def test_planner_survives_operator_restart():
             metrics.close()
         except Exception:
             pass
+
+
+def test_planner_status_clears_when_autoscaling_disabled():
+    """Disabling autoscaling must null plannerReplicas in status (a
+    merge-patch would otherwise retain the stale map and resurrect the
+    old scale on re-enable)."""
+    import copy
+
+    metrics = _FakeMetrics()
+    try:
+        with FakeK8s() as fake:
+            client = K8sClient(fake.url)
+            ctrl = Controller(client, namespace=None)
+            cr = _autoscaled_dgd(metrics.url)
+            client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo", cr)
+            metrics.queued = 14
+            ctrl.planner_tick(now=100.0)
+            ctrl.reconcile_once()
+            got = client.get(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                             "scale-demo")
+            assert got["status"]["plannerReplicas"] == {
+                "JetstreamDecodeWorker": 4}
+
+            off = copy.deepcopy(cr)
+            # upsert merge-patches: removal needs an explicit null
+            off["spec"]["services"]["JetstreamDecodeWorker"][
+                "autoscaling"] = None
+            client.upsert(mat.API_VERSION, mat.DGD_PLURAL, "dynamo", off)
+            ctrl.planner_tick(now=110.0)  # drops the in-memory key
+            ctrl.reconcile_once()
+            got = client.get(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                             "scale-demo")
+            assert not got["status"].get("plannerReplicas"), got["status"]
+    finally:
+        try:
+            metrics.close()
+        except Exception:
+            pass

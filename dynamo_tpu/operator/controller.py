@@ -358,6 +358,7 @@ class Controller:
         # frontends (exactly the state during an initial rollout) must
         # not stall reconciles by N x timeout
         work = []
+        urls: Dict[tuple, str] = {}
         for cr in dgds:
             ns, name = self._ns(cr), cr["metadata"]["name"]
             services = cr.get("spec", {}).get("services") or {}
@@ -367,11 +368,9 @@ class Controller:
                     continue
                 live.add((ns, name, svc_name))
                 work.append((cr, ns, name, svc_name, spec, auto))
-        urls = {}
-        for cr, ns, name, svc_name, spec, auto in work:
-            urls[(ns, name, svc_name)] = auto.get("metricsUrl") or (
-                f"http://{mat.frontend_host(cr)}.{ns}:"
-                f"{mat.FRONTEND_PORT}/metrics")
+                urls[(ns, name, svc_name)] = auto.get("metricsUrl") or (
+                    f"http://{mat.frontend_host(cr)}.{ns}:"
+                    f"{mat.FRONTEND_PORT}/metrics")
         scrapes: Dict[str, Optional[float]] = {}
         unique = sorted(set(urls.values()))
         if unique:

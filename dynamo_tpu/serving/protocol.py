@@ -176,7 +176,9 @@ def parse_chat_request(body: Dict[str, Any]) -> Dict[str, Any]:
 
 def _parse_tools(body: Dict[str, Any]):
     """OpenAI `tools` + `tool_choice`. Returns (tools, tool_choice) where
-    tool_choice is "none", "auto", or the forced function NAME.
+    tool_choice is "none", "auto", or the tagged tuple
+    ("function", name) for a forced function (tagged so a tool literally
+    named "auto"/"none" can still be forced).
 
     A forced function rides the JSON-guided decoder: the completion is
     constrained to one JSON object, returned as the call's arguments.

@@ -84,8 +84,14 @@ def prefix_key(text: str, prefix_chars: int = 256) -> str:
     return text[:prefix_chars]
 
 
-def text_block_chain(text: str, block_chars: int = 64,
-                     max_blocks: int = 64) -> List[str]:
+# ledger text-block geometry: 64-char blocks, 64-block hash window.
+# pick()'s relative-overlap denominator derives from the same constants.
+BLOCK_CHARS = 64
+MAX_BLOCKS = 64
+
+
+def text_block_chain(text: str, block_chars: int = BLOCK_CHARS,
+                     max_blocks: int = MAX_BLOCKS) -> List[str]:
     """Rolling hash chain over fixed-size TEXT blocks of the prompt — the
     frontend-side analogue of the engine's page-block hash chain
     (engine/kv_cache.py PrefixCache). The frontend is tokenizer-free, so
@@ -231,7 +237,7 @@ class Router:
             # overlap; only a request whose entire hashed window is known
             # history clears the bar there
             denom = max(len(chain),
-                        min(len(prompt_text) // 64, 64))
+                        min(len(prompt_text) // BLOCK_CHARS, MAX_BLOCKS))
             if (url is not None and depth >= 2
                     and depth * 10 >= 6 * denom
                     and live[url].headroom >= 0.05):

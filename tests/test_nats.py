@@ -228,3 +228,15 @@ def test_client_survives_broker_restart():
     finally:
         nc_sub.close()
         b2.close()
+
+
+def test_response_format_survives_the_nats_plane(serving_stack):
+    """guided_json rides the raw OpenAI body over NATS: the worker-side
+    parse applies the grammar, so the completion starts with '{' even at
+    temperature 1.5."""
+    base, _, _ = serving_stack
+    out = json.load(_chat(base, temperature=1.5, seed=3,
+                          response_format={"type": "json_object"}))
+    text = out["choices"][0]["message"]["content"]
+    assert text.lstrip()[:1] in ("{",) or text == "", text
+    assert text[:1] == "{", text  # grammar forbids leading whitespace

@@ -225,3 +225,41 @@ def test_completions_endpoint_response_format():
     assert p["guided_json"] is True
     assert proto.parse_completion_request(
         {"model": "m", "prompt": "x"})["guided_json"] is False
+
+
+def test_n_choices_each_guided_via_http():
+    """n>1 with response_format: every choice is independently guided
+    (per-choice seed chains), every stop-finished choice parses."""
+    import threading
+    import urllib.request
+
+    from dynamo_tpu.engine.engine import Engine, EngineConfig
+    from dynamo_tpu.serving.api import ServingContext, make_server
+
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4,
+                              num_pages=256, max_num_seqs=4,
+                              max_seq_len=512, num_scheduler_steps=8))
+    ctx = ServingContext(eng, served_model="tiny-debug")
+    srv = make_server(ctx, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        r = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions",
+            json.dumps({"model": "tiny-debug",
+                        "messages": [{"role": "user", "content": "json"}],
+                        "max_tokens": 260, "temperature": 1.5,
+                        "top_p": 1.0, "seed": 4, "n": 2,
+                        "response_format": {"type": "json_object"}}
+                       ).encode(),
+            {"Content-Type": "application/json"}))
+        choices = json.loads(r.read())["choices"]
+        assert len(choices) == 2
+        assert {c["index"] for c in choices} == {0, 1}
+        for c in choices:
+            if c["finish_reason"] == "stop":
+                assert isinstance(json.loads(c["message"]["content"]), dict)
+            else:
+                assert c["message"]["content"].startswith("{")
+    finally:
+        srv.shutdown()

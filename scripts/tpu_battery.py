@@ -295,21 +295,29 @@ def _case_int8_decode_parity() -> dict:
 def _case_sla_roofline() -> dict:
     """Roofline prediction for the SLA case's exact serving point, so the
     committed jsonl carries prediction and measurement side by side
-    (profiler calibration, VERDICT r4 weak #3)."""
+    (profiler calibration, VERDICT r4 weak #3). Emits tp=1 (what the
+    single-chip battery measures) AND tp=2 (what the DGDR profiler
+    recommends for this SLA — tp=1 narrowly misses the TTFT target)."""
     from dynamo_tpu.models.config import ModelConfig
     from dynamo_tpu.profiler import roofline
     from dynamo_tpu.profiler.systems import CHIPS, SystemSpec
 
     cfg = ModelConfig.from_model_name("meta-llama-3-8b-instruct")
-    sys_spec = SystemSpec("v5e-1", CHIPS["v5e"], 1)
-    est = roofline.estimate(cfg, sys_spec, tp=1,
-                            batch=_SLA_ENV["BENCH_BATCH"],
-                            isl=SLA["isl"], osl=SLA["osl"],
-                            quantization="w8a8")
-    return {"predicted_ttft_ms": round(est.ttft_s * 1e3, 2),
-            "predicted_itl_ms": round(est.itl_s * 1e3, 3),
-            "predicted_tok_s_per_chip": round(est.tok_s_per_chip, 1),
-            "feasible": est.feasible, **SLA}
+    out = {**SLA}
+    for tp in (1, 2):
+        sys_spec = SystemSpec(f"v5e-{tp}", CHIPS["v5e"], tp)
+        est = roofline.estimate(cfg, sys_spec, tp=tp,
+                                batch=_SLA_ENV["BENCH_BATCH"],
+                                isl=SLA["isl"], osl=SLA["osl"],
+                                quantization="w8a8")
+        sfx = "" if tp == 1 else f"_tp{tp}"
+        out.update({
+            f"predicted_ttft_ms{sfx}": round(est.ttft_s * 1e3, 2),
+            f"predicted_itl_ms{sfx}": round(est.itl_s * 1e3, 3),
+            f"predicted_tok_s_per_chip{sfx}": round(est.tok_s_per_chip, 1),
+            f"feasible{sfx}": est.feasible,
+        })
+    return out
 
 
 def run_single_case(tag: str) -> None:

@@ -372,6 +372,12 @@ class _Handler(JsonHTTPHandler):
         path = self.path.split("?")[0]
         if path == "/v1/models":
             self._json(200, proto.models_response([self.ctx.served_model]))
+        elif path.startswith("/v1/models/"):
+            mid = path[len("/v1/models/"):]
+            if mid == self.ctx.served_model:
+                self._json(200, proto.model_response(mid))
+            else:
+                self._error(404, f"model {mid!r} not found", "not_found")
         elif path == "/metrics":
             self.ctx.preempt_gauge.set(
                 self.ctx.engine.metrics.num_preempted)

@@ -74,6 +74,12 @@ class _FrontendHandler(JsonHTTPHandler):
         ctx = self.ctx
         if path == "/v1/models":
             self._json(200, proto.models_response(ctx.router.models()))
+        elif path.startswith("/v1/models/"):
+            mid = path[len("/v1/models/"):]
+            if mid in ctx.router.models():
+                self._json(200, proto.model_response(mid))
+            else:
+                self._error(404, f"model {mid!r} not found", "not_found")
         elif path == "/metrics":
             ctx.worker_gauge.set(len(ctx.router.alive(("agg", "prefill", "decode"))))
             with ctx._inflight_lock:

@@ -416,11 +416,14 @@ def models_response(models: List[str]) -> Dict[str, Any]:
     now = int(time.time())
     return {
         "object": "list",
-        "data": [
-            {"id": m, "object": "model", "created": now, "owned_by": "dynamo_tpu"}
-            for m in models
-        ],
+        "data": [model_response(m, now) for m in models],
     }
+
+
+def model_response(model: str, now: Optional[int] = None) -> Dict[str, Any]:
+    """One model card (GET /v1/models/{id}, OpenAI retrieve-model)."""
+    return {"id": model, "object": "model",
+            "created": now or int(time.time()), "owned_by": "dynamo_tpu"}
 
 
 def _token_bytes(token_text: str) -> List[int]:

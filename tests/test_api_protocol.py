@@ -238,3 +238,14 @@ def test_stop_token_ids_parse_and_validate():
     for bad in ("x", [True], [-1], list(range(20))):
         with pytest.raises(proto.BadRequest):
             proto.parse_chat_request({**base, "stop_token_ids": bad})
+
+
+def test_retrieve_model_endpoint_shapes():
+    from dynamo_tpu.serving import protocol as proto
+
+    card = proto.model_response("m1", now=7)
+    assert card == {"id": "m1", "object": "model", "created": 7,
+                    "owned_by": "dynamo_tpu"}
+    listing = proto.models_response(["m1", "m2"])
+    assert [d["id"] for d in listing["data"]] == ["m1", "m2"]
+    assert all(d["object"] == "model" for d in listing["data"])

@@ -263,3 +263,33 @@ def test_n_choices_each_guided_via_http():
                 assert c["message"]["content"].startswith("{")
     finally:
         srv.shutdown()
+
+
+def test_engine_guided_with_async_scheduling_and_churn():
+    """Guided decoding under async scheduling (window k+1 dispatched
+    before window k materializes): a short request finishing mid-stream
+    forces pipeline drains and device-state rebuilds from the host
+    grammar mirrors — the surviving guided stream must stay identical to
+    a solo synchronous run."""
+    from dynamo_tpu.engine.engine import Engine, EngineConfig, GenRequest
+
+    kw = dict(model="tiny-debug", page_size=4, num_pages=256,
+              max_num_seqs=4, max_seq_len=512, num_scheduler_steps=8)
+    solo = Engine(EngineConfig(**kw))
+    ref = _gen_guided(solo, 5, max_tokens=120)
+
+    eng = Engine(EngineConfig(**kw, async_scheduling=True),
+                 params=solo.params)
+    out = {"g5": [], "short": []}
+    eng.add_request(GenRequest("g5", [10, 20, 30], max_tokens=120,
+                               temperature=1.5, top_p=1.0, seed=5,
+                               guided_json=True))
+    eng.add_request(GenRequest("short", [7, 8], max_tokens=6,
+                               temperature=0.0, ignore_eos=True))
+    while eng.has_work:
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                out[ev.request_id].append(ev.token_id)
+    assert len(out["short"]) == 6
+    assert out["g5"] == ref, "guided stream diverged under async churn"
+    _check_guided_output(eng, out["g5"])

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -24,6 +24,7 @@ help:
 	@echo "  release-manifests  pinned install bundle in dist/ (RELEASE_VERSION=)"
 	@echo "  test           fast test tier (skips compile-heavy/slow; CI-grade, <5 min on 1 CPU)"
 	@echo "  test-full      full suite (compile-heavy + slow included)"
+	@echo "  trace-check    one-request /debug/spans smoke check (distributed tracing)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -57,3 +58,9 @@ test:
 
 test-full:
 	python -m pytest tests/ -q -m ""
+
+# Distributed-tracing smoke check: boots the tiny-debug engine server,
+# serves one request, and fails unless /debug/spans exports a well-formed
+# trace for it (docs/observability.md)
+trace-check:
+	JAX_PLATFORMS=cpu python scripts/trace_check.py

@@ -104,6 +104,14 @@ def run_one(cfg: LoadConfig, seed: int) -> RequestResult:
                         res.itl_s.append(now - last_tok)
                     last_tok = now
                     n_deltas += 1
+                elif (choices[0].get("finish_reason") is not None
+                        and last_tok is None):
+                    # a stream can legally finish with NO visible text (the
+                    # detokenizer holds back bytes that never complete a
+                    # codepoint); the finish chunk is then the first — and
+                    # only — token-arrival signal, so TTFT lands there
+                    # instead of reading 0
+                    res.ttft_s = time.perf_counter() - start
         res.latency_s = time.perf_counter() - start
         # exact server-side count when stream usage is on; delta count otherwise
         # (deltas may under-count: servers can batch tokens per SSE event, and

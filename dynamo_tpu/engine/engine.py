@@ -401,7 +401,10 @@ class Engine:
     def _build_jit(self):
         cfg, mcfg = self.cfg, self.model_cfg
         page_size = cfg.page_size
-        rep_sharding = jax.NamedSharding(self.mesh, jax.P())
+        # jax.P / jax.NamedSharding top-level aliases only exist on newer
+        # jax releases; the jax.sharding forms work on every version in use
+        rep_sharding = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec())
 
         def rep(x):
             """Pin host-readback outputs to fully-replicated: every process
@@ -1197,20 +1200,32 @@ class Engine:
             events.append(self._finalize_admission(
                 r, page_lists[i], int(seq_lens[i]), int(toks_np[i]), keys[i],
                 (float(chosen_np[i]), tids_np[i], tvals_np[i]),
+                t_prefill_start=t0,
             ))
         return events
 
     def _finalize_admission(self, req: GenRequest, pages, prompt_len: int,
-                            first: int, req_key, lp) -> TokenEvent:
+                            first: int, req_key, lp,
+                            t_prefill_start: Optional[float] = None
+                            ) -> TokenEvent:
         """Shared post-prefill bookkeeping for the single and grouped
         admission paths: publish the prefix, install the slot, stop-check
-        the first token, decorate logprobs."""
+        the first token, decorate logprobs. `t_prefill_start` (monotonic)
+        splits admission-to-first-token into queue vs prefill on the event's
+        `phase` dict — the per-request bridge the serving layer turns into
+        trace spans."""
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, pages)
         slot = self._free_slots.pop()
         seq = self._install_slot(req, slot, pages, prompt_len, first, req_key)
         finished, reason = self._check_stop(seq, first)
         ev = TokenEvent(req.request_id, first, 0, finished, reason)
+        if t_prefill_start is not None:
+            now = time.monotonic()
+            ev.phase = {
+                "queue_s": max(0.0, t_prefill_start - req.arrival_time),
+                "prefill_s": max(0.0, now - t_prefill_start),
+            }
         if req.logprobs is not None:
             self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
         if finished:
@@ -1463,9 +1478,10 @@ class Engine:
         ev.top_logprobs = [(int(tids[i]), float(tvals[i])) for i in range(n)]
 
     def _prefill_request(self, req: GenRequest) -> TokenEvent:
+        t0 = time.monotonic()
         first, pages, prompt_len, req_key, lp = self._run_prefill(req)
         return self._finalize_admission(req, pages, prompt_len, first,
-                                        req_key, lp)
+                                        req_key, lp, t_prefill_start=t0)
 
     def _ensure_pages(self, n: int) -> bool:
         """can_alloc with prefix-cache eviction as the pressure valve."""
@@ -1543,9 +1559,13 @@ class Engine:
         finished, reason = self._check_stop(seq, first)
         # "prefill" records admission-to-first-token for BOTH paths (the
         # TTFT phase); per-chunk timings live in "prefill_chunk"
-        self.metrics.observe_phase("prefill",
-                                   time.monotonic() - inf.t_start)
+        now = time.monotonic()
+        self.metrics.observe_phase("prefill", now - inf.t_start)
         ev = TokenEvent(req.request_id, first, 0, finished, reason)
+        ev.phase = {
+            "queue_s": max(0.0, inf.t_start - req.arrival_time),
+            "prefill_s": max(0.0, now - inf.t_start),
+        }
         if req.logprobs is not None:
             self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
         if finished:

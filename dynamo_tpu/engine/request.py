@@ -59,3 +59,10 @@ class TokenEvent:
     logprob: Optional[float] = None  # chosen-token logprob when requested
     # [(token_id, logprob)] best-first alternatives when requested
     top_logprobs: Optional[List[Tuple[int, float]]] = None
+    # per-request phase timings (seconds), attached ONLY to the first-token
+    # event by the engine's prefill paths: {"queue_s": admission wait,
+    # "prefill_s": prompt compute}. This is the bridge from the engine's
+    # aggregate PhaseTimer histograms to per-request trace spans — the
+    # serving layer back-dates worker.queue / worker.prefill child spans
+    # from these without the engine knowing about tracing.
+    phase: Optional[Dict[str, float]] = None

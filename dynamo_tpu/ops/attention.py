@@ -33,6 +33,16 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+try:  # top-level alias exists on newer jax only
+    _shard_map = jax.shard_map
+except AttributeError:  # pre-0.6 spelling (and check_vma was check_rep)
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def _shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_impl(f, **kw)
 from jax.sharding import Mesh, PartitionSpec as P
 
 # (backend, mesh, kv_lane_blocks) bound by the engine around each jit call
@@ -482,7 +492,7 @@ def chunk_attention(
             st = jnp.asarray(start, jnp.int32)
             if mesh is None:
                 return call(q, k_pages, v_pages, pages, st)
-            return jax.shard_map(
+            return _shard_map(
                 call,
                 mesh=mesh,
                 in_specs=(P(None, "model", None), P(None, None, "model"),
@@ -690,7 +700,7 @@ def paged_attention_decode(
         return call(q, k_pages, v_pages, block_table, context_lens)
     # Heads (the fused KV*D lane axis) shard on `model`, batch on `data`:
     # attention is embarrassingly parallel over both — no collectives inside.
-    return jax.shard_map(
+    return _shard_map(
         call,
         mesh=mesh,
         in_specs=(
@@ -791,7 +801,7 @@ def prefill_attention(
     if mesh is None:
         return call(q, k, v, jnp.asarray(seq_len, jnp.int32))
     # Prefill is single-sequence: replicated over `data`, heads on `model`.
-    return jax.shard_map(
+    return _shard_map(
         call,
         mesh=mesh,
         in_specs=(

@@ -54,6 +54,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed upstream (TPUCompilerParams -> CompilerParams); accept both
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) \
+    or pltpu.TPUCompilerParams
+
 NEG_INF = float("-inf")
 
 import os
@@ -400,7 +404,7 @@ def paged_attention_decode(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((bsz, n_heads, head_dim), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             # sequential on purpose: the DMA pipeline carries state across
             # grid steps (see module docstring)
             dimension_semantics=("arbitrary", "arbitrary"),
@@ -535,7 +539,7 @@ def prefill_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_kv, group, s_pad, head_dim), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -768,7 +772,7 @@ def chunk_prefill_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((nq, block_q, n_heads, head_dim),
                                        q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,

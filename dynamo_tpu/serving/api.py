@@ -19,6 +19,8 @@ from dynamo_tpu.engine.engine import Engine
 from dynamo_tpu.engine.kv_cache import OutOfPages
 from dynamo_tpu.engine.request import GenRequest
 from dynamo_tpu.engine.tokenizer import get_tokenizer
+from dynamo_tpu.observability import context as obs_context
+from dynamo_tpu.observability import tracing as obs_tracing
 from dynamo_tpu.serving import protocol as proto
 from dynamo_tpu.serving.engine_service import EngineService
 from dynamo_tpu.serving.http_base import (
@@ -100,10 +102,12 @@ class GenerationHandle:
     validation errors) happens strictly before any response bytes."""
 
     def __init__(self, ctx: "ServingContext", rid: str, prompt_ids: List[int],
-                 params: dict, index: int = 0):
+                 params: dict, index: int = 0, trace_span=None):
         self.ctx = ctx
         self.rid = rid
         self.index = index
+        self.span = trace_span if trace_span is not None \
+            else obs_tracing.NOOP_SPAN
         self.prompt_ids = prompt_ids
         self.stops: List[str] = params.get("stop") or []
         self.want_logprobs = params.get("logprobs") is not None
@@ -137,7 +141,8 @@ class GenerationHandle:
         )
         if ctx.disagg_client is not None:
             # decode role: prefill remotely, pull KV, continue locally
-            self.queue = ctx.disagg_client.start(self.req)
+            self.queue = ctx.disagg_client.start(self.req,
+                                                 parent_span=self.span)
         else:
             self.queue = ctx.service.submit(self.req)  # raises ValueError early
         ctx.metrics.requests_total.inc(model=ctx.served_model)
@@ -155,6 +160,39 @@ class GenerationHandle:
             [(tok.decode([tid]), lp) for tid, lp in (ev.top_logprobs or [])],
         )
 
+    def _first_token_spans(self, ev, ttft_s: float):
+        """Bridge the engine's per-request phase timings (TokenEvent.phase,
+        recorded by the same prefill paths that feed the PhaseTimer
+        histograms) into back-dated worker.queue / worker.prefill child
+        spans, then open the worker.decode span. Engine-wide PhaseTimer
+        quantiles ride as attributes so a single slow trace carries the
+        fleet context it should be judged against."""
+        if not self.span.recording:
+            return None
+        tracer = self.ctx.tracer
+        eng_ph = self.ctx.engine.metrics.phases
+        t_first_ns = time.time_ns()
+        phase = ev.phase or {}
+        queue_ns = int(phase.get("queue_s", 0.0) * 1e9)
+        prefill_ns = int(phase.get("prefill_s", 0.0) * 1e9)
+        pf_start_ns = t_first_ns - prefill_ns
+        if queue_ns or prefill_ns:
+            tracer.start_span(
+                "worker.queue", parent=self.span,
+                start_ns=pf_start_ns - queue_ns).end(end_ns=pf_start_ns)
+            tracer.start_span(
+                "worker.prefill", parent=self.span, start_ns=pf_start_ns,
+                attributes={
+                    "prompt_tokens": len(self.prompt_ids),
+                    "engine.prefill.p50_ms":
+                        round(eng_ph["prefill"].quantile_ms(0.5), 3),
+                    "engine.prefill.p95_ms":
+                        round(eng_ph["prefill"].quantile_ms(0.95), 3),
+                }).end(end_ns=t_first_ns)
+        return tracer.start_span(
+            "worker.decode", parent=self.span, start_ns=t_first_ns,
+            attributes={"ttft_s": round(ttft_s, 6)})
+
     def run(self, emit) -> tuple:
         """Drive the stream; emit(delta, finish|None, lp_entry|None) -> bool
         keeps going while True. A False return (client gone) aborts the
@@ -165,6 +203,7 @@ class GenerationHandle:
         model = ctx.served_model
         t0 = time.monotonic()
         t_prev: Optional[float] = None
+        decode_span = None
         detok = IncrementalDetokenizer(ctx.tokenizer)
         matcher = StopStringMatcher(self.stops) if self.stops else None
         text_parts: List[str] = []
@@ -174,6 +213,7 @@ class GenerationHandle:
             now = time.monotonic()
             if t_prev is None:
                 m.ttft.observe(now - t0, model=model)
+                decode_span = self._first_token_spans(ev, now - t0)
             else:
                 m.itl.observe(now - t_prev, model=model)
             t_prev = now
@@ -219,9 +259,27 @@ class GenerationHandle:
                     ctx.service.abort(self.rid)
                     finish = "abort"
                     break
-        m.duration.observe(time.monotonic() - t0, model=model)
+        dur = time.monotonic() - t0
+        m.duration.observe(dur, model=model)
         m.osl.observe(n_out, model=model)
         ctx.kv_gauge.set(ctx.engine.allocator.free_pages)
+        if decode_span is not None:
+            eng_ph = ctx.engine.metrics.phases
+            decode_span.set_attributes({
+                "completion_tokens": n_out,
+                "finish_reason": finish,
+                "engine.decode_step.p50_ms":
+                    round(eng_ph["decode_step"].quantile_ms(0.5), 3),
+                "engine.decode_step.p95_ms":
+                    round(eng_ph["decode_step"].quantile_ms(0.95), 3),
+            })
+            decode_span.end()
+        if (self.span.recording
+                and dur >= obs_tracing.slow_request_threshold_s()):
+            log.warning(
+                "slow request %s: %.2fs model=%s trace_id=%s — "
+                "GET /debug/spans?trace_id=%s", self.rid, dur, model,
+                self.span.trace_id, self.span.trace_id)
         return "".join(text_parts), finish, n_out
 
 
@@ -246,6 +304,10 @@ class ServingContext:
         )
         self.start_time = time.time()
         self._trace_lock = threading.Lock()  # one profiler capture at a time
+        # distributed request tracing: one tracer per serving role; spans
+        # land in the process-global ring buffer behind GET /debug/spans
+        self.tracer = obs_tracing.Tracer(
+            f"worker-{engine.cfg.disaggregation_mode or 'agg'}")
 
         # --- disaggregation wiring (mirrors the reference's role flags,
         # /root/reference/examples/deploy/sglang/disagg.yaml:45-52) ---
@@ -313,11 +375,13 @@ class ServingContext:
             self.kv_source.close()
         self.service.close()
 
-    def start_generation(self, rid, prompt_ids, params,
-                         index: int = 0) -> "GenerationHandle":
-        return GenerationHandle(self, rid, prompt_ids, params, index=index)
+    def start_generation(self, rid, prompt_ids, params, index: int = 0,
+                         trace_span=None) -> "GenerationHandle":
+        return GenerationHandle(self, rid, prompt_ids, params, index=index,
+                                trace_span=trace_span)
 
-    def start_choices(self, rid, prompt_ids, params) -> List["GenerationHandle"]:
+    def start_choices(self, rid, prompt_ids, params,
+                      trace_span=None) -> List["GenerationHandle"]:
         """Submit all n choices of a request (choice i streams under
         request_id '<rid>-i'). Submission is all-or-nothing: a rejection on
         choice k aborts choices 0..k-1 before re-raising."""
@@ -327,7 +391,7 @@ class ServingContext:
             for i in range(n):
                 handles.append(GenerationHandle(
                     self, f"{rid}-{i}" if n > 1 else rid,
-                    prompt_ids, params, index=i,
+                    prompt_ids, params, index=i, trace_span=trace_span,
                 ))
         except Exception:
             for h in handles:
@@ -366,6 +430,7 @@ def run_choices(handles: List["GenerationHandle"], emit_for) -> List[tuple]:
 
 class _Handler(JsonHTTPHandler):
     ctx: ServingContext  # bound by make_server
+    _span = obs_tracing.NOOP_SPAN  # set per-request in do_POST
 
     # ------------------------------------------------------------- routes --
     def do_GET(self):
@@ -394,6 +459,12 @@ class _Handler(JsonHTTPHandler):
         elif path in ("/health", "/live", "/ready"):
             self._json(200, {"status": "ok", "uptime_s": round(
                 time.time() - self.ctx.start_time, 1)})
+        elif path == "/debug/spans":
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            self._json(200, obs_tracing.spans_debug_payload(
+                qs, self.ctx.tracer.collector))
         elif path == "/debug/trace":
             from urllib.parse import parse_qs, urlparse
 
@@ -449,19 +520,45 @@ class _Handler(JsonHTTPHandler):
 
     def do_POST(self):
         path = self.path.split("?")[0]
+        # request span: child of the frontend's span when a traceparent
+        # arrived (HTTP header, or bridged off NATS message headers by
+        # nats_plane), else a fresh root seeded by x-request-id
+        span = obs_tracing.NOOP_SPAN
+        if path in ("/v1/chat/completions", "/v1/completions",
+                    "/disagg/prefill"):
+            parent = obs_context.extract_context(self.headers)
+            inbound_rid = ((self.headers.get("x-request-id") or "").strip()
+                           or None)
+            span = self.ctx.tracer.start_span(
+                "worker.request", parent=parent, kind="server",
+                trace_seed=inbound_rid,
+                attributes={
+                    "http.path": path,
+                    "worker.mode":
+                        self.ctx.engine.cfg.disaggregation_mode or "agg",
+                    "model": self.ctx.served_model,
+                })
+            rid = inbound_rid or (span.trace_id if span.recording else None)
+            if rid:
+                self.set_request_id(rid)
+        self._span = span
         try:
-            if path == "/v1/chat/completions":
-                self._chat(self._read_json_body())
-            elif path == "/v1/completions":
-                self._completion(self._read_json_body())
-            elif path == "/disagg/prefill":
-                self._disagg_prefill(self._read_json_body())
-            elif path == "/disagg/stage":
-                self._disagg_stage(self._read_json_body())
-            elif path == "/disagg/release":
-                self._disagg_release(self._read_json_body())
-            else:
-                self._error(404, f"no route {path}")
+            try:
+                if path == "/v1/chat/completions":
+                    self._chat(self._read_json_body())
+                elif path == "/v1/completions":
+                    self._completion(self._read_json_body())
+                elif path == "/disagg/prefill":
+                    self._disagg_prefill(self._read_json_body())
+                elif path == "/disagg/stage":
+                    self._disagg_stage(self._read_json_body())
+                elif path == "/disagg/release":
+                    self._disagg_release(self._read_json_body())
+                else:
+                    self._error(404, f"no route {path}")
+            except Exception as e:
+                span.set_status("ERROR", f"{type(e).__name__}: {e}")
+                raise
         except proto.BadRequest as e:
             self._fail(400, str(e))
         except OutOfPages as e:  # transient capacity: client should retry
@@ -475,6 +572,8 @@ class _Handler(JsonHTTPHandler):
         except Exception:
             log.exception("request failed")
             self._fail(500, "internal error", "internal_error")
+        finally:
+            span.end()
 
     def _fail(self, code: int, msg: str, etype: str = "invalid_request_error"):
         if self.sse_started:
@@ -510,8 +609,20 @@ class _Handler(JsonHTTPHandler):
             logprobs=int(lp) if lp is not None else None,
             guided_json=bool(body.get("guided_json", False)),
         )
+        self._span.set_attribute("request.id", rid)
         t0 = time.monotonic()
-        first, n_tokens, extras = ctx.engine.prefill_only(req)
+        with ctx.tracer.start_span(
+                "worker.prefill_only", parent=self._span,
+                attributes={"request.id": rid,
+                            "prompt_tokens": len(ids)}) as pspan:
+            first, n_tokens, extras = ctx.engine.prefill_only(req)
+            eng_ph = ctx.engine.metrics.phases
+            pspan.set_attributes({
+                "engine.prefill.p50_ms":
+                    round(eng_ph["prefill"].quantile_ms(0.5), 3),
+                "engine.prefill.p95_ms":
+                    round(eng_ph["prefill"].quantile_ms(0.95), 3),
+            })
         ctx.metrics.ttft.observe(time.monotonic() - t0, model=ctx.served_model)
         ctx.metrics.requests_total.inc(model=ctx.served_model)
         ctx.metrics.isl.observe(n_tokens, model=ctx.served_model)
@@ -588,7 +699,9 @@ class _Handler(JsonHTTPHandler):
             p["messages"], tools=tools if tc != "none" else None)
         prompt_ids = self.ctx.tokenizer.encode(prompt_text)
         rid = proto.new_id("chatcmpl")
-        handles = self.ctx.start_choices(rid, prompt_ids, p)  # may raise -> 400
+        self._span.set_attribute("request.id", rid)
+        handles = self.ctx.start_choices(  # may raise -> 400
+            rid, prompt_ids, p, trace_span=self._span)
 
         if p["stream"]:
             with_null = p.get("include_usage", False)
@@ -689,7 +802,9 @@ class _Handler(JsonHTTPHandler):
         self._check_model(p["model"])
         prompt_ids = self.ctx.tokenizer.encode(p["prompt"])
         rid = proto.new_id("cmpl")
-        handles = self.ctx.start_choices(rid, prompt_ids, p)
+        self._span.set_attribute("request.id", rid)
+        handles = self.ctx.start_choices(rid, prompt_ids, p,
+                                         trace_span=self._span)
 
         def lp_block(h):
             if not h.want_logprobs:

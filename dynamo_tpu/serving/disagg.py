@@ -20,10 +20,23 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from dynamo_tpu.engine.request import GenRequest, TokenEvent
+from dynamo_tpu.observability import context as obs_context
+from dynamo_tpu.observability import tracing as obs_tracing
 from dynamo_tpu.transfer.kv_transfer import fetch_kv
 from dynamo_tpu.utils import net
 
 log = logging.getLogger("dynamo_tpu.disagg")
+
+
+def _trace_headers(span) -> Dict[str, str]:
+    """HTTP headers carrying `span`'s context to the prefill worker (empty
+    when tracing is off — the RPCs stay byte-identical to the untraced
+    wire format)."""
+    h: Dict[str, str] = {}
+    ctx = getattr(span, "context", None)
+    if ctx is not None:
+        obs_context.inject_context(ctx, h)
+    return h
 
 
 class _StagedPullError(Exception):
@@ -128,13 +141,19 @@ class DisaggDecodeClient:
             "ici transfer backend: prefill %s %s — falling back to the dcn "
             "(TCP host-bounce) plane for this pair", prefill_url, why)
 
-    def start(self, req: GenRequest) -> "object":
+    def start(self, req: GenRequest, parent_span=None) -> "object":
         """Returns the event queue, with the first token already delivered.
 
         Bounded prefill failover: an UNREACHABLE prefill worker (connection
         refused / dropped before any KV moved) is retried on the pool's
         next rendezvous pick; definitive rejections (400) and mid-transfer
-        failures stay terminal."""
+        failures stay terminal.
+
+        `parent_span` (the decode worker's request span) parents the
+        disagg.prefill_rpc / disagg.kv_pull / disagg.kv_release spans and
+        its trace context rides the prefill RPCs as HTTP headers."""
+        if parent_span is None:
+            parent_span = obs_tracing.NOOP_SPAN
         affinity = "".join(map(str, req.prompt_token_ids[:64]))
         tried: list = []
         while True:
@@ -145,7 +164,7 @@ class DisaggDecodeClient:
                         f"prefill workers unreachable: {', '.join(tried)}")
                 raise RuntimeError("no prefill worker available")
             try:
-                return self._start_on(req, prefill_url)
+                return self._start_on(req, prefill_url, parent_span)
             except _PrefillUnreachable as e:
                 log.warning("prefill %s unreachable (%s); failing over",
                             prefill_url, e.reason)
@@ -155,14 +174,15 @@ class DisaggDecodeClient:
                         f"prefill workers unreachable: {', '.join(tried)}"
                     ) from e
 
-    def _start_on(self, req: GenRequest, prefill_url: str) -> "object":
+    def _start_on(self, req: GenRequest, prefill_url: str,
+                  parent_span=obs_tracing.NOOP_SPAN) -> "object":
         ctx = self.ctx
         if ctx.engine.cfg.disaggregation_transfer_backend == "ici":
             from dynamo_tpu.transfer import ici_registry
 
             local = ici_registry.lookup(prefill_url)
             if local is not None:
-                return self._start_ici(req, local, prefill_url)
+                return self._start_ici(req, local, prefill_url, parent_span)
 
         body = json.dumps({
             "request_id": req.request_id,
@@ -181,19 +201,120 @@ class DisaggDecodeClient:
             "guided_json": req.guided_json,
         }).encode()
         t0 = time.monotonic()
-        # phase 1 — the prefill RPC. ONLY connection-phase failures here
-        # are retry-safe (no prefill ran, no KV parked anywhere); a read
-        # TIMEOUT means the worker accepted and may be computing, so a
-        # retry would duplicate the prefill — terminal instead.
+        rpc_span = ctx.tracer.start_span(
+            "disagg.prefill_rpc", parent=parent_span, kind="client",
+            attributes={"prefill.url": prefill_url,
+                        "request.id": req.request_id,
+                        "prompt_tokens": len(req.prompt_token_ids)})
+        try:
+            out = self._prefill_rpc(prefill_url, body, rpc_span)
+        except BaseException as e:
+            rpc_span.set_status("ERROR", f"{type(e).__name__}: {e}")
+            rpc_span.end()
+            raise
+        rpc_span.set_attribute("n_tokens", int(out.get("n_tokens", 0)))
+        rpc_span.end()
+        # phase 2 — the KV pull. The prefill side now holds parked pages;
+        # failures here are terminal for this request (the parked-KV TTL
+        # sweep reclaims the pages), never silently retried elsewhere.
+        pull_span = ctx.tracer.start_span(
+            "disagg.kv_pull", parent=parent_span, kind="client",
+            attributes={"prefill.url": prefill_url,
+                        "request.id": req.request_id})
+        first_token = out["first_token"]
+        host = urllib.parse.urlparse(prefill_url).hostname
+        released = False
+        staged_ok = False  # stage RPC pinned a gather on the prefill side
+        k = None
+        want_ici = (
+            ctx.engine.cfg.disaggregation_transfer_backend == "ici")
+        if want_ici and out.get("device_transfer"):
+            try:
+                # cross-process device-buffer pull (no host bounce):
+                # stage RPC + direct pull from the peer's device memory
+                k, v = self._pull_device(prefill_url, host, req.request_id,
+                                         pull_span)
+                n_tokens = out["n_tokens"]
+                self._plane_counter.inc(plane="ici_device")
+            except _StagedPullError as e:
+                staged_ok = True
+                pull_span.add_event("device_pull_failed", {"error": str(e)})
+                self._warn_dcn_fallback(
+                    prefill_url, f"device-buffer pull failed ({e})")
+            except Exception as e:
+                pull_span.add_event("device_pull_failed", {"error": str(e)})
+                self._warn_dcn_fallback(
+                    prefill_url, f"device-buffer pull failed ({e})")
+        elif want_ici:
+            self._warn_dcn_fallback(
+                prefill_url,
+                "is neither in-process nor advertising device-buffer "
+                "transfer")
+        if k is None:
+            try:
+                k, v, n_tokens = fetch_kv(host, out["bootstrap_port"],
+                                          req.request_id)
+            except (ConnectionError, OSError) as e:
+                pull_span.set_status("ERROR", str(e))
+                pull_span.end()
+                raise RuntimeError(
+                    f"KV transfer from {prefill_url} failed: {e}") from e
+            released = True  # the TCP plane acks (and releases) in-stream
+            self._plane_counter.inc(plane="dcn")
+        pull_span.set_attributes({
+            "plane": "dcn" if released else "ici_device",
+            "bytes": int(k.nbytes + v.nbytes),
+            "n_tokens": int(n_tokens),
+        })
+        pull_span.end()
+        log.info(
+            "disagg%s: prefill(%d tok)+transfer(%.1f MB) in %.3fs via %s",
+            "" if released else "[ici-device]", n_tokens,
+            (k.nbytes + v.nbytes) / 1e6, time.monotonic() - t0,
+            prefill_url,
+        )
+
+        q = ctx.service.attach(req.request_id)
+        try:
+            finished, reason = ctx.engine.import_kv(req, first_token, k, v)
+        except Exception:
+            ctx.service.detach(req.request_id)
+            raise
+        finally:
+            # staged_ok + released: the TCP in-stream ack freed the parked
+            # POOL pages but not the prefill side's stage-ledger slot (and
+            # its pinned gather) — /disagg/release clears both and
+            # engine.release_parked is idempotent for the already-freed
+            # pages
+            if not released or staged_ok:
+                self._release_remote(prefill_url, req.request_id,
+                                     parent_span)
+        ev = TokenEvent(req.request_id, first_token, 0, finished, reason)
+        if req.logprobs is not None and "logprob" in out:
+            ev.logprob = out["logprob"]
+            ev.top_logprobs = [tuple(t) for t in out.get("top_logprobs", [])]
+        q.put(ev)
+        ctx.service.wake()
+        return q
+
+    def _prefill_rpc(self, prefill_url: str, body: bytes, span) -> dict:
+        """Phase-1 prefill RPC. ONLY connection-phase failures here are
+        retry-safe (no prefill ran, no KV parked anywhere); a read TIMEOUT
+        means the worker accepted and may be computing, so a retry would
+        duplicate the prefill — terminal instead. `span`'s trace context
+        rides the request headers so the prefill worker's spans join this
+        trace."""
         try:
             with urllib.request.urlopen(
                 urllib.request.Request(
                     prefill_url.rstrip("/") + "/disagg/prefill", data=body,
-                    headers={"Content-Type": "application/json"}, method="POST",
+                    headers={"Content-Type": "application/json",
+                             **_trace_headers(span)},
+                    method="POST",
                 ),
                 timeout=300,
             ) as resp:
-                out = json.loads(resp.read())
+                return json.loads(resp.read())
         except urllib.error.HTTPError as e:
             # a definitive client error from the prefill side stays definitive
             # (400), so callers don't retry a request that can never succeed
@@ -222,74 +343,9 @@ class DisaggDecodeClient:
                 f"prefill worker {prefill_url} connection lost after the "
                 f"request was sent ({e}); not retried"
             ) from e
-        # phase 2 — the KV pull. The prefill side now holds parked pages;
-        # failures here are terminal for this request (the parked-KV TTL
-        # sweep reclaims the pages), never silently retried elsewhere.
-        first_token = out["first_token"]
-        host = urllib.parse.urlparse(prefill_url).hostname
-        released = False
-        staged_ok = False  # stage RPC pinned a gather on the prefill side
-        k = None
-        want_ici = (
-            ctx.engine.cfg.disaggregation_transfer_backend == "ici")
-        if want_ici and out.get("device_transfer"):
-            try:
-                # cross-process device-buffer pull (no host bounce):
-                # stage RPC + direct pull from the peer's device memory
-                k, v = self._pull_device(prefill_url, host, req.request_id)
-                n_tokens = out["n_tokens"]
-                self._plane_counter.inc(plane="ici_device")
-            except _StagedPullError as e:
-                staged_ok = True
-                self._warn_dcn_fallback(
-                    prefill_url, f"device-buffer pull failed ({e})")
-            except Exception as e:
-                self._warn_dcn_fallback(
-                    prefill_url, f"device-buffer pull failed ({e})")
-        elif want_ici:
-            self._warn_dcn_fallback(
-                prefill_url,
-                "is neither in-process nor advertising device-buffer "
-                "transfer")
-        if k is None:
-            try:
-                k, v, n_tokens = fetch_kv(host, out["bootstrap_port"],
-                                          req.request_id)
-            except (ConnectionError, OSError) as e:
-                raise RuntimeError(
-                    f"KV transfer from {prefill_url} failed: {e}") from e
-            released = True  # the TCP plane acks (and releases) in-stream
-            self._plane_counter.inc(plane="dcn")
-        log.info(
-            "disagg%s: prefill(%d tok)+transfer(%.1f MB) in %.3fs via %s",
-            "" if released else "[ici-device]", n_tokens,
-            (k.nbytes + v.nbytes) / 1e6, time.monotonic() - t0,
-            prefill_url,
-        )
 
-        q = ctx.service.attach(req.request_id)
-        try:
-            finished, reason = ctx.engine.import_kv(req, first_token, k, v)
-        except Exception:
-            ctx.service.detach(req.request_id)
-            raise
-        finally:
-            # staged_ok + released: the TCP in-stream ack freed the parked
-            # POOL pages but not the prefill side's stage-ledger slot (and
-            # its pinned gather) — /disagg/release clears both and
-            # engine.release_parked is idempotent for the already-freed
-            # pages
-            if not released or staged_ok:
-                self._release_remote(prefill_url, req.request_id)
-        ev = TokenEvent(req.request_id, first_token, 0, finished, reason)
-        if req.logprobs is not None and "logprob" in out:
-            ev.logprob = out["logprob"]
-            ev.top_logprobs = [tuple(t) for t in out.get("top_logprobs", [])]
-        q.put(ev)
-        ctx.service.wake()
-        return q
-
-    def _pull_device(self, prefill_url: str, host: str, request_id: str):
+    def _pull_device(self, prefill_url: str, host: str, request_id: str,
+                     span=obs_tracing.NOOP_SPAN):
         """Stage (RPC) then pull a parked sequence's KV via the jax transfer
         server (cross-process ici leg). A wildcard-bound advertised address
         is substituted with the prefill worker's URL host."""
@@ -301,11 +357,15 @@ class DisaggDecodeClient:
             urllib.request.Request(
                 prefill_url.rstrip("/") + "/disagg/stage",
                 data=json.dumps({"request_id": request_id}).encode(),
-                headers={"Content-Type": "application/json"}, method="POST",
+                headers={"Content-Type": "application/json",
+                         **_trace_headers(span)},
+                method="POST",
             ),
             timeout=30,
         ) as resp:
             staged = json.loads(resp.read())
+        span.add_event("staged", {"transfer_address":
+                                  staged.get("transfer_address", "?")})
         try:
             addr = staged["transfer_address"]
             bind_host, _, port = addr.rpartition(":")
@@ -319,36 +379,59 @@ class DisaggDecodeClient:
             # must release it even though it falls back to the TCP plane
             raise _StagedPullError(str(e)) from e
 
-    def _release_remote(self, prefill_url: str, request_id: str) -> None:
+    def _release_remote(self, prefill_url: str, request_id: str,
+                        parent_span=obs_tracing.NOOP_SPAN) -> None:
         """Best-effort parked-page release after a device-buffer pull, on a
         background thread — the first token is already in hand and must not
         wait on cleanup (the prefill side's TTL sweep covers lost acks)."""
         def _post():
+            span = self.ctx.tracer.start_span(
+                "disagg.kv_release", parent=parent_span, kind="client",
+                attributes={"prefill.url": prefill_url,
+                            "request.id": request_id})
             try:
                 urllib.request.urlopen(
                     urllib.request.Request(
                         prefill_url.rstrip("/") + "/disagg/release",
                         data=json.dumps({"request_id": request_id}).encode(),
-                        headers={"Content-Type": "application/json"},
+                        headers={"Content-Type": "application/json",
+                                 **_trace_headers(span)},
                         method="POST",
                     ),
                     timeout=10,
                 ).close()
+                span.set_status("OK")
             except Exception as e:
+                span.set_status("ERROR", str(e))
                 log.warning("parked-KV release on %s failed (%s); TTL sweep "
                             "will reclaim", prefill_url, e)
+            span.end()
 
         threading.Thread(target=_post, daemon=True,
                          name="disagg-release").start()
 
-    def _start_ici(self, req: GenRequest, prefill_engine, prefill_url: str):
+    def _start_ici(self, req: GenRequest, prefill_engine, prefill_url: str,
+                   parent_span=obs_tracing.NOOP_SPAN):
         """In-process (colocated) prefill: direct engine calls + the
         device-to-device KV handoff — no HTTP RPC, no TCP byte pump, no host
         copy of the pages (the NIXL->ICI reroute made real)."""
         ctx = self.ctx
         t0 = time.monotonic()
-        first_token, n_tokens, extras = prefill_engine.prefill_only(req)
-        k, v, _ = prefill_engine.export_kv_device(req.request_id)
+        with ctx.tracer.start_span(
+                "disagg.prefill_rpc", parent=parent_span,
+                attributes={"prefill.url": prefill_url,
+                            "request.id": req.request_id,
+                            "prompt_tokens": len(req.prompt_token_ids),
+                            "plane": "ici_inproc"}) as rpc_span:
+            first_token, n_tokens, extras = prefill_engine.prefill_only(req)
+            rpc_span.set_attribute("n_tokens", int(n_tokens))
+        with ctx.tracer.start_span(
+                "disagg.kv_pull", parent=parent_span,
+                attributes={"prefill.url": prefill_url,
+                            "request.id": req.request_id,
+                            "plane": "ici_inproc"}) as pull_span:
+            k, v, _ = prefill_engine.export_kv_device(req.request_id)
+            pull_span.set_attribute("n_tokens", int(n_tokens))
         self._plane_counter.inc(plane="ici_inproc")  # handoff data in hand
         q = ctx.service.attach(req.request_id)
         try:

@@ -24,6 +24,8 @@ import urllib.error
 import urllib.request
 from typing import List, Optional
 
+from dynamo_tpu.observability import context as obs_context
+from dynamo_tpu.observability import tracing as obs_tracing
 from dynamo_tpu.serving import protocol as proto
 from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
 from dynamo_tpu.serving.metrics import FrontendMetrics, Gauge
@@ -31,6 +33,11 @@ from dynamo_tpu.serving.router import Router, prefix_key
 from dynamo_tpu.utils import net
 
 log = logging.getLogger("dynamo_tpu.frontend")
+
+# re-export: requests slower than this log a WARNING carrying their trace
+# id — the exemplar-style bridge from the dynamo_frontend_* latency series
+# to /debug/spans?trace_id=... (see docs/observability.md)
+slow_request_threshold_s = obs_tracing.slow_request_threshold_s
 
 
 class FrontendContext:
@@ -50,6 +57,7 @@ class FrontendContext:
             self.metrics.registry,
         )
         self.router.ledger_counter = self.ledger_counter
+        self.tracer = obs_tracing.Tracer("frontend")
         # in-flight request tracking feeds the queued-requests gauge the
         # operator's planner scrapes for autoscaling
         self._inflight = 0
@@ -99,6 +107,12 @@ class _FrontendHandler(JsonHTTPHandler):
                     for w in ctx.router.alive(("agg", "prefill", "decode"))
                 ]
             })
+        elif path == "/debug/spans":
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            self._json(200, obs_tracing.spans_debug_payload(
+                qs, ctx.tracer.collector))
         else:
             self._error(404, f"no route {path}")
 
@@ -199,8 +213,61 @@ class _FrontendHandler(JsonHTTPHandler):
             prompt_text = parsed["prompt"]
         affinity = prefix_key(prompt_text)
         model = parsed["model"]
-        worker = ctx.router.pick(model, affinity, prompt_text=prompt_text)
+
+        # --- distributed tracing: this span is the trace ROOT unless the
+        # client sent its own traceparent; x-request-id (inbound or minted
+        # from the trace id) rides every response for correlation ---
+        inbound_rid = ((self.headers.get("x-request-id") or "").strip()
+                       or None)
+        parent = obs_context.extract_context(self.headers)
+        span = ctx.tracer.start_span(
+            "frontend.request", parent=parent, kind="server",
+            trace_seed=inbound_rid,
+            attributes={"http.path": path, "model": model,
+                        "stream": bool(parsed.get("stream"))})
+        rid = inbound_rid or (span.trace_id if span.recording else None)
+        if rid:
+            self.set_request_id(rid)
+        # downstream hops get the SPAN as parent (or pass the inbound
+        # context through untouched when tracing is switched off)
+        trace_headers: dict = {}
+        obs_context.inject_context(
+            span.context if span.recording else parent, trace_headers,
+            request_id=rid)
+        t_req = time.monotonic()
+        try:
+            self._route_and_forward(path, raw, body, prompt_text, affinity,
+                                    model, span, trace_headers)
+        except Exception as e:
+            span.set_status("ERROR", f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            dur = time.monotonic() - t_req
+            span.set_attribute("duration_s", round(dur, 6))
+            span.end()
+            if span.recording and dur >= slow_request_threshold_s():
+                log.warning(
+                    "slow request: %.2fs model=%s path=%s trace_id=%s "
+                    "x_request_id=%s — GET /debug/spans?trace_id=%s",
+                    dur, model, path, span.trace_id, rid or "-",
+                    span.trace_id)
+
+    def _route_and_forward(self, path: str, raw: bytes, body: dict,
+                           prompt_text: str, affinity: str, model: str,
+                           span, trace_headers: dict):
+        ctx = self.ctx
+        explain: dict = {}
+        with ctx.tracer.start_span("router.pick", parent=span,
+                                   attributes={"model": model}) as pick_span:
+            worker = ctx.router.pick(model, affinity,
+                                     prompt_text=prompt_text,
+                                     explain=explain)
+            for k, v in explain.items():
+                pick_span.set_attribute(f"router.{k}", v)
+            if worker is not None:
+                pick_span.set_attribute("worker.url", worker.url)
         if worker is None:
+            span.set_status("ERROR", f"no live worker for {model!r}")
             self._error(503, f"no live worker for model {model!r}",
                         "service_unavailable")
             return
@@ -212,11 +279,15 @@ class _FrontendHandler(JsonHTTPHandler):
             try:
                 # resolving the head frame proves a responder exists; only
                 # failures BEFORE it (no responder / timeout) may fall back
-                parts = _nats_proxy_parts(ctx, worker, path, body)
+                parts = _nats_proxy_parts(ctx, worker, path, body,
+                                          trace_headers)
             except Exception as e:
                 log.warning("NATS plane failed (%s); HTTP fallback to %s",
                             e, worker.url)
+                span.add_event("nats_fallback", {"error": str(e)})
             else:
+                span.set_attribute("transport", "nats")
+                span.set_attribute("worker.url", worker.url)
                 self._send_nats_response(parts, model, t0)
                 return
         # bounded failover: a CONNECT-phase failure (refused / no route /
@@ -237,10 +308,15 @@ class _FrontendHandler(JsonHTTPHandler):
                                          exclude=tried)
                 if worker is None:
                     break
+                span.add_event("failover_repick",
+                               {"attempt": attempt, "worker.url": worker.url})
+            span.set_attribute("transport", "http")
+            span.set_attribute("worker.url", worker.url)
             req = urllib.request.Request(
                 worker.url.rstrip("/") + path,
                 data=raw,
-                headers={"Content-Type": "application/json"},
+                headers={"Content-Type": "application/json",
+                         **trace_headers},
                 method="POST",
             )
             try:
@@ -261,6 +337,7 @@ class _FrontendHandler(JsonHTTPHandler):
             except (urllib.error.URLError, socket.error) as e:
                 reason = getattr(e, "reason", e)
                 if isinstance(reason, (TimeoutError, socket.timeout)):
+                    span.set_status("ERROR", "worker timeout")
                     self._error(
                         504, f"worker {worker.url} timed out mid-request",
                         "timeout")
@@ -269,6 +346,7 @@ class _FrontendHandler(JsonHTTPHandler):
                     # connection lost AFTER the request was written: the
                     # worker may already be generating — a retry would
                     # duplicate the whole generation, so answer terminally
+                    span.set_status("ERROR", "worker connection lost")
                     self._error(
                         502,
                         f"worker {worker.url} connection lost after the "
@@ -283,6 +361,7 @@ class _FrontendHandler(JsonHTTPHandler):
                 tried.append(worker.url)
                 last_err = str(e)
         if resp is None:
+            span.set_status("ERROR", "no reachable worker")
             self._error(
                 502,
                 f"no reachable worker for model {model!r}"
@@ -329,11 +408,12 @@ class _FrontendHandler(JsonHTTPHandler):
         m.duration.observe(time.monotonic() - t0, model=model)
 
 
-def _nats_proxy_parts(ctx, worker, path, body):
+def _nats_proxy_parts(ctx, worker, path, body, trace_headers=None):
     from dynamo_tpu.serving import nats_plane
 
     return nats_plane.nats_request(
-        ctx.nats, nats_plane.worker_subject(worker.url), path, body
+        ctx.nats, nats_plane.worker_subject(worker.url), path, body,
+        trace_headers=trace_headers,
     )
 
 

@@ -7,6 +7,7 @@ import json
 import logging
 import socket
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict
 
@@ -22,6 +23,35 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):
         log.debug("%s %s", self.address_string(), fmt % args)
+
+    def handle_one_request(self):
+        # HTTP/1.1 keep-alive reuses the handler instance across requests:
+        # per-request state must reset here, or request N+1 inherits
+        # request N's id / SSE flag
+        self._x_request_id = None
+        self.sse_started = False
+        super().handle_one_request()
+
+    def request_id(self) -> str:
+        """Every response carries X-Request-Id: honor the inbound header,
+        else mint one (handlers that started a trace pre-seed it with the
+        trace id via set_request_id, so clients correlate with spans)."""
+        if not getattr(self, "_x_request_id", None):
+            inbound = (self.headers.get("x-request-id")
+                       if getattr(self, "headers", None) else None)
+            self._x_request_id = (inbound or "").strip() or uuid.uuid4().hex
+        return self._x_request_id
+
+    def set_request_id(self, rid: str) -> None:
+        if not getattr(self, "_x_request_id", None):
+            self._x_request_id = rid
+
+    def end_headers(self):
+        try:
+            self.send_header("X-Request-Id", self.request_id())
+        except Exception:  # a response must never die on its own header
+            pass
+        super().end_headers()
 
     def _json(self, code: int, obj: Dict[str, Any]):
         data = json.dumps(obj).encode()

@@ -22,8 +22,16 @@ _DEFAULT_BUCKETS = (
 _TOKEN_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384)
 
 
+def _escape_label_value(v) -> str:
+    """Exposition-format label escaping: backslash first (or the other two
+    escapes would be double-escaped), then quote and newline. Without this,
+    one adversarial label value corrupts the whole /metrics scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in labels]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in labels]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -123,13 +131,17 @@ class Histogram(_Metric):
             lbls = list(self._counts) or [()]
             for lbl in lbls:
                 counts = self._counts.get(lbl, [0] * (len(self.buckets) + 1))
+                # note: pre-built le= pairs — a backslash escape inside an
+                # f-string EXPRESSION is a SyntaxError before Python 3.12
                 for i, b in enumerate(self.buckets):
+                    le = f'le="{b}"'
                     out.append(
-                        f"{self.name}_bucket{_fmt_labels(lbl, f'le=\"{b}\"')} "
+                        f"{self.name}_bucket{_fmt_labels(lbl, le)} "
                         f"{counts[i]}"
                     )
+                inf_le = 'le="+Inf"'
                 out.append(
-                    f"{self.name}_bucket{_fmt_labels(lbl, 'le=\"+Inf\"')} {counts[-1]}"
+                    f"{self.name}_bucket{_fmt_labels(lbl, inf_le)} {counts[-1]}"
                 )
                 out.append(
                     f"{self.name}_sum{_fmt_labels(lbl)} {self._sum.get(lbl, 0.0)}"

@@ -57,6 +57,38 @@ def subject_token(raw: str) -> str:
     return "".join(c if c.isalnum() or c in "-_" else "-" for c in raw)
 
 
+HEADER_VERSION_LINE = b"NATS/1.0"
+
+
+def encode_headers(headers: Dict[str, str]) -> bytes:
+    """Encode a NATS message-header block (the HPUB wire form): version
+    line + `Key: Value` pairs + blank line. Values are sanitized of CR/LF
+    so a hostile value cannot smuggle extra header lines."""
+    out = [HEADER_VERSION_LINE]
+    for k, v in headers.items():
+        v = str(v).replace("\r", " ").replace("\n", " ")
+        out.append(f"{k}: {v}".encode())
+    return b"\r\n".join(out) + b"\r\n\r\n"
+
+
+def decode_headers(raw: Optional[bytes]) -> Dict[str, str]:
+    """Parse the raw header block off an HMSG frame into a dict (header
+    names lowercased: NATS headers are case-insensitive like HTTP's).
+    Tolerant: malformed lines are skipped, never raised."""
+    if not raw:
+        return {}
+    out: Dict[str, str] = {}
+    for line in raw.split(b"\r\n"):
+        if not line or line.startswith(HEADER_VERSION_LINE):
+            continue
+        k, sep, v = line.partition(b":")
+        if not sep:
+            continue
+        out[k.decode("utf-8", "replace").strip().lower()] = (
+            v.decode("utf-8", "replace").strip())
+    return out
+
+
 def _subject_matches(pattern: str, subject: str) -> bool:
     pt, st = pattern.split("."), subject.split(".")
     for i, p in enumerate(pt):
@@ -103,10 +135,12 @@ class Msg:
         self.subject = subject
         self.reply = reply
         self.data = data
-        # raw NATS/1.0 header block from HMSG frames (None for MSG); the
-        # request plane doesn't use headers, but a headers-enabled server
-        # must not desync the reader (see _read_loop)
+        # raw NATS/1.0 header block from HMSG frames (None for MSG) — the
+        # request plane carries trace context here (nats_plane)
         self.headers = headers
+
+    def parsed_headers(self) -> Dict[str, str]:
+        return decode_headers(self.headers)
 
 
 class NatsClient:
@@ -298,7 +332,17 @@ class NatsClient:
 
     # ------------------------------------------------------------- surface --
     def publish(self, subject: str, data: bytes,
-                reply: Optional[str] = None) -> None:
+                reply: Optional[str] = None,
+                headers: Optional[Dict[str, str]] = None) -> None:
+        """PUB, or HPUB when `headers` is given (nats-server 2.2+ and the
+        mini broker both speak it) — trace context rides NATS message
+        headers exactly as it rides HTTP headers."""
+        if headers:
+            hblock = encode_headers(headers)
+            head = (f"HPUB {subject} {reply + ' ' if reply else ''}"
+                    f"{len(hblock)} {len(hblock) + len(data)}\r\n")
+            self._send(head.encode() + hblock + data + b"\r\n")
+            return
         head = f"PUB {subject} {reply + ' ' if reply else ''}{len(data)}\r\n"
         self._send(head.encode() + data + b"\r\n")
 
@@ -326,7 +370,8 @@ class NatsClient:
 
     def request_stream(self, subject: str, data: bytes,
                        timeout: float = 600.0,
-                       first_timeout: Optional[float] = None):
+                       first_timeout: Optional[float] = None,
+                       headers: Optional[Dict[str, str]] = None):
         """Publish with a reply inbox; yield reply Msgs until the responder
         sends a message whose JSON body has "done": true.
 
@@ -338,7 +383,7 @@ class NatsClient:
         q: "queue.Queue[Msg]" = queue.Queue()
         sid = self.subscribe(inbox, q.put)
         try:
-            self.publish(subject, data, reply=inbox)
+            self.publish(subject, data, reply=inbox, headers=headers)
             wait = first_timeout if first_timeout is not None else timeout
             while True:
                 try:
@@ -424,6 +469,20 @@ class _BrokerConn:
                     data = self.reader.read_exact(int(nbytes))
                     self.reader.read_exact(2)
                     self.broker.route(subject, reply, data)
+                elif verb == b"HPUB":
+                    # HPUB <subject> [reply] <#hdr> <#total>: the first
+                    # #hdr bytes of the payload are the header block
+                    parts = line.decode().split(" ")
+                    if len(parts) == 5:
+                        _, subject, reply, hbytes, tbytes = parts
+                    else:
+                        _, subject, hbytes, tbytes = parts
+                        reply = None
+                    blob = self.reader.read_exact(int(tbytes))
+                    self.reader.read_exact(2)
+                    nh = int(hbytes)
+                    self.broker.route(subject, reply, blob[nh:],
+                                      headers=blob[:nh])
         except (ConnectionError, OSError):
             pass
         finally:
@@ -467,8 +526,11 @@ class MiniNatsBroker:
             if conn in self._conns:
                 self._conns.remove(conn)
 
-    def route(self, subject: str, reply: Optional[str], data: bytes) -> None:
-        """Deliver to every plain match; ONE member per queue group."""
+    def route(self, subject: str, reply: Optional[str], data: bytes,
+              headers: Optional[bytes] = None) -> None:
+        """Deliver to every plain match; ONE member per queue group.
+        Headered publishes fan out as HMSG (every client here advertises
+        headers support in CONNECT, so no per-client downgrade path)."""
         plain: List[Tuple[_BrokerConn, int]] = []
         groups: Dict[str, List[Tuple[_BrokerConn, int]]] = {}
         with self._lock:
@@ -486,10 +548,17 @@ class MiniNatsBroker:
             plain.append(group_members[self._rr % len(group_members)])
         head_reply = f" {reply}" if reply else ""
         for conn, sid in plain:
-            conn.send(
-                f"MSG {subject} {sid}{head_reply} {len(data)}\r\n".encode()
-                + data + b"\r\n"
-            )
+            if headers:
+                conn.send(
+                    f"HMSG {subject} {sid}{head_reply} {len(headers)} "
+                    f"{len(headers) + len(data)}\r\n".encode()
+                    + headers + data + b"\r\n"
+                )
+            else:
+                conn.send(
+                    f"MSG {subject} {sid}{head_reply} {len(data)}\r\n".encode()
+                    + data + b"\r\n"
+                )
 
     def close(self) -> None:
         self._closed = True

@@ -85,10 +85,18 @@ class WorkerNatsPlane:
             self.nc.publish(reply, b'{"ack": true}')
             body = json.loads(msg.data)
             path = body.pop("_path", "/v1/chat/completions")
+            headers = {"Content-Type": "application/json"}
+            # trace context rode the NATS message headers (HPUB) — bridge
+            # it onto the loopback HTTP hop so the worker's request span
+            # joins the frontend's trace
+            inbound = msg.parsed_headers()
+            for h in ("traceparent", "x-request-id"):
+                if inbound.get(h):
+                    headers[h] = inbound[h]
             req = urllib.request.Request(
                 self.http_url + path,
                 data=json.dumps(body).encode(),
-                headers={"Content-Type": "application/json"},
+                headers=headers,
                 method="POST",
             )
             try:
@@ -130,17 +138,24 @@ class WorkerNatsPlane:
 def nats_request(
     nc: NatsClient, subject: str, path: str, body: dict,
     timeout: float = 600.0, head_timeout: float = 5.0,
+    trace_headers: Optional[dict] = None,
 ) -> Tuple[int, str, Iterator[bytes]]:
     """Frontend-side call: returns (status, content_type, chunk iterator).
 
     The first reply frame resolves status/ctype... frames carry body chunks
     until the done frame; chunks observed before done are yielded in order
     (for SSE, each frame lands as soon as the worker emits it).
+
+    `trace_headers` (traceparent / x-request-id) ride as NATS message
+    headers (HPUB), NOT in the JSON body — the request payload stays the
+    raw OpenAI body and the context survives the plane the same way it
+    survives HTTP.
     """
     payload = dict(body)
     payload["_path"] = path
     frames = nc.request_stream(subject, json.dumps(payload).encode(),
-                               timeout=timeout, first_timeout=head_timeout)
+                               timeout=timeout, first_timeout=head_timeout,
+                               headers=trace_headers or None)
     head = json.loads(next(frames).data)
     if head.get("ack"):  # responder exists; the head may take a while
         head = json.loads(next(frames).data)

@@ -208,9 +208,16 @@ class Router:
     def pick(self, model: str, affinity_key: str,
              roles=("agg", "decode"),
              prompt_text: Optional[str] = None,
-             exclude=()) -> Optional[WorkerInfo]:
+             exclude=(),
+             explain: Optional[Dict] = None) -> Optional[WorkerInfo]:
+        """`explain`, when given, is filled with the routing decision's
+        inputs (candidate count, ledger depth/overlap, decision source) —
+        the attributes the frontend's route-decision trace span records."""
+        if explain is None:
+            explain = {}
         cands = [w for w in self.alive(roles, model)
                  if w.url not in exclude]
+        explain["candidates"] = len(cands)
         if not cands:
             # no worker serves this model -> let the frontend 503 rather than
             # bouncing the request off a wrong-model worker's 400
@@ -238,6 +245,8 @@ class Router:
             # history clears the bar there
             denom = max(len(chain),
                         min(len(prompt_text) // BLOCK_CHARS, MAX_BLOCKS))
+            explain["ledger_depth"] = depth
+            explain["kv_overlap"] = round(depth / denom, 4) if denom else 0.0
             if (url is not None and depth >= 2
                     and depth * 10 >= 6 * denom
                     and live[url].headroom >= 0.05):
@@ -246,8 +255,11 @@ class Router:
                     if self.ledger_counter is not None:
                         self.ledger_counter.inc()
                     self._ledger.record(model, chain, url)
+                explain["source"] = "kv_overlap_ledger"
+                explain["headroom"] = round(live[url].headroom, 4)
                 return live[url]
         picked = _pick_native(affinity_key, cands)
+        explain["source"] = "hrw_native" if picked is not None else "hrw"
         if picked is None:
             best, best_score = None, -1.0
             for w in cands:
@@ -265,6 +277,8 @@ class Router:
         if chain and picked is not None:
             with self._lock:
                 self._ledger.record(model, chain, picked.url)
+        if picked is not None:
+            explain["headroom"] = round(picked.headroom, 4)
         return picked
 
     def pick_prefill(self, model: str, affinity_key: str) -> Optional[WorkerInfo]:

@@ -308,7 +308,12 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
 
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
-    log.info("worker listening on %s:%d", args.host, args.port)
+    from dynamo_tpu.observability import tracing as obs_tracing
+
+    log.info("worker listening on %s:%d (request tracing %s; spans at "
+             "GET /debug/spans, kill switch DYNAMO_TPU_TRACE=0)",
+             args.host, args.port,
+             "on" if obs_tracing.tracing_enabled() else "off")
     try:
         srv.serve_forever()
     finally:

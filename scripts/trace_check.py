@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""CI smoke check for distributed request tracing (`make trace-check`).
+
+Boots the tiny-debug engine behind the worker HTTP server, issues one chat
+request, and fails (exit 1) unless /debug/spans returns a well-formed
+OTLP-JSON payload containing the request's trace: a worker.request span
+plus the engine-bridged worker.queue/worker.prefill/worker.decode children,
+with resolvable parent links and monotonic timestamps.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable straight from a checkout: `python scripts/trace_check.py`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"trace-check: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.serving.api import (
+        ServingContext, make_server, serve_forever_in_thread,
+    )
+
+    ctx = ServingContext(
+        Engine(EngineConfig(model="tiny-debug", page_size=4, num_pages=64,
+                            max_num_seqs=2, max_seq_len=64)),
+        served_model="tiny-debug")
+    srv = make_server(ctx, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        body = json.dumps({
+            "model": "tiny-debug",
+            "messages": [{"role": "user", "content": "trace check"}],
+            "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+        }).encode()
+        resp = urllib.request.urlopen(urllib.request.Request(
+            base + "/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"}), timeout=120)
+        out = json.loads(resp.read())
+        if out.get("usage", {}).get("completion_tokens") != 4:
+            fail(f"unexpected completion: {out}")
+        trace_id = resp.headers.get("X-Request-Id")
+        if not trace_id or len(trace_id) != 32:
+            fail(f"response X-Request-Id is not a trace id: {trace_id!r}")
+
+        spans = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(spans) < 4:
+            with urllib.request.urlopen(
+                    f"{base}/debug/spans?trace_id={trace_id}",
+                    timeout=10) as r:
+                payload = json.loads(r.read())
+            spans = [sp for rs in payload.get("resourceSpans", [])
+                     for ss in rs.get("scopeSpans", [])
+                     for sp in ss.get("spans", [])]
+            time.sleep(0.05)
+        if not spans:
+            fail("/debug/spans returned no spans for the request's trace "
+                 f"(trace_id={trace_id}, enabled={payload.get('enabled')})")
+
+        names = {sp["name"] for sp in spans}
+        want = {"worker.request", "worker.queue", "worker.prefill",
+                "worker.decode"}
+        if not want <= names:
+            fail(f"missing spans: {sorted(want - names)} (got {sorted(names)})")
+        by_id = {sp["spanId"]: sp for sp in spans}
+        for sp in spans:
+            for key in ("traceId", "spanId", "name", "startTimeUnixNano",
+                        "endTimeUnixNano", "attributes", "status"):
+                if key not in sp:
+                    fail(f"span {sp.get('name')} malformed: missing {key}")
+            if sp["traceId"] != trace_id:
+                fail(f"span {sp['name']} escaped the trace: {sp['traceId']}")
+            if int(sp["startTimeUnixNano"]) > int(sp["endTimeUnixNano"]):
+                fail(f"span {sp['name']} ends before it starts")
+            if sp["parentSpanId"] and sp["parentSpanId"] not in by_id:
+                fail(f"span {sp['name']} has a dangling parent")
+        print(f"trace-check: OK — {len(spans)} spans, trace {trace_id}: "
+              f"{', '.join(sorted(names))}")
+    finally:
+        srv.shutdown()
+        ctx.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -25,6 +25,7 @@ help:
 	@echo "  test           fast test tier (skips compile-heavy/slow; CI-grade, <5 min on 1 CPU)"
 	@echo "  test-full      full suite (compile-heavy + slow included)"
 	@echo "  trace-check    one-request /debug/spans smoke check (distributed tracing)"
+	@echo "  chaos-check    deterministic fault-injection suite (breakers, deadlines, failover)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -64,3 +65,12 @@ test-full:
 # trace for it (docs/observability.md)
 trace-check:
 	JAX_PLATFORMS=cpu python scripts/trace_check.py
+
+# Chaos gate (docs/robustness.md): drives every registered fault point
+# through the real serving topology under a FIXED seed — the fault plane's
+# seeded RNGs make the injected-failure schedule replay byte-identically,
+# so a chaos failure here is a deterministic repro, not a flake.
+chaos-check:
+	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
+		python -m pytest tests/test_chaos.py -q -p no:randomly
+

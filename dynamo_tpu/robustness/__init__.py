@@ -1,0 +1,16 @@
+"""Failure-domain hardening for the serving plane (ISSUE 2).
+
+Three cooperating pieces, each stdlib-only and individually importable:
+
+- `faults`   — deterministic fault-injection plane: named fault points
+  compiled into the serving path (`http_base`, `frontend`, `disagg`,
+  `nats`, `engine_service`), armed via env/HTTP, seeded so chaos tests
+  replay byte-identically (docs/robustness.md).
+- `breaker`  — per-worker circuit breakers with half-open probes; the
+  Router consults them on every pick and the frontend exports their
+  state at /metrics.
+- `deadline` — end-to-end deadline propagation: the client budget rides
+  an `x-deadline` header frontend -> worker -> prefill RPC, each hop
+  subtracting its own elapsed time; an exhausted budget sheds load with
+  504 + Retry-After instead of holding an engine slot.
+"""

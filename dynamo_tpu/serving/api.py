@@ -21,6 +21,8 @@ from dynamo_tpu.engine.request import GenRequest
 from dynamo_tpu.engine.tokenizer import get_tokenizer
 from dynamo_tpu.observability import context as obs_context
 from dynamo_tpu.observability import tracing as obs_tracing
+from dynamo_tpu.robustness import faults
+from dynamo_tpu.robustness.deadline import Deadline
 from dynamo_tpu.serving import protocol as proto
 from dynamo_tpu.serving.engine_service import EngineService
 from dynamo_tpu.serving.http_base import (
@@ -102,12 +104,14 @@ class GenerationHandle:
     validation errors) happens strictly before any response bytes."""
 
     def __init__(self, ctx: "ServingContext", rid: str, prompt_ids: List[int],
-                 params: dict, index: int = 0, trace_span=None):
+                 params: dict, index: int = 0, trace_span=None,
+                 deadline: Optional[Deadline] = None):
         self.ctx = ctx
         self.rid = rid
         self.index = index
         self.span = trace_span if trace_span is not None \
             else obs_tracing.NOOP_SPAN
+        self.deadline = deadline
         self.prompt_ids = prompt_ids
         self.stops: List[str] = params.get("stop") or []
         self.want_logprobs = params.get("logprobs") is not None
@@ -142,7 +146,8 @@ class GenerationHandle:
         if ctx.disagg_client is not None:
             # decode role: prefill remotely, pull KV, continue locally
             self.queue = ctx.disagg_client.start(self.req,
-                                                 parent_span=self.span)
+                                                 parent_span=self.span,
+                                                 deadline=deadline)
         else:
             self.queue = ctx.service.submit(self.req)  # raises ValueError early
         ctx.metrics.requests_total.inc(model=ctx.served_model)
@@ -209,7 +214,12 @@ class GenerationHandle:
         text_parts: List[str] = []
         n_out = 0
         finish = "stop"
-        for ev in ctx.service.drain(self.req, self.queue):
+        # the drain timeout is the request's REMAINING deadline budget
+        # (frontend hop time already subtracted), not a fixed 600 s
+        drain_timeout = (self.deadline.remaining()
+                         if self.deadline is not None else None)
+        for ev in ctx.service.drain(self.req, self.queue,
+                                    timeout=drain_timeout):
             now = time.monotonic()
             if t_prev is None:
                 m.ttft.observe(now - t0, model=model)
@@ -376,12 +386,13 @@ class ServingContext:
         self.service.close()
 
     def start_generation(self, rid, prompt_ids, params, index: int = 0,
-                         trace_span=None) -> "GenerationHandle":
+                         trace_span=None, deadline=None) -> "GenerationHandle":
         return GenerationHandle(self, rid, prompt_ids, params, index=index,
-                                trace_span=trace_span)
+                                trace_span=trace_span, deadline=deadline)
 
     def start_choices(self, rid, prompt_ids, params,
-                      trace_span=None) -> List["GenerationHandle"]:
+                      trace_span=None,
+                      deadline=None) -> List["GenerationHandle"]:
         """Submit all n choices of a request (choice i streams under
         request_id '<rid>-i'). Submission is all-or-nothing: a rejection on
         choice k aborts choices 0..k-1 before re-raising."""
@@ -392,6 +403,7 @@ class ServingContext:
                 handles.append(GenerationHandle(
                     self, f"{rid}-{i}" if n > 1 else rid,
                     prompt_ids, params, index=i, trace_span=trace_span,
+                    deadline=deadline,
                 ))
         except Exception:
             for h in handles:
@@ -465,6 +477,8 @@ class _Handler(JsonHTTPHandler):
             qs = parse_qs(urlparse(self.path).query)
             self._json(200, obs_tracing.spans_debug_payload(
                 qs, self.ctx.tracer.collector))
+        elif path == "/internal/faults":
+            self._json(200, faults.http_payload())
         elif path == "/debug/trace":
             from urllib.parse import parse_qs, urlparse
 
@@ -520,15 +534,23 @@ class _Handler(JsonHTTPHandler):
 
     def do_POST(self):
         path = self.path.split("?")[0]
+        # robustness plane: read-stall / reset-after-headers fault points
+        # (no-ops unless armed; control-plane routes are exempt)
+        self._fault_gate()
         # request span: child of the frontend's span when a traceparent
         # arrived (HTTP header, or bridged off NATS message headers by
         # nats_plane), else a fresh root seeded by x-request-id
         span = obs_tracing.NOOP_SPAN
+        self._deadline = None
         if path in ("/v1/chat/completions", "/v1/completions",
                     "/disagg/prefill"):
             parent = obs_context.extract_context(self.headers)
             inbound_rid = ((self.headers.get("x-request-id") or "").strip()
                            or None)
+            # the propagated deadline budget (x-deadline) keeps counting
+            # down on this hop; requests arriving already-exhausted shed
+            # with 504 before taking an engine slot
+            self._deadline = Deadline.from_headers(self.headers)
             span = self.ctx.tracer.start_span(
                 "worker.request", parent=parent, kind="server",
                 trace_seed=inbound_rid,
@@ -536,6 +558,7 @@ class _Handler(JsonHTTPHandler):
                     "http.path": path,
                     "worker.mode":
                         self.ctx.engine.cfg.disaggregation_mode or "agg",
+                    "deadline_s": round(self._deadline.budget_s, 3),
                     "model": self.ctx.served_model,
                 })
             rid = inbound_rid or (span.trace_id if span.recording else None)
@@ -544,6 +567,10 @@ class _Handler(JsonHTTPHandler):
         self._span = span
         try:
             try:
+                if self._deadline is not None and self._deadline.expired:
+                    raise TimeoutError(
+                        "deadline budget exhausted before processing; "
+                        "request shed")
                 if path == "/v1/chat/completions":
                     self._chat(self._read_json_body())
                 elif path == "/v1/completions":
@@ -554,6 +581,12 @@ class _Handler(JsonHTTPHandler):
                     self._disagg_stage(self._read_json_body())
                 elif path == "/disagg/release":
                     self._disagg_release(self._read_json_body())
+                elif path == "/internal/faults":
+                    try:
+                        self._json(200, faults.http_configure(
+                            self._read_json_body()))
+                    except ValueError as e:
+                        raise proto.BadRequest(str(e))
                 else:
                     self._error(404, f"no route {path}")
             except Exception as e:
@@ -610,6 +643,12 @@ class _Handler(JsonHTTPHandler):
             guided_json=bool(body.get("guided_json", False)),
         )
         self._span.set_attribute("request.id", rid)
+        faults.sleep_point("worker.slow_prefill")
+        if self._deadline is not None and self._deadline.expired:
+            # the stall (queueing, chaos, or a slow peer) ate the whole
+            # budget: shed BEFORE running a prefill nobody will pull
+            raise TimeoutError(
+                "deadline budget exhausted before prefill; request shed")
         t0 = time.monotonic()
         with ctx.tracer.start_span(
                 "worker.prefill_only", parent=self._span,
@@ -701,7 +740,8 @@ class _Handler(JsonHTTPHandler):
         rid = proto.new_id("chatcmpl")
         self._span.set_attribute("request.id", rid)
         handles = self.ctx.start_choices(  # may raise -> 400
-            rid, prompt_ids, p, trace_span=self._span)
+            rid, prompt_ids, p, trace_span=self._span,
+            deadline=self._deadline)
 
         if p["stream"]:
             with_null = p.get("include_usage", False)
@@ -804,7 +844,8 @@ class _Handler(JsonHTTPHandler):
         rid = proto.new_id("cmpl")
         self._span.set_attribute("request.id", rid)
         handles = self.ctx.start_choices(rid, prompt_ids, p,
-                                         trace_span=self._span)
+                                         trace_span=self._span,
+                                         deadline=self._deadline)
 
         def lp_block(h):
             if not h.want_logprobs:

@@ -22,6 +22,8 @@ from typing import Dict, List, Optional
 from dynamo_tpu.engine.request import GenRequest, TokenEvent
 from dynamo_tpu.observability import context as obs_context
 from dynamo_tpu.observability import tracing as obs_tracing
+from dynamo_tpu.robustness import deadline as ddl
+from dynamo_tpu.robustness import faults
 from dynamo_tpu.transfer.kv_transfer import fetch_kv
 from dynamo_tpu.utils import net
 
@@ -141,7 +143,8 @@ class DisaggDecodeClient:
             "ici transfer backend: prefill %s %s — falling back to the dcn "
             "(TCP host-bounce) plane for this pair", prefill_url, why)
 
-    def start(self, req: GenRequest, parent_span=None) -> "object":
+    def start(self, req: GenRequest, parent_span=None,
+              deadline: Optional[ddl.Deadline] = None) -> "object":
         """Returns the event queue, with the first token already delivered.
 
         Bounded prefill failover: an UNREACHABLE prefill worker (connection
@@ -151,12 +154,17 @@ class DisaggDecodeClient:
 
         `parent_span` (the decode worker's request span) parents the
         disagg.prefill_rpc / disagg.kv_pull / disagg.kv_release spans and
-        its trace context rides the prefill RPCs as HTTP headers."""
+        its trace context rides the prefill RPCs as HTTP headers.
+        `deadline` (the request's remaining budget) bounds the prefill RPC
+        and rides it as the x-deadline header."""
         if parent_span is None:
             parent_span = obs_tracing.NOOP_SPAN
         affinity = "".join(map(str, req.prompt_token_ids[:64]))
         tried: list = []
         while True:
+            if deadline is not None and deadline.expired:
+                raise TimeoutError(
+                    "deadline budget exhausted before prefill dispatch")
             prefill_url = self.pool.pick(affinity, exclude=tried)
             if prefill_url is None:
                 if tried:
@@ -164,7 +172,8 @@ class DisaggDecodeClient:
                         f"prefill workers unreachable: {', '.join(tried)}")
                 raise RuntimeError("no prefill worker available")
             try:
-                return self._start_on(req, prefill_url, parent_span)
+                return self._start_on(req, prefill_url, parent_span,
+                                      deadline)
             except _PrefillUnreachable as e:
                 log.warning("prefill %s unreachable (%s); failing over",
                             prefill_url, e.reason)
@@ -175,7 +184,8 @@ class DisaggDecodeClient:
                     ) from e
 
     def _start_on(self, req: GenRequest, prefill_url: str,
-                  parent_span=obs_tracing.NOOP_SPAN) -> "object":
+                  parent_span=obs_tracing.NOOP_SPAN,
+                  deadline: Optional[ddl.Deadline] = None) -> "object":
         ctx = self.ctx
         if ctx.engine.cfg.disaggregation_transfer_backend == "ici":
             from dynamo_tpu.transfer import ici_registry
@@ -207,7 +217,7 @@ class DisaggDecodeClient:
                         "request.id": req.request_id,
                         "prompt_tokens": len(req.prompt_token_ids)})
         try:
-            out = self._prefill_rpc(prefill_url, body, rpc_span)
+            out = self._prefill_rpc(prefill_url, body, rpc_span, deadline)
         except BaseException as e:
             rpc_span.set_status("ERROR", f"{type(e).__name__}: {e}")
             rpc_span.end()
@@ -297,22 +307,33 @@ class DisaggDecodeClient:
         ctx.service.wake()
         return q
 
-    def _prefill_rpc(self, prefill_url: str, body: bytes, span) -> dict:
+    def _prefill_rpc(self, prefill_url: str, body: bytes, span,
+                     deadline: Optional[ddl.Deadline] = None) -> dict:
         """Phase-1 prefill RPC. ONLY connection-phase failures here are
         retry-safe (no prefill ran, no KV parked anywhere); a read TIMEOUT
         means the worker accepted and may be computing, so a retry would
         duplicate the prefill — terminal instead. `span`'s trace context
         rides the request headers so the prefill worker's spans join this
-        trace."""
+        trace; the remaining deadline budget bounds the RPC (env-default
+        budget when no deadline propagated — the former hard-coded 300 s)."""
+        headers = {"Content-Type": "application/json",
+                   **_trace_headers(span)}
+        if deadline is not None:
+            deadline.propagate(headers)
+            timeout = deadline.timeout()
+        else:
+            timeout = ddl.default_budget_s()
         try:
+            faults.raise_point(
+                "disagg.prefill_connect_refused",
+                lambda m: urllib.error.URLError(ConnectionRefusedError(m)))
             with urllib.request.urlopen(
                 urllib.request.Request(
                     prefill_url.rstrip("/") + "/disagg/prefill", data=body,
-                    headers={"Content-Type": "application/json",
-                             **_trace_headers(span)},
+                    headers=headers,
                     method="POST",
                 ),
-                timeout=300,
+                timeout=timeout,
             ) as resp:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as e:

@@ -16,6 +16,8 @@ from typing import Dict, Iterator, Optional
 
 from dynamo_tpu.engine.engine import Engine
 from dynamo_tpu.engine.request import GenRequest, TokenEvent
+from dynamo_tpu.robustness import deadline as ddl
+from dynamo_tpu.robustness import faults
 
 log = logging.getLogger("dynamo_tpu.service")
 
@@ -41,6 +43,7 @@ class EngineService:
     def submit(self, req: GenRequest) -> "queue.Queue[TokenEvent]":
         """Validate and enqueue; raises ValueError BEFORE any output starts,
         so HTTP handlers can reject with a clean status line."""
+        faults.sleep_point("worker.slow_prefill")
         q: "queue.Queue[TokenEvent]" = queue.Queue()
         with self._lock:
             self._queues[req.request_id] = q
@@ -72,21 +75,31 @@ class EngineService:
     def wake(self):
         self._wake.set()
 
-    def stream(self, req: GenRequest, timeout: float = 600.0) -> Iterator[TokenEvent]:
+    def stream(self, req: GenRequest,
+               timeout: Optional[float] = None) -> Iterator[TokenEvent]:
         """Submit and yield TokenEvents until the request finishes."""
         q = self.submit(req)
         return self.drain(req, q, timeout)
 
     def drain(self, req: GenRequest, q: "queue.Queue[TokenEvent]",
-              timeout: float = 600.0) -> Iterator[TokenEvent]:
-        """Yield TokenEvents for an already-submitted request."""
+              timeout: Optional[float] = None) -> Iterator[TokenEvent]:
+        """Yield TokenEvents for an already-submitted request.
+
+        `timeout` is the request's remaining deadline budget (propagated
+        from the client's x-deadline header); None falls back to the
+        operator's DYNAMO_TPU_DEADLINE_S default — the former hard-coded
+        600 s."""
+        if timeout is None:
+            timeout = ddl.default_budget_s()
         deadline = time.monotonic() + timeout
         try:
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     self.abort(req.request_id)
-                    raise TimeoutError(f"request {req.request_id} timed out")
+                    raise TimeoutError(
+                        f"request {req.request_id} exceeded its "
+                        f"{timeout:.1f}s deadline budget")
                 try:
                     # short poll so a server shutdown can't strand the handler;
                     # a slow first token (jit compile) just keeps polling until
@@ -97,6 +110,14 @@ class EngineService:
                 yield ev
                 if ev.finished:
                     return
+                if faults.check("worker.crash_mid_decode") is not None:
+                    # the worker "crashes" with tokens already delivered:
+                    # abort the engine side and die mid-stream — the
+                    # frontend must truncate, never re-dispatch (a retry
+                    # would duplicate the generation)
+                    self.abort(req.request_id)
+                    raise ConnectionResetError(
+                        "injected fault: worker.crash_mid_decode")
         finally:
             with self._lock:
                 self._queues.pop(req.request_id, None)

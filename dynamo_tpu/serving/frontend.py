@@ -15,8 +15,10 @@ pod (every DGD manifest's `Frontend` service,
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import os
 import socket
 import threading
 import time
@@ -26,6 +28,9 @@ from typing import List, Optional
 
 from dynamo_tpu.observability import context as obs_context
 from dynamo_tpu.observability import tracing as obs_tracing
+from dynamo_tpu.robustness import faults
+from dynamo_tpu.robustness.breaker import STATE_CODES
+from dynamo_tpu.robustness.deadline import Deadline
 from dynamo_tpu.serving import protocol as proto
 from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
 from dynamo_tpu.serving.metrics import FrontendMetrics, Gauge
@@ -33,6 +38,19 @@ from dynamo_tpu.serving.router import Router, prefix_key
 from dynamo_tpu.utils import net
 
 log = logging.getLogger("dynamo_tpu.frontend")
+
+# admission control: bound on concurrently proxied requests; overflow is
+# answered 429 + Retry-After instead of queueing unboundedly (0 = off)
+MAX_INFLIGHT_ENV = "DYNAMO_TPU_MAX_INFLIGHT"
+DEFAULT_MAX_INFLIGHT = 256
+
+
+def _env_max_inflight() -> int:
+    try:
+        return max(0, int(os.environ.get(MAX_INFLIGHT_ENV,
+                                         DEFAULT_MAX_INFLIGHT)))
+    except ValueError:
+        return DEFAULT_MAX_INFLIGHT
 
 # re-export: requests slower than this log a WARNING carrying their trace
 # id — the exemplar-style bridge from the dynamo_frontend_* latency series
@@ -42,7 +60,8 @@ slow_request_threshold_s = obs_tracing.slow_request_threshold_s
 
 class FrontendContext:
     def __init__(self, router: Optional[Router] = None,
-                 nats_url: Optional[str] = None):
+                 nats_url: Optional[str] = None,
+                 max_inflight: Optional[int] = None):
         self.router = router or Router()
         self.metrics = FrontendMetrics()
         self.worker_gauge = Gauge(
@@ -57,6 +76,39 @@ class FrontendContext:
             self.metrics.registry,
         )
         self.router.ledger_counter = self.ledger_counter
+        # --- robustness plane (docs/robustness.md) ---
+        self.max_inflight = (max_inflight if max_inflight is not None
+                             else _env_max_inflight())
+        self.admission_rejected = Counter(
+            "dynamo_frontend_admission_rejected_total",
+            "Requests shed with 429 by the in-flight admission bound",
+            self.metrics.registry,
+        )
+        self.deadline_shed = Counter(
+            "dynamo_frontend_deadline_shed_total",
+            "Requests shed with 504 because their deadline budget was "
+            "exhausted before a worker answered",
+            self.metrics.registry,
+        )
+        self.expired_counter = Counter(
+            "dynamo_frontend_worker_expired_total",
+            "Workers purged because their heartbeat TTL lapsed",
+            self.metrics.registry,
+        )
+        self.router.expired_counter = self.expired_counter
+        self.breaker_open_counter = Counter(
+            "dynamo_frontend_breaker_open_total",
+            "Circuit-breaker open transitions (threshold trips and failed "
+            "half-open probes)",
+            self.metrics.registry,
+        )
+        self.breaker_gauge = Gauge(
+            "dynamo_frontend_breaker_state",
+            "Per-worker circuit-breaker state (0=closed 1=half_open 2=open)",
+            self.metrics.registry,
+        )
+        self.router.breakers.on_open = (
+            lambda url: self.breaker_open_counter.inc(worker=url))
         self.tracer = obs_tracing.Tracer("frontend")
         # in-flight request tracking feeds the queued-requests gauge the
         # operator's planner scrapes for autoscaling
@@ -92,8 +144,14 @@ class _FrontendHandler(JsonHTTPHandler):
             ctx.worker_gauge.set(len(ctx.router.alive(("agg", "prefill", "decode"))))
             with ctx._inflight_lock:
                 ctx.metrics.queued.set(ctx._inflight)
+            # breaker state is scrape-time truth (open->half_open happens
+            # by clock, not by an event anyone could have observed)
+            for url, state in ctx.router.breakers.snapshot().items():
+                ctx.breaker_gauge.set(STATE_CODES[state], worker=url)
             self._raw(200, ctx.metrics.registry.expose().encode(),
                       "text/plain; version=0.0.4")
+        elif path == "/internal/faults":
+            self._json(200, faults.http_payload())
         elif path in ("/health", "/live", "/ready"):
             workers = len(ctx.router.alive(("agg", "prefill", "decode")))
             code = 200 if path != "/ready" or workers > 0 else 503
@@ -132,6 +190,12 @@ class _FrontendHandler(JsonHTTPHandler):
                 body = self._read_json_body()
                 self.ctx.router.deregister(body["url"])
                 self._json(200, {"ok": True})
+            elif path == "/internal/faults":
+                try:
+                    self._json(200, faults.http_configure(
+                        self._read_json_body()))
+                except ValueError as e:
+                    self._error(400, str(e))
             elif path in ("/v1/chat/completions", "/v1/completions"):
                 self._proxy(path)
             else:
@@ -188,10 +252,23 @@ class _FrontendHandler(JsonHTTPHandler):
     def _proxy(self, path: str):
         # in-flight accounting spans the WHOLE proxied exchange (SSE
         # passthrough included) — it is the queued-requests signal the
-        # operator's planner autoscales on
+        # operator's planner autoscales on. The same counter is the
+        # admission bound: overflow sheds with 429 + Retry-After instead
+        # of queueing work no worker slot exists for.
         ctx = self.ctx
         with ctx._inflight_lock:
-            ctx._inflight += 1
+            if ctx.max_inflight and ctx._inflight >= ctx.max_inflight:
+                admitted = False
+            else:
+                admitted = True
+                ctx._inflight += 1
+        if not admitted:
+            ctx.admission_rejected.inc()
+            self._error(
+                429,
+                f"too many in-flight requests (limit {ctx.max_inflight}); "
+                "retry shortly", "rate_limit_exceeded")
+            return
         try:
             self._proxy_inner(path)
         finally:
@@ -219,11 +296,16 @@ class _FrontendHandler(JsonHTTPHandler):
         # from the trace id) rides every response for correlation ---
         inbound_rid = ((self.headers.get("x-request-id") or "").strip()
                        or None)
+        # end-to-end deadline: the client's x-deadline budget (clamped to
+        # the operator default) starts counting down NOW; every downstream
+        # hop gets the remainder
+        deadline = Deadline.from_headers(self.headers)
         parent = obs_context.extract_context(self.headers)
         span = ctx.tracer.start_span(
             "frontend.request", parent=parent, kind="server",
             trace_seed=inbound_rid,
             attributes={"http.path": path, "model": model,
+                        "deadline_s": round(deadline.budget_s, 3),
                         "stream": bool(parsed.get("stream"))})
         rid = inbound_rid or (span.trace_id if span.recording else None)
         if rid:
@@ -237,7 +319,7 @@ class _FrontendHandler(JsonHTTPHandler):
         t_req = time.monotonic()
         try:
             self._route_and_forward(path, raw, body, prompt_text, affinity,
-                                    model, span, trace_headers)
+                                    model, span, trace_headers, deadline)
         except Exception as e:
             span.set_status("ERROR", f"{type(e).__name__}: {e}")
             raise
@@ -252,10 +334,21 @@ class _FrontendHandler(JsonHTTPHandler):
                     dur, model, path, span.trace_id, rid or "-",
                     span.trace_id)
 
+    def _shed_deadline(self, span, where: str):
+        self.ctx.deadline_shed.inc()
+        span.set_status("ERROR", f"deadline exhausted ({where})")
+        self._error(
+            504, f"deadline budget exhausted {where}; request shed",
+            "timeout")
+
     def _route_and_forward(self, path: str, raw: bytes, body: dict,
                            prompt_text: str, affinity: str, model: str,
-                           span, trace_headers: dict):
+                           span, trace_headers: dict, deadline: Deadline):
         ctx = self.ctx
+        if deadline.expired:
+            # shed BEFORE routing: no pick, no dial, no engine slot
+            self._shed_deadline(span, "before routing")
+            return
         explain: dict = {}
         with ctx.tracer.start_span("router.pick", parent=span,
                                    attributes={"model": model}) as pick_span:
@@ -280,7 +373,7 @@ class _FrontendHandler(JsonHTTPHandler):
                 # resolving the head frame proves a responder exists; only
                 # failures BEFORE it (no responder / timeout) may fall back
                 parts = _nats_proxy_parts(ctx, worker, path, body,
-                                          trace_headers)
+                                          trace_headers, deadline)
             except Exception as e:
                 log.warning("NATS plane failed (%s); HTTP fallback to %s",
                             e, worker.url)
@@ -298,6 +391,7 @@ class _FrontendHandler(JsonHTTPHandler):
         resp = None
         last_err: Optional[str] = None
         tried: List[str] = []
+        breakers = ctx.router.breakers
         for attempt in range(3):
             if attempt:
                 # exclude workers that already refused: the ledger and HRW
@@ -310,21 +404,34 @@ class _FrontendHandler(JsonHTTPHandler):
                     break
                 span.add_event("failover_repick",
                                {"attempt": attempt, "worker.url": worker.url})
+            if deadline.expired:
+                # a failover re-pick must not outlive the client's budget
+                self._shed_deadline(span, "during failover")
+                return
             span.set_attribute("transport", "http")
             span.set_attribute("worker.url", worker.url)
             req = urllib.request.Request(
                 worker.url.rstrip("/") + path,
                 data=raw,
-                headers={"Content-Type": "application/json",
-                         **trace_headers},
+                headers=deadline.propagate({
+                    "Content-Type": "application/json", **trace_headers}),
                 method="POST",
             )
             try:
-                resp = urllib.request.urlopen(req, timeout=600)
+                faults.raise_point(
+                    "frontend.connect_refused",
+                    lambda m: urllib.error.URLError(ConnectionRefusedError(m)))
+                # the socket timeout IS the remaining deadline — the former
+                # hard-coded 600 s held a proxy slot long after any client
+                # had given up
+                resp = urllib.request.urlopen(req,
+                                              timeout=deadline.timeout())
+                breakers.record_success(worker.url)
                 break
             except urllib.error.HTTPError as e:
                 # the worker is alive and answered: a real API response,
                 # not a routing failure — pass it through
+                breakers.record_success(worker.url)
                 payload = e.read()
                 self.send_response(e.code)
                 self.send_header(
@@ -337,15 +444,19 @@ class _FrontendHandler(JsonHTTPHandler):
             except (urllib.error.URLError, socket.error) as e:
                 reason = getattr(e, "reason", e)
                 if isinstance(reason, (TimeoutError, socket.timeout)):
+                    breakers.record_failure(worker.url)
+                    ctx.deadline_shed.inc()
                     span.set_status("ERROR", "worker timeout")
                     self._error(
-                        504, f"worker {worker.url} timed out mid-request",
+                        504, f"worker {worker.url} timed out mid-request "
+                        f"(deadline budget {deadline.budget_s:.1f}s)",
                         "timeout")
                     return
                 if not net.pre_send_failure(e):
                     # connection lost AFTER the request was written: the
                     # worker may already be generating — a retry would
                     # duplicate the whole generation, so answer terminally
+                    breakers.record_failure(worker.url)
                     span.set_status("ERROR", "worker connection lost")
                     self._error(
                         502,
@@ -355,6 +466,7 @@ class _FrontendHandler(JsonHTTPHandler):
                     return
                 log.warning("worker %s unreachable (%s); failing over",
                             worker.url, e)
+                breakers.record_failure(worker.url)
                 ctx.router.deregister(worker.url)
                 # belt and braces with the deregister: a racing heartbeat
                 # could re-register the dead worker before the re-pick
@@ -389,10 +501,25 @@ class _FrontendHandler(JsonHTTPHandler):
                     self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
                     self.wfile.flush()
                 self.wfile.write(b"0\r\n\r\n")
-            except (BrokenPipeError, ConnectionResetError, socket.error):
+            except (BrokenPipeError, ConnectionResetError, socket.error,
+                    http.client.HTTPException):
+                # client gone, or the WORKER died mid-stream (reset after
+                # headers): the stream truncates — never re-dispatched,
+                # the generation must not run twice
                 pass
         else:
-            payload = resp.read()
+            try:
+                payload = resp.read()
+            except (socket.error, OSError, http.client.HTTPException) as e:
+                # worker connection died between its headers and its body:
+                # the generation may have run — terminal, never retried
+                span.set_status("ERROR", "worker connection lost mid-response")
+                ctx.router.breakers.record_failure(worker.url)
+                self._error(
+                    502,
+                    f"worker {worker.url} connection lost mid-response "
+                    f"({type(e).__name__}); not retried", "bad_gateway")
+                return
             m.ttft.observe(time.monotonic() - t0, model=model)
             try:
                 usage = json.loads(payload).get("usage", {})
@@ -408,12 +535,18 @@ class _FrontendHandler(JsonHTTPHandler):
         m.duration.observe(time.monotonic() - t0, model=model)
 
 
-def _nats_proxy_parts(ctx, worker, path, body, trace_headers=None):
+def _nats_proxy_parts(ctx, worker, path, body, trace_headers=None,
+                      deadline: Optional[Deadline] = None):
     from dynamo_tpu.serving import nats_plane
 
+    headers = dict(trace_headers or {})
+    timeout = 600.0
+    if deadline is not None:
+        deadline.propagate(headers)  # budget rides the NATS msg headers too
+        timeout = deadline.timeout()
     return nats_plane.nats_request(
         ctx.nats, nats_plane.worker_subject(worker.url), path, body,
-        trace_headers=trace_headers,
+        timeout=timeout, trace_headers=headers,
     )
 
 

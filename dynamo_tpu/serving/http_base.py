@@ -6,16 +6,22 @@ from __future__ import annotations
 import json
 import logging
 import socket
+import struct
 import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
+from dynamo_tpu.robustness import faults
 from dynamo_tpu.serving import protocol as proto
 
 log = logging.getLogger("dynamo_tpu.http")
 
 MAX_BODY_BYTES = 10 * 1024 * 1024
+
+# inference routes are the fault-injectable surface; control-plane routes
+# (/internal/*, /metrics, /health) must stay reliable even mid-chaos-test
+FAULTABLE_PATHS = ("/v1/", "/disagg/")
 
 
 class JsonHTTPHandler(BaseHTTPRequestHandler):
@@ -30,7 +36,34 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
         # request N's id / SSE flag
         self._x_request_id = None
         self.sse_started = False
+        self._fault_reset_after_headers = False
+        self._fault_closed = False
         super().handle_one_request()
+
+    # ------------------------------------------------- fault injection ----
+    def _fault_gate(self):
+        """Per-request fault hook for inference routes (the robustness
+        plane, docs/robustness.md). Called by worker handlers at the top of
+        do_POST: a read-stall delays processing; reset-after-headers arms
+        an abrupt close that end_headers() executes."""
+        if not self.path.startswith(FAULTABLE_PATHS):
+            return
+        faults.sleep_point("worker.read_stall")
+        if faults.check("worker.reset_after_headers") is not None:
+            self._fault_reset_after_headers = True
+
+    def _fault_abort_connection(self):
+        """RST-close the client connection (SO_LINGER 0 so the peer sees a
+        hard reset, not a clean FIN that could read as end-of-body)."""
+        self._fault_closed = True
+        self.close_connection = True
+        try:
+            self.wfile.flush()
+            self.connection.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                       struct.pack("ii", 1, 0))
+            self.connection.close()
+        except (OSError, ValueError):
+            pass
 
     def request_id(self) -> str:
         """Every response carries X-Request-Id: honor the inbound header,
@@ -52,24 +85,49 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
         except Exception:  # a response must never die on its own header
             pass
         super().end_headers()
+        if self._fault_reset_after_headers:
+            self._fault_reset_after_headers = False
+            self._fault_abort_connection()
 
-    def _json(self, code: int, obj: Dict[str, Any]):
+    def _json(self, code: int, obj: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None):
         data = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if self._fault_closed:
+                return
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError, socket.error):
+            # the client hung up first (e.g. its own deadline fired while
+            # we were shedding): a response to nobody is just dropped
+            self.close_connection = True
 
-    def _error(self, code: int, msg: str, etype: str = "invalid_request_error"):
-        self._json(code, {"error": {"message": msg, "type": etype, "code": code}})
+    def _error(self, code: int, msg: str, etype: str = "invalid_request_error",
+               headers: Optional[Dict[str, str]] = None):
+        headers = dict(headers or {})
+        if code in (429, 503, 504):
+            # shed/overload responses carry a retry hint so well-behaved
+            # clients back off instead of hammering (docs/robustness.md)
+            headers.setdefault("Retry-After", "1")
+        self._json(code, {"error": {"message": msg, "type": etype,
+                                    "code": code}}, headers=headers)
 
     def _raw(self, code: int, data: bytes, content_type: str):
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            if self._fault_closed:
+                return
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError, socket.error):
+            self.close_connection = True
 
     def _read_raw_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", 0))
@@ -85,6 +143,9 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
 
     # -------------------------------------------------------------- SSE ----
     sse_started: bool = False
+    # class-level defaults mirror handle_one_request's per-request reset
+    _fault_reset_after_headers: bool = False
+    _fault_closed: bool = False
 
     def _start_sse(self):
         self.send_response(200)
@@ -95,11 +156,14 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
         self.sse_started = True
 
     def _write_chunk(self, payload: bytes) -> bool:
+        if self._fault_closed:
+            return False
         try:
             self.wfile.write(b"%x\r\n%s\r\n" % (len(payload), payload))
             self.wfile.flush()
             return True
-        except (BrokenPipeError, ConnectionResetError, socket.error):
+        except (BrokenPipeError, ConnectionResetError, socket.error,
+                ValueError):  # ValueError: write on fault-closed file
             return False
 
     def _sse_chunk(self, obj) -> bool:
@@ -111,10 +175,13 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
         return self._write_chunk(payload)
 
     def _end_sse(self):
+        if self._fault_closed:
+            return
         try:
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError, socket.error):
+        except (BrokenPipeError, ConnectionResetError, socket.error,
+                ValueError):
             pass
 
     def _sse_error(self, msg: str):

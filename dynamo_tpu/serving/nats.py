@@ -33,6 +33,8 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
+from dynamo_tpu.robustness import faults
+
 log = logging.getLogger("dynamo_tpu.nats")
 
 DEFAULT_PORT = 4222
@@ -337,6 +339,10 @@ class NatsClient:
         """PUB, or HPUB when `headers` is given (nats-server 2.2+ and the
         mini broker both speak it) — trace context rides NATS message
         headers exactly as it rides HTTP headers."""
+        # chaos plane: a partitioned NATS fails every publish — the
+        # frontend's request path falls back to HTTP, worker responders
+        # fail their reply stream (docs/robustness.md)
+        faults.raise_point("nats.partition", ConnectionError)
         if headers:
             hblock = encode_headers(headers)
             head = (f"HPUB {subject} {reply + ' ' if reply else ''}"

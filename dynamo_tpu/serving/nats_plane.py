@@ -39,6 +39,7 @@ import urllib.error
 import urllib.request
 from typing import Iterator, Optional, Tuple
 
+from dynamo_tpu.robustness import deadline as ddl
 from dynamo_tpu.serving.nats import Msg, NatsClient, subject_token
 
 log = logging.getLogger("dynamo_tpu.nats_plane")
@@ -86,13 +87,15 @@ class WorkerNatsPlane:
             body = json.loads(msg.data)
             path = body.pop("_path", "/v1/chat/completions")
             headers = {"Content-Type": "application/json"}
-            # trace context rode the NATS message headers (HPUB) — bridge
-            # it onto the loopback HTTP hop so the worker's request span
-            # joins the frontend's trace
+            # trace context AND the deadline budget rode the NATS message
+            # headers (HPUB) — bridge them onto the loopback HTTP hop so
+            # the worker's request span joins the frontend's trace and its
+            # deadline keeps counting down
             inbound = msg.parsed_headers()
-            for h in ("traceparent", "x-request-id"):
+            for h in ("traceparent", "x-request-id", ddl.DEADLINE_HEADER):
                 if inbound.get(h):
                     headers[h] = inbound[h]
+            deadline = ddl.Deadline.from_headers(headers)
             req = urllib.request.Request(
                 self.http_url + path,
                 data=json.dumps(body).encode(),
@@ -100,7 +103,8 @@ class WorkerNatsPlane:
                 method="POST",
             )
             try:
-                resp = urllib.request.urlopen(req, timeout=600)
+                resp = urllib.request.urlopen(req,
+                                              timeout=deadline.timeout())
                 status = resp.status
             except urllib.error.HTTPError as e:
                 resp, status = e, e.code

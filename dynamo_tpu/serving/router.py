@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dynamo_tpu.robustness.breaker import BreakerBoard
+
 
 @dataclasses.dataclass
 class WorkerInfo:
@@ -149,7 +151,8 @@ class PrefixLedger:
 
 
 class Router:
-    def __init__(self, heartbeat_ttl: float = 15.0):
+    def __init__(self, heartbeat_ttl: float = 15.0,
+                 breakers: Optional[BreakerBoard] = None):
         self.ttl = heartbeat_ttl
         self._workers: Dict[str, WorkerInfo] = {}
         self._lock = threading.Lock()
@@ -159,6 +162,13 @@ class Router:
         # (under the router lock — scrape-time delta math would race
         # concurrent /metrics requests)
         self.ledger_counter = None
+        # per-worker circuit breakers: pick() filters open breakers out of
+        # the candidate set and admits the single half-open probe; the
+        # frontend reports dial outcomes back via router.breakers
+        self.breakers = breakers if breakers is not None else BreakerBoard()
+        # workers whose heartbeat TTL lapsed and were purged during pick()
+        self.expired_total = 0
+        self.expired_counter = None  # optional metrics Counter
 
     # ---------------------------------------------------------- membership --
     def register(self, url: str, model: str, mode: str = "agg",
@@ -196,6 +206,22 @@ class Router:
                 and (model is None or w.model == model)
             ]
 
+    def purge_expired(self) -> int:
+        """Drop workers whose heartbeat TTL lapsed (alive() only FILTERS
+        them; without this, a worker that died silently lingers in
+        _workers forever and its expiry is invisible operationally).
+        Called on every pick(); emits the worker_expired metric."""
+        cutoff = time.monotonic() - self.ttl
+        with self._lock:
+            dead = [u for u, w in self._workers.items()
+                    if w.last_heartbeat < cutoff]
+            for u in dead:
+                del self._workers[u]
+            self.expired_total += len(dead)
+            if dead and self.expired_counter is not None:
+                self.expired_counter.inc(len(dead))
+        return len(dead)
+
     def models(self) -> List[str]:
         cutoff = time.monotonic() - self.ttl
         with self._lock:
@@ -215,9 +241,19 @@ class Router:
         the attributes the frontend's route-decision trace span records."""
         if explain is None:
             explain = {}
+        self.purge_expired()
         cands = [w for w in self.alive(roles, model)
                  if w.url not in exclude]
         explain["candidates"] = len(cands)
+        if cands:
+            # circuit breakers: open breakers leave the candidate set (the
+            # proactive form of the frontend's reactive failover); a
+            # half-open breaker stays IN — being picked IS its probe
+            allowed = [w for w in cands if self.breakers.would_allow(w.url)]
+            skipped = len(cands) - len(allowed)
+            if skipped:
+                explain["breaker_skipped"] = skipped
+            cands = allowed
         if not cands:
             # no worker serves this model -> let the frontend 503 rather than
             # bouncing the request off a wrong-model worker's 400
@@ -257,7 +293,7 @@ class Router:
                     self._ledger.record(model, chain, url)
                 explain["source"] = "kv_overlap_ledger"
                 explain["headroom"] = round(live[url].headroom, 4)
-                return live[url]
+                return self._finish_pick(live[url], explain)
         picked = _pick_native(affinity_key, cands)
         explain["source"] = "hrw_native" if picked is not None else "hrw"
         if picked is None:
@@ -279,6 +315,14 @@ class Router:
                 self._ledger.record(model, chain, picked.url)
         if picked is not None:
             explain["headroom"] = round(picked.headroom, 4)
+            return self._finish_pick(picked, explain)
+        return picked
+
+    def _finish_pick(self, picked: WorkerInfo, explain: Dict) -> WorkerInfo:
+        """Common tail of every successful pick: consume the half-open
+        probe slot (if any) and expose breaker state to the trace span."""
+        self.breakers.on_picked(picked.url)
+        explain["breaker"] = self.breakers.state(picked.url)
         return picked
 
     def pick_prefill(self, model: str, affinity_key: str) -> Optional[WorkerInfo]:

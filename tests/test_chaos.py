@@ -1,0 +1,488 @@
+"""Chaos suite: drives every registered fault point through the real
+serving topology (frontend + workers over real sockets) and asserts the
+failure-domain invariants (ISSUE 2 / docs/robustness.md):
+
+- bounded failover never duplicates a generation;
+- the circuit breaker completes an open -> half_open -> closed cycle;
+- a propagated deadline sheds with 504 + Retry-After within budget+1s;
+- admission control sheds with 429 instead of queueing;
+- a NATS partition falls back to HTTP;
+- disagg prefill failover leaves the prefill page ledger balanced.
+
+Runs under `make chaos-check` with a pinned DYNAMO_TPU_FAULT_SEED; the
+fault plane's per-point seeded RNGs make each test's injected-failure
+schedule a deterministic replay. Tests are order-dependent ONLY through
+the final coverage assertion (cumulative fired_total), which is why the
+Makefile target passes -p no:randomly.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.robustness import faults
+from dynamo_tpu.robustness.breaker import BreakerBoard
+from dynamo_tpu.serving.api import (
+    ServingContext, make_server, serve_forever_in_thread,
+)
+from dynamo_tpu.serving.frontend import FrontendContext, make_frontend_server
+from dynamo_tpu.serving.router import Router
+
+MODEL = "tiny-debug"
+KW = dict(model=MODEL, page_size=4, num_pages=128, max_num_seqs=4,
+          max_seq_len=128)
+
+
+def post(url, path, body, headers=None, timeout=60, raw=False):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp if raw else json.loads(resp.read())
+
+
+def chat_body(text, max_tokens=4, **kw):
+    return {"model": MODEL,
+            "messages": [{"role": "user", "content": text}],
+            "max_tokens": max_tokens, "temperature": 0, "ignore_eos": True,
+            **kw}
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """Frontend + one agg worker over real sockets; a short-cooldown
+    breaker board so the half-open transition is testable in seconds."""
+    plane = faults.reset_plane()
+    engine = Engine(EngineConfig(**KW))
+    wctx = ServingContext(engine, MODEL)
+    wsrv = make_server(wctx, "127.0.0.1", 0)
+    serve_forever_in_thread(wsrv)
+    worker_url = f"http://127.0.0.1:{wsrv.server_address[1]}"
+
+    router = Router(breakers=BreakerBoard(threshold=3, cooldown_s=0.5))
+    fctx = FrontendContext(router=router)
+    fsrv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(fsrv)
+    frontend_url = f"http://127.0.0.1:{fsrv.server_address[1]}"
+
+    stack = {"frontend": frontend_url, "worker": worker_url,
+             "fctx": fctx, "wctx": wctx, "plane": plane}
+    register(stack)
+    yield stack
+    plane.clear()
+    fsrv.shutdown()
+    wsrv.shutdown()
+    wctx.close()
+
+
+def register(stack):
+    post(stack["frontend"], "/internal/register", {
+        "url": stack["worker"], "model": MODEL, "mode": "agg",
+        "stats": {"max_num_seqs": 4, "free_pages": 100, "total_pages": 128},
+    })
+
+
+# --------------------------------------------------------------------------
+# fault plane mechanics
+# --------------------------------------------------------------------------
+def test_fault_plane_is_seed_deterministic():
+    a = faults.FaultPlane(seed=7)
+    b = faults.FaultPlane(seed=7)
+    c = faults.FaultPlane(seed=8)
+    spec = {"nats.partition": {"times": -1, "p": 0.35}}
+    for p in (a, b, c):
+        p.configure(spec)
+    fires = {p: [p.check("nats.partition") is not None for _ in range(200)]
+             for p in (a, b, c)}
+    assert fires[a] == fires[b], "same seed must replay byte-identically"
+    assert fires[a] != fires[c], "different seed must diverge"
+    assert any(fires[a]) and not all(fires[a])
+
+
+def test_fault_plane_rejects_unknown_names():
+    plane = faults.FaultPlane(seed=1)
+    with pytest.raises(ValueError):
+        plane.configure({"no.such.fault": {}})
+    with pytest.raises(ValueError):
+        plane.configure({"nats.partition": {"bogus_field": 1}})
+
+
+def test_fault_http_config_roundtrip(stack):
+    out = post(stack["frontend"], "/internal/faults",
+               {"seed": 99, "faults": {"nats.partition": {"times": 2}}})
+    assert out["armed"]["nats.partition"]["times"] == 2
+    assert out["seed"] == 99
+    snap = json.loads(urllib.request.urlopen(
+        stack["frontend"] + "/internal/faults", timeout=10).read())
+    assert "nats.partition" in snap["armed"]
+    assert set(snap["registry"]) == set(faults.REGISTRY)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["frontend"], "/internal/faults",
+             {"faults": {"nope": {}}})
+    assert ei.value.code == 400
+    stack["plane"].clear()
+
+
+# --------------------------------------------------------------------------
+# connect-refused failover + the breaker cycle
+# --------------------------------------------------------------------------
+def _worker_requests_total(stack) -> float:
+    m = stack["wctx"].metrics.requests_total
+    with m._lock:
+        return sum(m._values.values())
+
+
+def test_connect_refused_fails_over_without_duplicating(stack):
+    """A pre-send connect failure is retry-safe: with a second (live) route
+    available the request must still succeed — and exactly one generation
+    runs. The same physical worker is registered under two url aliases so
+    the failover re-pick has somewhere to go."""
+    plane, fctx = stack["plane"], stack["fctx"]
+    register(stack)
+    alias = stack["worker"].replace("127.0.0.1", "localhost")
+    post(stack["frontend"], "/internal/register", {
+        "url": alias, "model": MODEL, "mode": "agg",
+        "stats": {"max_num_seqs": 4, "free_pages": 100, "total_pages": 128}})
+    before = _worker_requests_total(stack)
+    plane.configure({"frontend.connect_refused": {"times": 1}})
+    out = post(stack["frontend"], "/v1/chat/completions",
+               chat_body("failover probe"))
+    plane.clear()
+    assert out["usage"]["completion_tokens"] == 4
+    assert _worker_requests_total(stack) == before + 1, \
+        "failover duplicated the generation"
+    # cleanup: later tests assume exactly one registered worker and a
+    # clean breaker slate
+    post(stack["frontend"], "/internal/deregister", {"url": alias})
+    post(stack["frontend"], "/internal/deregister", {"url": stack["worker"]})
+    register(stack)
+    fctx.router.breakers.record_success(alias)
+    fctx.router.breakers.record_success(stack["worker"])
+
+
+def test_breaker_opens_half_opens_closes(stack):
+    """The acceptance-criteria cycle: 3 consecutive connect failures open
+    the breaker (fast-503 while open), the cooldown admits one half-open
+    probe, and the probe's success closes it."""
+    plane, fctx = stack["plane"], stack["fctx"]
+    url = stack["worker"]
+    board = fctx.router.breakers
+    board.record_success(url)  # reset any state left by earlier tests
+
+    plane.configure({"frontend.connect_refused": {"times": 3}})
+    for i in range(3):
+        register(stack)  # the heartbeat re-adding the flapping worker
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(stack["frontend"], "/v1/chat/completions",
+                 chat_body(f"breaker probe {i}"))
+        assert ei.value.code == 502  # sole worker refused -> no failover left
+    assert board.state(url) == "open"
+
+    # open: the worker is not a candidate even though it is registered
+    register(stack)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["frontend"], "/v1/chat/completions",
+             chat_body("while open"))
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After") is not None
+
+    # /metrics exports state 2 (open) for this worker
+    metrics = urllib.request.urlopen(stack["frontend"] + "/metrics",
+                                     timeout=10).read().decode()
+    assert "dynamo_frontend_breaker_state" in metrics
+    assert any(ln.startswith("dynamo_frontend_breaker_state{") and url in ln
+               and ln.rstrip().endswith(" 2")
+               for ln in metrics.splitlines())
+    assert "dynamo_frontend_breaker_open_total" in metrics
+
+    time.sleep(0.6)  # cooldown (0.5s board) elapses
+    assert board.state(url) == "half_open"
+
+    # half-open: the next pick IS the probe; the fault budget is spent, so
+    # the probe succeeds and closes the breaker
+    out = post(stack["frontend"], "/v1/chat/completions",
+               chat_body("half-open probe"))
+    assert out["usage"]["completion_tokens"] == 4
+    assert board.state(url) == "closed"
+    plane.clear()
+
+
+def test_failed_probe_reopens_breaker():
+    """Unit-level: a half-open probe failure restarts the cooldown."""
+    t = [0.0]
+    board = BreakerBoard(threshold=2, cooldown_s=10.0, clock=lambda: t[0])
+    for _ in range(2):
+        board.record_failure("u")
+    assert board.state("u") == "open"
+    assert not board.would_allow("u")
+    t[0] += 11
+    assert board.state("u") == "half_open"
+    assert board.would_allow("u")
+    board.on_picked("u")          # probe taken...
+    assert not board.would_allow("u")  # ...only one at a time
+    board.record_failure("u")     # probe failed
+    assert board.state("u") == "open"
+    t[0] += 11
+    board.on_picked("u")
+    board.record_success("u")
+    assert board.state("u") == "closed"
+
+
+# --------------------------------------------------------------------------
+# deadline propagation
+# --------------------------------------------------------------------------
+def test_deadline_504_within_budget_plus_one(stack):
+    """Acceptance criterion: a 2 s deadline against a stalled worker
+    returns 504 within 3 s; the same request un-injected completes."""
+    plane = stack["plane"]
+    register(stack)
+    plane.configure({"worker.read_stall": {"times": 1, "delay_s": 5.0}})
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["frontend"], "/v1/chat/completions",
+             chat_body("stalled"), headers={"x-deadline": "2"}, timeout=30)
+    elapsed = time.monotonic() - t0
+    assert ei.value.code == 504
+    assert ei.value.headers.get("Retry-After") is not None
+    assert elapsed < 3.0, f"deadline overshot: {elapsed:.2f}s"
+
+    plane.clear()
+    register(stack)  # the timeout deregistered nothing, but re-add anyway
+    stack["fctx"].router.breakers.record_success(stack["worker"])
+    out = post(stack["frontend"], "/v1/chat/completions",
+               chat_body("not stalled"), headers={"x-deadline": "10"})
+    assert out["usage"]["completion_tokens"] == 4
+
+
+def test_exhausted_deadline_sheds_before_routing(stack):
+    register(stack)
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["frontend"], "/v1/chat/completions",
+             chat_body("already late"), headers={"x-deadline": "0"})
+    assert ei.value.code == 504
+    assert time.monotonic() - t0 < 1.0
+    # the worker never saw it: shed happened before the dial
+    assert ei.value.headers.get("Retry-After") is not None
+
+
+def test_deadline_header_reaches_worker(stack):
+    """The worker's request span records the PROPAGATED (shrunken) budget,
+    proving the header rode the hop rather than being re-defaulted."""
+    register(stack)
+    resp = post(stack["frontend"], "/v1/chat/completions",
+                chat_body("carry my budget"),
+                headers={"x-deadline": "33.5"}, raw=True)
+    resp.read()
+    trace_id = resp.headers.get("X-Request-Id")
+    spans = json.loads(urllib.request.urlopen(
+        stack["worker"] + f"/debug/spans?trace_id={trace_id}",
+        timeout=10).read())
+    worker_spans = [sp for rs in spans["resourceSpans"]
+                    for ss in rs["scopeSpans"] for sp in ss["spans"]
+                    if sp["name"] == "worker.request"]
+    assert worker_spans, "worker.request span missing"
+    attrs = {a["key"]: a["value"] for a in worker_spans[-1]["attributes"]}
+    got = float(attrs["deadline_s"].get("doubleValue")
+                or attrs["deadline_s"].get("intValue"))
+    assert 0 < got <= 33.5, f"deadline did not propagate: {got}"
+
+
+# --------------------------------------------------------------------------
+# admission control
+# --------------------------------------------------------------------------
+def test_admission_control_429(stack):
+    """With max_inflight=1, a stalled request holds the only slot and the
+    next request sheds 429 + Retry-After instead of queueing."""
+    plane = stack["plane"]
+    register(stack)
+    fctx = stack["fctx"]
+    old_max = fctx.max_inflight
+    fctx.max_inflight = 1
+    plane.configure({"worker.read_stall": {"times": 1, "delay_s": 1.5}})
+    errs = {}
+
+    def stalled():
+        try:
+            post(stack["frontend"], "/v1/chat/completions",
+                 chat_body("slot holder"), timeout=30)
+        except urllib.error.HTTPError as e:
+            errs["holder"] = e.code
+    t = threading.Thread(target=stalled, daemon=True)
+    try:
+        t.start()
+        # wait until the holder actually OCCUPIES the slot — otherwise the
+        # overflow request could win the race, absorb the stall fault, and
+        # the test would assert on the wrong request
+        wait_until = time.monotonic() + 2.0
+        while time.monotonic() < wait_until:
+            with fctx._inflight_lock:
+                if fctx._inflight >= 1:
+                    break
+            time.sleep(0.01)
+        with fctx._inflight_lock:
+            assert fctx._inflight >= 1, "slot holder never got admitted"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(stack["frontend"], "/v1/chat/completions",
+                 chat_body("overflow"), timeout=5)
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After") is not None
+    finally:
+        t.join(timeout=30)
+        fctx.max_inflight = old_max
+        plane.clear()
+    assert errs.get("holder") is None, f"slot holder failed: {errs}"
+
+
+# --------------------------------------------------------------------------
+# NATS partition -> HTTP fallback
+# --------------------------------------------------------------------------
+def test_nats_partition_falls_back_to_http(stack):
+    from dynamo_tpu.serving.nats import MiniNatsBroker, NatsClient
+
+    plane = stack["plane"]
+    register(stack)
+    broker = MiniNatsBroker()
+    fctx = stack["fctx"]
+    assert fctx.nats is None
+    fctx.nats = NatsClient(broker.url, name="chaos-frontend")
+    try:
+        plane.configure({"nats.partition": {"times": 1}})
+        out = post(stack["frontend"], "/v1/chat/completions",
+                   chat_body("partitioned"))
+        assert out["usage"]["completion_tokens"] == 4
+        assert plane.snapshot()["fired"]["nats.partition"] == 1
+    finally:
+        plane.clear()
+        nc, fctx.nats = fctx.nats, None
+        nc.close()
+        broker.close()
+
+
+# --------------------------------------------------------------------------
+# crash mid-decode: truncate, never re-dispatch
+# --------------------------------------------------------------------------
+def test_crash_mid_decode_truncates_stream(stack):
+    plane, wctx = stack["plane"], stack["wctx"]
+    register(stack)
+    plane.configure({"worker.crash_mid_decode": {"times": 1}})
+    resp = post(stack["frontend"], "/v1/chat/completions",
+                chat_body("crash me", max_tokens=16, stream=True), raw=True)
+    body = resp.read().decode()
+    plane.clear()
+    # the stream STARTED (2xx head already on the wire) then died: the
+    # error rides an SSE event, and the stream is truncated short
+    assert "stream_error" in body or "[DONE]" not in body
+    # invariant: the engine aborted the request — nothing left running
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and wctx.engine.num_active:
+        time.sleep(0.05)
+    assert wctx.engine.num_active == 0
+    assert not wctx.engine.pending
+
+
+def test_reset_after_headers_is_terminal(stack):
+    """Reset AFTER response headers: the request provably reached the
+    worker, so the frontend answers 502 and must NOT re-dispatch."""
+    plane, wctx = stack["plane"], stack["wctx"]
+    register(stack)
+    m = wctx.metrics.requests_total
+    with m._lock:
+        before = sum(m._values.values())
+    plane.configure({"worker.reset_after_headers": {"times": 1}})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["frontend"], "/v1/chat/completions",
+             chat_body("reset me"), timeout=30)
+    assert ei.value.code == 502
+    assert "not retried" in json.loads(ei.value.read())["error"]["message"]
+    plane.clear()
+    with m._lock:
+        after = sum(m._values.values())
+    assert after == before + 1, "the generation ran more than once"
+
+
+# --------------------------------------------------------------------------
+# disagg: prefill failover under injected refusal, ledger balanced
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def disagg_stack(stack):
+    """Prefill worker + decode worker (shared params so the KV handoff is
+    coherent); the decode side knows the prefill under TWO url aliases so
+    an injected refusal on the first pick can fail over to the second."""
+    prefill_engine = Engine(
+        EngineConfig(**{**KW, "disaggregation_mode": "prefill"}))
+    pctx = ServingContext(prefill_engine, MODEL)
+    psrv = make_server(pctx, "127.0.0.1", 0)
+    serve_forever_in_thread(psrv)
+    pport = psrv.server_address[1]
+
+    decode_engine = Engine(
+        EngineConfig(**{**KW, "disaggregation_mode": "decode"}),
+        params=prefill_engine.params)
+    dctx = ServingContext(
+        decode_engine, MODEL,
+        prefill_urls=[f"http://127.0.0.1:{pport}",
+                      f"http://localhost:{pport}"])
+    dsrv = make_server(dctx, "127.0.0.1", 0)
+    serve_forever_in_thread(dsrv)
+    decode_url = f"http://127.0.0.1:{dsrv.server_address[1]}"
+
+    yield {"decode": decode_url, "pctx": pctx, "dctx": dctx,
+           "plane": stack["plane"]}
+    dsrv.shutdown()
+    psrv.shutdown()
+    dctx.close()
+    pctx.close()
+
+
+def test_disagg_prefill_failover_ledger_balanced(disagg_stack):
+    plane = disagg_stack["plane"]
+    pengine = disagg_stack["pctx"].engine
+    plane.configure({"disagg.prefill_connect_refused": {"times": 1}})
+    out = post(disagg_stack["decode"], "/v1/chat/completions",
+               chat_body("disagg failover"), timeout=120)
+    plane.clear()
+    assert out["usage"]["completion_tokens"] == 4
+    # the injected refusal was pre-send: exactly one prefill ran, and its
+    # parked pages were released after the pull — the parked-KV ledger
+    # must drain to empty (nothing leaked, nothing duplicated)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and pengine._parked:
+        time.sleep(0.05)
+    assert not pengine._parked, \
+        f"prefill ledger unbalanced: parked KV leaked ({set(pengine._parked)})"
+
+
+def test_slow_prefill_sheds_on_deadline(disagg_stack):
+    """worker.slow_prefill eats the whole budget on the prefill side; the
+    decode worker's prefill RPC times out -> 5xx shed, no infinite hold."""
+    plane = disagg_stack["plane"]
+    plane.configure({"worker.slow_prefill": {"times": 1, "delay_s": 3.0}})
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(disagg_stack["decode"], "/v1/chat/completions",
+             chat_body("slow prefill"), headers={"x-deadline": "1.5"},
+             timeout=30)
+    elapsed = time.monotonic() - t0
+    plane.clear()
+    assert ei.value.code in (500, 503, 504)
+    assert elapsed < 2.5, f"deadline overshot: {elapsed:.2f}s"
+
+
+# --------------------------------------------------------------------------
+# coverage: every registered fault point fired at least once
+# --------------------------------------------------------------------------
+def test_every_fault_point_fired(stack, disagg_stack):
+    fired = stack["plane"].snapshot()["fired_total"]
+    missing = [n for n in faults.REGISTRY if not fired.get(n)]
+    assert not missing, (
+        f"fault points never triggered by this suite: {missing} "
+        f"(fired: {fired})")

@@ -183,3 +183,32 @@ def test_sync_survives_unreachable_etcd():
     reg = EtcdRegistry(r, "http://127.0.0.1:9")  # closed port
     assert reg.sync_once() == 0  # no raise; local discovery keeps working
     assert {w.url for w in r.alive()} == {"http://w1:8000"}
+
+
+def test_lease_loss_regrants_and_republishes(etcd):
+    """ISSUE 2 satellite: a lost lease (etcd restart / partition outliving
+    the TTL) must be re-granted on the next sync and every directly-
+    heartbeated worker re-published under it — without the local router
+    ever dropping the workers (in-flight streams don't route through etcd
+    and must not notice)."""
+    r = Router()
+    r.register("http://w1:8000", "m", "agg", stats={"max_num_seqs": 8})
+    reg = EtcdRegistry(r, etcd.url, ttl_s=15)
+    reg.sync_once()
+    old_lease = reg._lease
+    assert old_lease is not None
+    c = EtcdClient(etcd.url)
+    assert c.range_prefix(EtcdRegistry.PREFIX)
+    # server-side lease loss: the lease vanishes and takes its records along
+    with etcd._lock:
+        etcd.leases.pop(old_lease, None)
+        etcd.kv = {k: (v, l) for k, (v, l) in etcd.kv.items()
+                   if l != old_lease}
+    assert c.range_prefix(EtcdRegistry.PREFIX) == {}
+    # keepalive now reports the lease dead; ONE sync cycle must recover
+    reg.sync_once()
+    assert reg._lease is not None and reg._lease != old_lease
+    records = c.range_prefix(EtcdRegistry.PREFIX)
+    assert set(records) == {EtcdRegistry.PREFIX + "http://w1:8000"}
+    # the local router never dropped the worker mid-outage
+    assert {w.url for w in r.alive()} == {"http://w1:8000"}

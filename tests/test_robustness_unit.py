@@ -1,0 +1,168 @@
+"""Unit tests for the robustness primitives (ISSUE 2): fault-spec
+semantics, deadline budget propagation/clamping, circuit-breaker state
+machine details, and the router's TTL purge + breaker candidate filter.
+No engines, no sockets — the integration story lives in test_chaos.py."""
+
+import time
+
+import pytest
+
+from dynamo_tpu.robustness import deadline as ddl
+from dynamo_tpu.robustness import faults
+from dynamo_tpu.robustness.breaker import BreakerBoard, CircuitBreaker
+from dynamo_tpu.serving.router import Router
+
+
+# ---------------------------------------------------------------- faults --
+def test_fault_spec_times_and_after():
+    p = faults.FaultPlane(seed=1)
+    p.configure({"nats.partition": {"times": 2, "after": 3}})
+    fires = [p.check("nats.partition") is not None for _ in range(10)]
+    assert fires == [False] * 3 + [True, True] + [False] * 5
+
+
+def test_fault_unarmed_is_noop():
+    p = faults.FaultPlane(seed=1)
+    assert p.check("nats.partition") is None
+    p.configure({"worker.read_stall": {"times": 1}})
+    assert p.check("nats.partition") is None  # armed point != checked point
+
+
+def test_fault_cumulative_totals_survive_reconfigure():
+    p = faults.FaultPlane(seed=1)
+    p.configure({"nats.partition": {"times": 1}})
+    assert p.check("nats.partition") is not None
+    p.configure({"worker.read_stall": {"times": 1, "delay_s": 0.0}})
+    assert p.check("worker.read_stall") is not None
+    totals = p.snapshot()["fired_total"]
+    assert totals == {"nats.partition": 1, "worker.read_stall": 1}
+
+
+def test_fault_sleep_and_raise_helpers(monkeypatch):
+    plane = faults.reset_plane(seed=5)
+    try:
+        plane.configure({"worker.read_stall": {"times": 1, "delay_s": 0.01},
+                         "nats.partition": {"times": 1}})
+        t0 = time.monotonic()
+        assert faults.sleep_point("worker.read_stall")
+        assert time.monotonic() - t0 >= 0.01
+        assert not faults.sleep_point("worker.read_stall")  # budget spent
+        with pytest.raises(ConnectionError):
+            faults.raise_point("nats.partition", ConnectionError)
+        faults.raise_point("nats.partition", ConnectionError)  # spent: no-op
+    finally:
+        faults.reset_plane()
+
+
+# -------------------------------------------------------------- deadline --
+def test_deadline_header_parse_and_clamp(monkeypatch):
+    monkeypatch.setenv(ddl.ENV_DEFAULT, "50")
+    d = ddl.Deadline.from_headers({ddl.DEADLINE_HEADER: "10"})
+    assert 9.9 < d.budget_s <= 10
+    # the header may only SHRINK the operator budget
+    d = ddl.Deadline.from_headers({ddl.DEADLINE_HEADER: "9999"})
+    assert d.budget_s == 50
+    d = ddl.Deadline.from_headers({ddl.DEADLINE_HEADER: "nonsense"})
+    assert d.budget_s == 50
+    d = ddl.Deadline.from_headers({})
+    assert d.budget_s == 50
+
+
+def test_deadline_countdown_and_propagation():
+    t = [100.0]
+    d = ddl.Deadline(10.0, clock=lambda: t[0])
+    assert d.remaining() == 10.0 and not d.expired
+    t[0] += 4
+    assert abs(d.remaining() - 6.0) < 1e-9
+    h = d.propagate({"Content-Type": "application/json"})
+    assert float(h[ddl.DEADLINE_HEADER]) == pytest.approx(6.0, abs=0.01)
+    t[0] += 7
+    assert d.expired and d.remaining() == 0.0
+    assert d.timeout() == ddl.MIN_TIMEOUT_S  # floor, never 0/negative
+
+
+def test_deadline_env_default_fallback(monkeypatch):
+    monkeypatch.delenv(ddl.ENV_DEFAULT, raising=False)
+    assert ddl.default_budget_s() == ddl.DEFAULT_BUDGET_S
+    monkeypatch.setenv(ddl.ENV_DEFAULT, "not-a-number")
+    assert ddl.default_budget_s() == ddl.DEFAULT_BUDGET_S
+    monkeypatch.setenv(ddl.ENV_DEFAULT, "-3")
+    assert ddl.default_budget_s() == ddl.DEFAULT_BUDGET_S
+
+
+# --------------------------------------------------------------- breaker --
+def test_breaker_threshold_and_success_reset():
+    t = [0.0]
+    b = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=lambda: t[0])
+    b.record_failure()
+    b.record_failure()
+    b.record_success()  # consecutive-failure count resets
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    assert b.record_failure() is True  # third consecutive: trips open
+    assert b.state == "open" and not b.available()
+
+
+def test_breaker_probe_timeout_releases_wedged_probe():
+    t = [0.0]
+    b = CircuitBreaker(threshold=1, cooldown_s=1.0, clock=lambda: t[0])
+    b.record_failure()
+    t[0] += 2
+    assert b.state == "half_open" and b.available()
+    b.take_probe()
+    assert not b.available()  # probe in flight
+    t[0] += b.probe_timeout_s + 1  # probe owner died without reporting
+    assert b.available()
+
+
+def test_board_on_open_hook_fires_once_per_open():
+    opened = []
+    board = BreakerBoard(threshold=2, cooldown_s=5.0,
+                         clock=lambda: 0.0, on_open=opened.append)
+    board.record_failure("u")
+    assert opened == []
+    board.record_failure("u")
+    assert opened == ["u"]
+    board.record_failure("u")  # already open: cooldown restart, no re-count
+    assert opened == ["u"]
+
+
+def test_board_unknown_worker_is_closed():
+    board = BreakerBoard(threshold=2, cooldown_s=5.0)
+    assert board.would_allow("never-seen")
+    assert board.state("never-seen") == "closed"
+    board.record_success("never-seen")  # no breaker allocated for successes
+    assert board.snapshot() == {}
+
+
+# ---------------------------------------------------------------- router --
+def test_router_pick_purges_expired_and_counts():
+    r = Router(heartbeat_ttl=0.05)
+    r.register("http://w1:1", "m", "agg")
+    assert r.pick("m", "key") is not None
+    time.sleep(0.08)
+    assert r.pick("m", "key") is None
+    assert r.expired_total == 1
+    # purged, not just filtered: the record is GONE
+    with r._lock:
+        assert "http://w1:1" not in r._workers
+
+
+def test_router_pick_skips_open_breaker():
+    board = BreakerBoard(threshold=1, cooldown_s=60.0)
+    r = Router(breakers=board)
+    r.register("http://w1:1", "m", "agg")
+    r.register("http://w2:1", "m", "agg")
+    board.record_failure("http://w1:1")  # threshold 1: open immediately
+    explain = {}
+    for _ in range(8):
+        w = r.pick("m", "some-key", explain=explain)
+        assert w is not None and w.url == "http://w2:1"
+    assert explain["breaker_skipped"] == 1
+    assert explain["breaker"] == "closed"
+    # every breaker open -> no candidates -> shed upstream
+    board.record_failure("http://w2:1")
+    explain = {}
+    assert r.pick("m", "some-key", explain=explain) is None
+    assert explain.get("breaker_skipped") == 2

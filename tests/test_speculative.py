@@ -8,6 +8,8 @@ The reference's engines (vLLM / TRT-LLM) ship the same capability.
 
 from typing import List
 
+import pytest
+
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.engine import Engine
 from dynamo_tpu.engine.kv_cache import SeqState
@@ -75,7 +77,16 @@ def test_oracle_drafts_accept_and_match():
 
 def test_mid_chain_stop_token():
     ref = gen(make_engine("off"), mt=24)
-    stop = ref[7]  # a token the chain will emit mid-verify
+    # pick a stop token whose FIRST occurrence is mid-chain: the tiny-debug
+    # chain depends on the jax build's PRNG (a hard-coded ref[7] repeated an
+    # earlier token on jax 0.4.37 and stopped the run at index 0 — ISSUE 2
+    # triage), so hunt for an index that actually exercises mid-verify stop
+    idx = next((i for i, t in enumerate(ref)
+                if i >= 2 and ref.index(t) == i), None)
+    if idx is None:
+        pytest.skip("tiny-debug chain is fully periodic on this build: no "
+                    "token first occurs mid-chain")
+    stop = ref[idx]
 
     def gen_stop(eng):
         # ignore_eos discards stop_token_ids (it means "no stop tokens"), so
@@ -89,7 +100,7 @@ def test_mid_chain_stop_token():
     _oracle(eng, ref)
     b = gen_stop(eng)
     assert a == b
-    assert b[-1] == stop and len(b) == 8
+    assert b[-1] == stop and len(b) == idx + 1
 
 
 def test_max_tokens_respected_despite_chain():

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -26,6 +26,7 @@ help:
 	@echo "  test-full      full suite (compile-heavy + slow included)"
 	@echo "  trace-check    one-request /debug/spans smoke check (distributed tracing)"
 	@echo "  chaos-check    deterministic fault-injection suite (breakers, deadlines, failover)"
+	@echo "  kvbm-check     KVBM suite + long-shared-prefix bench smoke (host-tier hit ratio)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -73,4 +74,11 @@ trace-check:
 chaos-check:
 	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
 		python -m pytest tests/test_chaos.py -q -p no:randomly
+
+# KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
+# deterministic long-shared-prefix bench smoke that must show a NONZERO
+# host-tier hit ratio and turn-2 TTFT no worse than with the tier off.
+kvbm-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_kvbm.py -q -p no:randomly
+	python scripts/kvbm_check.py
 

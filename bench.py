@@ -298,11 +298,99 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     return out
 
 
+def bench_long_shared_prefix() -> dict:
+    """KVBM scenario: two-turn shared-prefix traffic whose working set
+    OVERFLOWS the device prefix cache. Turn 2 replays every conversation's
+    prefix; with the host tier on, the evicted prefix pages onboard back
+    from host RAM instead of re-prefilling. Runs the identical workload
+    with the tier on and off and reports both turn-2 mean TTFTs plus the
+    host-tier hit ratio (deterministic: temperature 0, fixed prompts).
+
+    Env: BENCH_KVBM_CONVS (default 6), BENCH_KVBM_PREFIX_TOKENS (default
+    192), BENCH_KVBM_HOST_BLOCKS (default: prefix working set)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    model = os.environ.get("BENCH_MODEL", "tiny-debug")
+    convs = int(os.environ.get("BENCH_KVBM_CONVS", "6"))
+    prefix_len = int(os.environ.get("BENCH_KVBM_PREFIX_TOKENS", "192"))
+    page = 16
+    pages_per_conv = prefix_len // page + 2
+    # device pool holds ~2.5 conversations: turn 2 always misses on device
+    num_pages = int(pages_per_conv * 2.5)
+    host_blocks = int(os.environ.get("BENCH_KVBM_HOST_BLOCKS",
+                                     str(pages_per_conv * (convs + 1))))
+
+    def prompts(turn: int):
+        out = []
+        for c in range(convs):
+            prefix = [(c * 13 + j * 7) % 199 + 1 for j in range(prefix_len)]
+            tail = [(turn * 31 + c * 3 + j) % 199 + 1 for j in range(8)]
+            out.append(prefix + tail)
+        return out
+
+    def run(host_blocks_on: int) -> dict:
+        eng = Engine(EngineConfig(
+            model=model, page_size=page, num_pages=num_pages,
+            max_num_seqs=2, max_seq_len=prefix_len + 64,
+            prefill_chunk_tokens=64, kvbm_host_blocks=host_blocks_on,
+        ))
+        ttfts = {1: [], 2: []}
+        for turn in (1, 2):
+            for i, p in enumerate(prompts(turn)):
+                eng.add_request(GenRequest(f"t{turn}c{i}", p, max_tokens=2,
+                                           temperature=0.0, ignore_eos=True))
+                # serve one conversation at a time — the multi-turn shape
+                while eng.has_work:
+                    for ev in eng.step():
+                        if ev.phase and ev.index == 0:
+                            ttfts[turn].append(ev.phase["prefill_s"])
+        out = {
+            "ttft_turn1_mean_ms": round(
+                1e3 * sum(ttfts[1]) / max(len(ttfts[1]), 1), 3),
+            "ttft_turn2_mean_ms": round(
+                1e3 * sum(ttfts[2]) / max(len(ttfts[2]), 1), 3),
+        }
+        if eng.kvbm is not None:
+            st = eng.kvbm.stats()
+            lookups = st["host_hits_total"] + st["host_misses_total"]
+            out["host_hits_total"] = st["host_hits_total"]
+            out["host_hit_ratio"] = round(
+                st["host_hits_total"] / max(lookups, 1), 4)
+            out["demoted_blocks_total"] = st["demoted_blocks_total"]
+            out["onboarded_blocks_total"] = st["onboarded_blocks_total"]
+        return out
+
+    on = run(host_blocks)
+    off = run(0)
+    return {
+        "metric": "kvbm_long_shared_prefix_ttft_turn2",
+        "value": on["ttft_turn2_mean_ms"],
+        "unit": "ms",
+        "scenario": "long_shared_prefix",
+        "model": model,
+        "conversations": convs,
+        "prefix_tokens": prefix_len,
+        "device_pages": num_pages,
+        "host_blocks": host_blocks,
+        "tier_on": on,
+        "tier_off": off,
+        "ttft_turn2_speedup": round(
+            off["ttft_turn2_mean_ms"] / max(on["ttft_turn2_mean_ms"], 1e-9),
+            3),
+    }
+
+
 def main() -> None:
     backend = _init_backend()
     import jax
 
     on_tpu = backend not in ("cpu",)
+    if os.environ.get("BENCH_SCENARIO") == "long_shared_prefix":
+        # KVBM tier A/B: one JSON line, same contract as the headline
+        print(json.dumps(bench_long_shared_prefix()))
+        return
     dev = jax.devices()[0]
     chip = _chip_spec(dev) if on_tpu else None
     hbm = _effective_hbm(dev, chip) if on_tpu else None

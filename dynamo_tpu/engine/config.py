@@ -96,6 +96,20 @@ class EngineConfig:
     # runs through the chunked-prefill path).
     enable_prefix_caching: bool = True
 
+    # KVBM tiered KV block manager (dynamo_tpu.kvbm): > 0 enables a
+    # preallocated host-RAM pool of this many KV blocks (pages) that
+    # evicted prefix pages demote into instead of being destroyed; prefix
+    # lookups onboard them back. Host RAM cost = blocks * bytes/page (the
+    # pool logs it at startup). Requires enable_prefix_caching.
+    kvbm_host_blocks: int = 0
+    # onboarding cost gate: auto (roofline restore-vs-recompute compare) |
+    # always | never (kvbm/cost_model.py)
+    kvbm_gate: str = "auto"
+    # optional disk tier behind the host pool: blocks LRU-evicted from
+    # host RAM spill into this directory (empty = no disk tier)
+    kvbm_disk_dir: Optional[str] = None
+    kvbm_disk_blocks: int = 256
+
     # async scheduling: dispatch decode window k+1 BEFORE reading window k's
     # tokens back, overlapping the host sync with device compute (vLLM's
     # async scheduler analogue). Stop detection lags one window; membership
@@ -161,6 +175,18 @@ class EngineConfig:
                        action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("--prefill-chunk-tokens", type=int, default=256)
         p.add_argument("--max-prefill-batch", type=int, default=4)
+        # KVBM host tier (deploy manifests size it via the
+        # DYNAMO_TPU_KVBM_HOST_BLOCKS env the operator materializes)
+        import os as _os
+
+        p.add_argument("--kvbm-host-blocks", type=int,
+                       default=int(_os.environ.get(
+                           "DYNAMO_TPU_KVBM_HOST_BLOCKS", "0") or 0))
+        p.add_argument("--kvbm-gate", default="auto",
+                       choices=["auto", "always", "never"])
+        p.add_argument("--kvbm-disk-dir",
+                       default=_os.environ.get("DYNAMO_TPU_KVBM_DISK_DIR"))
+        p.add_argument("--kvbm-disk-blocks", type=int, default=256)
         p.add_argument("--disaggregation-mode", default="agg",
                        choices=["agg", "prefill", "decode"])
         p.add_argument("--is-prefill-worker", action="store_true")
@@ -216,6 +242,10 @@ class EngineConfig:
                                           True),
             prefill_chunk_tokens=getattr(args, "prefill_chunk_tokens", 256),
             max_prefill_batch=getattr(args, "max_prefill_batch", 4),
+            kvbm_host_blocks=getattr(args, "kvbm_host_blocks", 0),
+            kvbm_gate=getattr(args, "kvbm_gate", "auto"),
+            kvbm_disk_dir=getattr(args, "kvbm_disk_dir", None),
+            kvbm_disk_blocks=getattr(args, "kvbm_disk_blocks", 256),
             disaggregation_mode=mode,
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,

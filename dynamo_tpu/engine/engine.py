@@ -315,6 +315,23 @@ class Engine:
             self.cfg = cfg
         if cfg.enable_prefix_caching and cfg.prefill_chunk_tokens > 0:
             self.prefix_cache = PrefixCache(self.allocator, cfg.page_size)
+        # KVBM tiered block manager: evicted prefix pages demote to a
+        # bounded host-RAM pool (and optionally disk) instead of dying;
+        # lookups onboard them back, cost-gated (dynamo_tpu.kvbm)
+        self.kvbm = None
+        if self.prefix_cache is not None and cfg.kvbm_host_blocks > 0:
+            from dynamo_tpu.kvbm.manager import KVBM
+
+            self.kvbm = KVBM(self)
+            self.prefix_cache.kvbm = self.kvbm
+            log.info(
+                "kvbm host tier: %d blocks x %d bytes (%.1f MiB host RAM), "
+                "gate=%s%s", cfg.kvbm_host_blocks,
+                self.kvbm.pool.block_nbytes,
+                cfg.kvbm_host_blocks * self.kvbm.pool.block_nbytes / 2**20,
+                cfg.kvbm_gate,
+                f", disk tier at {cfg.kvbm_disk_dir}"
+                if cfg.kvbm_disk_dir else "")
 
         # --- batch slots (host-side mirrors of device batch state) ---
         b, pmax = cfg.max_num_seqs, cfg.max_pages_per_seq
@@ -731,6 +748,15 @@ class Engine:
                                     for (m, l), f in jw.items()}}
             if cfg.speculative_mode != "off":
                 self._jit_handles["spec"] = jspec
+
+    def set_kv_event_sink(self, sink) -> None:
+        """Attach the cluster KV event plane: `sink(kind, [hash bytes],
+        tier)` receives stored/demoted/removed block events from both the
+        prefix cache and the KVBM tiers (kvbm/events.py publishes them)."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.event_sink = sink
+        if self.kvbm is not None:
+            self.kvbm.events = sink
 
     def reset_metrics(self) -> None:
         """Fresh metrics (post-warmup, bench phase boundaries)."""

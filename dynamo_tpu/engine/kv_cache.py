@@ -181,6 +181,26 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.cached_tokens_served = 0
+        # KVBM tiering bridge (dynamo_tpu.kvbm.manager.KVBM), attached by
+        # the engine when a host tier is configured: evict() DEMOTES
+        # sole-owned victims through it and lookup() misses consult the
+        # lower tiers before giving up. None = classic destroy-on-evict.
+        self.kvbm = None
+        # KV event sink: callable(kind, [hash bytes], tier) feeding the
+        # cluster event plane (kvbm/events.py); independent of tiering so
+        # routing events flow even without a host pool.
+        self.event_sink = None
+
+    def _emit(self, kind: str, hashes, tier: str) -> None:
+        if self.event_sink is None or not hashes:
+            return
+        try:
+            self.event_sink(kind, list(hashes), tier)
+        except Exception:  # the event plane must never break the engine
+            import logging
+
+            logging.getLogger("dynamo_tpu.kvbm").exception(
+                "kv event sink failed")
 
     @staticmethod
     def _chain(prev: bytes, block) -> bytes:
@@ -205,13 +225,29 @@ class PrefixCache:
         recomputed."""
         limit = (len(prompt_tokens) - 1) // self.page_size
         pages: "list[int]" = []
-        for h in self._hashes(prompt_tokens, limit):
-            page = self._map.get(h)
-            if page is None:
+        hashes = self._hashes(prompt_tokens, limit)
+        i = 0
+        while i < limit:
+            page = self._map.get(hashes[i])
+            if page is not None:
+                self._map[hashes[i]] = self._map.pop(hashes[i])  # LRU bump
+                pages.append(page)
+                i += 1
+                continue
+            if self.kvbm is None:
                 break
-            # LRU bump
-            self._map[h] = self._map.pop(h)
-            pages.append(page)
+            # consult the lower tiers for the rest of the chain; onboarded
+            # pages come back with one cache-owned ref (exactly like
+            # insert) and are republished here, so the caller-ref below
+            # covers them too. Eviction is oldest-first, so a demoted run
+            # can sit IN FRONT of blocks still on device — keep walking.
+            got = self.kvbm.onboard_chain(hashes[i:])
+            if not got:
+                break
+            for h2, p2 in got:
+                self._map[h2] = p2
+                pages.append(p2)
+            i += len(got)
         if pages:
             self.allocator.ref(pages)
             self.hits += 1
@@ -233,28 +269,42 @@ class PrefixCache:
         """Publish a fully-prefilled prompt's FULL pages. Each newly
         published page gains a cache-owned reference."""
         n_full = len(prompt_tokens) // self.page_size
+        fresh: "list[bytes]" = []
         for h, page in zip(self._hashes(prompt_tokens, n_full),
                            pages[:n_full]):
             if h in self._map:
                 continue
             self.allocator.ref([page])
             self._map[h] = page
+            fresh.append(h)
+        self._emit("stored", fresh, "device")
 
     def evictable(self) -> int:
         """Pages reclaimable right now (cache is the sole owner)."""
         return sum(1 for p in self._map.values()
                    if self.allocator._refs[p] == 1)
 
-    def evict(self, n: int) -> int:
-        """Free up to n sole-owned pages, oldest first. Returns # evicted."""
+    def evict(self, n: int, protect=frozenset()) -> int:
+        """Free up to n sole-owned pages, oldest first. Returns # evicted.
+
+        With a KVBM attached the victims DEMOTE into the host tier (one
+        batched device gather) before their device pages are freed; the
+        host-pool-full remainder falls back to the classic plain free.
+        `protect` hashes are never victims — the onboard path frees room
+        for an incoming prefix by rotating OTHER prefixes down a tier,
+        and must not evict blocks of the chain it is restoring."""
         if n <= 0:
             return 0
         victims = []
         for h, page in self._map.items():  # insertion order == LRU
-            if self.allocator._refs[page] == 1:
+            if self.allocator._refs[page] == 1 and h not in protect:
                 victims.append((h, page))
                 if len(victims) >= n:
                     break
+        if self.kvbm is not None:
+            self.kvbm.demote(victims)  # emits demoted/removed events
+        else:
+            self._emit("removed", [h for h, _ in victims], "none")
         for h, page in victims:
             del self._map[h]
             self.allocator.free([page])

@@ -1,0 +1,23 @@
+"""KVBM: tiered KV block manager (the Dynamo KVBM analogue).
+
+Three pieces, mirroring the reference platform's block-manager story
+(RTP-LLM, arxiv 2605.29639, shows multi-tier KV reuse is the largest TTFT
+lever for multi-turn traffic):
+
+- `host_pool`  — a bounded, preallocated host-RAM arena (LRU, pinned-aware)
+  that evicted device prefix pages DEMOTE into instead of being destroyed,
+  with an optional disk tier behind the same interface;
+- `manager`    — the engine-side bridge: `PrefixCache.evict` spills
+  sole-owned pages down a tier, `PrefixCache.lookup` misses onboard them
+  back (device_put), gated by a roofline-derived restore-vs-recompute
+  cost check (`cost_model`), with an optional cross-worker pull over the
+  transfer plane;
+- `events`     — the cluster-wide KV event plane: workers publish block
+  stored/demoted/removed events on NATS; the frontend router builds a
+  per-worker global prefix index from them, replacing the guess ledger as
+  the primary kv_overlap routing source.
+"""
+
+from dynamo_tpu.kvbm.host_pool import DiskBlockTier, HostBlockPool  # noqa: F401
+from dynamo_tpu.kvbm.cost_model import OnboardGate  # noqa: F401
+from dynamo_tpu.kvbm.manager import KVBM  # noqa: F401

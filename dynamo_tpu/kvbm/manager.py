@@ -1,0 +1,274 @@
+"""KVBM manager: the engine-side bridge between the device prefix cache and
+the lower tiers (host RAM, disk, peer workers).
+
+Wiring: `Engine` constructs a KVBM when `kvbm_host_blocks > 0` and attaches
+it to its `PrefixCache`. From then on:
+
+- `PrefixCache.evict` DEMOTES sole-owned victim pages through `demote()`
+  (one batched device gather -> arena memcpy) instead of destroying them;
+  pages the pool can't take fall back to a plain free.
+- `PrefixCache.lookup` misses consult `onboard_chain()`: consecutive
+  blocks found in the host tier (or a peer's, via the transfer plane) are
+  restored with one padded scatter (`jax.device_put` + the engine's jitted
+  page import), gated by the roofline restore-vs-recompute check.
+
+Every device call here runs under the engine's `_exec_lock` — demote and
+onboard only fire from `evict()`/`lookup()`, whose callers (admission,
+page growth, KV import) all hold it.
+
+Threading note: the `events` sink (kvbm/events.py) and the metrics
+counters are touched from the scheduler thread; the host pool itself is
+lock-protected because peer-serving threads read it concurrently.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.kvbm.cost_model import OnboardGate
+from dynamo_tpu.kvbm.host_pool import DiskBlockTier, HostBlockPool
+
+log = logging.getLogger("dynamo_tpu.kvbm")
+
+
+def _pad_pow2(n: int) -> int:
+    """Pad batched page gathers/scatters to a power of two so the eager
+    gather and the jitted import compile O(log) distinct shapes, not one
+    per prefix length."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class KVBM:
+    """Tiered KV block manager for one engine."""
+
+    def __init__(self, engine, cfg=None):
+        cfg = cfg or engine.cfg
+        self.engine = engine
+        spec = engine.kv_spec
+        import jax.numpy as jnp
+
+        self.block_shape = (spec.num_layers, spec.page_size, spec.lane_width)
+        self._np_dtype = np.dtype(jnp.dtype(spec.dtype))
+        disk = None
+        if getattr(cfg, "kvbm_disk_dir", None):
+            disk = DiskBlockTier(cfg.kvbm_disk_dir,
+                                 capacity_blocks=cfg.kvbm_disk_blocks)
+        self.pool = HostBlockPool(cfg.kvbm_host_blocks, self.block_shape,
+                                  self._np_dtype, disk=disk)
+        self.gate = OnboardGate(
+            mode=getattr(cfg, "kvbm_gate", "auto"),
+            model_cfg=engine.model_cfg,
+            block_nbytes=self.pool.block_nbytes,
+            page_size=cfg.page_size,
+            prefill_chunk_tokens=cfg.prefill_chunk_tokens or cfg.page_size,
+        )
+        # cluster plane hooks (set by the serving layer):
+        # events(kind, [hash bytes], tier) -> None; kinds: stored | demoted
+        # | removed. peer_fetch([hash bytes]) -> [(k, v)] consecutive-from-
+        # the-start host-layout blocks pulled from a peer's host tier.
+        self.events: Optional[Callable[[str, List[bytes], str], None]] = None
+        self.peer_fetch: Optional[
+            Callable[[List[bytes]], List[Tuple[np.ndarray, np.ndarray]]]
+        ] = None
+        self.tracer = None  # set by ServingContext; spans kvbm.offload/onboard
+        self._lock = threading.Lock()  # counters only
+        # counters behind the dynamo_kvbm_* metric series
+        self.host_hits_total = 0        # lookups served >= 1 block from tiers
+        self.host_hit_blocks_total = 0
+        self.host_misses_total = 0      # lookup tails the tiers couldn't serve
+        self.demoted_blocks_total = 0
+        self.onboarded_blocks_total = 0
+        self.peer_onboarded_blocks_total = 0
+        self.removed_blocks_total = 0
+        self.gate_recompute_total = 0   # onboards the cost gate refused
+
+    # ------------------------------------------------------------- helpers --
+    def _emit(self, kind: str, hashes: List[bytes], tier: str) -> None:
+        if self.events is None or not hashes:
+            return
+        try:
+            self.events(kind, list(hashes), tier)
+        except Exception:  # the event plane must never break serving
+            log.exception("kvbm event sink failed")
+
+    def _span(self, name: str, **attrs):
+        if self.tracer is None:
+            from dynamo_tpu.observability import tracing as obs_tracing
+
+            return obs_tracing.NOOP_SPAN
+        return self.tracer.start_span(name, attributes=attrs)
+
+    # -------------------------------------------------------------- demote --
+    def demote(self, victims: List[Tuple[bytes, int]]) -> int:
+        """Spill evicted sole-owned pages into the host tier. One padded
+        device gather covers the whole victim batch; pages the pool cannot
+        take (full-of-pinned, arena rejected) are reported `removed` and
+        the caller frees them as before. Returns blocks demoted."""
+        if not victims:
+            return 0
+        span = self._span("kvbm.offload", blocks=len(victims))
+        try:
+            import jax.numpy as jnp
+
+            eng = self.engine
+            pages = [p for _, p in victims]
+            width = _pad_pow2(len(pages))
+            idx = np.zeros((width,), np.int32)  # pad rows gather trash page 0
+            idx[:len(pages)] = pages
+            k = np.asarray(jnp.take(eng.k_pages, jnp.asarray(idx), axis=1))
+            v = np.asarray(jnp.take(eng.v_pages, jnp.asarray(idx), axis=1))
+            demoted, removed, dropped = [], [], []
+            for i, (h, _) in enumerate(victims):
+                ok, lru_removed = self.pool.put(h, k[:, i], v[:, i])
+                dropped.extend(lru_removed)
+                (demoted if ok else removed).append(h)
+            with self._lock:
+                self.demoted_blocks_total += len(demoted)
+                self.removed_blocks_total += len(removed) + len(dropped)
+            self._emit("demoted", demoted, "host")
+            self._emit("removed", removed + dropped, "none")
+            span.set_attributes({"demoted": len(demoted),
+                                 "removed": len(removed) + len(dropped)})
+            return len(demoted)
+        except Exception:
+            log.exception("kvbm demote failed; pages freed undemoted")
+            span.set_status("ERROR", "demote failed")
+            return 0
+        finally:
+            span.end()
+
+    # ------------------------------------------------------------- onboard --
+    def onboard_chain(self, hashes: List[bytes]) -> List[Tuple[bytes, int]]:
+        """Restore the longest consecutive run of `hashes` available in the
+        lower tiers back into the device pool. Returns [(hash, page_id)]
+        with each new page holding ONE allocator ref (cache-owned, exactly
+        like a freshly inserted prefix page); the caller republishes them
+        in its hash map. Gated by the restore-vs-recompute check."""
+        if not hashes:
+            return []
+        disk_drops: List[bytes] = []
+        blocks: List[Tuple[bytes, np.ndarray, np.ndarray]] = []
+        for h in hashes:
+            got = self.pool.get(h, removed=disk_drops)
+            if got is None:
+                break
+            blocks.append((h, got[0], got[1]))
+        source = "host"
+        if not blocks and self.peer_fetch is not None:
+            blocks = self._fetch_from_peer(hashes)
+            source = "peer"
+        if disk_drops:
+            with self._lock:
+                self.removed_blocks_total += len(disk_drops)
+            self._emit("removed", disk_drops, "none")
+        if not blocks:
+            with self._lock:
+                self.host_misses_total += 1
+            return []
+        eng = self.engine
+        # cost gate FIRST — a refused onboard must not have demoted other
+        # prefixes to make room for nothing
+        if not self.gate.should_onboard(len(blocks)):
+            with self._lock:
+                self.gate_recompute_total += self.gate.skipped
+                self.gate.skipped = 0
+                self.host_misses_total += 1
+            return []
+        # make device room by rotating OTHER sole-owned cache entries down
+        # a tier (they demote, not die — the incoming prefix is the hot
+        # one); the chain's own hashes are protected from eviction, and
+        # whatever room can't be made truncates the onboard
+        free = eng.allocator.free_pages
+        if len(blocks) > free and eng.prefix_cache is not None:
+            eng.prefix_cache.evict(len(blocks) - free,
+                                   protect=frozenset(hashes))
+            free = eng.allocator.free_pages
+        if len(blocks) > free:
+            blocks = blocks[:free]
+        if not blocks:
+            with self._lock:
+                self.host_misses_total += 1
+            return []
+        span = self._span("kvbm.onboard", blocks=len(blocks), source=source)
+        try:
+            import jax.numpy as jnp
+
+            pages = eng.allocator.alloc(len(blocks))
+            width = _pad_pow2(len(blocks))
+            idx = np.zeros((width,), np.int32)  # pad rows scatter onto trash
+            idx[:len(pages)] = pages
+            k_new = np.zeros((self.block_shape[0], width) + self.block_shape[1:],
+                             self._np_dtype)
+            v_new = np.zeros_like(k_new)
+            for i, (_, kb, vb) in enumerate(blocks):
+                k_new[:, i] = kb
+                v_new[:, i] = vb
+            eng.k_pages, eng.v_pages = eng._import(
+                eng.k_pages, eng.v_pages, jnp.asarray(idx),
+                jnp.asarray(k_new), jnp.asarray(v_new),
+            )
+            out = [(h, p) for (h, _, _), p in zip(blocks, pages)]
+            with self._lock:
+                self.host_hits_total += 1
+                self.host_hit_blocks_total += len(out)
+                self.onboarded_blocks_total += len(out)
+                if source == "peer":
+                    self.peer_onboarded_blocks_total += len(out)
+            self._emit("stored", [h for h, _ in out], "device")
+            span.set_attribute("onboarded", len(out))
+            return out
+        except Exception:
+            log.exception("kvbm onboard failed; falling back to recompute")
+            span.set_status("ERROR", "onboard failed")
+            return []
+        finally:
+            span.end()
+
+    def _fetch_from_peer(self, hashes: List[bytes]
+                         ) -> List[Tuple[bytes, np.ndarray, np.ndarray]]:
+        """Cross-worker onboard: pull the prefix blocks from a peer's host
+        tier over the transfer plane instead of re-prefilling. Fetch
+        failures mean recompute, never a request failure."""
+        try:
+            got = self.peer_fetch(hashes)
+        except Exception as e:
+            log.warning("kvbm peer fetch failed (%s); recomputing", e)
+            return []
+        out = []
+        for h, (kb, vb) in zip(hashes, got):
+            if kb.shape != self.block_shape or kb.dtype != self._np_dtype:
+                log.warning("kvbm peer block layout mismatch "
+                            "(%s/%s vs %s/%s); recomputing",
+                            kb.shape, kb.dtype, self.block_shape,
+                            self._np_dtype)
+                return []
+            out.append((h, kb, vb))
+        return out
+
+    # --------------------------------------------------------------- stats --
+    def notify_stored(self, hashes: List[bytes]) -> None:
+        """PrefixCache.insert hook: freshly published device blocks."""
+        self._emit("stored", hashes, "device")
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "host_hits_total": self.host_hits_total,
+                "host_hit_blocks_total": self.host_hit_blocks_total,
+                "host_misses_total": self.host_misses_total,
+                "demoted_blocks_total": self.demoted_blocks_total,
+                "onboarded_blocks_total": self.onboarded_blocks_total,
+                "peer_onboarded_blocks_total": self.peer_onboarded_blocks_total,
+                "removed_blocks_total": self.removed_blocks_total,
+                "gate_recompute_total": self.gate_recompute_total,
+            }
+        out["host_pool"] = self.pool.stats()
+        out["gate"] = self.gate.explain(1)
+        return out

@@ -176,6 +176,17 @@ def _container(
                 "value": f"http://{frontend or dgd_name + '-frontend'}:{FRONTEND_PORT}",
             }
         )
+        # KVBM host-tier sizing (dynamo_tpu.kvbm): the worker CLI reads
+        # these envs as its --kvbm-host-blocks/--kvbm-disk-dir defaults,
+        # so manifests size the tier without touching container args.
+        # Host-RAM cost = blocks * bytes/page — pair kvbmHostBlocks with a
+        # matching resources.limits.memory bump.
+        if spec.get("kvbmHostBlocks") is not None:
+            env.append({"name": "DYNAMO_TPU_KVBM_HOST_BLOCKS",
+                        "value": str(spec["kvbmHostBlocks"])})
+        if spec.get("kvbmDiskDir"):
+            env.append({"name": "DYNAMO_TPU_KVBM_DISK_DIR",
+                        "value": str(spec["kvbmDiskDir"])})
     for e in spec.get("envs") or []:
         env.append(dict(e))
     c["env"] = env

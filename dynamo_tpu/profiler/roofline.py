@@ -130,6 +130,28 @@ class Estimate:
         return True
 
 
+def kvbm_restore_seconds(n_bytes: float, bytes_per_s: float,
+                         overhead_s: float = 0.0005) -> float:
+    """Time to restore demoted KV blocks onto the device: bytes over the
+    host<->device link plus one scatter-dispatch overhead. One side of the
+    KVBM onboard gate (kvbm/cost_model.py)."""
+    return overhead_s + n_bytes / max(bytes_per_s, 1.0)
+
+
+def kvbm_recompute_seconds(cfg: ModelConfig, n_tokens: int,
+                           chip_flops: float,
+                           n_dispatches: int = 1,
+                           mfu: float = MFU_PREFILL) -> float:
+    """Time to RECOMPUTE a cached prefix instead of restoring it: the
+    compute-bound prefill roofline for `n_tokens` (linear term only — the
+    quadratic attention term would only widen restore's win) plus the
+    per-chunk dispatch overhead of the chunked-prefill path that would
+    actually run. The other side of the KVBM onboard gate."""
+    flops = 2.0 * active_param_count(cfg) * n_tokens
+    return (n_dispatches * DISPATCH_OVERHEAD_S
+            + flops / max(chip_flops * mfu, 1.0))
+
+
 def _allreduce_time(bytes_per_device: float, tp: int, sys: SystemSpec) -> float:
     """Ring all-reduce over ICI: 2*(tp-1)/tp of the buffer crosses each link."""
     if tp <= 1:

@@ -297,7 +297,7 @@ class ServingContext:
     """Everything the request handlers need, bundled for the handler class."""
 
     def __init__(self, engine: Engine, served_model: str,
-                 prefill_urls=None, frontend_url=None):
+                 prefill_urls=None, frontend_url=None, kvbm_peers=None):
         self.engine = engine
         self.service = EngineService(engine)
         self.served_model = served_model
@@ -306,6 +306,49 @@ class ServingContext:
         self.kv_gauge = Gauge(
             "dynamo_worker_kv_free_pages", "Free KV pages", self.metrics.registry
         )
+        # --- KVBM tiered block manager (dynamo_tpu.kvbm) ---
+        self.kv_event_publisher = None  # attached by the worker entrypoint
+        self.kvbm_source = None  # peer-pull server over the transfer plane
+        if engine.kvbm is not None:
+            self.engine.kvbm.tracer = None  # set below with the tracer
+            from dynamo_tpu.serving.metrics import CallbackCounter
+
+            kvbm = engine.kvbm
+            for name, help_, attr in (
+                ("dynamo_kvbm_host_hits_total",
+                 "Prefix lookups served from the KVBM host/disk tier",
+                 "host_hits_total"),
+                ("dynamo_kvbm_host_misses_total",
+                 "Prefix lookup tails the KVBM tiers could not serve",
+                 "host_misses_total"),
+                ("dynamo_kvbm_demoted_blocks_total",
+                 "KV blocks demoted from device to the host tier",
+                 "demoted_blocks_total"),
+                ("dynamo_kvbm_onboarded_blocks_total",
+                 "KV blocks onboarded back onto the device",
+                 "onboarded_blocks_total"),
+                ("dynamo_kvbm_peer_onboarded_blocks_total",
+                 "KV blocks onboarded from a peer worker's host tier",
+                 "peer_onboarded_blocks_total"),
+                ("dynamo_kvbm_removed_blocks_total",
+                 "KV blocks dropped from every tier",
+                 "removed_blocks_total"),
+                ("dynamo_kvbm_gate_recompute_total",
+                 "Onboards skipped because recompute beat restore",
+                 "gate_recompute_total"),
+            ):
+                CallbackCounter(name, help_, self.metrics.registry,
+                                (lambda k=kvbm, a=attr: getattr(k, a)))
+            self.kvbm_blocks_gauge = Gauge(
+                "dynamo_kvbm_host_blocks",
+                "KVBM host-pool occupancy by state", self.metrics.registry)
+            from dynamo_tpu.transfer.kv_transfer import HostTierSource
+
+            self.kvbm_source = HostTierSource(kvbm)
+            log.info("kvbm host tier serving peers on port %d",
+                     self.kvbm_source.port)
+            if kvbm_peers:
+                self._wire_kvbm_peers(kvbm, kvbm_peers)
         self.staged_kv_gauge = None  # registered with DeviceKVSource below
         self.preempt_gauge = Gauge(
             "dynamo_worker_preempted_sequences",
@@ -318,6 +361,10 @@ class ServingContext:
         # land in the process-global ring buffer behind GET /debug/spans
         self.tracer = obs_tracing.Tracer(
             f"worker-{engine.cfg.disaggregation_mode or 'agg'}")
+        if engine.kvbm is not None:
+            # kvbm.offload / kvbm.onboard spans land in this worker's ring
+            # buffer (GET /debug/spans) like every other worker span
+            engine.kvbm.tracer = self.tracer
 
         # --- disaggregation wiring (mirrors the reference's role flags,
         # /root/reference/examples/deploy/sglang/disagg.yaml:45-52) ---
@@ -351,6 +398,54 @@ class ServingContext:
                 self, PrefillPool(prefill_urls, frontend_url)
             )
 
+    def _wire_kvbm_peers(self, kvbm, peers) -> None:
+        """Cross-worker onboard: on a host-tier miss, try each configured
+        peer's host tier over the transfer plane (kv_transfer.fetch_host_
+        blocks) before falling back to recompute."""
+        from dynamo_tpu.transfer.kv_transfer import fetch_host_blocks
+
+        parsed = []
+        for p in peers:
+            host, _, port = p.strip().rpartition(":")
+            if host and port.isdigit():
+                parsed.append((host, int(port)))
+        if not parsed:
+            return
+
+        def peer_fetch(hashes):
+            hexes = [h.hex() for h in hashes]
+            for host, port in parsed:
+                try:
+                    got = fetch_host_blocks(host, port, hexes)
+                except (ConnectionError, OSError, TimeoutError) as e:
+                    log.debug("kvbm peer %s:%d unreachable: %s",
+                              host, port, e)
+                    continue
+                if got:
+                    return got
+            return []
+
+        kvbm.peer_fetch = peer_fetch
+        log.info("kvbm cross-worker onboard enabled: %d peer(s)",
+                 len(parsed))
+
+    def register_kv_route(self, prompt_token_ids, routing_text: str) -> None:
+        """Feed the KV event publisher one request's (token-chain,
+        text-chain) association — `routing_text` must be the canonical
+        text the FRONTEND hashes for routing (completions: the prompt
+        string; chat: json.dumps(messages)). No-op without a publisher."""
+        if self.kv_event_publisher is None:
+            return
+        try:
+            self.kv_event_publisher.register(
+                prompt_token_ids, routing_text, self.engine.cfg.page_size)
+        except Exception:
+            log.exception("kv route registration failed")
+
+    def attach_kv_event_publisher(self, publisher) -> None:
+        self.kv_event_publisher = publisher
+        self.engine.set_kv_event_sink(publisher.on_engine_event)
+
     def capture_trace(self, duration_s: float) -> bytes:
         """Capture a jax.profiler trace for `duration_s` and return it as a
         zip of the trace directory (XProf/TensorBoard-loadable). The
@@ -383,6 +478,8 @@ class ServingContext:
     def close(self):
         if self.kv_source is not None:
             self.kv_source.close()
+        if self.kvbm_source is not None:
+            self.kvbm_source.close()
         self.service.close()
 
     def start_generation(self, rid, prompt_ids, params, index: int = 0,
@@ -458,6 +555,12 @@ class _Handler(JsonHTTPHandler):
         elif path == "/metrics":
             self.ctx.preempt_gauge.set(
                 self.ctx.engine.metrics.num_preempted)
+            if self.ctx.engine.kvbm is not None:
+                pool = self.ctx.engine.kvbm.pool.stats()
+                self.ctx.kvbm_blocks_gauge.set(pool["used_blocks"],
+                                               state="used")
+                self.ctx.kvbm_blocks_gauge.set(pool["capacity_blocks"],
+                                               state="capacity")
             ds = self.ctx.kv_device_source
             if ds is not None:
                 # scrape-time refresh: leaked > 0 flags a decode peer that
@@ -517,6 +620,13 @@ class _Handler(JsonHTTPHandler):
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 out["prefix_cache"] = pc.stats()
+            if eng.kvbm is not None:
+                out["kvbm"] = eng.kvbm.stats()
+                if self.ctx.kvbm_source is not None:
+                    out["kvbm"]["peer_port"] = self.ctx.kvbm_source.port
+                if self.ctx.kv_event_publisher is not None:
+                    out["kvbm"]["events"] = (
+                        self.ctx.kv_event_publisher.stats())
             dc = self.ctx.disagg_client
             if dc is not None:
                 # which KV plane requests ACTUALLY used (an ici deployment
@@ -737,6 +847,12 @@ class _Handler(JsonHTTPHandler):
         prompt_text = self.ctx.tokenizer.apply_chat_template(
             p["messages"], tools=tools if tc != "none" else None)
         prompt_ids = self.ctx.tokenizer.encode(prompt_text)
+        # KV event plane: associate this request's token-block chain with
+        # the canonical text the frontend's router hashed (json.dumps of
+        # the messages — serving/frontend.py builds the same string)
+        import json as _json
+
+        self.ctx.register_kv_route(prompt_ids, _json.dumps(p["messages"]))
         rid = proto.new_id("chatcmpl")
         self._span.set_attribute("request.id", rid)
         handles = self.ctx.start_choices(  # may raise -> 400
@@ -841,6 +957,9 @@ class _Handler(JsonHTTPHandler):
         p = proto.parse_completion_request(body)
         self._check_model(p["model"])
         prompt_ids = self.ctx.tokenizer.encode(p["prompt"])
+        # KV event plane: the frontend routes completions on the raw
+        # prompt string — the same canonical text registered here
+        self.ctx.register_kv_route(prompt_ids, p["prompt"])
         rid = proto.new_id("cmpl")
         self._span.set_attribute("request.id", rid)
         handles = self.ctx.start_choices(rid, prompt_ids, p,

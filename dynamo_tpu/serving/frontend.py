@@ -76,6 +76,22 @@ class FrontendContext:
             self.metrics.registry,
         )
         self.router.ledger_counter = self.ledger_counter
+        # --- KV event plane (dynamo_tpu.kvbm.events) ---
+        self.kv_index_counter = Counter(
+            "dynamo_frontend_kv_event_index_routed_total",
+            "Requests routed by the worker-published KV event index",
+            self.metrics.registry,
+        )
+        self.router.kv_index_counter = self.kv_index_counter
+        self.kv_events_counter = Counter(
+            "dynamo_frontend_kv_events_total",
+            "Worker KV cache events received on the event plane",
+            self.metrics.registry,
+        )
+        self.kv_index_gauge = Gauge(
+            "dynamo_frontend_kv_event_index_blocks",
+            "Blocks tracked by the KV event index", self.metrics.registry,
+        )
         # --- robustness plane (docs/robustness.md) ---
         self.max_inflight = (max_inflight if max_inflight is not None
                              else _env_max_inflight())
@@ -123,6 +139,18 @@ class FrontendContext:
             from dynamo_tpu.serving.nats import NatsClient
 
             self.nats = NatsClient(nats_url, name="frontend")
+            # KV event plane: workers publish block stored/demoted/removed
+            # events; the router's KVEventIndex turns them into the
+            # primary kv_overlap routing source (ledger = fallback)
+            self.nats.subscribe("dynamo.kv_events.>", self._on_kv_event)
+
+    def _on_kv_event(self, msg) -> None:
+        try:
+            payload = json.loads(msg.data)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return
+        if self.router.kv_index.apply(payload):
+            self.kv_events_counter.inc()
 
 
 class _FrontendHandler(JsonHTTPHandler):
@@ -142,6 +170,7 @@ class _FrontendHandler(JsonHTTPHandler):
                 self._error(404, f"model {mid!r} not found", "not_found")
         elif path == "/metrics":
             ctx.worker_gauge.set(len(ctx.router.alive(("agg", "prefill", "decode"))))
+            ctx.kv_index_gauge.set(ctx.router.kv_index.stats()["entries"])
             with ctx._inflight_lock:
                 ctx.metrics.queued.set(ctx._inflight)
             # breaker state is scrape-time truth (open->half_open happens

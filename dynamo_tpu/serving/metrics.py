@@ -78,6 +78,27 @@ class _CounterChild:
             )
 
 
+class CallbackCounter(_Metric):
+    """Counter whose value is read from a callback at scrape time — for
+    monotonic counts that live in another subsystem's own bookkeeping
+    (e.g. the engine KVBM's block counters) without double-counting or
+    cross-thread increment plumbing."""
+
+    kind = "counter"
+
+    def __init__(self, name, help_, registry, fn):
+        super().__init__(name, help_, registry)
+        self._fn = fn
+
+    def expose(self) -> List[str]:
+        try:
+            v = float(self._fn())
+        except Exception:
+            v = 0.0
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} counter", f"{self.name} {v}"]
+
+
 class Gauge(_Metric):
     kind = "gauge"
 

@@ -150,6 +150,93 @@ class PrefixLedger:
         return None, 0
 
 
+class KVEventIndex:
+    """Per-worker global prefix index built from worker-published KV events
+    (dynamo_tpu.kvbm.events): block-hash -> {worker url -> tier}. Unlike
+    the PrefixLedger — which only remembers where THIS frontend routed —
+    the index reflects what workers actually hold (including blocks other
+    frontend replicas routed, and blocks demoted to a worker's host tier),
+    so it is pick()'s primary kv_overlap source; the ledger stays as the
+    fallback when the event plane is down or cold.
+
+    Event types: `stored` / `demoted` keep a block routable (device and
+    host tiers both serve it — host onboards on lookup); `removed` drops
+    the worker's claim. LRU-capped like the ledger."""
+
+    def __init__(self, cap: int = 131072):
+        import collections
+
+        self.cap = cap
+        self._m: "collections.OrderedDict[str, Dict[str, str]]" = (
+            collections.OrderedDict())
+        self._lock = threading.Lock()
+        self.events_applied = 0
+
+    def apply(self, payload: Dict) -> bool:
+        """Apply one worker-published event payload (already-parsed JSON).
+        Malformed payloads are dropped (False) — the plane is advisory."""
+        try:
+            kind = payload["type"]
+            worker = payload["worker"]
+            model = payload.get("model", "?")
+            blocks = payload["blocks"]
+            tier = payload.get("tier", "device")
+        except (KeyError, TypeError):
+            return False
+        if kind not in ("stored", "demoted", "removed") or not isinstance(
+                blocks, list):
+            return False
+        with self._lock:
+            for b in blocks:
+                key = model + "|" + str(b)
+                holders = self._m.get(key)
+                if kind == "removed":
+                    if holders is not None:
+                        holders.pop(worker, None)
+                        if not holders:
+                            del self._m[key]
+                    continue
+                if holders is None:
+                    holders = self._m[key] = {}
+                else:
+                    self._m.move_to_end(key)
+                holders[worker] = tier
+            while len(self._m) > self.cap:
+                self._m.popitem(last=False)
+            self.events_applied += 1
+        return True
+
+    def drop_worker(self, url: str) -> None:
+        """Forget a departed worker's claims (deregister/TTL purge)."""
+        with self._lock:
+            dead = [k for k, holders in self._m.items()
+                    if holders.pop(url, None) is not None and not holders]
+            for k in dead:
+                del self._m[k]
+
+    def lookup(self, model: str, chain: List[str], live_urls
+               ) -> Tuple[Optional[str], int]:
+        """Deepest block held by a live worker. Ties at equal depth go to
+        the worker with the most headroom (live_urls maps url ->
+        WorkerInfo). Returns (url, depth); (None, 0) on no match."""
+        with self._lock:
+            for depth in range(len(chain), 0, -1):
+                holders = self._m.get(model + "|" + chain[depth - 1])
+                if not holders:
+                    continue
+                alive = [u for u in holders if u in live_urls]
+                if not alive:
+                    continue
+                best = max(alive, key=lambda u: live_urls[u].headroom)
+                return best, depth
+        return None, 0
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"entries": len(self._m),
+                    "events_applied": self.events_applied}
+
+
 class Router:
     def __init__(self, heartbeat_ttl: float = 15.0,
                  breakers: Optional[BreakerBoard] = None):
@@ -157,6 +244,11 @@ class Router:
         self._workers: Dict[str, WorkerInfo] = {}
         self._lock = threading.Lock()
         self._ledger = PrefixLedger()
+        # KV event index (kvbm event plane): the PRIMARY kv_overlap source
+        # when workers publish events; the ledger is the fallback
+        self.kv_index = KVEventIndex()
+        self.kv_index_hits = 0
+        self.kv_index_counter = None  # optional metrics Counter
         self.ledger_hits = 0  # observability: KV-overlap routed requests
         # optional metrics Counter, inc'd at the routing decision itself
         # (under the router lock — scrape-time delta math would race
@@ -195,6 +287,7 @@ class Router:
     def deregister(self, url: str):
         with self._lock:
             self._workers.pop(url, None)
+        self.kv_index.drop_worker(url)
 
     def alive(self, roles=("agg", "decode"), model: Optional[str] = None
               ) -> List[WorkerInfo]:
@@ -220,6 +313,8 @@ class Router:
             self.expired_total += len(dead)
             if dead and self.expired_counter is not None:
                 self.expired_counter.inc(len(dead))
+        for u in dead:
+            self.kv_index.drop_worker(u)
         return len(dead)
 
     def models(self) -> List[str]:
@@ -272,8 +367,15 @@ class Router:
         chain = text_block_chain(prompt_text) if prompt_text else []
         if chain:
             live = {w.url: w for w in cands}
-            with self._lock:
-                url, depth = self._ledger.lookup(model, chain, live)
+            # PRIMARY: the worker-published KV event index — real cache
+            # contents (kvbm event plane), not this frontend's routing
+            # history; the ledger covers cold/indexless prefixes
+            url, depth = self.kv_index.lookup(model, chain, live)
+            source = "kv_event_index"
+            if url is None:
+                with self._lock:
+                    url, depth = self._ledger.lookup(model, chain, live)
+                source = "kv_overlap_ledger"
             # the ratio denominator uses the TRUE prompt length (capped at
             # the chain window) so a prompt longer than the hashed window
             # cannot make a long shared template look like majority
@@ -287,11 +389,16 @@ class Router:
                     and depth * 10 >= 6 * denom
                     and live[url].headroom >= 0.05):
                 with self._lock:
-                    self.ledger_hits += 1
-                    if self.ledger_counter is not None:
-                        self.ledger_counter.inc()
+                    if source == "kv_event_index":
+                        self.kv_index_hits += 1
+                        if self.kv_index_counter is not None:
+                            self.kv_index_counter.inc()
+                    else:
+                        self.ledger_hits += 1
+                        if self.ledger_counter is not None:
+                            self.ledger_counter.inc()
                     self._ledger.record(model, chain, url)
-                explain["source"] = "kv_overlap_ledger"
+                explain["source"] = source
                 explain["headroom"] = round(live[url].headroom, 4)
                 return self._finish_pick(live[url], explain)
         picked = _pick_native(affinity_key, cands)

@@ -95,6 +95,9 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                 "free_pages": eng.allocator.free_pages,
                 "total_pages": eng.cfg.num_pages,
                 "max_num_seqs": eng.cfg.max_num_seqs,
+                **({"kvbm_host_blocks": eng.cfg.kvbm_host_blocks,
+                    "kvbm_peer_port": ctx.kvbm_source.port}
+                   if ctx.kvbm_source is not None else {}),
             },
         }).encode()
         try:
@@ -122,6 +125,11 @@ def build_parser(backend_name: str) -> argparse.ArgumentParser:
     p.add_argument("--nats-url", default=os.environ.get("NATS_URL"),
                    help="NATS server URL: serve requests over the NATS "
                         "request plane in addition to HTTP")
+    p.add_argument("--kvbm-peers", default=os.environ.get("KVBM_PEERS"),
+                   help="comma-separated host:port peers whose KVBM host "
+                        "tiers this worker may onboard prefix blocks from "
+                        "(the cross-worker KV pull; ports from peers' "
+                        "/worker/stats kvbm.peer_port)")
     p.add_argument("--coordinator", default=None,
                    help="jax.distributed coordinator host:port (multi-host "
                         "gang; the Grove-multinode analogue)")
@@ -194,6 +202,7 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
         engine, cfg.served_name,
         prefill_urls=(args.prefill_url.split(",") if args.prefill_url else None),
         frontend_url=args.frontend_url,
+        kvbm_peers=(args.kvbm_peers.split(",") if args.kvbm_peers else None),
     )
     srv = make_server(ctx, args.host, args.port)
 
@@ -232,6 +241,19 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
             )
         except OSError as e:
             log.warning("NATS plane unavailable (%s); HTTP only", e)
+        if nats_plane is not None and engine.prefix_cache is not None:
+            # KV event plane: publish block stored/demoted/removed events
+            # so the frontend's router can index this worker's real cache
+            # contents (rides the request plane's NATS connection)
+            from dynamo_tpu.kvbm.events import KVEventPublisher
+
+            ctx.attach_kv_event_publisher(KVEventPublisher(
+                nats_plane.nc,
+                _self_url(args.host, srv.server_address[1]),
+                cfg.served_name,
+            ))
+            log.info("kv event plane publishing on %s",
+                     ctx.kv_event_publisher.subject)
 
     stop = threading.Event()
     hb_thread = None

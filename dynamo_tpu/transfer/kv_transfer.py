@@ -140,6 +140,97 @@ def fetch_kv(host: str, port: int, request_id: str
         conn.close()
 
 
+# ----------------------------------------------------------- KVBM host tier --
+# Cross-worker onboard (dynamo_tpu.kvbm): on a disagg or failover miss a
+# worker pulls demoted prefix BLOCKS from a peer's host tier over this same
+# TCP plane instead of re-prefilling them. One connection per pull; the key
+# namespace ("kvbm") keeps it off the per-request parked-KV protocol above.
+
+KVBM_KEY = "kvbm"
+
+
+class HostTierSource:
+    """Serves a worker's KVBM host-tier blocks to pulling peers.
+
+    Wire: peer connects with key "kvbm", sends one JSON message
+    {"blocks": [hex hash, ...]}; the source answers a JSON header
+    {"found": n, "shape": [...], "dtype": "..."} for the longest
+    consecutive-from-the-start run it holds, then n (k, v) raw-byte
+    message pairs. Blocks are copied out of the pool under its lock, so
+    concurrent demotes/LRU evictions can't tear a served block."""
+
+    def __init__(self, kvbm, port: int = 0):
+        self.kvbm = kvbm
+        self.listener = transport.Listener(port)
+        self.port = self.listener.port
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="kvbm-host-tier")
+        self._thread.start()
+
+    def close(self):
+        self._stop = True
+        self.listener.close()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, key = self.listener.accept(timeout_ms=500)
+            except TimeoutError:
+                continue
+            except Exception:
+                if self._stop:
+                    return
+                log.exception("kvbm host-tier accept failed")
+                continue
+            if key != KVBM_KEY:
+                conn.close()
+                continue
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: transport.Connection):
+        try:
+            req = json.loads(conn.recv_msg(max_len=1 << 20))
+            hashes = [bytes.fromhex(h) for h in req.get("blocks", [])]
+            blocks = []
+            for h in hashes:
+                got = self.kvbm.pool.get(h)
+                if got is None:
+                    break
+                blocks.append(got)
+            header = {"found": len(blocks)}
+            if blocks:
+                header["shape"] = list(blocks[0][0].shape)
+                header["dtype"] = _dtype_name(blocks[0][0])
+            conn.send_msg(json.dumps(header).encode())
+            for k, v in blocks:
+                conn.send_msg(_tobytes(k))
+                conn.send_msg(_tobytes(v))
+        except Exception:
+            log.exception("kvbm host-tier pull failed")
+        finally:
+            conn.close()
+
+
+def fetch_host_blocks(host: str, port: int, hashes_hex
+                      ) -> "list[Tuple[np.ndarray, np.ndarray]]":
+    """Pull host-tier blocks from a peer. Returns the consecutive-from-the-
+    start run the peer held, as (k, v) numpy pairs in host-pool layout."""
+    conn = transport.connect(host, port, KVBM_KEY)
+    try:
+        conn.send_msg(json.dumps({"blocks": list(hashes_hex)}).encode())
+        header = json.loads(conn.recv_msg(max_len=1 << 16))
+        out = []
+        for _ in range(int(header.get("found", 0))):
+            k = _frombytes(conn.recv_msg(), header["dtype"], header["shape"])
+            v = _frombytes(conn.recv_msg(), header["dtype"], header["shape"])
+            out.append((k, v))
+        return out
+    finally:
+        conn.close()
+
+
 # ------------------------------------------------------- device-buffer plane --
 # Cross-PROCESS leg of the "ici" backend: when prefill and decode engines
 # are colocated on one slice but in different processes (the reference's

@@ -1,0 +1,59 @@
+#!/usr/bin/env python3
+"""KVBM CI gate (`make kvbm-check`): run the deterministic long-shared-
+prefix bench scenario and assert the host tier actually did its job —
+a NONZERO host-tier hit ratio and a turn-2 mean TTFT no worse than the
+tier-off run of the identical workload. Prints the bench line on success
+so the gate's evidence lands in CI logs."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["BENCH_SCENARIO"] = "long_shared_prefix"
+    env.setdefault("BENCH_FORCE_CPU", "1")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=900,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+        print("kvbm-check: bench.py failed", file=sys.stderr)
+        return 1
+    line = proc.stdout.strip().splitlines()[-1]
+    res = json.loads(line)
+    on, off = res["tier_on"], res["tier_off"]
+    failures = []
+    if on.get("host_hits_total", 0) <= 0:
+        failures.append("host tier served ZERO lookups "
+                        f"(host_hits_total={on.get('host_hits_total')})")
+    if on.get("host_hit_ratio", 0) <= 0:
+        failures.append(f"host_hit_ratio={on.get('host_hit_ratio')} not > 0")
+    if on.get("demoted_blocks_total", 0) <= 0:
+        failures.append("no blocks were demoted — the workload did not "
+                        "overflow the device cache")
+    if on["ttft_turn2_mean_ms"] > off["ttft_turn2_mean_ms"]:
+        failures.append(
+            f"turn-2 TTFT with the tier ON ({on['ttft_turn2_mean_ms']}ms) "
+            f"is WORSE than OFF ({off['ttft_turn2_mean_ms']}ms)")
+    if failures:
+        print(line)
+        for f in failures:
+            print(f"kvbm-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print(line)
+    print(f"kvbm-check OK: hit_ratio={on['host_hit_ratio']} "
+          f"turn2 TTFT {on['ttft_turn2_mean_ms']}ms (tier on) vs "
+          f"{off['ttft_turn2_mean_ms']}ms (tier off), "
+          f"speedup {res['ttft_turn2_speedup']}x")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,36 +31,44 @@ import pytest  # noqa: E402
 # (the `make test` fast tier) completes in minutes while `make test-full`
 # still runs everything.
 _HEAVY_FILE = os.path.join(os.path.dirname(__file__), "compile_heavy.txt")
+# measured slowest tier-1 offenders, demoted to `slow` so the tier-1 gate
+# (`-m "not slow"`) finishes inside its harness timeout; still in test-full
+_SLOW_TIER_FILE = os.path.join(os.path.dirname(__file__), "slow_tier.txt")
 
 
-def _load_heavy_ids():
+def _load_ids(path):
     try:
-        with open(_HEAVY_FILE) as f:
-            return {ln.strip() for ln in f
+        with open(path) as f:
+            return {ln.split(" #")[0].strip() for ln in f
                     if ln.strip() and not ln.startswith("#")}
     except OSError:
         return set()
 
 
 def pytest_collection_modifyitems(config, items):
-    heavy = _load_heavy_ids()
-    matched = set()
-    for item in items:
-        if item.nodeid in heavy:
-            matched.add(item.nodeid)
-            item.add_marker(pytest.mark.compile_heavy)
-    # staleness guard: a renamed/re-parametrized test silently dropping out
-    # of the tier would regress the fast `make test` target with no signal.
-    # Only meaningful on full-suite collections — a path-scoped run (e.g.
-    # `pytest tests/test_ops.py`) legitimately collects none of the others.
-    stale = heavy - matched
-    if stale and len(items) > 200:
-        import warnings
+    tiers = [(_load_ids(_HEAVY_FILE), pytest.mark.compile_heavy,
+              "tests/compile_heavy.txt"),
+             (_load_ids(_SLOW_TIER_FILE), pytest.mark.slow,
+              "tests/slow_tier.txt")]
+    for ids, marker, label in tiers:
+        matched = set()
+        for item in items:
+            if item.nodeid in ids:
+                matched.add(item.nodeid)
+                item.add_marker(marker)
+        # staleness guard: a renamed/re-parametrized test silently dropping
+        # out of the tier would regress the fast `make test` target (or
+        # re-bloat tier-1) with no signal. Only meaningful on full-suite
+        # collections — a path-scoped run (e.g. `pytest tests/test_ops.py`)
+        # legitimately collects none of the others.
+        stale = ids - matched
+        if stale and len(items) > 200:
+            import warnings
 
-        warnings.warn(
-            f"tests/compile_heavy.txt has {len(stale)} entr(y/ies) matching "
-            f"no collected test (renamed or removed?): "
-            f"{sorted(stale)[:5]}", stacklevel=1)
+            warnings.warn(
+                f"{label} has {len(stale)} entr(y/ies) matching "
+                f"no collected test (renamed or removed?): "
+                f"{sorted(stale)[:5]}", stacklevel=1)
 
 
 @pytest.fixture(scope="session")

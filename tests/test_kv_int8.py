@@ -59,22 +59,60 @@ def _gen(kvd, **kw):
     return toks, eng
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="ISSUE 2 triage: exact greedy parity between fp and int8 KV is "
-    "weights/PRNG dependent — tiny-debug's random init differs across jax "
-    "builds, and on jax 0.4.37/CPU one logit gap lands inside the int8 "
-    "half-step (diverges at token 6). The roundtrip error-bound test above "
-    "pins the quantizer itself; parity holds on the builds the suite was "
-    "authored against.")
-def test_engine_int8_kv_matches_fp_kv_greedy():
-    # tiny-model logit gaps dwarf the KV quantization error, so greedy
-    # tokens must match exactly here (larger models may diverge slightly —
-    # that is the accepted quantization trade)
-    a, _ = _gen("auto")
-    b, eng = _gen("int8")
+# a greedy flip caused by int8 KV quantization can only happen between
+# near-tie logits: the attention-output perturbation is bounded by the
+# int8 half-step (~1/254 of the per-(token,head) amax, plus the bf16
+# scale rounding), which propagates to a logit wobble far below this
+# bound on any build. A genuine quantizer bug (wrong scale lane, shifted
+# block) produces gaps orders of magnitude larger.
+INT8_KV_LOGIT_TOL = 0.05  # nats; observed near-tie gaps are ~0.003
+
+
+def _gen_with_logprobs(kvd):
+    """Greedy stream with per-token top-5 logprobs (both engines)."""
+    eng = Engine(EngineConfig(model="tiny-debug", page_size=4, num_pages=64,
+                              max_num_seqs=2, max_seq_len=64,
+                              kv_cache_dtype=kvd))
+    evs = []
+    eng.add_request(GenRequest("r", [1, 2, 3, 4, 5, 6, 7, 8], max_tokens=10,
+                               temperature=0.0, ignore_eos=True, logprobs=5))
+    while eng.has_work:
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                evs.append(ev)
+    return evs, eng
+
+
+def test_engine_int8_kv_greedy_parity_within_quant_error_bound():
+    """Greedy parity up to the int8 quantization error bound (the ISSUE 2
+    triage replaced the old exact-match xfail): the streams must agree
+    until their first divergence, and a divergence is only legal where
+    BOTH engines scored the two candidate tokens within INT8_KV_LOGIT_TOL
+    of each other — i.e. a near-tie the half-step noise may flip, never a
+    real argmax change. Exact-match builds pass trivially."""
+    a, _ = _gen_with_logprobs("auto")
+    b, eng = _gen_with_logprobs("int8")
     assert eng.k_pages.dtype == jnp.int8
-    assert a == b
+    toks_fp = [e.token_id for e in a]
+    toks_q = [e.token_id for e in b]
+    for i, (x, y) in enumerate(zip(toks_fp, toks_q)):
+        if x == y:
+            continue
+        # first divergence: both runs must consider the other's choice a
+        # near-tie under their OWN distribution (top-5 covers any near-tie
+        # this tight; absence means the gap exceeded the visible window)
+        fp_top = dict(a[i].top_logprobs)
+        q_top = dict(b[i].top_logprobs)
+        assert y in fp_top, (
+            f"int8 pick {y} not within fp run's top-5 at step {i}: "
+            f"gap exceeds the quantization error bound")
+        assert x in q_top, (
+            f"fp pick {x} not within int8 run's top-5 at step {i}")
+        gap_fp = a[i].logprob - fp_top[y]
+        gap_q = b[i].logprob - q_top[x]
+        assert 0 <= gap_fp <= INT8_KV_LOGIT_TOL, (i, gap_fp)
+        assert 0 <= gap_q <= INT8_KV_LOGIT_TOL, (i, gap_q)
+        break  # contexts diverge past this point; comparison ends here
 
 
 def test_int8_kv_with_chunked_prefill_and_prefix_cache():

@@ -1,0 +1,416 @@
+"""KVBM tiered KV block manager: host pool, cost gate, demote/onboard
+round trips, cross-worker pulls, and the KV event plane (`make kvbm-check`
+runs this suite plus the long-shared-prefix bench smoke)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.kv_cache import PageAllocator, PrefixCache
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.kvbm.cost_model import OnboardGate
+from dynamo_tpu.kvbm.events import KVEventPublisher, token_block_chain
+from dynamo_tpu.kvbm.host_pool import DiskBlockTier, HostBlockPool
+from dynamo_tpu.serving.router import KVEventIndex, Router, text_block_chain
+
+pytestmark = pytest.mark.kvbm
+
+BLOCK = (2, 4, 8)  # [layers, page_size, lanes]
+
+
+def _blk(seed, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    if np.dtype(dtype).kind in "iu":
+        return rng.integers(-100, 100, size=BLOCK).astype(dtype)
+    return rng.normal(size=BLOCK).astype(dtype)
+
+
+# --------------------------------------------------------------- host pool --
+
+@pytest.mark.parametrize("dtype", ["float32", "int8", "bfloat16"])
+def test_host_pool_roundtrip_bit_exact(dtype):
+    import jax.numpy as jnp
+
+    npdt = np.dtype(jnp.dtype(dtype))
+    pool = HostBlockPool(4, BLOCK, npdt)
+    k = _blk(0).astype(npdt)
+    v = _blk(1).astype(npdt)
+    ok, removed = pool.put(b"h0", k, v)
+    assert ok and not removed
+    k2, v2 = pool.get(b"h0")
+    assert k2.tobytes() == k.tobytes() and v2.tobytes() == v.tobytes()
+    assert pool.get(b"nope") is None
+    assert pool.stats()["hits"] == 1 and pool.stats()["misses"] == 1
+
+
+def test_host_pool_lru_eviction_and_pinning():
+    pool = HostBlockPool(2, BLOCK, np.float32)
+    pool.put(b"a", _blk(0), _blk(1))
+    pool.put(b"b", _blk(2), _blk(3))
+    assert pool.pin(b"a")
+    ok, removed = pool.put(b"c", _blk(4), _blk(5))
+    # "a" is pinned -> the LRU victim must be "b"
+    assert ok and removed == [b"b"]
+    assert pool.contains(b"a") and pool.contains(b"c")
+    pool.unpin(b"a")
+    ok, removed = pool.put(b"d", _blk(6), _blk(7))
+    # "a" (inserted first, never read since) is the LRU once unpinned
+    assert ok and removed == [b"a"]
+    assert pool.contains(b"c") and pool.contains(b"d")
+
+
+def test_host_pool_all_pinned_rejects():
+    pool = HostBlockPool(1, BLOCK, np.float32)
+    pool.put(b"a", _blk(0), _blk(1))
+    pool.pin(b"a")
+    ok, removed = pool.put(b"b", _blk(2), _blk(3))
+    assert not ok and not removed
+    assert pool.stats()["rejected_full"] == 1
+
+
+def test_disk_tier_spill_and_promote(tmp_path):
+    disk = DiskBlockTier(str(tmp_path), capacity_blocks=2)
+    pool = HostBlockPool(1, BLOCK, np.float32, disk=disk)
+    ka, va = _blk(0), _blk(1)
+    pool.put(b"a", ka, va)
+    pool.put(b"b", _blk(2), _blk(3))  # "a" spills to disk, not removed
+    assert not pool.contains(b"b") or pool.contains(b"a")
+    assert disk.contains(b"a")
+    k2, v2 = pool.get(b"a")  # disk hit promotes back to RAM
+    assert k2.tobytes() == ka.tobytes() and v2.tobytes() == va.tobytes()
+    assert disk.hits == 1
+
+
+def test_disk_tier_bounded(tmp_path):
+    disk = DiskBlockTier(str(tmp_path), capacity_blocks=1)
+    pool = HostBlockPool(1, BLOCK, np.float32, disk=disk)
+    pool.put(b"a", _blk(0), _blk(1))
+    pool.put(b"b", _blk(2), _blk(3))   # a -> disk
+    _, removed = pool.put(b"c", _blk(4), _blk(5))  # b -> disk, a DROPPED
+    assert removed == [b"a"]
+    assert len(disk) == 1
+
+
+# --------------------------------------------------------------- cost gate --
+
+def test_gate_modes():
+    g = OnboardGate(mode="always")
+    assert g.should_onboard(1)
+    g = OnboardGate(mode="never")
+    assert not g.should_onboard(1) and g.skipped == 1
+    with pytest.raises(ValueError):
+        OnboardGate(mode="sometimes")
+
+
+def test_gate_auto_roofline_directions():
+    from dynamo_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig.from_model_name("llama-3.2-1b-instruct")
+    # realistic block bytes on a fast link: restore wins
+    fast = OnboardGate(mode="auto", model_cfg=cfg, block_nbytes=1 << 20,
+                       page_size=16, chip_flops=2e14, bytes_per_s=8e9)
+    assert fast.should_onboard(8)
+    # a crawling link (1 KB/s) makes recompute win
+    slow = OnboardGate(mode="auto", model_cfg=cfg, block_nbytes=1 << 20,
+                       page_size=16, chip_flops=2e14, bytes_per_s=1e3)
+    assert not slow.should_onboard(8)
+    assert slow.explain(8)["restore_s"] > fast.explain(8)["restore_s"]
+
+
+# ------------------------------------------------- engine demote / onboard --
+
+PREFIX = [(i * 7) % 290 + 1 for i in range(30)]
+
+
+def _eng(**kw):
+    base = dict(model="tiny-debug", page_size=4, num_pages=13,
+                max_num_seqs=2, max_seq_len=64, prefill_chunk_tokens=8,
+                kvbm_host_blocks=32)
+    base.update(kw)
+    return Engine(EngineConfig(**base))
+
+
+def _overflow_then_return(eng):
+    """Turn 1 caches PREFIX, an unrelated big prompt evicts (demotes) it,
+    turn 2 re-uses PREFIX. Returns (turn1_tokens, turn2_tokens)."""
+    other = [(i * 11) % 290 + 3 for i in range(30)]
+    out1 = eng.generate(GenRequest("t1", PREFIX, max_tokens=4,
+                                   temperature=0.0, ignore_eos=True))
+    eng.generate(GenRequest("fill", other, max_tokens=4, temperature=0.0,
+                            ignore_eos=True))
+    out2 = eng.generate(GenRequest("t2", PREFIX, max_tokens=4,
+                                   temperature=0.0, ignore_eos=True))
+    return out1, out2
+
+
+def test_demote_onboard_round_trip_exact():
+    eng = _eng()
+    out1, out2 = _overflow_then_return(eng)
+    st = eng.kvbm.stats()
+    assert st["demoted_blocks_total"] > 0
+    assert st["host_hits_total"] >= 1
+    assert st["onboarded_blocks_total"] > 0
+    assert out2 == out1
+    # and identical to an engine that never evicted (bit-exact round trip)
+    big = _eng(num_pages=64)
+    ref = big.generate(GenRequest("r", PREFIX, max_tokens=4,
+                                  temperature=0.0, ignore_eos=True))
+    assert out2 == ref
+
+
+def test_demote_onboard_round_trip_int8_kv():
+    eng = _eng(kv_cache_dtype="int8")
+    out1, out2 = _overflow_then_return(eng)
+    st = eng.kvbm.stats()
+    assert st["demoted_blocks_total"] > 0 and st["host_hits_total"] >= 1
+    assert out2 == out1  # quantized rows round-trip bit-exactly too
+
+
+def test_gate_never_forces_recompute():
+    eng = _eng(kvbm_gate="never")
+    out1, out2 = _overflow_then_return(eng)
+    st = eng.kvbm.stats()
+    assert st["demoted_blocks_total"] > 0  # demotion still happens
+    assert st["onboarded_blocks_total"] == 0  # but restore is refused
+    assert st["gate_recompute_total"] >= 1
+    assert out2 == out1  # recompute path stays correct
+
+
+def test_host_pool_full_falls_back_to_plain_free():
+    # pool of 2 blocks cannot hold the 4+ evicted pages: the overflow is
+    # freed exactly as before KVBM existed (and reported removed)
+    eng = _eng(kvbm_host_blocks=2)
+    out1, out2 = _overflow_then_return(eng)
+    st = eng.kvbm.stats()
+    assert st["host_pool"]["capacity_blocks"] == 2
+    assert (st["demoted_blocks_total"] + st["removed_blocks_total"]) >= 4
+    assert out2 == out1
+
+
+def test_evict_while_referenced_never_demotes_live_pages():
+    alloc = PageAllocator(32)
+    pc = PrefixCache(alloc, 4)
+
+    class Sink:
+        def __init__(self):
+            self.calls = []
+
+        def demote(self, victims):
+            self.calls.append(list(victims))
+            return 0
+
+    pc.kvbm = Sink()
+    toks = list(range(1, 18))
+    pages = alloc.alloc(5)
+    pc.insert(toks, pages)
+    alloc.free(pages)  # ownership now: cache ref only
+    got, _ = pc.lookup(toks[:17])  # a live sequence now co-owns the pages
+    evicted = pc.evict(4)
+    assert evicted == 0 and pc.kvbm.calls in ([], [[]])
+    alloc.free(got)
+    assert pc.evict(4) == 4  # sole-owned again -> eviction proceeds
+    assert len(pc.kvbm.calls[-1]) == 4
+
+
+def test_disk_tier_round_trip_through_engine(tmp_path):
+    # host pool of 2 + disk tier: demoted blocks overflow to disk and come
+    # back bit-exactly through the same lookup path
+    eng = _eng(kvbm_host_blocks=2, kvbm_disk_dir=str(tmp_path),
+               kvbm_disk_blocks=64)
+    out1, out2 = _overflow_then_return(eng)
+    st = eng.kvbm.stats()
+    assert st["host_pool"]["disk"]["used_blocks"] > 0
+    assert out2 == out1
+
+
+# ------------------------------------------------------ cross-worker pulls --
+
+def test_cross_worker_onboard_over_transfer_plane():
+    from dynamo_tpu.transfer.kv_transfer import (
+        HostTierSource, fetch_host_blocks,
+    )
+
+    src = _eng()
+    out1, _ = _overflow_then_return(src)  # src's host tier now holds PREFIX
+    assert len(src.kvbm.pool) > 0
+
+    server = HostTierSource(src.kvbm)
+    try:
+        peer = _eng(num_pages=64)  # cold worker, nothing cached
+
+        def peer_fetch(hashes):
+            return fetch_host_blocks("127.0.0.1", server.port,
+                                     [h.hex() for h in hashes])
+
+        peer.kvbm.peer_fetch = peer_fetch
+        out = peer.generate(GenRequest("x", PREFIX, max_tokens=4,
+                                       temperature=0.0, ignore_eos=True))
+        st = peer.kvbm.stats()
+        assert st["peer_onboarded_blocks_total"] > 0
+        assert out == out1  # pulled blocks decode identically
+    finally:
+        server.close()
+
+
+def test_cross_worker_pull_miss_is_harmless():
+    from dynamo_tpu.transfer.kv_transfer import HostTierSource, \
+        fetch_host_blocks
+
+    src = _eng()  # empty host tier
+    server = HostTierSource(src.kvbm)
+    try:
+        got = fetch_host_blocks("127.0.0.1", server.port, ["ab" * 32])
+        assert got == []
+    finally:
+        server.close()
+
+
+# ------------------------------------------------------------- event plane --
+
+class _RecordingNats:
+    def __init__(self):
+        self.published = []
+
+    def publish(self, subject, data, **kw):
+        self.published.append((subject, json.loads(data)))
+
+
+def test_publisher_translates_token_events_to_text_space():
+    nc = _RecordingNats()
+    pub = KVEventPublisher(nc, "http://w1:8000", "m")
+    text = "You are a helpful assistant. " * 20  # >= 8 text blocks
+    toks = list(range(1, 33))  # 8 pages of 4
+    pub.register(toks, text, page_size=4)
+    token_hashes = token_block_chain(toks, 4)
+    chain = text_block_chain(text)
+    pub.on_engine_event("stored", token_hashes, "device")
+    assert nc.published, "stored event must publish"
+    subject, payload = nc.published[-1]
+    assert subject.startswith("dynamo.kv_events.m.")
+    assert payload["type"] == "stored" and payload["worker"] == "http://w1:8000"
+    assert set(payload["blocks"]) == set(chain)
+    # removing page 4 truncates the text chain proportionally (half gone)
+    nc.published.clear()
+    pub.on_engine_event("removed", [token_hashes[4]], "none")
+    _, payload = nc.published[-1]
+    assert payload["type"] == "removed"
+    assert set(payload["blocks"]) == set(chain[len(chain) * 4 // 8:])
+
+
+def test_kv_event_index_apply_lookup_remove():
+    idx = KVEventIndex()
+    chain = text_block_chain("x" * 64 * 4)
+    assert len(chain) == 4
+
+    class W:
+        headroom = 1.0
+
+    live = {"http://a:1": W(), "http://b:1": W()}
+    idx.apply({"type": "stored", "worker": "http://a:1", "model": "m",
+               "blocks": chain, "tier": "device"})
+    url, depth = idx.lookup("m", chain, live)
+    assert url == "http://a:1" and depth == 4
+    # demoted keeps the worker routable
+    idx.apply({"type": "demoted", "worker": "http://a:1", "model": "m",
+               "blocks": chain[2:], "tier": "host"})
+    assert idx.lookup("m", chain, live) == ("http://a:1", 4)
+    # removal truncates
+    idx.apply({"type": "removed", "worker": "http://a:1", "model": "m",
+               "blocks": chain[2:], "tier": "none"})
+    assert idx.lookup("m", chain, live) == ("http://a:1", 2)
+    idx.drop_worker("http://a:1")
+    assert idx.lookup("m", chain, live) == (None, 0)
+    assert not idx.apply({"type": "bogus", "worker": "w", "blocks": []})
+
+
+def _mk_router_with_workers(n=3):
+    r = Router()
+    for i in range(n):
+        r.register(f"http://w{i}:8000", "m", "agg",
+                   {"active_seqs": 0, "max_num_seqs": 8,
+                    "free_pages": 100, "total_pages": 100})
+    return r
+
+
+def test_router_pick_prefers_kv_event_index_over_ledger():
+    r = _mk_router_with_workers()
+    turn1 = "system prompt " * 40   # ~8+ blocks
+    turn2 = turn1 + "short follow-up"
+    chain1 = text_block_chain(turn1)
+    # the EVENTS say w2 holds the prefix (e.g. another frontend routed it)
+    r.kv_index.apply({"type": "stored", "worker": "http://w2:8000",
+                      "model": "m", "blocks": chain1, "tier": "device"})
+    explain = {}
+    picked = r.pick("m", turn2[:256], prompt_text=turn2, explain=explain)
+    assert picked.url == "http://w2:8000"
+    assert explain["source"] == "kv_event_index"
+    assert r.kv_index_hits == 1
+    # with no index entry the ledger fallback still works
+    r2 = _mk_router_with_workers()
+    first = r2.pick("m", turn1[:256], prompt_text=turn1, explain={})
+    explain2 = {}
+    again = r2.pick("m", turn2[:256], prompt_text=turn2, explain=explain2)
+    assert again.url == first.url
+    assert explain2["source"] == "kv_overlap_ledger"
+
+
+def test_multi_worker_events_drive_routing_over_real_nats():
+    """End-to-end: two workers publish on a real (mini) NATS broker; the
+    frontend's subscription feeds the router index; the follow-up turn
+    routes to the publishing worker with explain.source=kv_event_index."""
+    from dynamo_tpu.serving.frontend import FrontendContext
+    from dynamo_tpu.serving.nats import MiniNatsBroker, NatsClient
+
+    broker = MiniNatsBroker()
+    ctx = None
+    w_nc = None
+    try:
+        ctx = FrontendContext(nats_url=broker.url)
+        for i in range(3):
+            ctx.router.register(f"http://w{i}:8000", "m", "agg",
+                                {"free_pages": 100, "total_pages": 100,
+                                 "max_num_seqs": 8})
+        turn1 = "A long shared conversation prefix. " * 20
+        w_nc = NatsClient(broker.url, name="worker-w1")
+        pub = KVEventPublisher(w_nc, "http://w1:8000", "m")
+        pub.publish("stored", text_block_chain(turn1), "device")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                ctx.router.kv_index.stats()["entries"] == 0:
+            time.sleep(0.02)
+        assert ctx.router.kv_index.stats()["entries"] > 0, \
+            "event never reached the frontend"
+        explain = {}
+        picked = ctx.router.pick("m", turn1[:256],
+                                 prompt_text=turn1 + " next turn",
+                                 explain=explain)
+        assert picked.url == "http://w1:8000"
+        assert explain["source"] == "kv_event_index"
+    finally:
+        if w_nc is not None:
+            w_nc.close()
+        if ctx is not None and ctx.nats is not None:
+            ctx.nats.close()
+        broker.close()
+
+
+def test_engine_pipeline_emits_events():
+    """The full worker-side pipeline: engine insert/demote/remove events
+    flow through the publisher's token->text translation."""
+    nc = _RecordingNats()
+    eng = _eng()
+    pub = KVEventPublisher(nc, "http://w0:8000", "tiny-debug")
+    eng.set_kv_event_sink(pub.on_engine_event)
+    routing_text = "a shared system prompt, long enough to hash " * 8
+    pub.register(PREFIX, routing_text, eng.cfg.page_size)
+    _overflow_then_return(eng)
+    kinds = {p["type"] for _, p in nc.published}
+    assert "stored" in kinds and "demoted" in kinds
+    blocks = set()
+    for _, p in nc.published:
+        blocks.update(p["blocks"])
+    assert blocks & set(text_block_chain(routing_text))

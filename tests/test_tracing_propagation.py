@@ -34,9 +34,12 @@ def _post_chat(base, content, headers=None, max_tokens=6):
         headers=h), timeout=120)
 
 
-def _spans_for(base, trace_id, min_spans, deadline_s=10.0):
-    """Poll /debug/spans until the trace has at least `min_spans` (span ends
-    race the response write by microseconds)."""
+def _spans_for(base, trace_id, min_spans, deadline_s=10.0, require=()):
+    """Poll /debug/spans until the trace has at least `min_spans` AND every
+    span name in `require` — span ends race the response write by
+    microseconds, and e.g. frontend.request only lands in the collector
+    AFTER the client has read the full body, so counting alone can return
+    a snapshot that satisfies min_spans from worker spans only."""
     deadline = time.monotonic() + deadline_s
     spans = []
     while time.monotonic() < deadline:
@@ -47,7 +50,8 @@ def _spans_for(base, trace_id, min_spans, deadline_s=10.0):
                  for rs in payload["resourceSpans"]
                  for ss in rs["scopeSpans"]
                  for sp in ss["spans"]]
-        if len(spans) >= min_spans:
+        if (len(spans) >= min_spans
+                and set(require) <= {sp["name"] for _, sp in spans}):
             return payload, spans
         time.sleep(0.05)
     return payload, spans
@@ -108,7 +112,11 @@ def test_disagg_trace_spans_three_components(disagg_stack):
     assert trace_id and len(trace_id) == 32, \
         "minted x-request-id should be the trace id"
 
-    payload, spans = _spans_for(frontend, trace_id, min_spans=5)
+    payload, spans = _spans_for(
+        frontend, trace_id, min_spans=5,
+        require=("frontend.request", "router.pick", "worker.request",
+                 "disagg.prefill_rpc", "disagg.kv_pull",
+                 "worker.prefill_only", "worker.decode"))
     names = {sp["name"] for _, sp in spans}
     services = {svc for svc, _ in spans}
 
@@ -177,7 +185,8 @@ def test_inbound_traceparent_honored_byte_exact(disagg_stack):
     # inbound x-request-id echoes back byte-exact
     assert resp.headers.get("X-Request-Id") == "client-rid-1"
 
-    _, spans = _spans_for(frontend, parent.trace_id, min_spans=5)
+    _, spans = _spans_for(frontend, parent.trace_id, min_spans=5,
+                          require=("frontend.request",))
     assert spans, "spans must join the CLIENT's trace id"
     by_name = {sp["name"]: sp for _, sp in spans}
     fr = by_name["frontend.request"]

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -27,6 +27,7 @@ help:
 	@echo "  trace-check    one-request /debug/spans smoke check (distributed tracing)"
 	@echo "  chaos-check    deterministic fault-injection suite (breakers, deadlines, failover)"
 	@echo "  kvbm-check     KVBM suite + long-shared-prefix bench smoke (host-tier hit ratio)"
+	@echo "  recovery-check mid-stream recovery suite (journaled continuation failover, drain handoff)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -74,6 +75,15 @@ trace-check:
 chaos-check:
 	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
 		python -m pytest tests/test_chaos.py -q -p no:randomly
+
+# Recovery gate (docs/robustness.md "Recovery semantics"): the token-
+# journaled continuation-failover suite under the same pinned fault seed
+# as chaos-check — a crash mid-decode must splice a byte-identical
+# continuation onto the client stream. Runs the slow-marked disagg
+# acceptance test too (the file is invoked directly, no marker filter).
+recovery-check:
+	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
+		python -m pytest tests/test_recovery.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

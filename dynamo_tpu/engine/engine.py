@@ -1259,11 +1259,30 @@ class Engine:
         return ev
 
     def _request_key(self, req: GenRequest):
-        """Per-request PRNG chain root: deterministic when seeded."""
+        """Per-request PRNG chain root: deterministic when seeded; a
+        resume_key (recovery/drain-handoff continuation) restores the
+        original worker's chain root bit-exactly."""
+        if req.resume_key is not None:
+            return smp.key_from_snapshot(req.resume_key)
         if req.seed is not None:
             return jax.random.PRNGKey(req.seed)
         self.rng, key = jax.random.split(self.rng)
         return key
+
+    def export_sampling_state(self, request_id: str) -> Optional[Dict]:
+        """Resumable sampling-state snapshot for a LIVE sequence: the
+        per-request PRNG chain root plus the output position. A drain
+        handoff ships this to the frontend's journal so the continuation
+        worker resumes the identical fold_in(key, position) chain —
+        exact even for unseeded sampled requests, whose root key exists
+        only in this process."""
+        for slot, seq in list(self.seqs.items()):
+            if seq.request_id == request_id:
+                return {
+                    "key": smp.key_snapshot(self.slot_keys[slot]),
+                    "n_output": len(seq.output_tokens),
+                }
+        return None
 
     def _run_prefill(self, req: GenRequest):
         """Shared prefill: bucket, allocate pages, run the jitted prefill, and

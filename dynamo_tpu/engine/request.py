@@ -47,6 +47,11 @@ class GenRequest:
     # prompt for recompute, but penalties must still count them as output
     prior_output_token_ids: List[int] = dataclasses.field(
         default_factory=list)
+    # exact PRNG chain-root restore (sampling.key_snapshot pair) for
+    # cross-worker recovery/drain handoff: when set, the request samples
+    # the identical fold_in(key, position) chain the original worker was
+    # on — even for unseeded sampled requests
+    resume_key: Optional[List[int]] = None
 
 
 @dataclasses.dataclass

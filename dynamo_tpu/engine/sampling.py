@@ -185,3 +185,21 @@ def sample_with_logprobs(
 def fold_positions(keys: jax.Array, positions: jax.Array) -> jax.Array:
     """Per-slot step keys: fold_in(slot_key, position). keys [B,2], pos [B]."""
     return jax.vmap(jax.random.fold_in)(keys, positions)
+
+
+def key_snapshot(key) -> list:
+    """Serialize a per-request PRNG chain root as its raw uint32 pair.
+
+    The root key never changes over a request's lifetime (only
+    fold_in(key, position) derives step keys), so this pair IS the
+    complete resumable sampling state: a continuation restoring it via
+    key_from_snapshot samples the identical chain from any position —
+    the recovery/drain-handoff analogue of the preemption guarantee."""
+    import numpy as np
+
+    return [int(x) for x in np.asarray(key, dtype=np.uint32).reshape(-1)[:2]]
+
+
+def key_from_snapshot(snap) -> jax.Array:
+    """Restore a chain root serialized by key_snapshot (bit-exact)."""
+    return jnp.asarray(list(snap)[:2], dtype=jnp.uint32)

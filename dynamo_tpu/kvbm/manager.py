@@ -144,6 +144,16 @@ class KVBM:
         finally:
             span.end()
 
+    def demote_all(self, prefix_cache) -> int:
+        """Graceful-drain handoff: spill EVERY sole-owned prefix page into
+        the host tier (prefix_cache.evict routes victims through demote()
+        above, which publishes `demoted` events). Surviving workers keep
+        routing on those blocks via the KV event index and onboard them
+        over the cross-worker host-tier fetch — the departing worker's
+        warm prefixes outlive the pod. Caller holds the engine exec lock.
+        Returns pages demoted/evicted."""
+        return prefix_cache.evict(prefix_cache.evictable())
+
     # ------------------------------------------------------------- onboard --
     def onboard_chain(self, hashes: List[bytes]) -> List[Tuple[bytes, int]]:
         """Restore the longest consecutive run of `hashes` available in the

@@ -29,6 +29,13 @@ from dynamo_tpu.operator.k8s_client import ApiError, K8sClient
 
 log = logging.getLogger("dynamo_tpu.operator")
 
+# drain-before-delete (hitless rollouts): a stale worker Deployment/
+# StatefulSet is first scaled to 0 — SIGTERM runs each pod's graceful
+# drain (admission off, in-flight handoff, KV demote) under the pod's
+# terminationGracePeriod — and only deleted on a later pass once its
+# pods are gone. The annotation records that phase 1 happened.
+DRAIN_ANNOTATION = f"{mat.GROUP}/drain-before-delete"
+
 
 def _yaml_load(text: str) -> Dict[str, Any]:
     try:
@@ -74,6 +81,32 @@ class Controller:
         sel = f"{mat.MANAGED_BY_LABEL}={mat.OPERATOR_NAME},{mat.NS_LABEL}={ns_label}"
         return self.k8s.list(api_version, plural, ns, label_selector=sel)
 
+    def _drain_then_delete(self, api_version: str, plural: str, ns: str,
+                           existing: Dict[str, Any]) -> None:
+        """Two-phase prune: scale a stale workload to 0 first (its pods'
+        SIGTERM drain hands in-flight requests off and demotes KV), then
+        delete once the drain has actually happened — a raw delete would
+        race the pods' grace period against the controller's cascade and
+        drop whatever was mid-stream."""
+        meta = existing["metadata"]
+        name = meta["name"]
+        ann = meta.get("annotations") or {}
+        spec_replicas = int((existing.get("spec") or {}).get("replicas")
+                            or 0)
+        live = int((existing.get("status") or {}).get("replicas") or 0)
+        if ann.get(DRAIN_ANNOTATION) and spec_replicas == 0 and live == 0:
+            log.info("pruning drained %s %s/%s", plural, ns, name)
+            self.k8s.delete(api_version, plural, ns, name)
+            return
+        if not ann.get(DRAIN_ANNOTATION) or spec_replicas != 0:
+            log.info("draining stale %s %s/%s before delete", plural, ns,
+                     name)
+            self.k8s.merge_patch(api_version, plural, ns, name, {
+                "metadata": {"annotations": {DRAIN_ANNOTATION: "true"}},
+                "spec": {"replicas": 0},
+            })
+        # else: scaled to 0, pods still terminating — revisit next pass
+
     def reconcile_dgd(self, cr: Dict[str, Any]) -> None:
         name = cr["metadata"]["name"]
         ns = self._ns(cr)
@@ -111,25 +144,22 @@ class Controller:
                 if not e.conflict:  # PVC specs are immutable; leave existing
                     raise
 
-        # prune children whose service was removed from the CR
+        # prune children whose service was removed from the CR —
+        # drain-before-delete: scale to 0 (graceful pod drain) on the
+        # first pass, delete on a later one
         want_deps = {d["metadata"]["name"] for d in desired["deployments"]}
         kept_deps = []
         for existing in self._owned("apps/v1", "deployments", ns, ns_label):
             if existing["metadata"]["name"] not in want_deps:
-                log.info("pruning stale deployment %s", existing["metadata"]["name"])
-                self.k8s.delete(
-                    "apps/v1", "deployments", ns, existing["metadata"]["name"],
-                )
+                self._drain_then_delete("apps/v1", "deployments", ns,
+                                        existing)
             else:
                 kept_deps.append(existing)
         want_sts = {s["metadata"]["name"] for s in desired["statefulsets"]}
         for existing in self._owned("apps/v1", "statefulsets", ns, ns_label):
             if existing["metadata"]["name"] not in want_sts:
-                log.info("pruning stale statefulset %s",
-                         existing["metadata"]["name"])
-                self.k8s.delete(
-                    "apps/v1", "statefulsets", ns, existing["metadata"]["name"]
-                )
+                self._drain_then_delete("apps/v1", "statefulsets", ns,
+                                        existing)
             else:
                 kept_deps.append(existing)  # joins the DGD status rollup
         want_svcs = {s["metadata"]["name"] for s in desired["services"]}

@@ -187,6 +187,12 @@ def _container(
         if spec.get("kvbmDiskDir"):
             env.append({"name": "DYNAMO_TPU_KVBM_DISK_DIR",
                         "value": str(spec["kvbmDiskDir"])})
+        # graceful-drain budget (worker SIGTERM: admission off, in-flight
+        # handoff, KV demote); _pod_spec aligns the pod's
+        # terminationGracePeriodSeconds with it so K8s never SIGKILLs a
+        # pod that is still mid-handoff
+        env.append({"name": "DRAIN_TIMEOUT_S",
+                    "value": str(drain_seconds(spec))})
     for e in spec.get("envs") or []:
         env.append(dict(e))
     c["env"] = env
@@ -208,6 +214,16 @@ def _container(
     return c
 
 
+def drain_seconds(spec: Dict[str, Any]) -> int:
+    """The manifest's graceful-drain budget (`drainSeconds`, default 30):
+    how long a SIGTERMed worker may spend finishing / handing off
+    in-flight requests and demoting KV before it stops serving."""
+    try:
+        return max(0, int(spec.get("drainSeconds", 30)))
+    except (TypeError, ValueError):
+        return 30
+
+
 def _pod_spec(
     namespace: str, dgd_name: str, svc_name: str, spec: Dict[str, Any], ctype: str,
     frontend: str = "",
@@ -215,6 +231,12 @@ def _pod_spec(
     pod: Dict[str, Any] = {
         "containers": [_container(dgd_name, svc_name, spec, ctype, frontend)]
     }
+    if ctype != "frontend":
+        # drain-before-kill: the kubelet's grace period must outlast the
+        # worker's DRAIN_TIMEOUT_S (set from the same drainSeconds in
+        # _container) plus deregister/demote margin, or rolling restarts
+        # SIGKILL pods mid-handoff
+        pod["terminationGracePeriodSeconds"] = drain_seconds(spec) + 15
     volumes = []
     for pvc in spec.get("pvcs") or []:
         # pvcs[].create: false references an existing claim

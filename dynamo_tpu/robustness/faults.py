@@ -25,8 +25,10 @@ worker.slow_prefill                     engine admission sleeps ``delay_s``
                                         (agg submit and /disagg/prefill)
 worker.crash_mid_decode                 the token stream dies after a token
                                         was already delivered; the request is
-                                        aborted engine-side (truncate, never
-                                        re-dispatch)
+                                        aborted engine-side (the frontend
+                                        splices a journaled continuation on
+                                        another worker, or truncates — never
+                                        re-runs the whole generation)
 nats.partition                          NATS publishes raise ConnectionError
                                         (frontend falls back to HTTP; worker
                                         responders fail their reply stream)
@@ -77,7 +79,8 @@ REGISTRY: Dict[str, str] = {
     "worker.slow_prefill":
         "engine admission sleeps delay_s (slow prefill)",
     "worker.crash_mid_decode":
-        "token stream dies after delivery started; request aborted",
+        "token stream dies after delivery started; request aborted "
+        "(recovery plane splices a continuation, else truncate)",
     "nats.partition":
         "NATS publishes raise ConnectionError (plane partition)",
     "disagg.prefill_connect_refused":

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 from typing import List, Optional
@@ -24,6 +25,7 @@ from dynamo_tpu.observability import tracing as obs_tracing
 from dynamo_tpu.robustness import faults
 from dynamo_tpu.robustness.deadline import Deadline
 from dynamo_tpu.serving import protocol as proto
+from dynamo_tpu.serving import recovery
 from dynamo_tpu.serving.engine_service import EngineService
 from dynamo_tpu.serving.http_base import (
     JsonHTTPHandler,
@@ -112,9 +114,24 @@ class GenerationHandle:
         self.span = trace_span if trace_span is not None \
             else obs_tracing.NOOP_SPAN
         self.deadline = deadline
-        self.prompt_ids = prompt_ids
         self.stops: List[str] = params.get("stop") or []
         self.want_logprobs = params.get("logprobs") is not None
+        # --- mid-stream recovery continuation (serving/recovery.py) ---
+        # the journaled tokens the original worker already emitted become
+        # extra PREFILL (prompt ⊕ emitted tokens) with the remaining token
+        # budget; prior_output_token_ids keeps penalties/guided replay
+        # honest and resume_key restores the exact sampling chain — the
+        # same correctness contract as preemption-by-recompute
+        self.journal_sink = None  # set by the handler on journaled streams
+        rec = params.get("_recovery") if index == 0 else None
+        self.recovery = rec
+        prior = list(rec["prior_tokens"]) if rec else []
+        self.prior_count = len(prior)
+        max_tokens = params["max_tokens"]
+        if prior:
+            prompt_ids = list(prompt_ids) + prior
+            max_tokens = max(1, max_tokens - len(prior))
+        self.prompt_ids = prompt_ids
         # each choice of an n>1 request gets its own deterministic chain
         seed = params.get("seed")
         stop_ids = list(params.get("stop_token_ids") or [])
@@ -128,7 +145,7 @@ class GenerationHandle:
         self.req = GenRequest(
             rid,
             list(prompt_ids),
-            max_tokens=params["max_tokens"],
+            max_tokens=max_tokens,
             temperature=params["temperature"],
             top_p=params["top_p"],
             top_k=params["top_k"],
@@ -142,6 +159,8 @@ class GenerationHandle:
             priority=params.get("priority", 0),
             guided_json=params.get("guided_json", False),
             stop_token_ids=stop_ids,
+            prior_output_token_ids=prior,
+            resume_key=(rec or {}).get("resume_key"),
         )
         if ctx.disagg_client is not None:
             # decode role: prefill remotely, pull KV, continue locally
@@ -214,12 +233,69 @@ class GenerationHandle:
         text_parts: List[str] = []
         n_out = 0
         finish = "stop"
+        # --- recovery journal bookkeeping (serving/recovery.py) ---
+        consumed = self.prior_count  # tokens covered by the journal
+        content_total = 0  # cumulative content chars (incl. primed text)
+        pending_journal: List[int] = []  # tokens since the last checkpoint
+
+        def checkpoint(extra: Optional[dict] = None) -> None:
+            """Flush a journal checkpoint BEFORE the delta it covers goes
+            on the wire — the journal may run ahead of delivery, never
+            behind, which is the exactly-once seam invariant."""
+            nonlocal pending_journal
+            entry = {"n": consumed, "c": content_total, "t": pending_journal}
+            if extra:
+                entry.update(extra)
+            pending_journal = []
+            self.journal_sink(entry)
+
+        if self.recovery is not None:
+            # continuation: replay the journaled tokens through a fresh
+            # detok/matcher pipeline (deterministic, so its output is
+            # byte-identical to what the original worker delivered) and
+            # re-emit exactly the chars past delivered_chars — the seam
+            primed_parts: List[str] = []
+            stopped_in_prior = False
+            for t in self.recovery["prior_tokens"]:
+                d = detok.push(t)
+                if matcher is not None and not stopped_in_prior:
+                    d, stopped_in_prior = matcher.push(d)
+                primed_parts.append(d)
+            primed = "".join(primed_parts)
+            content_total = len(primed)
+            catch_up = primed[self.recovery["delivered_chars"]:]
+            if self.journal_sink is not None:
+                checkpoint()
+            if stopped_in_prior:
+                # the stop string had fully arrived before the original
+                # stream died: nothing left to generate
+                text_parts.append(catch_up)
+                emit(catch_up, "stop", None)
+                ctx.service.abort(self.rid)
+                m.duration.observe(time.monotonic() - t0, model=model)
+                m.osl.observe(0, model=model)
+                return catch_up, "stop", 0
+            if catch_up:
+                text_parts.append(catch_up)
+                emit(catch_up, None, None)
         # the drain timeout is the request's REMAINING deadline budget
         # (frontend hop time already subtracted), not a fixed 600 s
         drain_timeout = (self.deadline.remaining()
                          if self.deadline is not None else None)
         for ev in ctx.service.drain(self.req, self.queue,
                                     timeout=drain_timeout):
+            if (self.journal_sink is not None and not ev.finished
+                    and ctx.drain_handoff.is_set()):
+                # graceful drain, ACTIVE handoff: snapshot the sampling
+                # chain, push the journal tail back to the frontend as
+                # the final comment, and abort — the frontend splices a
+                # continuation onto the same client stream elsewhere
+                st = ctx.service.sampling_state(self.rid)
+                checkpoint({"handoff": 1,
+                            **({"key": st["key"]} if st else {})})
+                ctx.service.abort(self.rid)
+                finish = "handoff"
+                break
             now = time.monotonic()
             if t_prev is None:
                 m.ttft.observe(now - t0, model=model)
@@ -231,6 +307,8 @@ class GenerationHandle:
             lp_entry = None
             if ev.token_id >= 0:
                 n_out += 1
+                consumed += 1
+                pending_journal.append(ev.token_id)
                 if ev.finished and ev.finish_reason == "stop":
                     # the finishing stop TOKEN is not content: HF decode
                     # skips specials, but the byte tokenizer cannot (a
@@ -250,6 +328,10 @@ class GenerationHandle:
                 # token's logprob entry (logprobs must match the returned
                 # content), abort the engine side, report finish "stop"
                 text_parts.append(delta)
+                if self.journal_sink is not None and pending_journal:
+                    if delta:
+                        content_total += len(delta)
+                    checkpoint()
                 emit(delta, "stop", None)
                 if not ev.finished:
                     ctx.service.abort(self.rid)
@@ -261,6 +343,15 @@ class GenerationHandle:
             if ev.finished:
                 finish = fr or "stop"
             text_parts.append(delta)
+            if self.journal_sink is not None and pending_journal:
+                # checkpoint EVERY consumed token, not just content-
+                # bearing ones: a held-back token (UTF-8 / stop-string
+                # holdback) is still committed state a continuation must
+                # not re-sample differently — and the comment still lands
+                # before the delta it may cover
+                if delta:
+                    content_total += len(delta)
+                checkpoint()
             # emit on no-delta events too when they carry a logprob entry
             # (UTF-8 holdback): streaming logprobs are one entry per token
             if delta or ev.finished or lp_entry is not None:
@@ -356,6 +447,13 @@ class ServingContext:
             self.metrics.registry,
         )
         self.start_time = time.time()
+        # --- graceful drain (SIGTERM; docs/robustness.md "Recovery
+        # semantics") --- draining sheds NEW inference requests with 503;
+        # drain_handoff makes journaled in-flight streams push their
+        # journal back to the frontend and abort, so the frontend can
+        # splice a continuation on another worker
+        self.draining = threading.Event()
+        self.drain_handoff = threading.Event()
         self._trace_lock = threading.Lock()  # one profiler capture at a time
         # distributed request tracing: one tracer per serving role; spans
         # land in the process-global ring buffer behind GET /debug/spans
@@ -474,6 +572,57 @@ class ServingContext:
                 return buf.getvalue()
             finally:
                 shutil.rmtree(d, ignore_errors=True)
+
+    def begin_drain(self) -> None:
+        """Stop admission NOW: new /v1 + /disagg/prefill requests shed 503
+        (+ Retry-After) so a retrying client or the frontend's 503
+        failover lands them on another replica. In-flight requests keep
+        running until they finish or hand off."""
+        self.draining.set()
+
+    def request_handoff(self) -> None:
+        """Ask journaled in-flight streams to hand off: each pushes its
+        journal tail (token seam + sampling-key snapshot) back to the
+        frontend as the final stream comment and aborts; the frontend
+        splices a continuation on another worker. Non-journaled requests
+        are unaffected (they finish or time out under the drain bound)."""
+        self.drain_handoff.set()
+
+    def drain_demote(self) -> int:
+        """Demote every sole-owned prefix-cache page to the KVBM host
+        tier (one batched device gather) so surviving peers can serve the
+        departing worker's prefixes via the cross-worker host-tier fetch.
+        No-op without a KVBM tier. Returns pages demoted."""
+        eng = self.engine
+        if eng.prefix_cache is None or eng.kvbm is None:
+            return 0
+        with eng._exec_lock:
+            return eng.kvbm.demote_all(eng.prefix_cache)
+
+    def drain(self, drain_s: float = 30.0,
+              handoff_grace_s: float = 5.0) -> bool:
+        """The drain state machine (worker SIGTERM / chaos tests):
+        draining -> (grace: finish naturally) -> handoff -> quiesce ->
+        demote KV to the host tier. Returns True when the engine emptied
+        within the budget."""
+        eng = self.engine
+        self.begin_drain()
+        t0 = time.monotonic()
+        deadline = t0 + max(0.0, drain_s)
+        grace_end = min(deadline, t0 + max(0.0, handoff_grace_s))
+        while time.monotonic() < grace_end and (eng.num_active
+                                                or eng.pending):
+            time.sleep(0.05)
+        if eng.num_active or eng.pending:
+            self.request_handoff()
+        while time.monotonic() < deadline and (eng.num_active
+                                               or eng.pending):
+            time.sleep(0.1)
+        demoted = self.drain_demote()
+        if demoted:
+            log.info("drain: demoted %d prefix pages to the host tier",
+                     demoted)
+        return not (eng.num_active or eng.pending)
 
     def close(self):
         if self.kv_source is not None:
@@ -644,6 +793,16 @@ class _Handler(JsonHTTPHandler):
 
     def do_POST(self):
         path = self.path.split("?")[0]
+        if (self.ctx.draining.is_set()
+                and path.startswith(("/v1/", "/disagg/prefill"))):
+            # graceful drain: admission is OFF before anything else — a
+            # 503 here is retry-safe by construction (nothing ran), and
+            # the frontend fails it over to another replica. The disagg
+            # stage/release routes stay up: decode peers must still
+            # finish in-flight KV pulls against this worker.
+            self._error(503, "worker draining; retry another replica",
+                        "service_unavailable")
+            return
         # robustness plane: read-stall / reset-after-headers fault points
         # (no-ops unless armed; control-plane routes are exempt)
         self._fault_gate()
@@ -832,6 +991,41 @@ class _Handler(JsonHTTPHandler):
                 f"model {model!r} not served (serving {self.ctx.served_model!r})"
             )
 
+    # ------------------------------------------- mid-stream recovery ----
+    def _journal_comment(self, obj) -> None:
+        """One recovery-journal record as an SSE comment frame. Rides the
+        response stream itself, so the journal dies with the connection
+        exactly when the frontend stops needing it."""
+        self._write_chunk(recovery.comment_frame(obj))
+
+    def _setup_recovery(self, body, p, stream_gated: bool = False):
+        """Continuation + journaling plumbing (serving/recovery.py).
+
+        Returns (rec, journaling): `rec` is the validated inbound
+        ``dynamo_recovery`` continuation (streaming only), `journaling`
+        whether this stream should emit journal comments. For a journaled
+        UNSEEDED sampled stream the effective seed is pinned here and
+        journaled, so a continuation resumes the identical chain.
+        `stream_gated` marks streams whose text is gated/buffered (auto
+        tool-choice) — delivered chars there aren't a pure function of
+        the token ids, so they are not journaled."""
+        rec = body.get(recovery.RECOVERY_BODY_KEY)
+        if rec is not None:
+            try:
+                rec = recovery.normalize_continuation(rec)
+            except ValueError as e:
+                raise proto.BadRequest(str(e))
+        journaling = bool(self.headers.get(recovery.JOURNAL_HEADER)
+                          and p["stream"] and p.get("n", 1) == 1
+                          and not stream_gated)
+        if rec is not None and p["stream"]:
+            p["_recovery"] = rec
+            if p["seed"] is None and rec.get("seed") is not None:
+                p["seed"] = rec["seed"]
+        if journaling and p["seed"] is None and p["temperature"] > 0:
+            p["seed"] = random.getrandbits(31)
+        return (rec if p["stream"] else None), journaling
+
     def _chat(self, body):
         p = proto.parse_chat_request(body)
         self._check_model(p["model"])
@@ -853,7 +1047,11 @@ class _Handler(JsonHTTPHandler):
         import json as _json
 
         self.ctx.register_kv_route(prompt_ids, _json.dumps(p["messages"]))
-        rid = proto.new_id("chatcmpl")
+        # a recovery continuation reuses the ORIGINAL response id so the
+        # spliced stream's chunks stay self-consistent for the client
+        rec, journaling = self._setup_recovery(
+            body, p, stream_gated=(tools is not None and tc == "auto"))
+        rid = (rec or {}).get("response_id") or proto.new_id("chatcmpl")
         self._span.set_attribute("request.id", rid)
         handles = self.ctx.start_choices(  # may raise -> 400
             rid, prompt_ids, p, trace_span=self._span,
@@ -863,12 +1061,20 @@ class _Handler(JsonHTTPHandler):
             with_null = p.get("include_usage", False)
             self._start_sse()
             lock = threading.Lock()
-            for h in handles:
-                self._sse_chunk(
-                    proto.chat_chunk(rid, p["model"], {"role": "assistant"},
-                                     None, with_usage_null=with_null,
-                                     index=h.index)
-                )
+            if journaling:
+                handles[0].journal_sink = self._journal_comment
+                self._journal_comment(
+                    {"start": {"id": rid, "seed": p.get("seed")}})
+            if rec is None or not rec.get("role_sent"):
+                # a continuation skips the role preamble when the
+                # original stream already delivered it
+                for h in handles:
+                    self._sse_chunk(
+                        proto.chat_chunk(rid, p["model"],
+                                         {"role": "assistant"},
+                                         None, with_usage_null=with_null,
+                                         index=h.index)
+                    )
 
             # tool_choice "auto": gate each choice's stream so a leading
             # '{' buffers until finish and can become ONE tool_calls
@@ -915,10 +1121,20 @@ class _Handler(JsonHTTPHandler):
                 return emit
 
             results = run_choices(handles, emit_for)
+            if any(r[1] == "handoff" for r in results):
+                # active drain handoff: end the chunked body WITHOUT
+                # [DONE] — the frontend relay reads that as a mid-stream
+                # failure and splices the journaled continuation
+                self._end_sse()
+                return
             if p.get("include_usage"):
+                # usage describes the LOGICAL request: original prompt
+                # length, and completion tokens across the recovery seam
                 self._sse_chunk(proto.usage_chunk(
                     rid, p["model"], "chat.completion.chunk",
-                    len(prompt_ids), sum(r[2] for r in results),
+                    len(prompt_ids),
+                    sum(r[2] for r in results)
+                    + sum(h.prior_count for h in handles),
                 ))
             self._sse_chunk("[DONE]")
             self._end_sse()
@@ -960,7 +1176,8 @@ class _Handler(JsonHTTPHandler):
         # KV event plane: the frontend routes completions on the raw
         # prompt string — the same canonical text registered here
         self.ctx.register_kv_route(prompt_ids, p["prompt"])
-        rid = proto.new_id("cmpl")
+        rec, journaling = self._setup_recovery(body, p)
+        rid = (rec or {}).get("response_id") or proto.new_id("cmpl")
         self._span.set_attribute("request.id", rid)
         handles = self.ctx.start_choices(rid, prompt_ids, p,
                                          trace_span=self._span,
@@ -979,6 +1196,10 @@ class _Handler(JsonHTTPHandler):
         if p["stream"]:
             self._start_sse()
             lock = threading.Lock()
+            if journaling:
+                handles[0].journal_sink = self._journal_comment
+                self._journal_comment(
+                    {"start": {"id": rid, "seed": p.get("seed")}})
 
             def emit_for(h):
                 def emit(delta, finish, lp_entry) -> bool:
@@ -1005,10 +1226,15 @@ class _Handler(JsonHTTPHandler):
                 return emit
 
             results = run_choices(handles, emit_for)
+            if any(r[1] == "handoff" for r in results):
+                # drain handoff: no [DONE] — the frontend splices on
+                self._end_sse()
+                return
             if p.get("include_usage"):
                 self._sse_chunk(proto.usage_chunk(
                     rid, p["model"], "text_completion", len(prompt_ids),
-                    sum(r[2] for r in results),
+                    sum(r[2] for r in results)
+                    + sum(h.prior_count for h in handles),
                 ))
             self._sse_chunk("[DONE]")
             self._end_sse()

@@ -267,6 +267,13 @@ class DisaggDecodeClient:
             except (ConnectionError, OSError) as e:
                 pull_span.set_status("ERROR", str(e))
                 pull_span.end()
+                # the pull died with the prefill KV still parked: release
+                # it NOW (best-effort; the TTL sweep remains the backstop)
+                # so a frontend-recovered continuation re-prefilling under
+                # the same request id never races a stale park — a
+                # decode-side failure must leave the ledger balanced
+                self._release_remote(prefill_url, req.request_id,
+                                     parent_span)
                 raise RuntimeError(
                     f"KV transfer from {prefill_url} failed: {e}") from e
             released = True  # the TCP plane acks (and releases) in-stream

@@ -75,6 +75,13 @@ class EngineService:
     def wake(self):
         self._wake.set()
 
+    def sampling_state(self, request_id: str):
+        """Resumable sampling-state export (engine.export_sampling_state):
+        the drain-handoff path journals this so a continuation on another
+        worker resumes the exact PRNG chain. None once the request left
+        the engine."""
+        return self.engine.export_sampling_state(request_id)
+
     def stream(self, req: GenRequest,
                timeout: Optional[float] = None) -> Iterator[TokenEvent]:
         """Submit and yield TokenEvents until the request finishes."""
@@ -113,8 +120,9 @@ class EngineService:
                 if faults.check("worker.crash_mid_decode") is not None:
                     # the worker "crashes" with tokens already delivered:
                     # abort the engine side and die mid-stream — the
-                    # frontend must truncate, never re-dispatch (a retry
-                    # would duplicate the generation)
+                    # frontend either resumes the journaled continuation
+                    # on another worker (recovery plane) or truncates;
+                    # it never re-runs the whole generation
                     self.abort(req.request_id)
                     raise ConnectionResetError(
                         "injected fault: worker.crash_mid_decode")

@@ -32,6 +32,7 @@ from dynamo_tpu.robustness import faults
 from dynamo_tpu.robustness.breaker import STATE_CODES
 from dynamo_tpu.robustness.deadline import Deadline
 from dynamo_tpu.serving import protocol as proto
+from dynamo_tpu.serving import recovery
 from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
 from dynamo_tpu.serving.metrics import FrontendMetrics, Gauge
 from dynamo_tpu.serving.router import Router, prefix_key
@@ -121,6 +122,14 @@ class FrontendContext:
         self.breaker_gauge = Gauge(
             "dynamo_frontend_breaker_state",
             "Per-worker circuit-breaker state (0=closed 1=half_open 2=open)",
+            self.metrics.registry,
+        )
+        # --- request recovery plane (serving/recovery.py) ---
+        self.recovered_counter = Counter(
+            "dynamo_frontend_recovered_total",
+            "Requests recovered after a worker failure, by phase (connect "
+            "= pre-send failover re-pick; stream = mid-stream journaled "
+            "continuation spliced onto the same client stream)",
             self.metrics.registry,
         )
         self.router.breakers.on_open = (
@@ -414,11 +423,19 @@ class _FrontendHandler(JsonHTTPHandler):
                 return
         # bounded failover: a CONNECT-phase failure (refused / no route /
         # DNS) proves the request never reached a worker, so retrying the
-        # next pick is safe; a read timeout means a worker accepted and may
-        # be generating — retrying would duplicate the generation, so it is
-        # terminal (504). 502 only when no live worker accepts.
+        # next pick is safe; a worker 503 (draining / overloaded) shed
+        # BEFORE any work started, so it fails over too — that is what
+        # makes rolling restarts hitless. A read timeout means a worker
+        # accepted and may be generating — retrying would duplicate the
+        # generation, so it is terminal (504). 502 only when no live
+        # worker accepts. Journal-eligible STREAMS go further: the SSE
+        # relay journals delivered tokens and splices a continuation onto
+        # the same stream after a mid-stream worker death
+        # (docs/robustness.md "Recovery semantics").
+        journal_on = recovery.journal_eligible(body)
         resp = None
         last_err: Optional[str] = None
+        last_503: Optional[tuple] = None  # replayed if every pick sheds
         tried: List[str] = []
         breakers = ctx.router.breakers
         for attempt in range(3):
@@ -439,11 +456,16 @@ class _FrontendHandler(JsonHTTPHandler):
                 return
             span.set_attribute("transport", "http")
             span.set_attribute("worker.url", worker.url)
+            dispatch_headers = deadline.propagate({
+                "Content-Type": "application/json", **trace_headers})
+            if journal_on:
+                # ask the worker to interleave recovery-journal comments
+                # with the stream (serving/recovery.py)
+                dispatch_headers[recovery.JOURNAL_HEADER] = "1"
             req = urllib.request.Request(
                 worker.url.rstrip("/") + path,
                 data=raw,
-                headers=deadline.propagate({
-                    "Content-Type": "application/json", **trace_headers}),
+                headers=dispatch_headers,
                 method="POST",
             )
             try:
@@ -459,9 +481,25 @@ class _FrontendHandler(JsonHTTPHandler):
                 break
             except urllib.error.HTTPError as e:
                 # the worker is alive and answered: a real API response,
-                # not a routing failure — pass it through
+                # not a routing failure
                 breakers.record_success(worker.url)
                 payload = e.read()
+                if e.code == 503:
+                    # a draining/overloaded worker sheds BEFORE any work
+                    # starts (admission gate), so failing over is safe;
+                    # the shed response is replayed only if every pick
+                    # sheds. The worker stays registered — it is alive,
+                    # and re-heartbeats its real state
+                    span.add_event("worker_503_failover",
+                                   {"worker.url": worker.url})
+                    tried.append(worker.url)
+                    last_err = f"worker {worker.url} shed 503"
+                    last_503 = (payload,
+                                e.headers.get("Content-Type",
+                                              "application/json"),
+                                e.headers.get("Retry-After"))
+                    continue
+                # anything else is a definitive answer — pass it through
                 self.send_response(e.code)
                 self.send_header(
                     "Content-Type",
@@ -502,6 +540,20 @@ class _FrontendHandler(JsonHTTPHandler):
                 tried.append(worker.url)
                 last_err = str(e)
         if resp is None:
+            if last_503 is not None:
+                # every live pick shed 503 (cluster-wide drain/overload):
+                # replay the worker's own shed response, Retry-After
+                # jitter included, rather than escalating to 502
+                payload, p_ctype, retry_after = last_503
+                span.set_status("ERROR", "all workers shed 503")
+                self.send_response(503)
+                self.send_header("Content-Type", p_ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                if retry_after:
+                    self.send_header("Retry-After", retry_after)
+                self.end_headers()
+                self.wfile.write(payload)
+                return
             span.set_status("ERROR", "no reachable worker")
             self._error(
                 502,
@@ -509,33 +561,16 @@ class _FrontendHandler(JsonHTTPHandler):
                 + (f" (last error: {last_err})" if last_err else ""),
                 "bad_gateway")
             return
+        if attempt:
+            # connect-phase recovery: an earlier pick failed pre-send and
+            # the re-pick carried the request
+            ctx.recovered_counter.inc(phase="connect")
 
         ctype = resp.headers.get("Content-Type", "application/json")
         if "text/event-stream" in ctype:
-            # SSE passthrough; observe TTFT on the first forwarded byte
-            self.send_response(200)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Cache-Control", "no-cache")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-            first = True
-            try:
-                while True:
-                    chunk = resp.read1(65536) if hasattr(resp, "read1") else resp.read(65536)
-                    if not chunk:
-                        break
-                    if first:
-                        m.ttft.observe(time.monotonic() - t0, model=model)
-                        first = False
-                    self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
-                    self.wfile.flush()
-                self.wfile.write(b"0\r\n\r\n")
-            except (BrokenPipeError, ConnectionResetError, socket.error,
-                    http.client.HTTPException):
-                # client gone, or the WORKER died mid-stream (reset after
-                # headers): the stream truncates — never re-dispatched,
-                # the generation must not run twice
-                pass
+            self._relay_sse(resp, worker, path, body, prompt_text,
+                            affinity, model, span, trace_headers, deadline,
+                            tried, attempt, journal_on, t0)
         else:
             try:
                 payload = resp.read()
@@ -559,9 +594,170 @@ class _FrontendHandler(JsonHTTPHandler):
             self.send_response(resp.status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
+            # recovery observability: how many dispatches this response
+            # took, and whether a failover carried it
+            self.send_header("x-request-attempts", str(attempt + 1))
+            if attempt:
+                self.send_header("x-recovered", "1")
             self.end_headers()
             self.wfile.write(payload)
         m.duration.observe(time.monotonic() - t0, model=model)
+
+    # ----------------------------------------------- mid-stream recovery --
+    def _relay_sse(self, resp, worker, path: str, body: dict,
+                   prompt_text: str, affinity: str, model: str, span,
+                   trace_headers: dict, deadline: Deadline,
+                   tried: List[str], attempt: int, journal_on: bool,
+                   t0: float) -> None:
+        """SSE relay with mid-stream recovery (serving/recovery.py).
+
+        The worker stream is parsed into event blocks instead of being
+        byte-proxied: ``dynr`` journal comments feed the per-request
+        RequestJournal and are stripped; data frames are re-framed to the
+        client verbatim. On a mid-stream failure (in-stream error event,
+        reset, stall timeout, EOF without [DONE]) a healthy worker is
+        re-picked — preferring ANY journaled-prefix KV overlap
+        (router relaxed_overlap) — and the request is re-POSTed as a
+        continuation; the worker re-emits exactly the chars past the
+        seam, so greedy/seeded streams are byte-identical to a fault-free
+        run. Non-journaled streams keep PR 2's truncate semantics."""
+        ctx = self.ctx
+        m = ctx.metrics
+        journal = recovery.RequestJournal(enabled_=journal_on)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("x-request-attempts", str(attempt + 1))
+        if attempt:
+            self.send_header("x-recovered", "1")
+        self.end_headers()
+        first = True
+
+        def forward(block: bytes) -> bool:
+            nonlocal first
+            if first:
+                m.ttft.observe(time.monotonic() - t0, model=model)
+                first = False
+            try:
+                payload = block + b"\n\n"
+                self.wfile.write(b"%x\r\n%s\r\n" % (len(payload), payload))
+                self.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError, socket.error,
+                    http.client.HTTPException, ValueError):
+                return False
+
+        def pump(stream):
+            """Relay one worker stream. Returns (outcome, held_error):
+            outcome in {"done", "client_gone", "failed"}."""
+            for kind, block in recovery.iter_sse_blocks(stream):
+                if kind != "block":
+                    # conn/eof without [DONE]: the worker died (or handed
+                    # off) mid-stream
+                    return "failed", None
+                bkind, extra = recovery.parse_block(block)
+                if bkind == "journal":
+                    journal.apply_comment(extra)
+                elif bkind == "done":
+                    return (("done", None) if forward(block)
+                            else ("client_gone", None))
+                elif bkind == "error":
+                    # the worker reported its own death in-stream (crash
+                    # mid-decode): hold the error — a successful splice
+                    # makes it invisible to the client
+                    return "failed", block
+                else:
+                    if not forward(block):
+                        return "client_gone", None
+                    if bkind == "data":
+                        journal.on_data(extra)
+            return "failed", None  # defensive: stream ended markerless
+
+        outcome = "failed"
+        held_error: Optional[bytes] = None
+        while True:
+            outcome, held_error = pump(resp)
+            try:
+                resp.close()
+            except Exception:
+                pass
+            if outcome != "failed":
+                break
+            # ---- mid-stream failure: splice a continuation ----
+            if journal.handoff:
+                span.add_event("worker_handoff",
+                               {"worker.url": worker.url,
+                                "seam_token_index":
+                                    journal.seam_token_index})
+            resp = None
+            while (journal.recoverable
+                   and attempt + 1 < recovery.MAX_ATTEMPTS
+                   and not deadline.expired):
+                attempt += 1
+                if worker.url not in tried:
+                    tried.append(worker.url)
+                explain: dict = {}
+                nxt = ctx.router.pick(model, affinity,
+                                      prompt_text=prompt_text,
+                                      exclude=tried, explain=explain,
+                                      relaxed_overlap=True)
+                if nxt is None:
+                    break
+                worker = nxt
+                cont = dict(body)
+                cont[recovery.RECOVERY_BODY_KEY] = journal.continuation()
+                headers = deadline.propagate({
+                    "Content-Type": "application/json",
+                    recovery.JOURNAL_HEADER: "1", **trace_headers})
+                req = urllib.request.Request(
+                    worker.url.rstrip("/") + path,
+                    data=json.dumps(cont).encode(), headers=headers,
+                    method="POST")
+                try:
+                    resp = urllib.request.urlopen(
+                        req, timeout=deadline.timeout())
+                    break
+                except urllib.error.HTTPError as e:
+                    # shed (503 draining) or rejected: spend the attempt
+                    # and keep looking
+                    e.read()
+                    ctx.router.breakers.record_success(worker.url)
+                    resp = None
+                except (urllib.error.URLError, socket.error):
+                    ctx.router.breakers.record_failure(worker.url)
+                    resp = None
+            if resp is None:
+                # recovery impossible: surface the failure the pre-
+                # recovery way — forward the worker's own error event (or
+                # say why) and terminate the stream
+                span.set_status(
+                    "ERROR", "worker stream failed; not recovered")
+                if held_error is not None:
+                    forward(held_error)
+                elif journal.enabled:
+                    forward(b"data: " + json.dumps({"error": {
+                        "message": "worker lost mid-stream; recovery "
+                                   "failed (no healthy worker in budget)",
+                        "type": "stream_error"}}).encode())
+                if held_error is not None or journal.enabled:
+                    forward(b"data: [DONE]")
+                break
+            # spliced: the continuation now feeds the SAME client stream
+            ctx.recovered_counter.inc(phase="stream")
+            span.add_event("stream_recovered", {
+                "worker.url": worker.url, "attempt": attempt,
+                "seam_token_index": journal.seam_token_index})
+            span.set_attribute("recovery.seam_token_index",
+                               journal.seam_token_index)
+            span.set_attribute("worker.url", worker.url)
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, socket.error,
+                http.client.HTTPException, ValueError):
+            pass
+        # the shared _route_and_forward tail observes request duration
 
 
 def _nats_proxy_parts(ctx, worker, path, body, trace_headers=None,

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import socket
 import struct
 import threading
@@ -18,6 +19,17 @@ from dynamo_tpu.serving import protocol as proto
 log = logging.getLogger("dynamo_tpu.http")
 
 MAX_BODY_BYTES = 10 * 1024 * 1024
+
+# every shed/routing-failure response carries a retry hint (429 admission,
+# 502 failed failover, 503 no-worker/draining, 504 deadline)
+RETRY_AFTER_CODES = (429, 502, 503, 504)
+
+
+def retry_after_value(base_s: float = 1.0) -> str:
+    """Retry-After with ±20% jitter: a burst of simultaneously-shed
+    clients must not come back in lockstep and re-create the exact
+    overload that shed them (docs/robustness.md)."""
+    return f"{base_s * (1.0 + random.uniform(-0.2, 0.2)):.2f}"
 
 # inference routes are the fault-injectable surface; control-plane routes
 # (/internal/*, /metrics, /health) must stay reliable even mid-chaos-test
@@ -110,10 +122,11 @@ class JsonHTTPHandler(BaseHTTPRequestHandler):
     def _error(self, code: int, msg: str, etype: str = "invalid_request_error",
                headers: Optional[Dict[str, str]] = None):
         headers = dict(headers or {})
-        if code in (429, 503, 504):
-            # shed/overload responses carry a retry hint so well-behaved
-            # clients back off instead of hammering (docs/robustness.md)
-            headers.setdefault("Retry-After", "1")
+        if code in RETRY_AFTER_CODES:
+            # shed/overload responses carry a jittered retry hint so
+            # well-behaved clients back off instead of hammering — and
+            # don't all come back at once (docs/robustness.md)
+            headers.setdefault("Retry-After", retry_after_value())
         self._json(code, {"error": {"message": msg, "type": etype,
                                     "code": code}}, headers=headers)
 

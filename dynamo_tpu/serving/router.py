@@ -330,10 +330,17 @@ class Router:
              roles=("agg", "decode"),
              prompt_text: Optional[str] = None,
              exclude=(),
-             explain: Optional[Dict] = None) -> Optional[WorkerInfo]:
+             explain: Optional[Dict] = None,
+             relaxed_overlap: bool = False) -> Optional[WorkerInfo]:
         """`explain`, when given, is filled with the routing decision's
         inputs (candidate count, ledger depth/overlap, decision source) —
-        the attributes the frontend's route-decision trace span records."""
+        the attributes the frontend's route-decision trace span records.
+
+        `relaxed_overlap` is the recovery re-pick mode: a mid-stream
+        failover re-dispatches prompt ⊕ emitted-tokens as a continuation
+        prefill, so ANY worker holding even a shallow prefix of it (KV
+        event index or ledger) beats the template-herding guardrail —
+        the continuation's prefill cost is what the overlap offsets."""
         if explain is None:
             explain = {}
         self.purge_expired()
@@ -385,8 +392,11 @@ class Router:
                         min(len(prompt_text) // BLOCK_CHARS, MAX_BLOCKS))
             explain["ledger_depth"] = depth
             explain["kv_overlap"] = round(depth / denom, 4) if denom else 0.0
-            if (url is not None and depth >= 2
-                    and depth * 10 >= 6 * denom
+            deep_enough = (depth >= 1 if relaxed_overlap
+                           else depth >= 2 and depth * 10 >= 6 * denom)
+            if relaxed_overlap:
+                explain["recovery_repick"] = True
+            if (url is not None and deep_enough
                     and live[url].headroom >= 0.05):
                 with self._lock:
                     if source == "kv_event_index":

@@ -267,10 +267,14 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
         hb_thread.start()
 
     def shutdown(*_):
-        """Graceful drain (pod termination): deregister from the frontend
-        so no new requests route here, keep serving until in-flight work
-        finishes (bounded by DRAIN_TIMEOUT_S — align terminationGracePeriod
-        with it), then stop the server. A second signal skips the drain."""
+        """Graceful drain (pod termination): stop admission (new requests
+        shed 503 and the frontend fails them over), deregister from the
+        frontend, give in-flight requests a grace window to finish, then
+        ACTIVELY hand off journaled streams (the worker pushes its token
+        journal back to the frontend, which splices a continuation on
+        another replica) and demote prefix KV to the host tier for peer
+        fetch. Bounded by DRAIN_TIMEOUT_S — align terminationGracePeriod
+        with it. A second signal skips the drain."""
         if stop.is_set():  # impatient second SIGTERM/SIGINT
             threading.Thread(target=srv.shutdown, daemon=True).start()
             return
@@ -284,6 +288,14 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
                     log.warning("invalid DRAIN_TIMEOUT_S %r; using 30s",
                                 os.environ.get("DRAIN_TIMEOUT_S"))
                     drain_s = 30.0
+                try:
+                    grace_s = float(os.environ.get(
+                        "DRAIN_HANDOFF_GRACE_S", "5"))
+                except ValueError:
+                    grace_s = 5.0
+                # admission off FIRST: a request routed here between now
+                # and the deregister sheds 503 and fails over cleanly
+                ctx.begin_drain()
                 if nats_plane is not None:
                     # stop consuming the NATS request plane NOW — new
                     # subjects must not refill the queue mid-drain
@@ -314,11 +326,11 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
                 # be accepted but not yet submitted — let it reach the
                 # engine before the first empty check
                 time.sleep(1.0)
-                deadline = time.monotonic() + drain_s
-                while time.monotonic() < deadline and (
-                        engine.num_active or engine.pending):
-                    time.sleep(0.25)
-                if engine.num_active or engine.pending:
+                # drain state machine (api.ServingContext.drain): finish
+                # naturally within the grace window, then hand off what
+                # remains and demote prefix KV for peers
+                if not ctx.drain(drain_s=drain_s,
+                                 handoff_grace_s=min(grace_s, drain_s)):
                     log.warning(
                         "drain timeout with %d active / %d pending; "
                         "stopping anyway", engine.num_active,

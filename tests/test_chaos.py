@@ -478,6 +478,146 @@ def test_slow_prefill_sheds_on_deadline(disagg_stack):
 
 
 # --------------------------------------------------------------------------
+# graceful drain: SIGTERM semantics (admission off, handoff, deregister)
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def drain_stack():
+    """A dedicated frontend + two agg workers SHARING params, so a drain
+    handoff's spliced continuation is comparable byte-for-byte."""
+    eng_a = Engine(EngineConfig(**KW))
+    eng_b = Engine(EngineConfig(**KW), params=eng_a.params)
+    ctxs, srvs, urls = [], [], []
+    for eng in (eng_a, eng_b):
+        ctx = ServingContext(eng, MODEL)
+        srv = make_server(ctx, "127.0.0.1", 0)
+        serve_forever_in_thread(srv)
+        ctxs.append(ctx)
+        srvs.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+    fctx = FrontendContext(router=Router())
+    fsrv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(fsrv)
+    yield {"frontend": f"http://127.0.0.1:{fsrv.server_address[1]}",
+           "fctx": fctx, "wctxs": ctxs, "urls": urls,
+           "plane": faults.get_plane()}
+    fsrv.shutdown()
+    for srv in srvs:
+        srv.shutdown()
+    for ctx in ctxs:
+        ctx.close()
+
+
+def _register_drain(stack, only=None):
+    for url in (stack["urls"] if only is None else only):
+        post(stack["frontend"], "/internal/register", {
+            "url": url, "model": MODEL, "mode": "agg",
+            "stats": {"max_num_seqs": 4, "free_pages": 100,
+                      "total_pages": 128}})
+
+
+def test_drain_rejects_new_requests_and_fails_over(drain_stack):
+    """Draining worker: direct requests shed 503 + Retry-After; via the
+    frontend the 503 fails over to the healthy replica, so a rolling
+    restart never surfaces an error to clients."""
+    ctx_a = drain_stack["wctxs"][0]
+    _register_drain(drain_stack)
+    ctx_a.begin_drain()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(drain_stack["urls"][0], "/v1/chat/completions",
+                 chat_body("direct while draining"))
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        out = post(drain_stack["frontend"], "/v1/chat/completions",
+                   chat_body("hitless failover"))
+        assert out["usage"]["completion_tokens"] == 4
+        # the healthy worker served it
+        m = drain_stack["wctxs"][1].metrics.requests_total
+        with m._lock:
+            assert sum(m._values.values()) >= 1
+    finally:
+        ctx_a.draining.clear()
+
+
+def test_drain_handoff_completes_inflight_stream(drain_stack):
+    """SIGTERM mid-stream (simulated via the drain state machine the
+    signal handler drives): the in-flight journaled stream hands off and
+    COMPLETES byte-identically on the surviving worker; the drained
+    worker deregisters cleanly and its engine quiesces."""
+    plane = drain_stack["plane"]
+    fctx = drain_stack["fctx"]
+    ctx_a, ctx_b = drain_stack["wctxs"]
+    url_a = drain_stack["urls"][0]
+    # reference (both up, no drain)
+    _register_drain(drain_stack)
+    ref = post(drain_stack["frontend"], "/v1/chat/completions",
+               chat_body("drain handoff probe", max_tokens=12,
+                         stream=True), raw=True).read().decode()
+    ref_content = "".join(
+        (c.get("delta") or {}).get("content") or ""
+        for block in ref.split("\n\n")
+        if block.strip().startswith("data: ")
+        and block.strip() != "data: [DONE]"
+        for c in json.loads(block.strip()[len("data: "):])["choices"])
+
+    # pin the stream to worker A, stalled long enough to drain under it
+    post(drain_stack["frontend"], "/internal/deregister",
+         {"url": drain_stack["urls"][1]})
+    _register_drain(drain_stack, only=[url_a])
+    plane.configure({"worker.read_stall": {"times": 1, "delay_s": 0.8}})
+    result = {}
+
+    def run_stream():
+        try:
+            resp = post(drain_stack["frontend"], "/v1/chat/completions",
+                        chat_body("drain handoff probe", max_tokens=12,
+                                  stream=True), raw=True, timeout=60)
+            result["body"] = resp.read().decode()
+        except Exception as e:  # surfaced by the main thread's asserts
+            result["error"] = e
+
+    t = threading.Thread(target=run_stream, daemon=True)
+    t.start()
+    wait_until = time.monotonic() + 5.0
+    while time.monotonic() < wait_until:
+        with fctx._inflight_lock:
+            if fctx._inflight >= 1:
+                break
+        time.sleep(0.01)
+    # SIGTERM on A: admission off, handoff in-flight, deregister
+    _register_drain(drain_stack, only=[drain_stack["urls"][1]])
+    try:
+        ctx_a.begin_drain()
+        ctx_a.request_handoff()
+        post(drain_stack["frontend"], "/internal/deregister",
+             {"url": url_a})
+        t.join(timeout=60)
+        plane.clear()
+        assert "error" not in result, f"stream failed: {result.get('error')}"
+        body = result["body"]
+        events = [b.strip()[len("data: "):] for b in body.split("\n\n")
+                  if b.strip().startswith("data: ")]
+        assert events[-1] == "[DONE]", "handoff must COMPLETE the stream"
+        content = "".join(
+            (c.get("delta") or {}).get("content") or ""
+            for e in events if e != "[DONE]"
+            for c in json.loads(e)["choices"])
+        assert content == ref_content, "handoff corrupted the stream"
+        # deregistered cleanly: the frontend no longer lists worker A
+        workers = json.loads(urllib.request.urlopen(
+            drain_stack["frontend"] + "/internal/workers",
+            timeout=10).read())["workers"]
+        assert url_a not in [w["url"] for w in workers]
+        # the drained engine quiesced (handoff aborted its half)
+        assert ctx_a.drain(drain_s=5.0, handoff_grace_s=0.1)
+        assert ctx_a.engine.num_active == 0 and not ctx_a.engine.pending
+    finally:
+        plane.clear()
+        ctx_a.draining.clear()
+        ctx_a.drain_handoff.clear()
+
+
+# --------------------------------------------------------------------------
 # coverage: every registered fault point fired at least once
 # --------------------------------------------------------------------------
 def test_every_fault_point_fired(stack, disagg_stack):

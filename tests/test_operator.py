@@ -147,6 +147,9 @@ def test_reconcile_creates_children_and_status():
 
 
 def test_reconcile_prunes_removed_services():
+    """Drain-before-delete: pass 1 scales the stale worker to 0 (pods run
+    their graceful SIGTERM drain under the termination grace period) and
+    annotates it; pass 2, once no replicas are live, deletes it."""
     with FakeK8s() as fake:
         fake.put_object(mat.API_VERSION, "dynamo", mat.DGD_PLURAL,
                         copy.deepcopy(DGD))
@@ -159,6 +162,14 @@ def test_reconcile_prunes_removed_services():
                              "agg-demo")
         del cr["spec"]["services"]["JetstreamDecodeWorker"]
         ctrl.reconcile_once()
+        dep = fake.get_object("apps/v1", "dynamo", "deployments",
+                              "agg-demo-jetstreamdecodeworker")
+        assert dep is not None, "phase 1 must drain, not delete"
+        assert dep["spec"]["replicas"] == 0
+        from dynamo_tpu.operator.controller import DRAIN_ANNOTATION
+
+        assert dep["metadata"]["annotations"][DRAIN_ANNOTATION] == "true"
+        ctrl.reconcile_once()  # pods gone (no status.replicas) -> delete
         assert fake.get_object("apps/v1", "dynamo", "deployments",
                                "agg-demo-jetstreamdecodeworker") is None
         assert fake.get_object("apps/v1", "dynamo", "deployments",
@@ -485,10 +496,17 @@ def test_controller_reconciles_multihost_statefulset():
         sts = fake.get_object("apps/v1", "demo", "statefulsets",
                               "mh-bigworker")
         assert sts is not None and sts["spec"]["replicas"] == 4
-        # removing the service prunes the StatefulSet
+        # removing the service prunes the StatefulSet via the two-phase
+        # drain-before-delete (scale to 0, then delete once no pods live
+        # — the annotation carries the phase across controller restarts)
         del cr["spec"]["services"]["BigWorker"]
         fake.put_object(mat.API_VERSION, "demo", mat.DGD_PLURAL,
                         copy.deepcopy(cr))
+        Controller(K8sClient(fake.url), namespace=None,
+                   gang=True).reconcile_once()
+        sts = fake.get_object("apps/v1", "demo", "statefulsets",
+                              "mh-bigworker")
+        assert sts is not None and sts["spec"]["replicas"] == 0
         Controller(K8sClient(fake.url), namespace=None,
                    gang=True).reconcile_once()
         assert fake.get_object("apps/v1", "demo", "statefulsets",

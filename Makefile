@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -28,6 +28,7 @@ help:
 	@echo "  chaos-check    deterministic fault-injection suite (breakers, deadlines, failover)"
 	@echo "  kvbm-check     KVBM suite + long-shared-prefix bench smoke (host-tier hit ratio)"
 	@echo "  recovery-check mid-stream recovery suite (journaled continuation failover, drain handoff)"
+	@echo "  lora-check     multi-LoRA suite (registry LRU, mixed-batch parity, adapter routing)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -84,6 +85,14 @@ chaos-check:
 recovery-check:
 	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
 		python -m pytest tests/test_recovery.py -q -p no:randomly
+
+# Multi-LoRA gate (docs/backends.md "Multi-LoRA"): the `lora` marker suite —
+# registry load/unload/LRU + slot pinning, adapter-keyed prefix-cache
+# isolation, router adapter-affinity, and the jitted mixed-adapter-batch
+# greedy-parity acceptance test (slow-marked, so tier-1 stays light; this
+# target runs it).
+lora-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_lora.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

@@ -110,6 +110,21 @@ class EngineConfig:
     kvbm_disk_dir: Optional[str] = None
     kvbm_disk_blocks: int = 256
 
+    # multi-LoRA serving (dynamo_tpu.lora): > 0 reserves this many device
+    # adapter slots — stacked [L, slots+1, in, rank] LoRA tensors ride the
+    # param tree (slot 0 = the all-zero base slot) and every forward
+    # carries per-sequence slot indices, so mixed adapter/base batches run
+    # one fused program. 0 disables (no extra args, no extra HBM).
+    lora_slots: int = 0
+    # max adapter rank the device stacks hold; lower-rank adapters are
+    # zero-padded (free — padded lanes contribute nothing)
+    lora_rank: int = 16
+    # boot-time host-store registrations: "name=/path,other=/path2"
+    # (each path holds adapter.npz or HF-peft adapter_model.safetensors);
+    # device residency stays lazy. The operator materializes the
+    # `loraAdapters` manifest key into DYNAMO_TPU_LORA_ADAPTERS.
+    lora_adapters: Optional[str] = None
+
     # async scheduling: dispatch decode window k+1 BEFORE reading window k's
     # tokens back, overlapping the host sync with device compute (vLLM's
     # async scheduler analogue). Stop detection lags one window; membership
@@ -187,6 +202,18 @@ class EngineConfig:
         p.add_argument("--kvbm-disk-dir",
                        default=_os.environ.get("DYNAMO_TPU_KVBM_DISK_DIR"))
         p.add_argument("--kvbm-disk-blocks", type=int, default=256)
+        # multi-LoRA serving (manifests size it via the DYNAMO_TPU_LORA_*
+        # envs the operator materializes from the loraAdapters key)
+        p.add_argument("--lora-slots", type=int,
+                       default=int(_os.environ.get(
+                           "DYNAMO_TPU_LORA_SLOTS", "0") or 0))
+        p.add_argument("--lora-rank", type=int,
+                       default=int(_os.environ.get(
+                           "DYNAMO_TPU_LORA_RANK", "16") or 16))
+        p.add_argument("--lora-adapters",
+                       default=_os.environ.get("DYNAMO_TPU_LORA_ADAPTERS"),
+                       help="boot-time adapter registrations: "
+                            "name=/path[,name2=/path2]")
         p.add_argument("--disaggregation-mode", default="agg",
                        choices=["agg", "prefill", "decode"])
         p.add_argument("--is-prefill-worker", action="store_true")
@@ -246,6 +273,9 @@ class EngineConfig:
             kvbm_gate=getattr(args, "kvbm_gate", "auto"),
             kvbm_disk_dir=getattr(args, "kvbm_disk_dir", None),
             kvbm_disk_blocks=getattr(args, "kvbm_disk_blocks", 256),
+            lora_slots=getattr(args, "lora_slots", 0),
+            lora_rank=getattr(args, "lora_rank", 16),
+            lora_adapters=getattr(args, "lora_adapters", None),
             disaggregation_mode=mode,
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,

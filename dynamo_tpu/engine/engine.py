@@ -42,6 +42,7 @@ from dynamo_tpu.engine.kv_cache import (
 )
 from dynamo_tpu.engine.request import GenRequest, TokenEvent
 from dynamo_tpu.engine import sampling as smp
+from dynamo_tpu.lora.registry import NoFreeAdapterSlot
 from dynamo_tpu.models import llama
 from dynamo_tpu.ops import json_guide
 from dynamo_tpu.models.config import ModelConfig
@@ -183,10 +184,10 @@ class InflightPrefill:
     """A long prompt being prefilled chunk-by-chunk between decode windows."""
 
     __slots__ = ("req", "pages", "pages_arr", "prompt_len", "done", "slot",
-                 "t_start")
+                 "t_start", "aslot")
 
     def __init__(self, req: GenRequest, pages, pages_arr, prompt_len: int,
-                 slot: int):
+                 slot: int, aslot: int = 0):
         self.req = req
         self.pages = pages  # real page ids (host list, allocator-owned)
         self.pages_arr = pages_arr  # bucket-padded np.int32 for the jit
@@ -195,6 +196,8 @@ class InflightPrefill:
         self.t_start = time.monotonic()  # admission time (TTFT accounting)
         self.slot = slot  # decode slot RESERVED at admission (a concurrent
         # import_kv taking the last slot mid-prefill would strand the finish)
+        self.aslot = aslot  # LoRA device slot (pins it against eviction
+        # for the chunks' duration; the registry reads it)
 
 
 class Engine:
@@ -333,6 +336,24 @@ class Engine:
                 f", disk tier at {cfg.kvbm_disk_dir}"
                 if cfg.kvbm_disk_dir else "")
 
+        # --- multi-LoRA adapter serving (dynamo_tpu.lora) ---
+        # the registry installs stacked [L, slots+1, in, rank] adapter
+        # tensors into self.params (slot 0 = the all-zero base slot) and
+        # manages host-store registration + LRU device loads; every jit
+        # signature gains per-sequence slot indices ONLY when enabled
+        self.lora = None
+        if cfg.lora_slots > 0:
+            from dynamo_tpu.lora.registry import LoRARegistry, \
+                parse_adapter_list
+
+            self.lora = LoRARegistry(self)
+            for name, path in parse_adapter_list(cfg.lora_adapters or ""):
+                self.lora.register(name, path=path)
+            log.info(
+                "multi-LoRA serving: %d device slots x rank<=%d (%s "
+                "boot-registered)", cfg.lora_slots, cfg.lora_rank,
+                len(self.lora.names()) or "none")
+
         # --- batch slots (host-side mirrors of device batch state) ---
         b, pmax = cfg.max_num_seqs, cfg.max_pages_per_seq
         self.block_tables = np.zeros((b, pmax), dtype=np.int32)
@@ -351,6 +372,9 @@ class Engine:
         # per-slot PRNG chain roots (seeded requests are deterministic
         # regardless of batch composition; see engine/sampling.py)
         self.slot_keys = np.zeros((b, 2), dtype=np.uint32)
+        # per-slot LoRA adapter slots (0 = base); uploaded with the
+        # sampling state when multi-LoRA serving is enabled
+        self.adapter_slots = np.zeros((b,), dtype=np.int32)
         self.seqs: Dict[int, SeqState] = {}
         self._free_slots = list(range(b - 1, -1, -1))
         self.pending: collections.deque[GenRequest] = collections.deque()
@@ -382,6 +406,7 @@ class Engine:
         self._dev_tables = None
         # (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals, keys)
         self._dev_sampling = None
+        self._dev_adapters = None  # [B] int32 LoRA slots (lora mode only)
         # async scheduling: the decode window whose tokens have been
         # dispatched but not read back yet — (window, ys, want_lp, t0)
         self._pending_win = None
@@ -412,12 +437,18 @@ class Engine:
             self._dev_state = None
             self._dev_sampling = None
             self._dev_guide = None
+            self._dev_adapters = None
 
     # ------------------------------------------------------------------ jit --
 
     def _build_jit(self):
         cfg, mcfg = self.cfg, self.model_cfg
         page_size = cfg.page_size
+        # multi-LoRA serving: when on, every prefill/chunk/window program
+        # takes one extra operand (the per-sequence adapter-slot indices).
+        # The *aslot splat keeps the lora-off signatures byte-identical to
+        # before — no recompiles, no donation-index churn, zero cost.
+        lora_on = self.lora is not None
         # jax.P / jax.NamedSharding top-level aliases only exist on newer
         # jax releases; the jax.sharding forms work on every version in use
         rep_sharding = jax.sharding.NamedSharding(
@@ -432,18 +463,21 @@ class Engine:
                 lambda a: jax.lax.with_sharding_constraint(a, rep_sharding), x
             )
 
-        def prefill_fn(params, tokens, seq_len, k_pages, v_pages, pages):
+        def prefill_fn(params, tokens, seq_len, k_pages, v_pages, pages,
+                       *aslot):
             out = llama.prefill(
                 mcfg, params, tokens, seq_len, k_pages, v_pages, pages,
                 page_size=page_size,
+                adapter_slots=aslot[0] if aslot else None,
             )
             return rep(out.last_logits), out.k_pages, out.v_pages
 
         def prefill_batch_fn(params, tokens, seq_lens, k_pages, v_pages,
-                             pages):
+                             pages, *aslot):
             out = llama.prefill_batch(
                 mcfg, params, tokens, seq_lens, k_pages, v_pages, pages,
                 page_size=page_size,
+                adapter_slots=aslot[0] if aslot else None,
             )
             return rep(out.last_logits), out.k_pages, out.v_pages
 
@@ -458,10 +492,11 @@ class Engine:
             return rep(smp.sample_with_logprobs(logits, state, folded))
 
         def chunk_fn(params, tokens, start, chunk_len, k_pages, v_pages,
-                     pages):
+                     pages, *aslot):
             out = llama.prefill_chunk(
                 mcfg, params, tokens, start, chunk_len, k_pages, v_pages,
                 pages, page_size=page_size,
+                adapter_slots=aslot[0] if aslot else None,
             )
             return rep(out.last_logits), out.k_pages, out.v_pages
 
@@ -491,8 +526,14 @@ class Engine:
                 params, tokens, positions, context_lens, active, block_tables,
                 temperature, top_p, top_k, presence, frequency, min_p,
                 bias_ids, bias_vals, slot_keys, counts, k_pages, v_pages,
-                *guide_state,
+                *extra,
             ):
+                # extra layout: [adapter_slots]? + [gmode, gdepth, gbits,
+                # gactive]? — adapter slots ride first when lora is on
+                gs = extra
+                aslots = None
+                if lora_on:
+                    aslots, gs = extra[0], extra[1:]
                 state = smp.SamplingState(
                     temperature, top_p, top_k, presence, frequency,
                     min_p, bias_ids, bias_vals,
@@ -500,7 +541,7 @@ class Engine:
                 step = active.astype(positions.dtype)  # inactive slots frozen
                 b = tokens.shape[0]
                 if guided:
-                    gmode0, gdepth0, gbits0, gactive = guide_state
+                    gmode0, gdepth0, gbits0, gactive = gs
                     gact = gactive & active
 
                 def body(carry, _):
@@ -511,6 +552,7 @@ class Engine:
                     out = llama.decode_step(
                         mcfg, params, toks, pos, block_tables, ctx_lens,
                         kp, vp, page_size=page_size,
+                        adapter_slots=aslots,
                     )
                     logits = out.logits
                     if guided:
@@ -721,12 +763,15 @@ class Engine:
                 """Guided decode-window variant, built lazily on first use
                 (warmup()'s __warm_guided/__warm_guided_lp requests trigger
                 all four variants before /ready). The carried grammar state
-                (gmode/gdepth/gbits at 18-20) is donated like the other
-                carry; gactive (21) is reused."""
+                (gmode/gdepth/gbits at 18-20, shifted by one when the lora
+                adapter-slot operand precedes it) is donated like the other
+                carry; gactive (the next position) is reused."""
                 fn = make_decode_window(n_multi if multi else 1, lp,
                                         guide_tables=self._guide_dev)
+                g0 = 19 if lora_on else 18
                 j = jax.jit(fn,
-                            donate_argnums=window_donate + (18, 19, 20))
+                            donate_argnums=window_donate + (g0, g0 + 1,
+                                                            g0 + 2))
                 self._jit_handles[f"window_guided_{multi}_{lp}"] = j
                 return ctx(j)
 
@@ -897,7 +942,14 @@ class Engine:
 
     def validate_request(self, req: GenRequest) -> None:
         """Raise ValueError if the request can never be served (over-length
-        prompt or a KV footprint larger than the whole pool)."""
+        prompt, a KV footprint larger than the whole pool, or an adapter
+        this worker cannot serve)."""
+        if req.adapter:
+            if self.lora is None:
+                raise ValueError(
+                    "adapter requests need --lora-slots > 0 on this worker")
+            if not self.lora.known(req.adapter):
+                raise ValueError(f"unknown adapter {req.adapter!r}")
         if len(req.prompt_token_ids) >= self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_token_ids)} tokens exceeds "
@@ -1031,6 +1083,13 @@ class Engine:
                 self._finish_slot(slot, "abort")
         return events
 
+    def _adapter_slot(self, req: GenRequest) -> int:
+        """Resolve a request's adapter name to its device slot, lazily
+        loading it (LRU-evicting an idle resident if needed). 0 = base."""
+        if self.lora is None or not req.adapter:
+            return 0
+        return self.lora.acquire_slot(req.adapter)
+
     def _admit(self) -> List[TokenEvent]:
         events: List[TokenEvent] = []
         chunk = self.cfg.prefill_chunk_tokens
@@ -1039,6 +1098,22 @@ class Engine:
                 if not self.pending:
                     break
                 req = self.pending[0]
+            if req.adapter:
+                # resolve (and lazily device-load) the adapter BEFORE any
+                # allocation: from here to installation nothing else can
+                # evict the slot (group widening only admits adapters that
+                # are already resident, so no further loads intervene)
+                try:
+                    self._adapter_slot(req)
+                except NoFreeAdapterSlot:
+                    break  # all slots serve live sequences; finishes free one
+                except KeyError:
+                    # unregistered between submit and admission
+                    with self._lock:
+                        self.pending.popleft()
+                    events.append(
+                        TokenEvent(req.request_id, -1, 0, True, "abort"))
+                    continue
             # prefix lookup BEFORE the page gate: only the suffix needs
             # fresh pages, and gating on the full prompt would let the
             # eviction pressure valve evict this very request's cached
@@ -1046,7 +1121,7 @@ class Engine:
             cached_pages, n_cached = [], 0
             if self.prefix_cache is not None:
                 cached_pages, n_cached = self.prefix_cache.lookup(
-                    req.prompt_token_ids
+                    req.prompt_token_ids, namespace=req.adapter or ""
                 )
             n_pages = max(
                 1, -(-len(req.prompt_token_ids) // self.cfg.page_size)
@@ -1116,8 +1191,15 @@ class Engine:
                 break  # chunked path
             if _next_bucket(plen, cfg.page_size, cfg.max_seq_len) != bucket:
                 break  # different compile bucket
+            if nxt.adapter and (self.lora is None
+                                or self.lora.slot_of(nxt.adapter) is None):
+                # non-resident adapter: admit it on its own pass so the
+                # lazy device load (which may LRU-evict a slot an earlier
+                # group member just resolved) never runs mid-group
+                break
             if (self.prefix_cache is not None
-                    and self.prefix_cache.has_prefix(nxt.prompt_token_ids)):
+                    and self.prefix_cache.has_prefix(
+                        nxt.prompt_token_ids, namespace=nxt.adapter or "")):
                 break  # cached prefix -> chunked path (normal loop)
             n_pg = max(1, -(-plen // cfg.page_size))
             if not self._ensure_pages(pending_need + n_pg):
@@ -1167,9 +1249,18 @@ class Engine:
                     self._insert_pending(r, requeue=True)
             return None
 
+        lx = ()
+        if self.lora is not None:
+            # every lane's adapter is resident by construction (_admit
+            # resolved the lead, _widen_group only pulls resident ones) —
+            # these acquires are LRU bumps, never loads
+            aslots = np.zeros((npad,), np.int32)
+            for i, r in enumerate(reqs):
+                aslots[i] = self._adapter_slot(r)
+            lx = (jnp.asarray(aslots),)
         logits, self.k_pages, self.v_pages = self._prefill_batch(
             self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
-            self.k_pages, self.v_pages, jnp.asarray(pages_arr),
+            self.k_pages, self.v_pages, jnp.asarray(pages_arr), *lx,
         )
         keys = np.zeros((npad, 2), np.uint32)
         temp = np.zeros((npad,), np.float32)
@@ -1241,7 +1332,8 @@ class Engine:
         `phase` dict — the per-request bridge the serving layer turns into
         trace spans."""
         if self.prefix_cache is not None:
-            self.prefix_cache.insert(req.prompt_token_ids, pages)
+            self.prefix_cache.insert(req.prompt_token_ids, pages,
+                                     namespace=req.adapter or "")
         slot = self._free_slots.pop()
         seq = self._install_slot(req, slot, pages, prompt_len, first, req_key)
         finished, reason = self._check_stop(seq, first)
@@ -1305,6 +1397,8 @@ class Engine:
         tokens = np.zeros((bucket,), dtype=np.int32)
         tokens[:prompt_len] = prompt
 
+        lx = ((jnp.int32(self._adapter_slot(req)),)
+              if self.lora is not None else ())
         last_logits, self.k_pages, self.v_pages = self._prefill(
             self.params,
             jnp.asarray(tokens),
@@ -1312,6 +1406,7 @@ class Engine:
             self.k_pages,
             self.v_pages,
             jnp.asarray(pages_arr),
+            *lx,
         )
         first, req_key, lp = self._first_token(req, last_logits, prompt_len)
         dt = time.monotonic() - t0
@@ -1348,23 +1443,21 @@ class Engine:
         return self._guide_table
 
     def _stop_ids_for(self, req: GenRequest) -> List[int]:
-        """Effective stop-token set. For guided requests the MODEL eos ids
-        are always included even when the user supplied custom stops: at
-        JSON completion the grammar mask only allows model eos ids, so
-        dropping them would burn a completed object to finish 'length'."""
+        """Effective stop-token set (vLLM semantics): user stop_token_ids
+        are ADDITIONAL — the model's eos ids always stop too, and
+        ignore_eos exempts the MODEL eos only, never the user's explicit
+        ids. The merge lives HERE (the one place that knows model_cfg), so
+        the API layer passes user ids through unmodified and
+        ignore_eos=true + stop_token_ids can no longer stop on model EOS.
+        Guided requests keep model eos regardless of custom stops: at JSON
+        completion the grammar mask only allows model eos ids, so dropping
+        them would burn a completed object to finish 'length'."""
         if req.ignore_eos:
-            # ignore_eos exempts MODEL eos only (vLLM semantics):
-            # explicit user stop ids keep stopping
             return list(req.stop_token_ids or [])
-        ids = list(req.stop_token_ids
-                   or [self.model_cfg.eos_token_id,
-                       *self.model_cfg.extra_stop_token_ids])
-        if req.guided_json:
-            for t in (self.model_cfg.eos_token_id,
-                      *self.model_cfg.extra_stop_token_ids):
-                if t not in ids:
-                    ids.append(t)
-        return ids
+        return list(dict.fromkeys(
+            [*(req.stop_token_ids or []),
+             self.model_cfg.eos_token_id,
+             *self.model_cfg.extra_stop_token_ids]))
 
     def _guide_first_row(self, req: GenRequest):
         """First-token grammar mask as a penalty row (+1e9 on disallowed
@@ -1480,6 +1573,8 @@ class Engine:
         )
         seq.prompt_ids = list(req.prompt_token_ids)
         seq.req = req
+        seq.adapter_slot = self._adapter_slot(req)  # resident: a dict hit
+        self.adapter_slots[slot] = seq.adapter_slot
         seq.output_tokens.append(first)
         if req.guided_json:
             seq.guide = json_guide.replay(
@@ -1555,7 +1650,8 @@ class Engine:
         pages_arr = np.zeros((width,), dtype=np.int32)
         pages_arr[: len(pages)] = pages
         slot = self._free_slots.pop()
-        inf = InflightPrefill(req, pages, pages_arr, prompt_len, slot)
+        inf = InflightPrefill(req, pages, pages_arr, prompt_len, slot,
+                              aslot=self._adapter_slot(req))
         inf.done = n_cached  # cached prefix blocks skip straight to suffix
         self._inflight = inf
 
@@ -1572,6 +1668,7 @@ class Engine:
         tokens = np.zeros((c,), dtype=np.int32)
         tokens[:take] = inf.req.prompt_token_ids[start:start + take]
 
+        lx = (jnp.int32(inf.aslot),) if self.lora is not None else ()
         last_logits, self.k_pages, self.v_pages = self._prefill_chunk(
             self.params,
             jnp.asarray(tokens),
@@ -1580,6 +1677,7 @@ class Engine:
             self.k_pages,
             self.v_pages,
             jnp.asarray(inf.pages_arr),
+            *lx,
         )
         inf.done += take
         dt = time.monotonic() - t0
@@ -1595,7 +1693,8 @@ class Engine:
         self.metrics.prompt_tokens += inf.prompt_len
         req = inf.req
         if self.prefix_cache is not None:
-            self.prefix_cache.insert(req.prompt_token_ids, inf.pages)
+            self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
+                                     namespace=req.adapter or "")
         first, req_key, lp = self._first_token(req, last_logits,
                                                inf.prompt_len)
         slot = inf.slot  # reserved at _start_inflight
@@ -1801,9 +1900,11 @@ class Engine:
         Logprobs requests fall back to the classic window path for the step
         (per-position logprob extraction is not wired through verify);
         JSON-guided requests likewise — the verify forward samples from
-        unmasked logits, which would let drafts escape the grammar."""
+        unmasked logits, which would let drafts escape the grammar; and
+        LoRA-attached sequences — the verify forward runs base-model
+        logits, so drafts would be accepted against the wrong model."""
         if any(s.logprobs is not None or s.guide is not None
-               for s in self.seqs.values()):
+               or s.adapter_slot for s in self.seqs.values()):
             return self._decode_once()
         events: List[TokenEvent] = []
         cfg = self.cfg
@@ -1970,6 +2071,8 @@ class Engine:
                 self.presence, self.frequency, self.min_p,
                 self.bias_ids, self.bias_vals, self.slot_keys,
             )
+        if self.lora is not None and self._dev_adapters is None:
+            (self._dev_adapters,) = self._upload(self.adapter_slots)
 
     def _dispatch_window(self, window: int) -> None:
         t0 = time.monotonic()
@@ -1978,6 +2081,9 @@ class Engine:
         cur, pos, ctx_lens, active_dev = self._dev_state
         (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
          keys) = self._dev_sampling
+        # lora mode: the per-slot adapter indices ride every window (slot 0
+        # keeps base sequences on the zero delta)
+        lx = (self._dev_adapters,) if self.lora is not None else ()
         if any(s.guide is not None for s in self.seqs.values()):
             self._ensure_dev_guide()
             gm, gd, gb, ga = self._dev_guide
@@ -1987,7 +2093,7 @@ class Engine:
                 self.params, cur, pos, ctx_lens, active_dev,
                 self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
                 bias_ids, bias_vals, keys, self.token_counts,
-                self.k_pages, self.v_pages, gm, gd, gb, ga,
+                self.k_pages, self.v_pages, *lx, gm, gd, gb, ga,
             )
             self._dev_guide = (gm, gd, gb, ga)
         else:
@@ -1997,7 +2103,7 @@ class Engine:
                 self.params, cur, pos, ctx_lens, active_dev,
                 self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
                 bias_ids, bias_vals, keys, self.token_counts,
-                self.k_pages, self.v_pages,
+                self.k_pages, self.v_pages, *lx,
             )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
         # capture membership AT DISPATCH: a slot installed later (disagg
@@ -2089,6 +2195,7 @@ class Engine:
         self.min_p[slot] = 0.0
         self.bias_ids[slot] = -1
         self.bias_vals[slot] = 0.0
+        self.adapter_slots[slot] = 0  # unpin the LoRA slot
         self._free_slots.append(slot)
         self.metrics.num_finished += 1
         # the freed slot's device-side block-table row must stop pointing at
@@ -2109,6 +2216,10 @@ class Engine:
         hold-until-pulled contract
         (/root/reference/examples/deploy/sglang/disagg.yaml:47-52).
         """
+        if req.adapter and (self.lora is None
+                            or not self.lora.known(req.adapter)):
+            raise ValueError(f"unknown adapter {req.adapter!r} on this "
+                             f"prefill worker")
         if len(req.prompt_token_ids) >= self.cfg.max_seq_len:
             raise ValueError("prompt exceeds max_seq_len")
         n_pages = max(1, -(-len(req.prompt_token_ids) // self.cfg.page_size))
@@ -2206,6 +2317,10 @@ class Engine:
                 f"roles must use the same --kv-cache-dtype (and, for int8 "
                 f"KV, the same --tensor-parallel: the rows are lane-blocked "
                 f"per TP shard)")
+        if req.adapter and (self.lora is None
+                            or not self.lora.known(req.adapter)):
+            raise ValueError(f"unknown adapter {req.adapter!r} on this "
+                             f"decode worker")
         stop_ids = self._stop_ids_for(req)
         if first_token in stop_ids:
             return True, "stop"
@@ -2218,6 +2333,9 @@ class Engine:
     def _import_kv_locked(self, req, first_token, k, v, n_prompt, n_pages):
         if not self._free_slots:
             raise OutOfPages("no free decode slot for imported sequence")
+        # resolve (and lazily load) the adapter BEFORE any allocation so a
+        # NoFreeAdapterSlot/unknown-adapter failure can't leak pages/slots
+        self._adapter_slot(req)
         self._ensure_pages(n_pages)  # evict cached pages under pressure
         pages = self.allocator.alloc(n_pages)
         idx = jnp.asarray(pages, jnp.int32)

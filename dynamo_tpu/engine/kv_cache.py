@@ -210,22 +210,30 @@ class PrefixCache:
         h.update(np.asarray(block, dtype=np.int64).tobytes())
         return h.digest()
 
-    def _hashes(self, tokens, n_blocks: int):
-        out, h = [], b"root"
+    def _hashes(self, tokens, n_blocks: int, namespace: str = ""):
+        """Rolling block-hash chain. `namespace` seeds the chain root —
+        multi-LoRA serving keys cached prefixes by (adapter, tokens), so
+        two adapters (or an adapter and the base model) can NEVER share a
+        KV prefix: their attention projections differ, so identical tokens
+        produce different pages. The namespaced hashes flow through the
+        KVBM tiers and the cluster KV event plane unchanged."""
+        out, h = [], (b"root" if not namespace
+                      else b"root|" + namespace.encode("utf-8"))
         for i in range(n_blocks):
             h = self._chain(h, tokens[i * self.page_size:
                                        (i + 1) * self.page_size])
             out.append(h)
         return out
 
-    def lookup(self, prompt_tokens) -> "tuple[list[int], int]":
+    def lookup(self, prompt_tokens,
+               namespace: str = "") -> "tuple[list[int], int]":
         """Longest cached prefix: returns (page_ids, n_tokens). The pages
         come back ref'd for the caller (the sequence now co-owns them).
         Always leaves >= 1 token uncached so the final-token logits are
         recomputed."""
         limit = (len(prompt_tokens) - 1) // self.page_size
         pages: "list[int]" = []
-        hashes = self._hashes(prompt_tokens, limit)
+        hashes = self._hashes(prompt_tokens, limit, namespace)
         i = 0
         while i < limit:
             page = self._map.get(hashes[i])
@@ -256,21 +264,21 @@ class PrefixCache:
             self.misses += 1
         return pages, len(pages) * self.page_size
 
-    def has_prefix(self, prompt_tokens) -> bool:
+    def has_prefix(self, prompt_tokens, namespace: str = "") -> bool:
         """True when lookup() would hit — WITHOUT taking references,
         bumping LRU order, or touching hit/miss statistics (admission
         grouping peeks to route cached prompts to the chunked path)."""
         if len(prompt_tokens) <= self.page_size:
             return False
-        first = self._chain(b"root", prompt_tokens[:self.page_size])
+        first = self._hashes(prompt_tokens, 1, namespace)[0]
         return first in self._map
 
-    def insert(self, prompt_tokens, pages) -> None:
+    def insert(self, prompt_tokens, pages, namespace: str = "") -> None:
         """Publish a fully-prefilled prompt's FULL pages. Each newly
         published page gains a cache-owned reference."""
         n_full = len(prompt_tokens) // self.page_size
         fresh: "list[bytes]" = []
-        for h, page in zip(self._hashes(prompt_tokens, n_full),
+        for h, page in zip(self._hashes(prompt_tokens, n_full, namespace),
                            pages[:n_full]):
             if h in self._map:
                 continue
@@ -328,6 +336,7 @@ class SeqState:
         "prompt_len", "logprobs", "prompt_ids",
         "req",  # originating GenRequest (preemption rebuilds a continuation)
         "guide",  # (mode, depth, bits) JSON-guide host mirror, or None
+        "adapter_slot",  # LoRA device slot (0 = base) — pins the slot
     )
 
     def __init__(
@@ -356,6 +365,7 @@ class SeqState:
         self.stop_token_ids = stop_token_ids or []
         self.logprobs = logprobs
         self.guide = None
+        self.adapter_slot = 0
         # prompt token ids, retained for the n-gram speculative proposer
         # (engine._propose_ngram fills it at slot installation)
         self.prompt_ids: List[int] = []

@@ -52,6 +52,11 @@ class GenRequest:
     # the identical fold_in(key, position) chain the original worker was
     # on — even for unseeded sampled requests
     resume_key: Optional[List[int]] = None
+    # multi-LoRA serving (dynamo_tpu.lora): adapter NAME this request
+    # decodes under (None = the bare base model). Resolved to a device
+    # slot at admission — lazily loading the adapter if it isn't resident
+    # — and carried across preemption/recovery continuations.
+    adapter: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -1,0 +1,6 @@
+"""Multi-LoRA adapter serving.
+
+`apply` holds the batched in-engine LoRA math (stacked `[slots, r, d]`
+device tensors, one gathered einsum per projection); `registry` holds the
+host-resident adapter store with bounded device slots and LRU load/unload.
+"""

@@ -285,11 +285,17 @@ def _scan_layers_paged(params: Params, body, x, k_pages, v_pages,
 
 
 def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array,
-         rope=None):
+         rope=None, lora_slots=None):
     """x: [T, E] -> q [T, H, D], k/v [T, KV, D] with rope applied.
 
     `rope`: optional per-layer (theta, position_scale) from _layer_rope
     (gemma-3's interleaved rope bases); None = cfg.rope_theta everywhere.
+
+    `lora_slots`: [T] int32 per-token adapter-slot indices (multi-LoRA
+    serving, dynamo_tpu.lora): when given and the param tree carries
+    stacked LoRA matrices, each projection gains its token's adapter delta
+    `(x @ A[s]) @ B[s]` via one gathered einsum — slot 0 is the all-zero
+    base slot, so mixed adapter/base batches run one fused program.
 
     MLA models route through _qkv_mla: the returned "k"/"v" are the SHARED
     latent rows [T, 1, lora+rope] (what the paged cache stores) and q is
@@ -300,6 +306,15 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jax.Array, positions: jax.Array,
     q = qeinsum("te,ehd->thd", x, lp["wq"])
     k = qeinsum("te,ekd->tkd", x, lp["wk"])
     v = qeinsum("te,ekd->tkd", x, lp["wv"])
+    if lora_slots is not None and "lora_qa" in lp:
+        from dynamo_tpu.lora import apply as _lora
+
+        q = q + _lora.delta(jnp, x, lp["lora_qa"], lp["lora_qb"],
+                            lora_slots).reshape(q.shape)
+        k = k + _lora.delta(jnp, x, lp["lora_ka"], lp["lora_kb"],
+                            lora_slots).reshape(k.shape)
+        v = v + _lora.delta(jnp, x, lp["lora_va"], lp["lora_vb"],
+                            lora_slots).reshape(v.shape)
     if cfg.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -373,11 +388,13 @@ def _qkv_mla(cfg: ModelConfig, lp: Params, x: jax.Array,
     return q_eff, row, row
 
 
-def _attn_out(cfg: ModelConfig, lp: Params, o: jax.Array) -> jax.Array:
+def _attn_out(cfg: ModelConfig, lp: Params, o: jax.Array,
+              lora_slots=None) -> jax.Array:
     """Attention output [..., H, D] -> residual [..., E].
 
     MLA: o's first kv_lora_rank lanes are probs @ c_kv; expand through
-    W_UV per head, then the normal output projection."""
+    W_UV per head, then the normal output projection. `lora_slots` adds
+    the o-projection's per-token adapter delta (see _qkv)."""
     lead = o.shape[:-2]
     h = o.shape[-2]
     o2 = o.reshape((-1, h, o.shape[-1]))
@@ -386,6 +403,11 @@ def _attn_out(cfg: ModelConfig, lp: Params, o: jax.Array) -> jax.Array:
                         o2[..., :cfg.kv_lora_rank].astype(jnp.float32),
                         lp["w_uv"].astype(jnp.float32)).astype(o.dtype)
     out = qeinsum("thd,hde->te", o2, lp["wo"])
+    if lora_slots is not None and "lora_oa" in lp:
+        from dynamo_tpu.lora import apply as _lora
+
+        out = out + _lora.delta(jnp, o2.reshape(o2.shape[0], -1),
+                                lp["lora_oa"], lp["lora_ob"], lora_slots)
     return out.reshape(lead + (out.shape[-1],))
 
 
@@ -462,6 +484,7 @@ def prefill(
     pages: jax.Array,  # [S // page_size] page ids for this sequence
     *,
     page_size: int,
+    adapter_slots=None,  # scalar int32 LoRA slot for this sequence, or None
 ) -> PrefillOut:
     """Process a full prompt, writing its KV into the paged cache.
 
@@ -471,17 +494,21 @@ def prefill(
     s = tokens.shape[0]
     positions = jnp.arange(s)
     token_mask = positions < seq_len  # padding rows past the true length
+    slots = (None if adapter_slots is None
+             else jnp.full((s,), adapter_slots, jnp.int32))
     x = _embed_rows(cfg, params, tokens)
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions,
                        rope=_layer_rope(cfg, page_off,
-                                        k_pages.shape[1]))
+                                        k_pages.shape[1]),
+                       lora_slots=slots)
         o = att.prefill_attention(
             q, k, v, seq_len,
             **_attn_kwargs(cfg, page_off, k_pages.shape[1]))
-        x = x + _post(cfg, lp, "post_attn_norm", _attn_out(cfg, lp, o))
+        x = x + _post(cfg, lp, "post_attn_norm",
+                      _attn_out(cfg, lp, o, lora_slots=slots))
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, pages + page_off, page_size=page_size
         )
@@ -510,6 +537,7 @@ def prefill_chunk(
     pages: jax.Array,  # [Pbucket] ALL page ids of the sequence (0-padded)
     *,
     page_size: int,
+    adapter_slots=None,  # scalar int32 LoRA slot for this sequence, or None
 ) -> PrefillOut:
     """One chunk of an incremental (chunked) prefill.
 
@@ -531,13 +559,16 @@ def prefill_chunk(
     chunk_pages = jax.lax.dynamic_slice(
         pages, (start // page_size,), (c // page_size,)
     )
+    slots = (None if adapter_slots is None
+             else jnp.full((c,), adapter_slots, jnp.int32))
     x = _embed_rows(cfg, params, tokens)
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions,
                        rope=_layer_rope(cfg, page_off,
-                                        k_pages.shape[1]))
+                                        k_pages.shape[1]),
+                       lora_slots=slots)
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, chunk_pages + page_off, page_size=page_size
         )
@@ -546,7 +577,8 @@ def prefill_chunk(
             num_kv_heads=cfg.cache_kv_heads,
             **_attn_kwargs(cfg, page_off, k_pages.shape[1]),
         )
-        x = x + _post(cfg, lp, "post_attn_norm", _attn_out(cfg, lp, o))
+        x = x + _post(cfg, lp, "post_attn_norm",
+                      _attn_out(cfg, lp, o, lora_slots=slots))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         x = x + _post(cfg, lp, "post_mlp_norm",
                   _mlp(cfg, lp, h, token_mask=token_mask,
@@ -578,6 +610,7 @@ def prefill_batch(
     #                     AND for every page of a dummy lane)
     *,
     page_size: int,
+    adapter_slots=None,  # [N] int32 per-lane LoRA slots, or None
 ) -> PrefillBatchOut:
     """Prefill N same-bucket prompts in ONE dispatch.
 
@@ -591,13 +624,16 @@ def prefill_batch(
     n, s = tokens.shape
     positions = jnp.tile(jnp.arange(s), n)  # [N*S] per-lane positions
     token_mask = (jnp.arange(s)[None, :] < seq_lens[:, None]).reshape(-1)
+    slots = (None if adapter_slots is None
+             else jnp.repeat(adapter_slots.astype(jnp.int32), s))
     x = _embed_rows(cfg, params, tokens.reshape(-1))
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions,
                        rope=_layer_rope(cfg, page_off,
-                                        k_pages.shape[1]))  # [N*S,...]
+                                        k_pages.shape[1]),
+                       lora_slots=slots)  # [N*S,...]
         akw = _attn_kwargs(cfg, page_off, k_pages.shape[1])
         o = jax.vmap(
             lambda qq, kk, vv, sl: att.prefill_attention(
@@ -609,7 +645,8 @@ def prefill_batch(
             seq_lens,
         )
         x = x + _post(cfg, lp, "post_attn_norm",
-                  _attn_out(cfg, lp, o.reshape(n * s, *o.shape[2:])))
+                  _attn_out(cfg, lp, o.reshape(n * s, *o.shape[2:]),
+                            lora_slots=slots))
         kp, vp = att.write_kv_prefill(
             kp, vp, k, v, pages.reshape(-1) + page_off, page_size=page_size
         )
@@ -717,15 +754,19 @@ def decode_step(
     v_pages: jax.Array,
     *,
     page_size: int,
+    adapter_slots=None,  # [B] int32 per-slot LoRA slots, or None
 ) -> DecodeOut:
     """One continuous-batching decode step over all batch slots."""
     x = _embed_rows(cfg, params, tokens)  # [B, E]
+    slots = (None if adapter_slots is None
+             else adapter_slots.astype(jnp.int32))
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, positions,
                        rope=_layer_rope(cfg, page_off,
-                                        k_pages.shape[1]))
+                                        k_pages.shape[1]),
+                       lora_slots=slots)
         tables = block_tables + page_off
         kp, vp = att.write_kv_token(
             kp, vp, k, v, tables, positions, page_size=page_size
@@ -735,7 +776,8 @@ def decode_step(
             num_kv_heads=cfg.cache_kv_heads,
             **_attn_kwargs(cfg, page_off, k_pages.shape[1]),
         )
-        x = x + _post(cfg, lp, "post_attn_norm", _attn_out(cfg, lp, o))
+        x = x + _post(cfg, lp, "post_attn_norm",
+                      _attn_out(cfg, lp, o, lora_slots=slots))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         x = x + _post(cfg, lp, "post_mlp_norm", _mlp(cfg, lp, h))
         return x, kp, vp

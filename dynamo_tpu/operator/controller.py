@@ -75,6 +75,23 @@ class Controller:
                 in self._planner.items()
                 if n == ns and d == name and st.get("replicas")}
 
+    def _prune_planner(self, cr: Dict[str, Any]) -> None:
+        """Drop planner decisions whose service lost its `autoscaling`
+        block (or vanished) — checked on EVERY reconcile, not just in
+        planner_tick, so removing autoscaling from the CR takes effect on
+        the next watch event instead of persisting a stale replica
+        override for up to a planner interval."""
+        ns, name = self._ns(cr), cr["metadata"]["name"]
+        services = cr.get("spec", {}).get("services") or {}
+        stale = [key for key in self._planner
+                 if key[0] == ns and key[1] == name
+                 and not ((services.get(key[2]) or {}).get("autoscaling")
+                          or {}).get("enabled")]
+        for key in stale:
+            log.info("planner: dropping stale override for %s/%s.%s "
+                     "(autoscaling removed)", *key)
+            del self._planner[key]
+
     # ------------------------------------------------------------- children --
     def _owned(self, api_version: str, plural: str, ns: str,
                ns_label: str) -> List[Dict]:
@@ -111,6 +128,7 @@ class Controller:
         name = cr["metadata"]["name"]
         ns = self._ns(cr)
         ns_label = mat.discovery_label_value(ns, name)
+        self._prune_planner(cr)
         desired = mat.materialize(cr, gang=self.gang,
                                   gang_scheduler=self.gang_scheduler,
                                   replica_overrides=self._planner_overrides(

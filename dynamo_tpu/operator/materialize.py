@@ -193,6 +193,14 @@ def _container(
         # pod that is still mid-handoff
         env.append({"name": "DRAIN_TIMEOUT_S",
                     "value": str(drain_seconds(spec))})
+        # multi-LoRA serving (dynamo_tpu.lora): `loraAdapters` lists the
+        # adapters this worker registers at boot — entries are
+        # {name, path} maps or "name=/path" strings; paths usually live on
+        # a mounted PVC. `loraSlots`/`loraMaxRank` size the device slots.
+        # The worker CLI reads these envs as its --lora-* defaults.
+        spec_env = lora_adapter_env(spec)
+        for name, value in spec_env:
+            env.append({"name": name, "value": value})
     for e in spec.get("envs") or []:
         env.append(dict(e))
     c["env"] = env
@@ -212,6 +220,38 @@ def _container(
     if res:
         c["resources"] = res
     return c
+
+
+def lora_adapter_env(spec: Dict[str, Any]) -> List[tuple]:
+    """The `loraAdapters`/`loraSlots`/`loraMaxRank` manifest keys as
+    (env name, value) pairs for a worker container. `loraAdapters` entries
+    may be {name, path} maps or "name=/path" strings; slots default to the
+    adapter count when adapters are given without an explicit size."""
+    out: List[tuple] = []
+    adapters = spec.get("loraAdapters") or []
+    pairs = []
+    for a in adapters:
+        if isinstance(a, dict):
+            name, path = a.get("name"), a.get("path")
+            if not name or not path:
+                raise ValueError(
+                    f"loraAdapters entries need name AND path: {a!r}")
+            pairs.append(f"{name}={path}")
+        else:
+            if "=" not in str(a):
+                raise ValueError(
+                    f"loraAdapters string entries are name=/path: {a!r}")
+            pairs.append(str(a))
+    slots = spec.get("loraSlots")
+    if slots is None and pairs:
+        slots = len(pairs)
+    if slots is not None:
+        out.append(("DYNAMO_TPU_LORA_SLOTS", str(int(slots))))
+    if pairs:
+        out.append(("DYNAMO_TPU_LORA_ADAPTERS", ",".join(pairs)))
+    if spec.get("loraMaxRank") is not None:
+        out.append(("DYNAMO_TPU_LORA_RANK", str(int(spec["loraMaxRank"]))))
+    return out
 
 
 def drain_seconds(spec: Dict[str, Any]) -> int:

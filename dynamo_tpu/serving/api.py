@@ -134,14 +134,11 @@ class GenerationHandle:
         self.prompt_ids = prompt_ids
         # each choice of an n>1 request gets its own deterministic chain
         seed = params.get("seed")
+        # user stop_token_ids pass through UNMODIFIED: the model-EOS merge
+        # lives in engine._stop_ids_for (which knows model_cfg and the
+        # ignore_eos exemption), so ignore_eos=true + stop_token_ids no
+        # longer stops on model EOS (vLLM semantics)
         stop_ids = list(params.get("stop_token_ids") or [])
-        if stop_ids:
-            # vLLM semantics: stop_token_ids are ADDITIONAL — model EOS
-            # keeps stopping (the engine treats a non-empty list as the
-            # full set, so append the model's ids here)
-            mc = ctx.engine.model_cfg
-            stop_ids = list(dict.fromkeys(
-                [*stop_ids, mc.eos_token_id, *mc.extra_stop_token_ids]))
         self.req = GenRequest(
             rid,
             list(prompt_ids),
@@ -161,7 +158,12 @@ class GenerationHandle:
             stop_token_ids=stop_ids,
             prior_output_token_ids=prior,
             resume_key=(rec or {}).get("resume_key"),
+            adapter=params.get("adapter"),
         )
+        if self.req.adapter and ctx.lora_requests_total is not None:
+            ctx.lora_requests_total.inc(adapter=self.req.adapter)
+            if ctx.engine.lora is not None:
+                ctx.engine.lora.note_request(self.req.adapter)
         if ctx.disagg_client is not None:
             # decode role: prefill remotely, pull KV, continue locally
             self.queue = ctx.disagg_client.start(self.req,
@@ -194,7 +196,14 @@ class GenerationHandle:
         if not self.span.recording:
             return None
         tracer = self.ctx.tracer
-        eng_ph = self.ctx.engine.metrics.phases
+        eng = self.ctx.engine
+        if self.req.adapter and eng.lora is not None:
+            # the device slot is known once admission resolved it
+            self.span.set_attributes({
+                "lora.adapter": self.req.adapter,
+                "lora.slot": eng.lora.slot_of(self.req.adapter) or 0,
+            })
+        eng_ph = eng.metrics.phases
         t_first_ns = time.time_ns()
         phase = ev.phase or {}
         queue_ns = int(phase.get("queue_s", 0.0) * 1e9)
@@ -397,6 +406,28 @@ class ServingContext:
         self.kv_gauge = Gauge(
             "dynamo_worker_kv_free_pages", "Free KV pages", self.metrics.registry
         )
+        # --- multi-LoRA adapter serving (dynamo_tpu.lora) ---
+        self.lora_requests_total = None
+        self.lora_loaded_gauge = None
+        if engine.lora is not None:
+            from dynamo_tpu.serving.metrics import CallbackCounter, Counter
+
+            self.lora_requests_total = Counter(
+                "dynamo_lora_requests_total",
+                "Requests served under a LoRA adapter, by adapter",
+                self.metrics.registry,
+            )
+            CallbackCounter(
+                "dynamo_lora_swaps_total",
+                "Adapter loads into a device slot (incl. LRU swap reloads)",
+                self.metrics.registry,
+                lambda: engine.lora.swaps_total,
+            )
+            self.lora_loaded_gauge = Gauge(
+                "dynamo_lora_loaded",
+                "Adapters resident in device slots right now",
+                self.metrics.registry,
+            )
         # --- KVBM tiered block manager (dynamo_tpu.kvbm) ---
         self.kv_event_publisher = None  # attached by the worker entrypoint
         self.kvbm_source = None  # peer-pull server over the transfer plane
@@ -691,19 +722,44 @@ class _Handler(JsonHTTPHandler):
     _span = obs_tracing.NOOP_SPAN  # set per-request in do_POST
 
     # ------------------------------------------------------------- routes --
+    def _model_ids(self) -> List[str]:
+        """Served model ids: the base plus one '<base>:<adapter>' entry per
+        host-registered adapter (multi-LoRA addressing)."""
+        ids = [self.ctx.served_model]
+        lora = self.ctx.engine.lora
+        if lora is not None:
+            ids += [f"{self.ctx.served_model}:{n}" for n in lora.names()]
+        return ids
+
     def do_GET(self):
         path = self.path.split("?")[0]
         if path == "/v1/models":
-            self._json(200, proto.models_response([self.ctx.served_model]))
+            self._json(200, proto.models_response(self._model_ids()))
         elif path.startswith("/v1/models/"):
             mid = path[len("/v1/models/"):]
-            if mid == self.ctx.served_model:
+            if mid in self._model_ids():
                 self._json(200, proto.model_response(mid))
             else:
                 self._error(404, f"model {mid!r} not found", "not_found")
+        elif path == "/v1/adapters":
+            lora = self.ctx.engine.lora
+            if lora is None:
+                self._error(400, "this worker serves no adapters "
+                            "(--lora-slots is 0)")
+                return
+            st = lora.stats()
+            self._json(200, {
+                "object": "list",
+                "data": lora.describe(),
+                "slots": {"total": st["slots_total"],
+                          "free": st["slots_free"]},
+            })
         elif path == "/metrics":
             self.ctx.preempt_gauge.set(
                 self.ctx.engine.metrics.num_preempted)
+            if self.ctx.lora_loaded_gauge is not None:
+                self.ctx.lora_loaded_gauge.set(
+                    len(self.ctx.engine.lora.resident()))
             if self.ctx.engine.kvbm is not None:
                 pool = self.ctx.engine.kvbm.pool.stats()
                 self.ctx.kvbm_blocks_gauge.set(pool["used_blocks"],
@@ -769,6 +825,8 @@ class _Handler(JsonHTTPHandler):
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 out["prefix_cache"] = pc.stats()
+            if eng.lora is not None:
+                out["lora"] = eng.lora.stats()
             if eng.kvbm is not None:
                 out["kvbm"] = eng.kvbm.stats()
                 if self.ctx.kvbm_source is not None:
@@ -850,6 +908,8 @@ class _Handler(JsonHTTPHandler):
                     self._disagg_stage(self._read_json_body())
                 elif path == "/disagg/release":
                     self._disagg_release(self._read_json_body())
+                elif path == "/v1/adapters":
+                    self._adapters_post(self._read_json_body())
                 elif path == "/internal/faults":
                     try:
                         self._json(200, faults.http_configure(
@@ -910,6 +970,9 @@ class _Handler(JsonHTTPHandler):
             seed=int(seed) if seed is not None else None,
             logprobs=int(lp) if lp is not None else None,
             guided_json=bool(body.get("guided_json", False)),
+            # multi-LoRA: the decode role forwards its request's adapter so
+            # the prefill runs under the same weights the decode will
+            adapter=body.get("adapter") or None,
         )
         self._span.set_attribute("request.id", rid)
         faults.sleep_point("worker.slow_prefill")
@@ -985,11 +1048,67 @@ class _Handler(JsonHTTPHandler):
             ctx.kv_device_source.mark_released(rid)
         self._json(200, {"request_id": rid, "released": True})
 
-    def _check_model(self, model: str):
-        if model not in (self.ctx.served_model, self.ctx.engine.cfg.model):
+    def _adapters_post(self, body):
+        """Runtime adapter management (POST /v1/adapters):
+        {"name": n, "path": p}           register (host store; device lazy)
+        {"name": n, "path": p, "load": true}   register + pin into a slot
+        {"name": n, "unload": true}      drop the device slot (host stays)
+        {"name": n, "remove": true}      unregister entirely
+        """
+        from dynamo_tpu.lora.registry import NoFreeAdapterSlot
+
+        lora = self.ctx.engine.lora
+        if lora is None:
             raise proto.BadRequest(
-                f"model {model!r} not served (serving {self.ctx.served_model!r})"
-            )
+                "this worker serves no adapters (--lora-slots is 0)")
+        name = body.get("name")
+        if not isinstance(name, str) or not name:
+            raise proto.BadRequest("'name' is required")
+        try:
+            if body.get("remove"):
+                lora.unregister(name)
+                self._json(200, {"name": name, "removed": True})
+                return
+            if body.get("unload"):
+                was = lora.unload(name)
+                self._json(200, {"name": name, "unloaded": was})
+                return
+            if body.get("path"):
+                lora.register(name, path=str(body["path"]))
+            elif not lora.known(name):
+                raise proto.BadRequest(
+                    f"unknown adapter {name!r} (give 'path' to register)")
+            slot = None
+            if body.get("load"):
+                slot = lora.acquire_slot(name)
+        except NoFreeAdapterSlot as e:
+            self._error(503, str(e), "service_unavailable")
+            return
+        except (ValueError, KeyError) as e:
+            raise proto.BadRequest(str(e))
+        self._json(200, {"name": name, "registered": True,
+                         "resident": lora.slot_of(name) is not None,
+                         **({"slot": slot} if slot is not None else {})})
+
+    def _check_model(self, model: str) -> Optional[str]:
+        """Validate the request's model id; returns the adapter name when
+        the id uses '<base>:<adapter>' addressing (multi-LoRA), else None."""
+        bases = (self.ctx.served_model, self.ctx.engine.cfg.model)
+        if model in bases:
+            return None
+        adapter = None
+        for b in bases:
+            if model.startswith(b + ":"):
+                adapter = model[len(b) + 1:]
+                break
+        lora = self.ctx.engine.lora
+        if adapter and lora is not None and lora.known(adapter):
+            return adapter
+        raise proto.BadRequest(
+            f"model {model!r} not served (serving {self.ctx.served_model!r}"
+            + (f" + adapters {lora.names()}" if lora is not None else "")
+            + ")"
+        )
 
     # ------------------------------------------- mid-stream recovery ----
     def _journal_comment(self, obj) -> None:
@@ -1028,7 +1147,7 @@ class _Handler(JsonHTTPHandler):
 
     def _chat(self, body):
         p = proto.parse_chat_request(body)
-        self._check_model(p["model"])
+        p["adapter"] = self._check_model(p["model"])
         tools, tc = p["tools"], p["tool_choice"]
         forced_tool = isinstance(tc, tuple)  # ("function", name)
         if forced_tool:
@@ -1171,7 +1290,7 @@ class _Handler(JsonHTTPHandler):
 
     def _completion(self, body):
         p = proto.parse_completion_request(body)
-        self._check_model(p["model"])
+        p["adapter"] = self._check_model(p["model"])
         prompt_ids = self.ctx.tokenizer.encode(p["prompt"])
         # KV event plane: the frontend routes completions on the raw
         # prompt string — the same canonical text registered here

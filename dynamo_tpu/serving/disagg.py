@@ -209,6 +209,9 @@ class DisaggDecodeClient:
             # the prefill worker samples the FIRST token, so the grammar
             # mask must apply there too
             "guided_json": req.guided_json,
+            # multi-LoRA: prefill must run under the same adapter weights
+            # the decode side will attach
+            "adapter": req.adapter,
         }).encode()
         t0 = time.monotonic()
         rpc_span = ctx.tracer.start_span(

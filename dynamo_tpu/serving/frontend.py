@@ -35,7 +35,7 @@ from dynamo_tpu.serving import protocol as proto
 from dynamo_tpu.serving import recovery
 from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
 from dynamo_tpu.serving.metrics import FrontendMetrics, Gauge
-from dynamo_tpu.serving.router import Router, prefix_key
+from dynamo_tpu.serving.router import Router, prefix_key, split_adapter
 from dynamo_tpu.utils import net
 
 log = logging.getLogger("dynamo_tpu.frontend")
@@ -170,10 +170,13 @@ class _FrontendHandler(JsonHTTPHandler):
         path = self.path.split("?")[0]
         ctx = self.ctx
         if path == "/v1/models":
-            self._json(200, proto.models_response(ctx.router.models()))
+            # base models plus every '<base>:<adapter>' any live worker
+            # can serve (multi-LoRA addressing)
+            self._json(200, proto.models_response(
+                ctx.router.models_with_adapters()))
         elif path.startswith("/v1/models/"):
             mid = path[len("/v1/models/"):]
-            if mid in ctx.router.models():
+            if mid in ctx.router.models_with_adapters():
                 self._json(200, proto.model_response(mid))
             else:
                 self._error(404, f"model {mid!r} not found", "not_found")
@@ -387,12 +390,18 @@ class _FrontendHandler(JsonHTTPHandler):
             # shed BEFORE routing: no pick, no dial, no engine slot
             self._shed_deadline(span, "before routing")
             return
+        # multi-LoRA addressing: '<base>:<adapter>' routes on the BASE
+        # model's worker set with adapter-affinity (resident > lazy-load
+        # capable > any); the worker re-validates the adapter itself
+        base, adapter = split_adapter(model, ctx.router.models())
+        if adapter:
+            span.set_attribute("router.adapter", adapter)
         explain: dict = {}
         with ctx.tracer.start_span("router.pick", parent=span,
                                    attributes={"model": model}) as pick_span:
-            worker = ctx.router.pick(model, affinity,
+            worker = ctx.router.pick(base, affinity,
                                      prompt_text=prompt_text,
-                                     explain=explain)
+                                     explain=explain, adapter=adapter)
             for k, v in explain.items():
                 pick_span.set_attribute(f"router.{k}", v)
             if worker is not None:
@@ -443,9 +452,9 @@ class _FrontendHandler(JsonHTTPHandler):
                 # exclude workers that already refused: the ledger and HRW
                 # are deterministic, so an unexcluded re-pick would bounce
                 # off the same dead worker three times
-                worker = ctx.router.pick(model, affinity,
+                worker = ctx.router.pick(base, affinity,
                                          prompt_text=prompt_text,
-                                         exclude=tried)
+                                         exclude=tried, adapter=adapter)
                 if worker is None:
                     break
                 span.add_event("failover_repick",
@@ -570,7 +579,8 @@ class _FrontendHandler(JsonHTTPHandler):
         if "text/event-stream" in ctype:
             self._relay_sse(resp, worker, path, body, prompt_text,
                             affinity, model, span, trace_headers, deadline,
-                            tried, attempt, journal_on, t0)
+                            tried, attempt, journal_on, t0,
+                            base=base, adapter=adapter)
         else:
             try:
                 payload = resp.read()
@@ -608,7 +618,8 @@ class _FrontendHandler(JsonHTTPHandler):
                    prompt_text: str, affinity: str, model: str, span,
                    trace_headers: dict, deadline: Deadline,
                    tried: List[str], attempt: int, journal_on: bool,
-                   t0: float) -> None:
+                   t0: float, base: Optional[str] = None,
+                   adapter: Optional[str] = None) -> None:
         """SSE relay with mid-stream recovery (serving/recovery.py).
 
         The worker stream is parsed into event blocks instead of being
@@ -698,10 +709,10 @@ class _FrontendHandler(JsonHTTPHandler):
                 if worker.url not in tried:
                     tried.append(worker.url)
                 explain: dict = {}
-                nxt = ctx.router.pick(model, affinity,
+                nxt = ctx.router.pick(base or model, affinity,
                                       prompt_text=prompt_text,
                                       exclude=tried, explain=explain,
-                                      relaxed_overlap=True)
+                                      relaxed_overlap=True, adapter=adapter)
                 if nxt is None:
                     break
                 worker = nxt

@@ -86,6 +86,22 @@ def prefix_key(text: str, prefix_chars: int = 256) -> str:
     return text[:prefix_chars]
 
 
+def split_adapter(model: str, live_models) -> Tuple[str, Optional[str]]:
+    """'<base>:<adapter>' -> (base, adapter); plain base ids pass through.
+
+    Matching is against the LIVE base-model set first (a base id could in
+    principle contain ':'), falling back to splitting at the last colon so
+    an adapter request can still 503 with a precise model name when no
+    base worker is up."""
+    if model in live_models:
+        return model, None
+    for m in live_models:
+        if model.startswith(m + ":"):
+            return m, model[len(m) + 1:]
+    base, sep, adapter = model.rpartition(":")
+    return (base, adapter) if sep else (model, None)
+
+
 # ledger text-block geometry: 64-char blocks, 64-block hash window.
 # pick()'s relative-overlap denominator derives from the same constants.
 BLOCK_CHARS = 64
@@ -325,13 +341,31 @@ class Router:
                 if w.last_heartbeat >= cutoff
             })
 
+    def models_with_adapters(self) -> List[str]:
+        """Base model ids plus one '<base>:<adapter>' entry per adapter any
+        live worker can serve (resident or lazy-loadable) — the frontend's
+        /v1/models surface."""
+        cutoff = time.monotonic() - self.ttl
+        out = set()
+        with self._lock:
+            for w in self._workers.values():
+                if w.last_heartbeat < cutoff:
+                    continue
+                out.add(w.model)
+                s = w.stats or {}
+                for a in (s.get("adapters_available")
+                          or s.get("adapters") or ()):
+                    out.add(f"{w.model}:{a}")
+        return sorted(out)
+
     # ------------------------------------------------------------- routing --
     def pick(self, model: str, affinity_key: str,
              roles=("agg", "decode"),
              prompt_text: Optional[str] = None,
              exclude=(),
              explain: Optional[Dict] = None,
-             relaxed_overlap: bool = False) -> Optional[WorkerInfo]:
+             relaxed_overlap: bool = False,
+             adapter: Optional[str] = None) -> Optional[WorkerInfo]:
         """`explain`, when given, is filled with the routing decision's
         inputs (candidate count, ledger depth/overlap, decision source) —
         the attributes the frontend's route-decision trace span records.
@@ -340,7 +374,16 @@ class Router:
         failover re-dispatches prompt ⊕ emitted-tokens as a continuation
         prefill, so ANY worker holding even a shallow prefix of it (KV
         event index or ledger) beats the template-herding guardrail —
-        the continuation's prefill cost is what the overlap offsets."""
+        the continuation's prefill cost is what the overlap offsets.
+
+        `adapter` turns on adapter-affinity (multi-LoRA): workers
+        advertising the adapter device-RESIDENT in their heartbeats win;
+        with none resident, workers that can lazy-load it (host store)
+        keep the request; failing that every base-model worker stays a
+        candidate so stale stats can't strand the request. KV-overlap and
+        HRW then run WITHIN the affinity set, and the prefix ledger/event
+        index are keyed '<base>:<adapter>' so adapters never inherit each
+        other's (or the base model's) routing history."""
         if explain is None:
             explain = {}
         self.purge_expired()
@@ -356,6 +399,21 @@ class Router:
             if skipped:
                 explain["breaker_skipped"] = skipped
             cands = allowed
+        if adapter and cands:
+            explain["adapter"] = adapter
+            resident = [w for w in cands
+                        if adapter in ((w.stats or {}).get("adapters")
+                                       or ())]
+            if resident:
+                cands = resident
+                explain["adapter_affinity"] = "resident"
+            else:
+                lazy = [w for w in cands
+                        if adapter in ((w.stats or {})
+                                       .get("adapters_available") or ())]
+                if lazy:
+                    cands = lazy
+                explain["adapter_affinity"] = "fallback_lazy_load"
         if not cands:
             # no worker serves this model -> let the frontend 503 rather than
             # bouncing the request off a wrong-model worker's 400
@@ -372,16 +430,22 @@ class Router:
         # fraction however long the template is. Saturated holders still
         # shed to HRW (recompute beats queueing).
         chain = text_block_chain(prompt_text) if prompt_text else []
+        # adapter requests key the routing history by '<base>:<adapter>' —
+        # mirroring the engine's adapter-keyed prefix cache, so an
+        # adapter's turns never herd onto a worker that only cached the
+        # BASE model's KV for the same text
+        ledger_model = f"{model}:{adapter}" if adapter else model
         if chain:
             live = {w.url: w for w in cands}
             # PRIMARY: the worker-published KV event index — real cache
             # contents (kvbm event plane), not this frontend's routing
             # history; the ledger covers cold/indexless prefixes
-            url, depth = self.kv_index.lookup(model, chain, live)
+            url, depth = self.kv_index.lookup(ledger_model, chain, live)
             source = "kv_event_index"
             if url is None:
                 with self._lock:
-                    url, depth = self._ledger.lookup(model, chain, live)
+                    url, depth = self._ledger.lookup(ledger_model, chain,
+                                                     live)
                 source = "kv_overlap_ledger"
             # the ratio denominator uses the TRUE prompt length (capped at
             # the chain window) so a prompt longer than the hashed window
@@ -407,7 +471,7 @@ class Router:
                         self.ledger_hits += 1
                         if self.ledger_counter is not None:
                             self.ledger_counter.inc()
-                    self._ledger.record(model, chain, url)
+                    self._ledger.record(ledger_model, chain, url)
                 explain["source"] = source
                 explain["headroom"] = round(live[url].headroom, 4)
                 return self._finish_pick(live[url], explain)
@@ -429,7 +493,7 @@ class Router:
             picked = best
         if chain and picked is not None:
             with self._lock:
-                self._ledger.record(model, chain, picked.url)
+                self._ledger.record(ledger_model, chain, picked.url)
         if picked is not None:
             explain["headroom"] = round(picked.headroom, 4)
             return self._finish_pick(picked, explain)

@@ -98,6 +98,12 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                 **({"kvbm_host_blocks": eng.cfg.kvbm_host_blocks,
                     "kvbm_peer_port": ctx.kvbm_source.port}
                    if ctx.kvbm_source is not None else {}),
+                # multi-LoRA: device-RESIDENT adapters drive the router's
+                # adapter-affinity pass; host-registered ones mark this
+                # worker lazy-load capable for the fallback
+                **({"adapters": sorted(eng.lora.resident()),
+                    "adapters_available": eng.lora.names()}
+                   if eng.lora is not None else {}),
             },
         }).encode()
         try:

@@ -190,3 +190,30 @@ def test_health_and_stats(server_url):
     assert stats["model"] == MODEL
     assert stats["total_pages"] == 128
     assert stats["metrics"]["num_finished"] >= 3
+
+
+def test_ignore_eos_with_user_stop_token_ids_ignores_model_eos(server_url):
+    """ADVICE r5: ignore_eos=true + stop_token_ids must NOT stop on model
+    EOS (vLLM semantics — the EOS merge lives in engine._stop_ids_for, not
+    the API layer). logit_bias +100 on the model's EOS id (2)
+    makes greedy decode emit EOS every step, so the old merged-stop-set
+    bug would finish 'stop' after 1 token."""
+    out = post(server_url, "/v1/completions", {
+        "model": MODEL, "prompt": "x", "max_tokens": 5, "temperature": 0,
+        "ignore_eos": True, "stop_token_ids": [300],
+        "logit_bias": {"2": 100},
+    })
+    assert out["usage"]["completion_tokens"] == 5
+    assert out["choices"][0]["finish_reason"] == "length"
+
+
+def test_user_stop_token_ids_are_additional_to_model_eos(server_url):
+    """Without ignore_eos, model EOS keeps stopping even when the user
+    supplies custom stop ids (they are ADDITIONAL, not a replacement)."""
+    out = post(server_url, "/v1/completions", {
+        "model": MODEL, "prompt": "x", "max_tokens": 5, "temperature": 0,
+        "stop_token_ids": [300],
+        "logit_bias": {"2": 100},
+    })
+    assert out["usage"]["completion_tokens"] == 1
+    assert out["choices"][0]["finish_reason"] == "stop"

@@ -249,3 +249,46 @@ def test_release_bundle_builds_and_pins_images(tmp_path):
                   'tpu-device-plugin-${RELEASE_VERSION}.yaml',
                   'tpu-metrics-exporter-${RELEASE_VERSION}.yaml'):
         assert token in sh, token
+
+
+# ---- multi-LoRA manifest key ------------------------------------------------
+
+
+def test_lora_example_materializes_adapter_env():
+    """examples/deploy/jetstream/agg-lora.yaml: the loraAdapters manifest
+    key must land as the DYNAMO_TPU_LORA_* envs the worker CLI reads."""
+    docs = dict(_dgd_docs())
+    doc = docs["examples/deploy/jetstream/agg-lora.yaml"]
+    out = materialize(doc)
+    worker = next(d for d in out["deployments"]
+                  if "loraworker" in d["metadata"]["name"])
+    env = {e["name"]: e.get("value")
+           for e in worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["DYNAMO_TPU_LORA_SLOTS"] == "4"
+    assert env["DYNAMO_TPU_LORA_RANK"] == "16"
+    assert env["DYNAMO_TPU_LORA_ADAPTERS"] == (
+        "support-bot=/models/adapters/support-bot,"
+        "sql-gen=/models/adapters/sql-gen,"
+        "summarizer=/models/adapters/summarizer")
+    # frontends never get LoRA envs
+    fe = next(d for d in out["deployments"]
+              if "frontend" in d["metadata"]["name"])
+    fe_env = {e["name"] for e in
+              fe["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert not any(n.startswith("DYNAMO_TPU_LORA") for n in fe_env)
+
+
+def test_lora_adapter_env_shapes():
+    from dynamo_tpu.operator.materialize import lora_adapter_env
+
+    # string entries + implicit slot count
+    env = dict(lora_adapter_env({"loraAdapters": ["a=/x", "b=/y"]}))
+    assert env["DYNAMO_TPU_LORA_ADAPTERS"] == "a=/x,b=/y"
+    assert env["DYNAMO_TPU_LORA_SLOTS"] == "2"
+    # explicit slots win; no adapters -> no env at all
+    assert dict(lora_adapter_env({})) == {}
+    env = dict(lora_adapter_env({"loraSlots": 8}))
+    assert env == {"DYNAMO_TPU_LORA_SLOTS": "8"}
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        lora_adapter_env({"loraAdapters": [{"name": "x"}]})

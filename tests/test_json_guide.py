@@ -293,3 +293,60 @@ def test_engine_guided_with_async_scheduling_and_churn():
     assert len(out["short"]) == 6
     assert out["g5"] == ref, "guided stream diverged under async churn"
     _check_guided_output(eng, out["g5"])
+
+
+# ---- SentencePiece vocab decomposition (ADVICE r5 medium) -------------------
+
+
+class _FakeSPTokenizer:
+    """Minimal SentencePiece-style tokenizer: pieces carry the '▁'
+    word-boundary marker and byte-fallback '<0xNN>' entries, and
+    decode([id]) STRIPS the leading space marker — exactly the lossiness
+    that let '▁5' be masked as '5' (Phi-3's tokenizer family)."""
+
+    vocab_size = 300
+
+    def __init__(self):
+        self.tok = self  # for_tokenizer's "real tokenizer" duck-type
+        self.pieces = {
+            260: "▁5",       # word-initial digit: bytes must be " 5"
+            261: "▁true",
+            262: "<0x41>",   # byte-fallback piece: exactly b"A"
+            263: "3",        # plain continuation digit
+            264: '{"a":12',  # state-setter for the mask regression below
+        }
+
+    def convert_ids_to_tokens(self, i):
+        return self.pieces.get(i, "<unk>")
+
+    def decode(self, ids):
+        out = "".join(self.pieces.get(i, "") for i in ids)
+        return out.replace("▁", " ").lstrip(" ")  # SP strip semantics
+
+
+def test_for_tokenizer_sp_pieces_keep_leading_space():
+    tok = _FakeSPTokenizer()
+    table = jg.VocabTable.for_tokenizer(tok, eos_ids=[257])
+    # '▁5' must decompose to ' 5' — decode([id]) would have said '5'
+    assert table.token_len[260] == 2
+    assert list(table.token_bytes[260, :2]) == [ord(" "), ord("5")]
+    assert list(table.token_bytes[261, :5]) == [ord(c) for c in " true"]
+    # byte-fallback piece is its raw byte
+    assert table.token_len[262] == 1 and table.token_bytes[262, 0] == 0x41
+    # plain pieces keep the decode path
+    assert table.token_len[263] == 1 and table.token_bytes[263, 0] == ord("3")
+
+
+def test_sp_word_boundary_token_cannot_split_a_number():
+    """Regression for the '12 5' / 'tr ue' class: mid-number, the grammar
+    must NOT allow a word-initial ('▁'-prefixed) digit token — its real
+    rendering starts with a space, which would terminate the number and
+    restart a second bare literal."""
+    tok = _FakeSPTokenizer()
+    table = jg.VocabTable.for_tokenizer(tok, eos_ids=[257])
+    state = jg.replay(table, [264])  # folded '{"a":12' -> mid-number
+    mask = jg.mask_row(table, *state)
+    assert mask[263], "a continuation digit must stay legal mid-number"
+    assert not mask[260], (
+        "'▁5' (renders ' 5') was allowed mid-number — ws-separated digit "
+        "runs would render as '12 5' and fail json.loads")

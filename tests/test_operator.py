@@ -1010,3 +1010,44 @@ def test_planner_status_clears_when_autoscaling_disabled():
             metrics.close()
         except Exception:
             pass
+
+
+def test_reconcile_prunes_stale_planner_override_between_ticks():
+    """ADVICE r5: removing a service's `autoscaling` block must take
+    effect on the NEXT reconcile (watch event), not only at the next
+    planner_tick — a stale in-memory override would otherwise keep
+    applying the old autoscaled replica count for up to a planner
+    interval."""
+    import copy
+
+    metrics = _FakeMetrics()
+    try:
+        with FakeK8s() as fake:
+            client = K8sClient(fake.url)
+            ctrl = Controller(client, namespace=None)
+            cr = _autoscaled_dgd(metrics.url)
+            client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo", cr)
+            metrics.queued = 14
+            ctrl.planner_tick(now=100.0)
+            ctrl.reconcile_once()
+            dep = client.get("apps/v1", "deployments", "dynamo",
+                             "scale-demo-jetstreamdecodeworker")
+            assert dep["spec"]["replicas"] == 4
+
+            # autoscaling removed; a WATCH-triggered reconcile runs BEFORE
+            # the next planner tick and must already apply the CR baseline
+            off = copy.deepcopy(cr)
+            off["spec"]["services"]["JetstreamDecodeWorker"][
+                "autoscaling"] = None
+            client.upsert(mat.API_VERSION, mat.DGD_PLURAL, "dynamo", off)
+            ctrl.reconcile_once()  # no planner_tick in between
+            dep = client.get("apps/v1", "deployments", "dynamo",
+                             "scale-demo-jetstreamdecodeworker")
+            assert dep["spec"]["replicas"] == 1, (
+                "stale planner override applied after autoscaling removal")
+            assert not ctrl._planner, "in-memory override not pruned"
+    finally:
+        try:
+            metrics.close()
+        except Exception:
+            pass

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -29,6 +29,7 @@ help:
 	@echo "  kvbm-check     KVBM suite + long-shared-prefix bench smoke (host-tier hit ratio)"
 	@echo "  recovery-check mid-stream recovery suite (journaled continuation failover, drain handoff)"
 	@echo "  lora-check     multi-LoRA suite (registry LRU, mixed-batch parity, adapter routing)"
+	@echo "  obs-check      SLO/exemplar suite + live scrape validation (burn rates, OpenMetrics)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -93,6 +94,16 @@ recovery-check:
 # target runs it).
 lora-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_lora.py -q -p no:randomly
+
+# Observability gate (docs/observability.md "SLOs and burn rates"): the
+# SLO/exemplar suite (deterministic fake-clock burn rates, exemplar ->
+# span resolution, engine phase exposition) plus a live frontend+worker
+# boot whose /metrics scrapes must pass the exposition validator
+# (escaping, bucket monotonicity, _sum/_count consistency, well-formed
+# OpenMetrics exemplars).
+obs-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q -p no:randomly
+	JAX_PLATFORMS=cpu python scripts/obs_check.py
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

@@ -40,7 +40,6 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import sys
 import time
 
@@ -68,17 +67,12 @@ def _init_backend() -> str:
 
 
 def _chip_spec(device):
-    """Map jax device_kind onto the profiler's chip catalog (None if unknown)."""
-    from dynamo_tpu.profiler.systems import CHIPS
+    """Map jax device_kind onto the profiler's chip catalog (None if
+    unknown) — the same mapping the live MFU/MBU exposition uses
+    (profiler.systems.chip_for_device_kind)."""
+    from dynamo_tpu.profiler.systems import chip_for_device_kind
 
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    for pat, name in [
-        (r"v5 ?lite|v5e", "v5e"), (r"v5p|v5 ?pod", "v5p"),
-        (r"v6e|v6 ?lite|trillium", "v6e"), (r"v4", "v4"),
-    ]:
-        if re.search(pat, kind):
-            return CHIPS[name]
-    return None
+    return chip_for_device_kind(getattr(device, "device_kind", "") or "")
 
 
 def _hbm_bytes(device) -> float | None:
@@ -214,7 +208,14 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     # FRESH prompts for the timed run: reusing the warmup prompts would let
     # the prefix cache absorb every prefill and report cache-hit TTFT
     timed_prompts = [mk(i, 2) for i in range(batch)]
+    # independently-measured TTFT: admission -> first-token WALL clock per
+    # request, sampled at the bench layer — reported alongside the engine
+    # histograms so the two sources cross-check each other (a serving-
+    # histogram bug can't silently skew the bench's headline percentiles)
+    t_submit: dict = {}
+    ttft_samples: list = []
     for i, p in enumerate(timed_prompts):
+        t_submit[f"b{i}"] = time.perf_counter()
         eng.add_request(
             GenRequest(f"b{i}", p, max_tokens=steps, temperature=0.0,
                        ignore_eos=True, guided_json=guided)
@@ -223,6 +224,9 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
     guided_outs = {} if guided else None
     while eng.pending:
         for ev in eng.step():
+            if ev.index == 0 and ev.request_id in t_submit:
+                ttft_samples.append(
+                    time.perf_counter() - t_submit.pop(ev.request_id))
             # pre-timed tokens still belong to the guided grammar audit
             # (a replay missing the opening tokens would start mid-JSON)
             if guided_outs is not None and ev.token_id >= 0:
@@ -239,20 +243,37 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
         jax.profiler.start_trace(trace_dir)
     t0 = time.perf_counter()
     tokens = 0
+    itl_samples: list = []  # per-step wall time / steps advanced
     steps_before = eng.metrics.decode_steps
     while eng.has_work:
+        t_step = time.perf_counter()
+        step_tokens = 0
+        active = max(eng.num_active, 1)
         for ev in eng.step():
             if ev.token_id >= 0:
                 tokens += 1
+                step_tokens += 1
                 if guided_outs is not None:
                     guided_outs.setdefault(ev.request_id, []).append(
                         ev.token_id)
+        if step_tokens:
+            # independent per-token latency sample: this iteration's wall
+            # time over the steps it advanced each sequence
+            steps_adv = max(1, round(step_tokens / active))
+            itl_samples.append((time.perf_counter() - t_step) / steps_adv)
     dt = time.perf_counter() - t0
     if trace_dir:
         jax.profiler.stop_trace()
     decode_steps = eng.metrics.decode_steps - steps_before
 
     tok_s = tokens / dt
+
+    def _pctl(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
     phases = eng.metrics.phases
     out = {
         "model": model,
@@ -261,9 +282,21 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
         "itl_ms": round(1e3 * dt * batch / max(tokens, 1), 3),
         # BASELINE.json headline: tok/s/chip + p50 TTFT/ITL. TTFT ~= prefill
         # latency (admission-to-first-token); ITL from per-step timings.
+        # Two sources, reported side by side (ISSUE 6 satellite): the
+        # engine's serving histograms AND bench-layer wall-clock samples —
+        # large disagreement flags a histogram bug or host-side stalls the
+        # engine timers can't see.
         "ttft_p50_ms": phases["prefill"].quantile_ms(0.5),
         "itl_p50_ms": phases["decode_step"].quantile_ms(0.5),
         "itl_p95_ms": phases["decode_step"].quantile_ms(0.95),
+        "latency_source": "engine_histogram",
+        "measured": {
+            "source": "bench_wall_clock",
+            "ttft_p50_ms": round(1e3 * _pctl(ttft_samples, 0.5), 3),
+            "ttft_p95_ms": round(1e3 * _pctl(ttft_samples, 0.95), 3),
+            "itl_p50_ms": round(1e3 * _pctl(itl_samples, 0.5), 3),
+            "itl_p95_ms": round(1e3 * _pctl(itl_samples, 0.95), 3),
+        },
         "decode_steps_timed": decode_steps,
     }
     if quant != "none":
@@ -417,9 +450,13 @@ def main() -> None:
         "model": res["model"],
         "batch": res["batch"],
         "itl_ms": res["itl_ms"],
+        # the non-comparability flag lives HERE, next to both latency
+        # sources: CPU-fallback percentiles must never be compared to the
+        # TPU north star (standing ROADMAP constraint)
+        "comparable": bool(on_tpu),
     }
     for k in ("mfu", "mbu", "quantization", "ttft_p50_ms", "itl_p50_ms",
-              "itl_p95_ms", "spec_drafted", "spec_accepted",
+              "itl_p95_ms", "measured", "spec_drafted", "spec_accepted",
               "spec_acceptance", "guided", "guided_legal"):
         if k in res:
             line[k] = res[k]

@@ -30,6 +30,65 @@ def _pctl(values: List[float], q: float) -> float:
     return values[idx]
 
 
+def _hist_quantile(buckets: List[tuple], q: float) -> float:
+    """Quantile estimate from cumulative (le, count) pairs (upper-edge
+    bound, the Prometheus convention)."""
+    if not buckets:
+        return 0.0
+    total = buckets[-1][1]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_edge = 0.0
+    for le, c in buckets:
+        if c >= target:
+            return le if le != float("inf") else prev_edge
+        prev_edge = le
+    return prev_edge
+
+
+def server_histogram_pctls(endpoint_url: str) -> Dict[str, Dict[str, float]]:
+    """Scrape the endpoint's own /metrics and derive TTFT/ITL percentiles
+    from the serving histograms — reported ALONGSIDE the loadgen's
+    client-side measurements so the two latency sources cross-check each
+    other (server histograms can't see client/network time; the client
+    can't see per-model breakdowns). Empty dict when the endpoint exposes
+    no scrape."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                endpoint_url.rstrip("/") + "/metrics", timeout=5) as r:
+            text = r.read().decode("utf-8", "replace")
+    except Exception:
+        return {}
+    series = {
+        "ttft_ms": "dynamo_frontend_time_to_first_token_seconds_bucket",
+        "itl_ms": "dynamo_frontend_inter_token_latency_seconds_bucket",
+    }
+    out: Dict[str, Dict[str, float]] = {}
+    for key, name in series.items():
+        acc: Dict[float, float] = {}
+        for ln in text.splitlines():
+            if not ln.startswith(name + "{"):
+                continue
+            try:
+                labels, value = ln.rsplit(" ", 1)
+                le_part = labels.split('le="', 1)[1].split('"', 1)[0]
+                le = float("inf") if le_part == "+Inf" else float(le_part)
+                acc[le] = acc.get(le, 0.0) + float(value)
+            except (IndexError, ValueError):
+                continue
+        buckets = sorted(acc.items())
+        if buckets and buckets[-1][1] > 0:
+            out[key] = {
+                "p50": round(_hist_quantile(buckets, 0.50) * 1e3, 2),
+                "p90": round(_hist_quantile(buckets, 0.90) * 1e3, 2),
+                "p99": round(_hist_quantile(buckets, 0.99) * 1e3, 2),
+            }
+    return out
+
+
 def summarize(results: List[RequestResult], wall_s: float, num_chips: int) -> Dict:
     ok = [r for r in results if r.ok]
     out_toks = sum(r.output_tokens for r in ok)
@@ -124,6 +183,11 @@ def main(argv=None) -> int:
         summary = summarize(results, wall, args.num_chips)
         summary["concurrency"] = conc
         summary["warmup_excluded"] = warmup
+        # both latency sources side by side: client-measured (above) and
+        # the server's own histogram-derived percentiles — upper-edge
+        # bounds over the whole scrape lifetime, so expect them coarser
+        summary["server_histogram"] = (
+            server_histogram_pctls(args.endpoint_url) or None)
         sweep.append(summary)
         print(f"[benchmark]   -> {summary['output_tok_per_s']} tok/s, "
               f"TTFT p50 {summary['ttft_ms']['p50']}ms, "

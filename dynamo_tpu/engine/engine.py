@@ -147,6 +147,11 @@ class EngineMetrics:
     """Counters + per-phase timing histograms surfaced via /worker/stats."""
 
     _PHASES = ("prefill", "prefill_chunk", "decode_window", "decode_step")
+    # decode-window batch occupancy (active slots / max_num_seqs) —
+    # persistently low occupancy means max_num_seqs is oversized (padded
+    # rows burn HBM stream for nothing); the exposition bridge
+    # (observability/engine_metrics.py) serves it as a histogram
+    _OCC_EDGES = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 
     def __init__(self):
         self.num_requests = 0
@@ -162,6 +167,9 @@ class EngineMetrics:
         # = accepted / drafted; bonus tokens not counted in either)
         self.spec_draft_tokens = 0
         self.spec_accepted_tokens = 0
+        self.occupancy_buckets = [0] * (len(self._OCC_EDGES) + 1)
+        self.occupancy_sum = 0.0
+        self.occupancy_count = 0
         self.phases: Dict[str, PhaseTimer] = {p: PhaseTimer()
                                               for p in self._PHASES}
 
@@ -169,14 +177,30 @@ class EngineMetrics:
                       weight: int = 1) -> None:
         self.phases[phase].observe(seconds, weight)
 
+    def observe_occupancy(self, active: int, capacity: int) -> None:
+        """One decode window's batch occupancy fraction."""
+        frac = active / max(capacity, 1)
+        for i, edge in enumerate(self._OCC_EDGES):
+            if frac <= edge:
+                self.occupancy_buckets[i] += 1
+                break
+        else:
+            self.occupancy_buckets[-1] += 1
+        self.occupancy_sum += frac
+        self.occupancy_count += 1
+
     def reset_phases(self, *names: str) -> None:
         """Re-zero selected phase histograms (bench section boundaries)."""
         for n in names:
             self.phases[n] = PhaseTimer()
 
     def snapshot(self) -> Dict[str, float]:
-        out = {k: v for k, v in self.__dict__.items() if k != "phases"}
+        out = {k: v for k, v in self.__dict__.items()
+               if k not in ("phases", "occupancy_buckets")}
         out["phases"] = {p: t.snapshot() for p, t in self.phases.items()}
+        out["occupancy_mean"] = (
+            round(self.occupancy_sum / self.occupancy_count, 4)
+            if self.occupancy_count else 0.0)
         return out
 
 
@@ -410,6 +434,9 @@ class Engine:
         # async scheduling: the decode window whose tokens have been
         # dispatched but not read back yet — (window, ys, want_lp, t0)
         self._pending_win = None
+        # last warmup() result (programs compiled, seconds) — exposed on
+        # worker /metrics by observability/engine_metrics.py
+        self.warmup_info = None
         # JSON-guided decoding (ops/json_guide.py): vocab byte table (host +
         # device), lazily-compiled guided window variants, and the
         # device-resident grammar state (gmode, gdepth, gbits, gactive) —
@@ -935,6 +962,10 @@ class Engine:
             "programs": self.compiled_program_count(),
             "seconds": round(time.monotonic() - t0, 2),
         }
+        # survives reset_metrics: the jit-compile exposition
+        # (dynamo_engine_warmup_seconds / _jit_programs, the bridge in
+        # observability/engine_metrics.py) reads it at scrape time
+        self.warmup_info = dict(out)
         log.info("warmup complete: %s", out)
         return out
 
@@ -1959,6 +1990,7 @@ class Engine:
         self.metrics.spec_draft_tokens += int(room[slots].sum()) * k
         self.metrics.spec_accepted_tokens += int(nacc_np[slots].sum())
         self.metrics.observe_phase("decode_window", dt)
+        self.metrics.observe_occupancy(len(slots), self.cfg.max_num_seqs)
         # weight = effective steps this verify advanced, so spec verifies
         # and fused windows carry proportional votes in the shared histogram
         eff_steps = max(1, -(-total // len(slots)))
@@ -2135,6 +2167,7 @@ class Engine:
         self.metrics.decode_time_s += dt
         self.metrics.observe_phase("decode_window", dt)
         self.metrics.observe_phase("decode_step", dt / window, weight=window)
+        self.metrics.observe_occupancy(len(slots), self.cfg.max_num_seqs)
 
         for slot in slots:
             seq = self.seqs.get(slot)

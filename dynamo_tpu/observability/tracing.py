@@ -219,6 +219,10 @@ class SpanCollector:
         self.capacity = max(1, capacity)
         self._spans: "collections.deque[Span]" = collections.deque(
             maxlen=self.capacity)
+        # spans evicted by ring wrap-around — previously a SILENT loss; now
+        # `dynamo_spans_dropped_total` on /metrics, so "exemplar link
+        # resolves to nothing" is diagnosable as buffer churn
+        self.dropped_total = 0
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
@@ -227,6 +231,8 @@ class SpanCollector:
 
     def add(self, span: Span) -> None:
         with self._lock:
+            if len(self._spans) == self.capacity:
+                self.dropped_total += 1
             self._spans.append(span)
 
     def clear(self) -> None:
@@ -234,21 +240,25 @@ class SpanCollector:
             self._spans.clear()
 
     def snapshot(self, trace_id: Optional[str] = None,
-                 service: Optional[str] = None) -> List[Span]:
+                 service: Optional[str] = None,
+                 name_prefix: Optional[str] = None) -> List[Span]:
         with self._lock:
             spans = list(self._spans)
         if trace_id:
             spans = [s for s in spans if s.trace_id == trace_id]
         if service:
             spans = [s for s in spans if s.service == service]
+        if name_prefix:
+            spans = [s for s in spans if s.name.startswith(name_prefix)]
         return spans
 
     def export(self, trace_id: Optional[str] = None,
-               service: Optional[str] = None) -> Dict[str, Any]:
+               service: Optional[str] = None,
+               name_prefix: Optional[str] = None) -> Dict[str, Any]:
         """OTLP/JSON `ExportTraceServiceRequest` shape: spans grouped into
         one resourceSpans entry per service name."""
         by_service: Dict[str, List[Span]] = {}
-        for s in self.snapshot(trace_id, service):
+        for s in self.snapshot(trace_id, service, name_prefix):
             by_service.setdefault(s.service, []).append(s)
         return {
             "resourceSpans": [
@@ -328,15 +338,19 @@ def spans_debug_payload(qs: Dict[str, List[str]],
                         collector: Optional[SpanCollector] = None
                         ) -> Dict[str, Any]:
     """Shared `GET /debug/spans` body builder (frontend + worker servers):
-    honors ?trace_id= and ?service= filters and always carries the recent
-    trace-id index so operators can discover what to filter by."""
+    honors ?trace_id=, ?service= and ?name= (span-name prefix) filters and
+    always carries the recent trace-id index so operators can discover
+    what to filter by."""
     collector = collector if collector is not None else get_collector()
     trace_id = (qs.get("trace_id") or [None])[0]
     service = (qs.get("service") or [None])[0]
-    payload = collector.export(trace_id=trace_id, service=service)
+    name_prefix = (qs.get("name") or [None])[0]
+    payload = collector.export(trace_id=trace_id, service=service,
+                               name_prefix=name_prefix)
     payload["traceIds"] = collector.trace_ids()
     payload["enabled"] = tracing_enabled()
     payload["capacity"] = collector.capacity
+    payload["droppedTotal"] = collector.dropped_total
     return payload
 
 

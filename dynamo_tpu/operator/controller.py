@@ -419,14 +419,14 @@ class Controller:
                 urls[(ns, name, svc_name)] = auto.get("metricsUrl") or (
                     f"http://{mat.frontend_host(cr)}.{ns}:"
                     f"{mat.FRONTEND_PORT}/metrics")
-        scrapes: Dict[str, Optional[float]] = {}
+        scrapes: Dict[str, Optional[Dict[str, float]]] = {}
         unique = sorted(set(urls.values()))
         if unique:
             from concurrent.futures import ThreadPoolExecutor
 
             with ThreadPoolExecutor(max_workers=min(8, len(unique))) as ex:
                 for url, val in zip(unique,
-                                    ex.map(self._scrape_queued, unique)):
+                                    ex.map(self._scrape_signals, unique)):
                     scrapes[url] = val
         for cr, ns, name, svc_name, spec, auto in work:
             lo = max(1, int(auto.get("minReplicas", 1)))
@@ -446,14 +446,34 @@ class Controller:
                 st = self._planner[key] = {
                     "replicas": int(persisted or spec.get("replicas", 1)),
                     "low_since": None}
-            queued = scrapes.get(urls[key])
-            if queued is None:
+            signals = scrapes.get(urls[key])
+            if signals is None:
                 continue  # unreachable metrics: hold the last decision
+            queued = signals["queued"]
+            burn = signals.get("burn", 0.0)
             st["replicas"] = max(lo, min(hi, st["replicas"]))
             want = max(lo, min(hi, -(-int(queued) // target)))
+            # SLO-burn boost (the ROADMAP's SLO-driven autoscaling seam,
+            # fed by observability/slo.py): an active fast-window burn
+            # means the pool is missing its objectives at the CURRENT
+            # scale even if the queue looks tame — add ONE replica at the
+            # start of a burn episode, then hold the scale (the 5m window
+            # lags the capacity add, so re-boosting every tick would race
+            # straight to maxReplicas; the queue signal keeps handling
+            # proportional pressure). Opt out per service with
+            # autoscaling.sloBurnBoost: false.
+            if burn > 1.0 and auto.get("sloBurnBoost", True):
+                if not st.get("burn_active"):
+                    st["burn_active"] = True
+                    want = max(want, min(hi, st["replicas"] + 1))
+                else:
+                    want = max(want, st["replicas"])  # no mid-burn shrink
+            else:
+                st["burn_active"] = False
             if want > st["replicas"]:
-                log.info("planner: %s/%s.%s %d -> %d (queued=%d)",
-                         ns, name, svc_name, st["replicas"], want, queued)
+                log.info("planner: %s/%s.%s %d -> %d (queued=%d burn=%.2f)",
+                         ns, name, svc_name, st["replicas"], want, queued,
+                         burn)
                 st["replicas"] = want
                 st["low_since"] = None
                 changed += 1
@@ -474,8 +494,12 @@ class Controller:
         return changed
 
     @staticmethod
-    def _scrape_queued(url: str) -> Optional[float]:
-        """dynamo_frontend_queued_requests from a Prometheus text page."""
+    def _scrape_signals(url: str) -> Optional[Dict[str, float]]:
+        """Planner inputs from one Prometheus text page: the
+        queued-requests gauge plus the worst fast-window SLO burn rate
+        (`dynamo_slo_burn_rate{...,window="5m"}`, observability/slo.py).
+        Returns None when the page is unreachable or carries no queue
+        gauge (hold the last decision)."""
         import urllib.request
 
         try:
@@ -483,13 +507,30 @@ class Controller:
                 text = r.read().decode("utf-8", "replace")
         except Exception:
             return None
+        queued: Optional[float] = None
+        burn = 0.0
         for ln in text.splitlines():
             if ln.startswith("dynamo_frontend_queued_requests"):
                 try:
-                    return float(ln.split()[-1])
+                    queued = float(ln.split()[-1])
                 except ValueError:
-                    return None
-        return None
+                    pass
+            elif (ln.startswith("dynamo_slo_burn_rate")
+                  and 'window="5m"' in ln):
+                try:
+                    burn = max(burn, float(ln.split()[-1]))
+                except ValueError:
+                    pass
+        if queued is None:
+            return None
+        return {"queued": queued, "burn": burn}
+
+    @staticmethod
+    def _scrape_queued(url: str) -> Optional[float]:
+        """dynamo_frontend_queued_requests from a Prometheus text page
+        (kept for tooling; planner_tick uses _scrape_signals)."""
+        signals = Controller._scrape_signals(url)
+        return None if signals is None else signals["queued"]
 
     # ----------------------------------------------------------------- loop --
     def reconcile_once(self) -> int:

@@ -169,6 +169,11 @@ def _container(
         },
         {"name": "DYNAMO_COMPONENT", "value": svc_name},
     ]
+    # SLO targets (observability/slo.py): `sloTargets` applies to EVERY
+    # component type — the frontend tracks end-to-end burn, workers track
+    # their own role's (prefill TTFT / decode ITL) burn
+    for name, value in slo_env(spec):
+        env.append({"name": name, "value": value})
     if ctype != "frontend":
         env.append(
             {
@@ -252,6 +257,47 @@ def lora_adapter_env(spec: Dict[str, Any]) -> List[tuple]:
     if spec.get("loraMaxRank") is not None:
         out.append(("DYNAMO_TPU_LORA_RANK", str(int(spec["loraMaxRank"]))))
     return out
+
+
+def slo_env(spec: Dict[str, Any]) -> List[tuple]:
+    """The `sloTargets` manifest key as (env name, value) pairs.
+
+    Two shapes (observability/slo.py consumes both):
+    - a MAP of scalars — one wildcard target:
+        sloTargets: {ttftMs: 500, itlMs: 50, errorRate: 0.01, goal: 0.99}
+      -> DYNAMO_TPU_SLO_TTFT_MS=500 ...
+    - a LIST of target specs (per model/adapter/role):
+        sloTargets: [{model: llama:fr-adapter, role: decode, itlMs: 40}]
+      -> DYNAMO_TPU_SLO_TARGETS=<json>
+    Unknown keys fail loudly (a typo'd SLO is a disabled SLO)."""
+    import json as _json
+
+    tg = spec.get("sloTargets")
+    if not tg:
+        return []
+    if isinstance(tg, dict):
+        scalar_envs = {"ttftMs": "DYNAMO_TPU_SLO_TTFT_MS",
+                       "itlMs": "DYNAMO_TPU_SLO_ITL_MS",
+                       "errorRate": "DYNAMO_TPU_SLO_ERROR_RATE",
+                       "goal": "DYNAMO_TPU_SLO_GOAL"}
+        unknown = set(tg) - set(scalar_envs)
+        if unknown:
+            raise ValueError(
+                f"unknown sloTargets keys: {sorted(unknown)} "
+                f"(known: {sorted(scalar_envs)}; use a list for "
+                "per-model/role targets)")
+        return [(scalar_envs[k], str(tg[k])) for k in sorted(tg)]
+    if isinstance(tg, list):
+        # validate each spec via the SLO engine's own parser so the
+        # operator rejects what the worker would reject
+        from dynamo_tpu.observability.slo import target_from_dict
+
+        for spec_item in tg:
+            target_from_dict(spec_item)
+        return [("DYNAMO_TPU_SLO_TARGETS",
+                 _json.dumps(tg, separators=(",", ":")))]
+    raise ValueError("sloTargets must be a map of scalars or a list of "
+                     "target specs")
 
 
 def drain_seconds(spec: Dict[str, Any]) -> int:

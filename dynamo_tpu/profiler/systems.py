@@ -78,6 +78,25 @@ SYSTEMS: Dict[str, SystemSpec] = {
 _SYSTEM_RE = re.compile(r"^(v\d+[ep]?)-(\d+)$")
 
 
+# device_kind regexes (jax `device.device_kind` strings) -> chip catalog
+# names; shared by bench.py and the live MFU/MBU exposition
+# (observability/engine_metrics.py) so both map hardware the same way
+_DEVICE_KIND_PATTERNS = (
+    (r"v5 ?lite|v5e", "v5e"), (r"v5p|v5 ?pod", "v5p"),
+    (r"v6e|v6 ?lite|trillium", "v6e"), (r"v4", "v4"),
+)
+
+
+def chip_for_device_kind(kind: str) -> "ChipSpec | None":
+    """Map a jax `device_kind` string onto the chip catalog (None if
+    unknown — e.g. the CPU fallback backend)."""
+    kind = (kind or "").lower()
+    for pat, name in _DEVICE_KIND_PATTERNS:
+        if re.search(pat, kind):
+            return CHIPS[name]
+    return None
+
+
 def get_system(name: str) -> SystemSpec:
     """Look up a system, accepting any `<family>-<nchips>` string."""
     if name in SYSTEMS:

@@ -21,6 +21,7 @@ from dynamo_tpu.engine.kv_cache import OutOfPages
 from dynamo_tpu.engine.request import GenRequest
 from dynamo_tpu.engine.tokenizer import get_tokenizer
 from dynamo_tpu.observability import context as obs_context
+from dynamo_tpu.observability import slo as obs_slo
 from dynamo_tpu.observability import tracing as obs_tracing
 from dynamo_tpu.robustness import faults
 from dynamo_tpu.robustness.deadline import Deadline
@@ -306,11 +307,14 @@ class GenerationHandle:
                 finish = "handoff"
                 break
             now = time.monotonic()
+            # exemplar: the request's trace id rides the latency buckets,
+            # so a p99 bucket resolves at /debug/spans?trace_id=...
+            ex = self.span.trace_id if self.span.recording else None
             if t_prev is None:
-                m.ttft.observe(now - t0, model=model)
+                m.ttft.observe(now - t0, exemplar=ex, model=model)
                 decode_span = self._first_token_spans(ev, now - t0)
             else:
-                m.itl.observe(now - t_prev, model=model)
+                m.itl.observe(now - t_prev, exemplar=ex, model=model)
             t_prev = now
             delta = ""
             lp_entry = None
@@ -370,7 +374,9 @@ class GenerationHandle:
                     finish = "abort"
                     break
         dur = time.monotonic() - t0
-        m.duration.observe(dur, model=model)
+        m.duration.observe(
+            dur, exemplar=(self.span.trace_id if self.span.recording
+                           else None), model=model)
         m.osl.observe(n_out, model=model)
         ctx.kv_gauge.set(ctx.engine.allocator.free_pages)
         if decode_span is not None:
@@ -415,7 +421,7 @@ class ServingContext:
             self.lora_requests_total = Counter(
                 "dynamo_lora_requests_total",
                 "Requests served under a LoRA adapter, by adapter",
-                self.metrics.registry,
+                self.metrics.registry, labelnames=("adapter",),
             )
             CallbackCounter(
                 "dynamo_lora_swaps_total",
@@ -463,7 +469,8 @@ class ServingContext:
                                 (lambda k=kvbm, a=attr: getattr(k, a)))
             self.kvbm_blocks_gauge = Gauge(
                 "dynamo_kvbm_host_blocks",
-                "KVBM host-pool occupancy by state", self.metrics.registry)
+                "KVBM host-pool occupancy by state", self.metrics.registry,
+                labelnames=("state",))
             from dynamo_tpu.transfer.kv_transfer import HostTierSource
 
             self.kvbm_source = HostTierSource(kvbm)
@@ -490,6 +497,28 @@ class ServingContext:
         # land in the process-global ring buffer behind GET /debug/spans
         self.tracer = obs_tracing.Tracer(
             f"worker-{engine.cfg.disaggregation_mode or 'agg'}")
+        # --- SLO plane (observability/slo.py): per-role burn rate from
+        # this worker's own latency histograms; the role selector lets one
+        # manifest give prefill pools a TTFT SLO and decode pools an ITL
+        # SLO (the per-pool signals planner v2 scales on)
+        self.slo = obs_slo.SLOEngine(
+            self.metrics, role=engine.cfg.disaggregation_mode or "agg")
+        # --- engine phase/utilization exposition (observability/
+        # engine_metrics.py): PhaseTimer histograms, batch occupancy,
+        # jit-compile counters, live roofline MFU/MBU on /metrics
+        from dynamo_tpu.observability.engine_metrics import (
+            attach_engine_metrics,
+        )
+
+        self.engine_bridge = attach_engine_metrics(
+            self.metrics.registry, engine)
+        from dynamo_tpu.serving.metrics import CallbackCounter as _CC
+
+        _CC("dynamo_spans_dropped_total",
+            "Finished spans evicted from the ring buffer before any "
+            "scrape could lift them (size: DYNAMO_TPU_TRACE_BUFFER)",
+            self.metrics.registry,
+            lambda: self.tracer.collector.dropped_total)
         if engine.kvbm is not None:
             # kvbm.offload / kvbm.onboard spans land in this worker's ring
             # buffer (GET /debug/spans) like every other worker span
@@ -518,7 +547,7 @@ class ServingContext:
                     "dynamo_worker_staged_kv_gathers",
                     "Device-plane staged KV gathers by state (leaked = "
                     "expired un-released, still pinning HBM)",
-                    self.metrics.registry,
+                    self.metrics.registry, labelnames=("state",),
                 )
         elif mode == "decode":
             from dynamo_tpu.serving.disagg import DisaggDecodeClient, PrefillPool
@@ -774,8 +803,11 @@ class _Handler(JsonHTTPHandler):
                 live, leaked = ds.counts()  # one lock/sweep: no double count
                 self.ctx.staged_kv_gauge.set(live, state="staged")
                 self.ctx.staged_kv_gauge.set(leaked, state="leaked")
-            self._raw(200, self.ctx.metrics.registry.expose().encode(),
-                      "text/plain; version=0.0.4")
+            self.ctx.slo.refresh_gauges()
+            self.ctx.engine_bridge.refresh()  # live MFU/MBU + warmup gauges
+            body, ctype = self.ctx.metrics.registry.scrape(
+                self.headers.get("Accept"))
+            self._raw(200, body, ctype)
         elif path in ("/health", "/live", "/ready"):
             self._json(200, {"status": "ok", "uptime_s": round(
                 time.time() - self.ctx.start_time, 1)})
@@ -785,6 +817,11 @@ class _Handler(JsonHTTPHandler):
             qs = parse_qs(urlparse(self.path).query)
             self._json(200, obs_tracing.spans_debug_payload(
                 qs, self.ctx.tracer.collector))
+        elif path == "/debug/slo":
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            self._json(200, obs_slo.debug_slo_payload(self.ctx.slo, qs))
         elif path == "/internal/faults":
             self._json(200, faults.http_payload())
         elif path == "/debug/trace":
@@ -938,6 +975,11 @@ class _Handler(JsonHTTPHandler):
             span.end()
 
     def _fail(self, code: int, msg: str, etype: str = "invalid_request_error"):
+        if code >= 500:
+            # the worker-side error-rate SLO source (observability/slo.py);
+            # 4xx are the client's problem and never burn budget
+            self.ctx.metrics.errors_total.inc(
+                model=self.ctx.served_model, code=str(code))
         if self.sse_started:
             self._sse_error(msg)
         else:
@@ -994,7 +1036,10 @@ class _Handler(JsonHTTPHandler):
                 "engine.prefill.p95_ms":
                     round(eng_ph["prefill"].quantile_ms(0.95), 3),
             })
-        ctx.metrics.ttft.observe(time.monotonic() - t0, model=ctx.served_model)
+        ctx.metrics.ttft.observe(
+            time.monotonic() - t0,
+            exemplar=(self._span.trace_id if self._span.recording else None),
+            model=ctx.served_model)
         ctx.metrics.requests_total.inc(model=ctx.served_model)
         ctx.metrics.isl.observe(n_tokens, model=ctx.served_model)
         self._json(200, {

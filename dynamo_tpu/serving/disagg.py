@@ -121,7 +121,7 @@ class DisaggDecodeClient:
         self._plane_counter = Counter(
             "dynamo_worker_kv_transfers_total",
             "Completed disagg KV transfers by data plane",
-            ctx.metrics.registry)
+            ctx.metrics.registry, labelnames=("plane",))
 
     @property
     def plane_counts(self) -> dict:

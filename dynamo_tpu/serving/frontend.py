@@ -27,6 +27,7 @@ import urllib.request
 from typing import List, Optional
 
 from dynamo_tpu.observability import context as obs_context
+from dynamo_tpu.observability import slo as obs_slo
 from dynamo_tpu.observability import tracing as obs_tracing
 from dynamo_tpu.robustness import faults
 from dynamo_tpu.robustness.breaker import STATE_CODES
@@ -117,12 +118,12 @@ class FrontendContext:
             "dynamo_frontend_breaker_open_total",
             "Circuit-breaker open transitions (threshold trips and failed "
             "half-open probes)",
-            self.metrics.registry,
+            self.metrics.registry, labelnames=("worker",),
         )
         self.breaker_gauge = Gauge(
             "dynamo_frontend_breaker_state",
             "Per-worker circuit-breaker state (0=closed 1=half_open 2=open)",
-            self.metrics.registry,
+            self.metrics.registry, labelnames=("worker",),
         )
         # --- request recovery plane (serving/recovery.py) ---
         self.recovered_counter = Counter(
@@ -130,11 +131,24 @@ class FrontendContext:
             "Requests recovered after a worker failure, by phase (connect "
             "= pre-send failover re-pick; stream = mid-stream journaled "
             "continuation spliced onto the same client stream)",
-            self.metrics.registry,
+            self.metrics.registry, labelnames=("phase",),
         )
         self.router.breakers.on_open = (
             lambda url: self.breaker_open_counter.inc(worker=url))
         self.tracer = obs_tracing.Tracer("frontend")
+        # --- SLO plane (observability/slo.py): multi-window burn rate from
+        # the latency histograms above; targets from DYNAMO_TPU_SLO_* (the
+        # operator materializes the manifest's sloTargets key into them)
+        self.slo = obs_slo.SLOEngine(self.metrics, role="frontend")
+        from dynamo_tpu.serving.metrics import CallbackCounter
+
+        CallbackCounter(
+            "dynamo_spans_dropped_total",
+            "Finished spans evicted from the ring buffer before any "
+            "scrape could lift them (size: DYNAMO_TPU_TRACE_BUFFER)",
+            self.metrics.registry,
+            lambda: self.tracer.collector.dropped_total,
+        )
         # in-flight request tracking feeds the queued-requests gauge the
         # operator's planner scrapes for autoscaling
         self._inflight = 0
@@ -189,8 +203,10 @@ class _FrontendHandler(JsonHTTPHandler):
             # by clock, not by an event anyone could have observed)
             for url, state in ctx.router.breakers.snapshot().items():
                 ctx.breaker_gauge.set(STATE_CODES[state], worker=url)
-            self._raw(200, ctx.metrics.registry.expose().encode(),
-                      "text/plain; version=0.0.4")
+            ctx.slo.refresh_gauges()
+            body, ctype = ctx.metrics.registry.scrape(
+                self.headers.get("Accept"))
+            self._raw(200, body, ctype)
         elif path == "/internal/faults":
             self._json(200, faults.http_payload())
         elif path in ("/health", "/live", "/ready"):
@@ -212,6 +228,11 @@ class _FrontendHandler(JsonHTTPHandler):
             qs = parse_qs(urlparse(self.path).query)
             self._json(200, obs_tracing.spans_debug_payload(
                 qs, ctx.tracer.collector))
+        elif path == "/debug/slo":
+            from urllib.parse import parse_qs, urlparse
+
+            qs = parse_qs(urlparse(self.path).query)
+            self._json(200, obs_slo.debug_slo_payload(ctx.slo, qs))
         else:
             self._error(404, f"no route {path}")
 
@@ -247,7 +268,8 @@ class _FrontendHandler(JsonHTTPHandler):
             log.exception("frontend request failed")
             self._error(500, "internal error", "internal_error")
 
-    def _send_nats_response(self, parts, model: str, t0: float):
+    def _send_nats_response(self, parts, model: str, t0: float,
+                            exemplar=None):
         """Write a NATS-plane response out. The response has STARTED once we
         are here — mid-stream failures truncate (never re-dispatch to the
         HTTP plane, which would re-run inference and corrupt the stream)."""
@@ -264,7 +286,8 @@ class _FrontendHandler(JsonHTTPHandler):
             try:
                 for chunk in chunks:
                     if first:
-                        m.ttft.observe(time.monotonic() - t0, model=model)
+                        m.ttft.observe(time.monotonic() - t0,
+                                       exemplar=exemplar, model=model)
                         first = False
                     self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
                     self.wfile.flush()
@@ -275,7 +298,8 @@ class _FrontendHandler(JsonHTTPHandler):
                 log.exception("NATS stream truncated mid-response")
         else:
             payload = b"".join(chunks)
-            m.ttft.observe(time.monotonic() - t0, model=model)
+            m.ttft.observe(time.monotonic() - t0, exemplar=exemplar,
+                           model=model)
             try:
                 usage = json.loads(payload).get("usage", {})
                 m.isl.observe(usage.get("prompt_tokens", 0), model=model)
@@ -287,7 +311,8 @@ class _FrontendHandler(JsonHTTPHandler):
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
-        m.duration.observe(time.monotonic() - t0, model=model)
+        m.duration.observe(time.monotonic() - t0, exemplar=exemplar,
+                           model=model)
 
     # ----------------------------------------------------------------- proxy
     def _proxy(self, path: str):
@@ -375,8 +400,10 @@ class _FrontendHandler(JsonHTTPHandler):
                     dur, model, path, span.trace_id, rid or "-",
                     span.trace_id)
 
-    def _shed_deadline(self, span, where: str):
+    def _shed_deadline(self, span, where: str, model: Optional[str] = None):
         self.ctx.deadline_shed.inc()
+        if model:
+            self.ctx.metrics.errors_total.inc(model=model, code="504")
         span.set_status("ERROR", f"deadline exhausted ({where})")
         self._error(
             504, f"deadline budget exhausted {where}; request shed",
@@ -386,9 +413,12 @@ class _FrontendHandler(JsonHTTPHandler):
                            prompt_text: str, affinity: str, model: str,
                            span, trace_headers: dict, deadline: Deadline):
         ctx = self.ctx
+        # exemplar: latency observations carry the trace id, so a hot
+        # histogram bucket links straight to /debug/spans?trace_id=...
+        ex = span.trace_id if span.recording else None
         if deadline.expired:
             # shed BEFORE routing: no pick, no dial, no engine slot
-            self._shed_deadline(span, "before routing")
+            self._shed_deadline(span, "before routing", model)
             return
         # multi-LoRA addressing: '<base>:<adapter>' routes on the BASE
         # model's worker set with adapter-affinity (resident > lazy-load
@@ -408,6 +438,7 @@ class _FrontendHandler(JsonHTTPHandler):
                 pick_span.set_attribute("worker.url", worker.url)
         if worker is None:
             span.set_status("ERROR", f"no live worker for {model!r}")
+            ctx.metrics.errors_total.inc(model=model, code="503")
             self._error(503, f"no live worker for model {model!r}",
                         "service_unavailable")
             return
@@ -428,7 +459,7 @@ class _FrontendHandler(JsonHTTPHandler):
             else:
                 span.set_attribute("transport", "nats")
                 span.set_attribute("worker.url", worker.url)
-                self._send_nats_response(parts, model, t0)
+                self._send_nats_response(parts, model, t0, exemplar=ex)
                 return
         # bounded failover: a CONNECT-phase failure (refused / no route /
         # DNS) proves the request never reached a worker, so retrying the
@@ -461,7 +492,7 @@ class _FrontendHandler(JsonHTTPHandler):
                                {"attempt": attempt, "worker.url": worker.url})
             if deadline.expired:
                 # a failover re-pick must not outlive the client's budget
-                self._shed_deadline(span, "during failover")
+                self._shed_deadline(span, "during failover", model)
                 return
             span.set_attribute("transport", "http")
             span.set_attribute("worker.url", worker.url)
@@ -509,6 +540,9 @@ class _FrontendHandler(JsonHTTPHandler):
                                 e.headers.get("Retry-After"))
                     continue
                 # anything else is a definitive answer — pass it through
+                if e.code >= 500:
+                    ctx.metrics.errors_total.inc(model=model,
+                                                 code=str(e.code))
                 self.send_response(e.code)
                 self.send_header(
                     "Content-Type",
@@ -522,6 +556,7 @@ class _FrontendHandler(JsonHTTPHandler):
                 if isinstance(reason, (TimeoutError, socket.timeout)):
                     breakers.record_failure(worker.url)
                     ctx.deadline_shed.inc()
+                    ctx.metrics.errors_total.inc(model=model, code="504")
                     span.set_status("ERROR", "worker timeout")
                     self._error(
                         504, f"worker {worker.url} timed out mid-request "
@@ -533,6 +568,7 @@ class _FrontendHandler(JsonHTTPHandler):
                     # worker may already be generating — a retry would
                     # duplicate the whole generation, so answer terminally
                     breakers.record_failure(worker.url)
+                    ctx.metrics.errors_total.inc(model=model, code="502")
                     span.set_status("ERROR", "worker connection lost")
                     self._error(
                         502,
@@ -555,6 +591,7 @@ class _FrontendHandler(JsonHTTPHandler):
                 # jitter included, rather than escalating to 502
                 payload, p_ctype, retry_after = last_503
                 span.set_status("ERROR", "all workers shed 503")
+                ctx.metrics.errors_total.inc(model=model, code="503")
                 self.send_response(503)
                 self.send_header("Content-Type", p_ctype)
                 self.send_header("Content-Length", str(len(payload)))
@@ -564,6 +601,7 @@ class _FrontendHandler(JsonHTTPHandler):
                 self.wfile.write(payload)
                 return
             span.set_status("ERROR", "no reachable worker")
+            ctx.metrics.errors_total.inc(model=model, code="502")
             self._error(
                 502,
                 f"no reachable worker for model {model!r}"
@@ -589,12 +627,13 @@ class _FrontendHandler(JsonHTTPHandler):
                 # the generation may have run — terminal, never retried
                 span.set_status("ERROR", "worker connection lost mid-response")
                 ctx.router.breakers.record_failure(worker.url)
+                ctx.metrics.errors_total.inc(model=model, code="502")
                 self._error(
                     502,
                     f"worker {worker.url} connection lost mid-response "
                     f"({type(e).__name__}); not retried", "bad_gateway")
                 return
-            m.ttft.observe(time.monotonic() - t0, model=model)
+            m.ttft.observe(time.monotonic() - t0, exemplar=ex, model=model)
             try:
                 usage = json.loads(payload).get("usage", {})
                 m.isl.observe(usage.get("prompt_tokens", 0), model=model)
@@ -611,7 +650,7 @@ class _FrontendHandler(JsonHTTPHandler):
                 self.send_header("x-recovered", "1")
             self.end_headers()
             self.wfile.write(payload)
-        m.duration.observe(time.monotonic() - t0, model=model)
+        m.duration.observe(time.monotonic() - t0, exemplar=ex, model=model)
 
     # ----------------------------------------------- mid-stream recovery --
     def _relay_sse(self, resp, worker, path: str, body: dict,
@@ -644,12 +683,20 @@ class _FrontendHandler(JsonHTTPHandler):
             self.send_header("x-recovered", "1")
         self.end_headers()
         first = True
+        t_prev: Optional[float] = None
 
         def forward(block: bytes) -> bool:
-            nonlocal first
+            nonlocal first, t_prev
+            now = time.monotonic()
+            ex = span.trace_id if span.recording else None
             if first:
-                m.ttft.observe(time.monotonic() - t0, model=model)
+                m.ttft.observe(now - t0, exemplar=ex, model=model)
                 first = False
+            elif t_prev is not None:
+                # client-visible inter-token latency (includes relay +
+                # network time the worker's own ITL histogram can't see)
+                m.itl.observe(now - t_prev, exemplar=ex, model=model)
+            t_prev = now
             try:
                 payload = block + b"\n\n"
                 self.wfile.write(b"%x\r\n%s\r\n" % (len(payload), payload))

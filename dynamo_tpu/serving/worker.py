@@ -354,6 +354,13 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
              "GET /debug/spans, kill switch DYNAMO_TPU_TRACE=0)",
              args.host, args.port,
              "on" if obs_tracing.tracing_enabled() else "off")
+    if ctx.slo.targets:
+        # SLO plane (docs/observability.md "SLOs and burn rates"): targets
+        # come from DYNAMO_TPU_SLO_* — materialized by the operator from
+        # the manifest's sloTargets key
+        log.info("SLO targets active for role %s: %s (gauges on /metrics, "
+                 "GET /debug/slo)", ctx.slo.role,
+                 [t.label for t in ctx.slo.targets])
     try:
         srv.serve_forever()
     finally:

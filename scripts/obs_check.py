@@ -1,0 +1,199 @@
+#!/usr/bin/env python3
+"""CI smoke check for the SLO & profiling plane (`make obs-check`).
+
+Boots a real frontend + agg worker (tiny-debug engine, one LoRA adapter
+registered, fault plane armed), drives base / adapter / streaming /
+fault-failed traffic through the frontend, then validates:
+
+- every /metrics scrape (frontend AND worker, classic text AND
+  OpenMetrics) passes the exposition validator (tests/metrics_lint.py:
+  escaping, bucket monotonicity, _sum/_count consistency, well-formed
+  exemplars);
+- the worker exposes dynamo_engine_phase_seconds for all four phases
+  plus the MFU/MBU gauges and batch-occupancy/jit series;
+- a TTFT exemplar from the OpenMetrics scrape resolves via
+  /debug/spans?trace_id= to that request's span tree;
+- GET /debug/slo serves burn-rate evaluations and ?history=1 serves the
+  request-rate ring.
+"""
+
+import json
+import os
+import re
+import sys
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# SLO targets BEFORE any context is built (same envs the operator
+# materializes from the manifest's sloTargets key)
+os.environ.setdefault("DYNAMO_TPU_SLO_TTFT_MS", "500")
+os.environ.setdefault("DYNAMO_TPU_SLO_ITL_MS", "100")
+os.environ.setdefault("DYNAMO_TPU_SLO_ERROR_RATE", "0.01")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# runnable straight from a checkout: `python scripts/obs_check.py`
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+MODEL = "tiny-debug"
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"obs-check: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _post(base, path, body, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get(base, path, accept=None, timeout=30):
+    req = urllib.request.Request(base + path)
+    if accept:
+        req.add_header("Accept", accept)
+    return urllib.request.urlopen(req, timeout=timeout).read().decode()
+
+
+def _chat(base, model=MODEL, **kw):
+    return _post(base, "/v1/chat/completions", {
+        "model": model,
+        "messages": [{"role": "user", "content": "obs check"}],
+        "max_tokens": 4, "temperature": 0, "ignore_eos": True, **kw})
+
+
+def main() -> None:
+    from metrics_lint import lint_exposition
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.models.config import ModelConfig
+    from dynamo_tpu.lora import apply as lora_apply
+    from dynamo_tpu.robustness import faults
+    from dynamo_tpu.serving.api import (
+        ServingContext, make_server, serve_forever_in_thread,
+    )
+    from dynamo_tpu.serving.frontend import (
+        FrontendContext, make_frontend_server,
+    )
+
+    faults.reset_plane()
+    engine = Engine(EngineConfig(
+        model=MODEL, page_size=4, num_pages=128, max_num_seqs=4,
+        max_seq_len=96, lora_slots=2, lora_rank=4))
+    engine.lora.register(
+        "ada", tensors=lora_apply.random_adapter(ModelConfig(), rank=4,
+                                                 seed=1, scale=0.3), rank=4)
+    wctx = ServingContext(engine, MODEL)
+    wsrv = make_server(wctx, "127.0.0.1", 0)
+    serve_forever_in_thread(wsrv)
+    worker = f"http://127.0.0.1:{wsrv.server_address[1]}"
+
+    fctx = FrontendContext()
+    fsrv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(fsrv)
+    frontend = f"http://127.0.0.1:{fsrv.server_address[1]}"
+    _post(frontend, "/internal/register", {
+        "url": worker, "model": MODEL, "mode": "agg",
+        "stats": {"max_num_seqs": 4, "free_pages": 100, "total_pages": 128,
+                  "adapters": ["ada"], "adapters_available": ["ada"]}})
+    try:
+        # --- traffic: base (non-stream + stream), adapter, fault-failed ---
+        resp = _chat(frontend)
+        resp.read()
+        trace_id = resp.headers.get("X-Request-Id")
+        _chat(frontend, stream=True).read()
+        _chat(frontend, model=f"{MODEL}:ada").read()
+        # arm a fault and drive a request into it so fault/error series
+        # are LIVE on the page the validator sees
+        _post(frontend, "/internal/faults",
+              {"faults": {"worker.reset_after_headers": {"times": 1}}})
+        try:
+            _chat(frontend).read()
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                fail(f"fault drive answered {e.code}, expected a 5xx")
+        else:
+            fail("armed worker.reset_after_headers but the request "
+                 "succeeded")
+
+        # --- every scrape, both formats, must lint clean ------------------
+        pages = {}
+        for who, base in (("frontend", frontend), ("worker", worker)):
+            for fmt, accept in (("text", None),
+                                ("openmetrics",
+                                 "application/openmetrics-text")):
+                text = _get(base, "/metrics", accept=accept)
+                errors = lint_exposition(text, openmetrics=fmt ==
+                                         "openmetrics")
+                if errors:
+                    fail(f"{who} {fmt} scrape invalid:\n  " +
+                         "\n  ".join(errors))
+                pages[(who, fmt)] = text
+
+        wtext = pages[("worker", "text")]
+        for phase in ("prefill", "prefill_chunk", "decode_window",
+                      "decode_step"):
+            if f'dynamo_engine_phase_seconds_bucket{{phase="{phase}"' \
+                    not in wtext:
+                fail(f"worker scrape missing engine phase {phase!r}")
+        for series in ("dynamo_engine_mfu", "dynamo_engine_mbu",
+                       "dynamo_engine_batch_occupancy_bucket",
+                       "dynamo_engine_jit_programs",
+                       "dynamo_spans_dropped_total",
+                       'dynamo_lora_requests_total{adapter="ada"}',
+                       "dynamo_slo_burn_rate", "dynamo_slo_attainment"):
+            if series not in wtext:
+                fail(f"worker scrape missing {series}")
+        ftext = pages[("frontend", "text")]
+        for series in ("dynamo_slo_burn_rate", "dynamo_slo_attainment",
+                       "dynamo_frontend_errors_total"):
+            if series not in ftext:
+                fail(f"frontend scrape missing {series}")
+
+        # --- exemplar -> span tree ----------------------------------------
+        om = pages[("frontend", "openmetrics")]
+        exemplars = re.findall(
+            r'dynamo_frontend_time_to_first_token_seconds_bucket\{[^}]*\} '
+            r'[0-9.]+ # \{trace_id="([0-9a-f]{32})"\}', om)
+        if not exemplars:
+            fail("no TTFT exemplars on the OpenMetrics frontend scrape")
+        if trace_id not in exemplars:
+            # newest-per-bucket may have displaced it; any exemplar must
+            # still resolve
+            trace_id = exemplars[0]
+        spans = json.loads(_get(frontend, f"/debug/spans?trace_id={trace_id}"))
+        names = {sp["name"] for rs in spans.get("resourceSpans", [])
+                 for ss in rs.get("scopeSpans", []) for sp in ss.get("spans", [])}
+        if "frontend.request" not in names:
+            fail(f"exemplar trace {trace_id} resolved to no frontend span "
+                 f"(got {sorted(names)})")
+
+        # --- /debug/slo ---------------------------------------------------
+        slo = json.loads(_get(frontend, "/debug/slo"))
+        if not slo.get("evaluations"):
+            fail("/debug/slo returned no evaluations")
+        hist = json.loads(_get(frontend, "/debug/slo?history=1"))
+        if not hist.get("history") or \
+                sum(h["requests"] for h in hist["history"]) < 3:
+            fail(f"/debug/slo history missing the driven requests: "
+                 f"{hist.get('history')}")
+        burns = [r for r in slo["evaluations"] if r["objective"] ==
+                 "error_rate" and r["window"] == "5m"]
+        if not burns or burns[0]["burn_rate"] <= 0:
+            fail(f"error-rate burn did not register the fault-failed "
+                 f"request: {burns}")
+        print(f"obs-check: OK — 4 scrapes lint-clean, exemplar {trace_id} "
+              f"resolved ({len(names)} span names), error-rate 5m burn "
+              f"{burns[0]['burn_rate']}")
+    finally:
+        faults.get_plane().clear()
+        fsrv.shutdown()
+        wsrv.shutdown()
+        wctx.close()
+
+
+if __name__ == "__main__":
+    main()

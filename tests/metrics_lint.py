@@ -1,0 +1,147 @@
+"""Exposition-format validator for /metrics scrapes (ISSUE 6 satellite).
+
+A small, dependency-free parser the tests (and scripts/obs_check.py) run
+over every scrape they take: it returns a list of human-readable errors,
+empty when the page is valid. Checks:
+
+- every non-comment line parses as ``name{labels} value [# exemplar]``;
+- label values are exposition-escaped (a raw newline would already break
+  the line regex; unescaped quotes break label parsing);
+- histogram buckets are CUMULATIVE and monotone in ``le``, the ``+Inf``
+  bucket equals ``_count``, and ``_sum``/``_count`` exist per label set;
+- OpenMetrics exemplars are well-formed (``# {labels} value [ts]``) and
+  the exemplar's value fits inside its bucket's upper bound;
+- an OpenMetrics page ends with ``# EOF``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+_SERIES_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*?)\})? '
+    r'(?P<value>[0-9eE+.\-]+|NaN|[+-]Inf)'
+    r'(?P<exemplar> # \{.*\} .*)?$'
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_EXEMPLAR_RE = re.compile(
+    r'^ # \{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*='
+    r'"(?:[^"\\]|\\.)*",?)*)\} '
+    r'(?P<value>[0-9eE+.\-]+)(?: (?P<ts>[0-9.]+))?$'
+)
+
+
+def _parse_labels(raw: Optional[str]) -> Optional[Dict[str, str]]:
+    if not raw:
+        return {}
+    out: Dict[str, str] = {}
+    consumed = 0
+    for m in _LABEL_RE.finditer(raw):
+        out[m.group(1)] = m.group(2)
+        consumed = m.end()
+    rest = raw[consumed:].strip(", ")
+    if rest:
+        return None  # junk the label regex could not consume
+    return out
+
+
+def _value(v: str) -> float:
+    if v == "NaN":
+        return float("nan")
+    if v in ("+Inf", "Inf"):
+        return float("inf")
+    if v == "-Inf":
+        return float("-inf")
+    return float(v)
+
+
+def lint_exposition(text: str, openmetrics: bool = False) -> List[str]:
+    """Validate one /metrics page; returns error strings (empty = valid)."""
+    errors: List[str] = []
+    # (base_name, frozen labels w/o le) -> [(le, count)]
+    buckets: Dict[Tuple[str, tuple], List[Tuple[float, float]]] = {}
+    sums: Dict[Tuple[str, tuple], float] = {}
+    counts: Dict[Tuple[str, tuple], float] = {}
+    lines = text.splitlines()
+    for i, line in enumerate(lines, 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            continue  # HELP/TYPE/EOF
+        m = _SERIES_RE.match(line)
+        if m is None:
+            errors.append(f"line {i}: unparseable series line: {line!r}")
+            continue
+        labels = _parse_labels(m.group("labels"))
+        if labels is None:
+            errors.append(f"line {i}: unparseable labels: {line!r}")
+            continue
+        try:
+            value = _value(m.group("value"))
+        except ValueError:
+            errors.append(f"line {i}: bad sample value: {line!r}")
+            continue
+        name = m.group("name")
+        ex = m.group("exemplar")
+        if ex is not None:
+            if not openmetrics:
+                errors.append(
+                    f"line {i}: exemplar on a non-OpenMetrics scrape")
+            em = _EXEMPLAR_RE.match(ex)
+            if em is None:
+                errors.append(f"line {i}: malformed exemplar: {ex!r}")
+            elif name.endswith("_bucket"):
+                le_raw = labels.get("le")
+                if le_raw is not None:
+                    le = _value(le_raw)
+                    if float(em.group("value")) > le:
+                        errors.append(
+                            f"line {i}: exemplar value "
+                            f"{em.group('value')} above bucket le={le_raw}")
+        if name.endswith("_bucket"):
+            le_raw = labels.get("le")
+            if le_raw is None:
+                errors.append(f"line {i}: _bucket series without le label")
+                continue
+            base = name[:-len("_bucket")]
+            key = (base, tuple(sorted((k, v) for k, v in labels.items()
+                                      if k != "le")))
+            buckets.setdefault(key, []).append((_value(le_raw), value))
+        elif name.endswith("_sum"):
+            sums[(name[:-len("_sum")],
+                  tuple(sorted(labels.items())))] = value
+        elif name.endswith("_count"):
+            counts[(name[:-len("_count")],
+                    tuple(sorted(labels.items())))] = value
+    # histogram structural checks
+    for key, rows in buckets.items():
+        base, lbl = key
+        rows = sorted(rows, key=lambda r: r[0])
+        prev = -1.0
+        for le, c in rows:
+            if c < prev:
+                errors.append(
+                    f"{base}{dict(lbl)}: bucket counts not monotone at "
+                    f"le={le} ({c} < {prev})")
+            prev = c
+        if rows[-1][0] != float("inf"):
+            errors.append(f"{base}{dict(lbl)}: missing +Inf bucket")
+            continue
+        n = counts.get((base, lbl))
+        if n is None:
+            errors.append(f"{base}{dict(lbl)}: missing _count")
+        elif rows[-1][1] != n:
+            errors.append(
+                f"{base}{dict(lbl)}: +Inf bucket {rows[-1][1]} != _count {n}")
+        if (base, lbl) not in sums:
+            errors.append(f"{base}{dict(lbl)}: missing _sum")
+    if openmetrics and (not lines or lines[-1].strip() != "# EOF"):
+        errors.append("OpenMetrics page does not end with # EOF")
+    return errors
+
+
+def assert_valid_scrape(text: str, openmetrics: bool = False) -> None:
+    errors = lint_exposition(text, openmetrics=openmetrics)
+    assert not errors, "invalid /metrics exposition:\n" + "\n".join(errors)

@@ -435,8 +435,8 @@ def disagg_stack(stack):
     serve_forever_in_thread(dsrv)
     decode_url = f"http://127.0.0.1:{dsrv.server_address[1]}"
 
-    yield {"decode": decode_url, "pctx": pctx, "dctx": dctx,
-           "plane": stack["plane"]}
+    yield {"decode": decode_url, "prefill": f"http://127.0.0.1:{pport}",
+           "pctx": pctx, "dctx": dctx, "plane": stack["plane"]}
     dsrv.shutdown()
     psrv.shutdown()
     dctx.close()
@@ -615,6 +615,38 @@ def test_drain_handoff_completes_inflight_stream(drain_stack):
         plane.clear()
         ctx_a.draining.clear()
         ctx_a.drain_handoff.clear()
+
+
+# --------------------------------------------------------------------------
+# exposition validity across every chaos topology (ISSUE 6 acceptance)
+# --------------------------------------------------------------------------
+def test_metrics_scrape_valid_on_every_topology(stack, disagg_stack,
+                                                drain_stack):
+    """After the whole suite's faults, failovers, drains and disagg
+    traffic, EVERY process's /metrics page — classic text and OpenMetrics
+    — must still pass the exposition validator (tests/metrics_lint.py)."""
+    from metrics_lint import assert_valid_scrape
+
+    endpoints = {
+        "agg.frontend": stack["frontend"],
+        "agg.worker": stack["worker"],
+        "disagg.prefill": disagg_stack["prefill"],
+        "disagg.decode": disagg_stack["decode"],
+        "drain.frontend": drain_stack["frontend"],
+        "drain.worker_a": drain_stack["urls"][0],
+        "drain.worker_b": drain_stack["urls"][1],
+    }
+    for who, base in endpoints.items():
+        for accept, om in ((None, False),
+                           ("application/openmetrics-text", True)):
+            req = urllib.request.Request(base + "/metrics")
+            if accept:
+                req.add_header("Accept", accept)
+            text = urllib.request.urlopen(req, timeout=30).read().decode()
+            try:
+                assert_valid_scrape(text, openmetrics=om)
+            except AssertionError as e:
+                raise AssertionError(f"{who} ({accept or 'text'}): {e}")
 
 
 # --------------------------------------------------------------------------

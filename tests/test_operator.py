@@ -839,7 +839,8 @@ def test_profiler_job_failure_marks_dgdr_failed():
 
 # --------------------------------------------------------------- planner --
 class _FakeMetrics:
-    """Tiny HTTP server exposing a settable queued-requests gauge."""
+    """Tiny HTTP server exposing settable queued-requests + SLO-burn
+    gauges (the two planner inputs, Controller._scrape_signals)."""
 
     def __init__(self):
         import http.server
@@ -849,8 +850,14 @@ class _FakeMetrics:
 
         class H(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
-                body = ("dynamo_frontend_queued_requests "
-                        f"{outer.queued}\n").encode()
+                body = (
+                    "dynamo_frontend_queued_requests "
+                    f"{outer.queued}\n"
+                    'dynamo_slo_burn_rate{slo="default",objective="ttft",'
+                    f'window="5m",model="*",role="frontend"}} {outer.burn}\n'
+                    'dynamo_slo_burn_rate{slo="default",objective="ttft",'
+                    'window="1h",model="*",role="frontend"} 99.0\n'
+                ).encode()
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -860,6 +867,7 @@ class _FakeMetrics:
                 pass
 
         self.queued = 0.0
+        self.burn = 0.0
         self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
         threading.Thread(target=self.srv.serve_forever, daemon=True).start()
         self.url = f"http://127.0.0.1:{self.srv.server_address[1]}/metrics"
@@ -931,6 +939,61 @@ def test_planner_scales_worker_replicas_from_live_metrics():
             metrics.close()
         except Exception:
             pass
+
+
+def test_planner_slo_burn_boost():
+    """An active 5m SLO burn adds a replica even while the queue looks
+    tame, and holds the scale during the burn; sloBurnBoost: false opts
+    out. Only window="5m" series count (the 1h line in the fake always
+    reads 99 and must not trigger anything by itself)."""
+    metrics = _FakeMetrics()
+    try:
+        with FakeK8s() as fake:
+            client = K8sClient(fake.url)
+            ctrl = Controller(client, namespace=None)
+            client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                          _autoscaled_dgd(metrics.url))
+            ctrl.reconcile_once()
+
+            def worker_replicas():
+                dep = client.get("apps/v1", "deployments", "dynamo",
+                                 "scale-demo-jetstreamdecodeworker")
+                return dep["spec"]["replicas"]
+
+            # tame queue, no burn: nothing happens (1h=99 ignored)
+            metrics.queued = 1
+            assert ctrl.planner_tick(now=1000.0) == 0
+
+            # fast-window burn > 1.0: one replica added despite the queue
+            metrics.burn = 2.5
+            assert ctrl.planner_tick(now=1010.0) == 1
+            ctrl.reconcile_once()
+            assert worker_replicas() == 2
+
+            # burn persists: holds (boost is current+1, already there) and
+            # the hysteresis window must not scale down mid-burn
+            assert ctrl.planner_tick(now=1100.0) == 0
+            assert worker_replicas() == 2
+
+            # burn ends: normal hysteresis scale-down resumes
+            metrics.burn = 0.0
+            ctrl.planner_tick(now=1110.0)
+            assert ctrl.planner_tick(now=1200.0) == 1
+            ctrl.reconcile_once()
+            assert worker_replicas() == 1
+
+            # opt-out: sloBurnBoost false ignores the burn signal
+            cr = client.get(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                            "scale-demo")
+            svc = cr["spec"]["services"]["JetstreamDecodeWorker"]
+            svc["autoscaling"]["sloBurnBoost"] = False
+            client.replace(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                           "scale-demo", cr)
+            metrics.burn = 5.0
+            assert ctrl.planner_tick(now=1300.0) == 0
+            assert worker_replicas() == 1
+    finally:
+        metrics.close()
 
 
 def test_planner_ignores_services_without_autoscaling():

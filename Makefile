@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -30,6 +30,7 @@ help:
 	@echo "  recovery-check mid-stream recovery suite (journaled continuation failover, drain handoff)"
 	@echo "  lora-check     multi-LoRA suite (registry LRU, mixed-batch parity, adapter routing)"
 	@echo "  obs-check      SLO/exemplar suite + live scrape validation (burn rates, OpenMetrics)"
+	@echo "  qos-check      per-tenant QoS suite (weighted-fair isolation, tenant admission, SLO-burn shed)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -104,6 +105,16 @@ lora-check:
 obs-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q -p no:randomly
 	JAX_PLATFORMS=cpu python scripts/obs_check.py
+
+# Per-tenant QoS gate (docs/robustness.md "Per-tenant QoS"): the `qos`
+# marker suite — identity resolution, weighted-fair budget accounting,
+# the deterministic engine isolation acceptance (an aggressor flooding at
+# 10x its weight cannot starve a well-behaved tenant), per-tenant 429
+# shedding with tenant-derived Retry-After, and the recovery-continuation
+# tenant-preservation stack test, under the pinned chaos fault seed.
+qos-check:
+	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
+		python -m pytest tests/test_qos.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

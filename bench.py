@@ -415,6 +415,136 @@ def bench_long_shared_prefix() -> dict:
     }
 
 
+def bench_multi_tenant_skew(on_tpu: bool) -> dict:
+    """Per-tenant QoS scenario: ONE aggressive tenant flooding at ~10x
+    its weighted share against N well-behaved tenants on a shared engine
+    (docs/robustness.md "Per-tenant QoS"). Reports per-tenant TTFT/ITL
+    percentiles measured at the bench layer (wall clock per TokenEvent)
+    plus the engine accountant's defer/preempt counters, A/B against the
+    identical workload with QoS off. Deterministic: greedy, fixed
+    prompts, single-threaded step loop.
+
+    Env: BENCH_TENANTS (well-behaved tenant count, default 3),
+    BENCH_SKEW (aggressor request multiplier, default 10),
+    BENCH_QOS_TOKENS (max_tokens per request, default 32)."""
+    import time as _time
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    model = os.environ.get("BENCH_MODEL",
+                           "llama-3.2-1b-instruct" if on_tpu else "tiny-debug")
+    n_good = int(os.environ.get("BENCH_TENANTS", "3"))
+    skew = int(os.environ.get("BENCH_SKEW", "10"))
+    steps = int(os.environ.get("BENCH_QOS_TOKENS", "32"))
+    tenants = [{"name": "aggressor", "weight": 1}] + [
+        {"name": f"good{i}", "weight": 1} for i in range(n_good)]
+
+    def requests():
+        reqs = []
+        for i in range(skew):
+            reqs.append(("aggressor", f"agg{i}",
+                         [(i * 13 + j * 7) % 199 + 1 for j in range(24)]))
+        for i in range(n_good):
+            reqs.append((f"good{i}", f"good{i}-0",
+                         [(i * 31 + j * 5) % 199 + 1 for j in range(24)]))
+        return reqs
+
+    def pctl(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    def run(qos_on: bool, params=None):
+        eng = Engine(EngineConfig(
+            model=model, page_size=16, num_pages=256, max_num_seqs=4,
+            max_seq_len=steps + 64, seed=11, enable_prefix_caching=False,
+            tenants=json.dumps(tenants) if qos_on else "[]"), params=params)
+        # warm every program the timed run can hit — the SOLO prefill
+        # (QoS admissions land one by one), the batched group prefill,
+        # the next bucket up (preemption continuations carry prompt +
+        # output), and the decode window — so the timed section measures
+        # SCHEDULING, not compiles
+        eng.add_request(GenRequest(
+            "warm-solo", [(j * 3) % 199 + 1 for j in range(24)],
+            max_tokens=8, temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            eng.step()
+        eng.add_request(GenRequest(
+            "warm-cont", [(j * 5) % 199 + 1 for j in range(40)],
+            max_tokens=8, temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            eng.step()
+        for i in range(4):
+            eng.add_request(GenRequest(
+                f"warm{i}", [(i * 17 + j * 3) % 199 + 1 for j in range(24)],
+                max_tokens=8, temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            eng.step()
+        eng.reset_metrics()
+        submit, first, itl, last = {}, {}, {}, {}
+        for tenant, rid, prompt in requests():
+            submit[rid] = (_time.perf_counter(), tenant)
+            eng.add_request(GenRequest(rid, prompt, max_tokens=steps,
+                                       temperature=0.0, ignore_eos=True,
+                                       tenant=tenant if qos_on else None))
+        while eng.has_work:
+            for ev in eng.step():
+                now = _time.perf_counter()
+                if ev.token_id < 0:
+                    continue
+                t0, tenant = submit[ev.request_id]
+                if ev.request_id not in first:
+                    first[ev.request_id] = now - t0
+                else:
+                    itl.setdefault(tenant, []).append(
+                        now - last[ev.request_id])
+                last[ev.request_id] = now
+        per_tenant = {}
+        for rid, (t0, tenant) in submit.items():
+            per_tenant.setdefault(tenant, {}).setdefault(
+                "ttft_samples", []).append(first.get(rid, 0.0))
+        out = {}
+        for tenant, d in sorted(per_tenant.items()):
+            samples = itl.get(tenant, [])
+            out[tenant] = {
+                "ttft_p50_ms": round(1e3 * pctl(d["ttft_samples"], 0.5), 3),
+                "ttft_p95_ms": round(1e3 * pctl(d["ttft_samples"], 0.95), 3),
+                "itl_p50_ms": round(1e3 * pctl(samples, 0.5), 3),
+                "itl_p95_ms": round(1e3 * pctl(samples, 0.95), 3),
+            }
+        res = {"tenants": out}
+        if eng.qos is not None:
+            res["qos"] = eng.qos.stats()
+        return res, eng.params
+
+    qos_res, params = run(qos_on=True)
+    base_res, _ = run(qos_on=False, params=params)
+    good_ttft_on = [v["ttft_p95_ms"] for t, v in qos_res["tenants"].items()
+                    if t != "aggressor"]
+    good_ttft_off = [v["ttft_p95_ms"] for t, v in base_res["tenants"].items()
+                     if t != "aggressor"]
+    return {
+        "metric": "multi_tenant_skew_good_ttft_p95",
+        "value": max(good_ttft_on) if good_ttft_on else 0.0,
+        "unit": "ms",
+        "scenario": "multi_tenant_skew",
+        "model": model,
+        "aggressor_requests": skew,
+        "well_behaved_tenants": n_good,
+        "qos_on": qos_res,
+        "qos_off": base_res,
+        "good_ttft_p95_speedup": round(
+            max(good_ttft_off) / max(max(good_ttft_on), 1e-9), 3)
+        if good_ttft_off and good_ttft_on else 0.0,
+        # CPU-fallback latency is never comparable to the TPU north star
+        # (standing ROADMAP constraint)
+        "comparable": bool(on_tpu),
+    }
+
+
 def main() -> None:
     backend = _init_backend()
     import jax
@@ -423,6 +553,10 @@ def main() -> None:
     if os.environ.get("BENCH_SCENARIO") == "long_shared_prefix":
         # KVBM tier A/B: one JSON line, same contract as the headline
         print(json.dumps(bench_long_shared_prefix()))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "multi_tenant_skew":
+        # per-tenant QoS isolation A/B: one JSON line, same contract
+        print(json.dumps(bench_multi_tenant_skew(on_tpu)))
         return
     dev = jax.devices()[0]
     chip = _chip_spec(dev) if on_tpu else None

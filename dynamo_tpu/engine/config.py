@@ -125,6 +125,16 @@ class EngineConfig:
     # `loraAdapters` manifest key into DYNAMO_TPU_LORA_ADAPTERS.
     lora_adapters: Optional[str] = None
 
+    # per-tenant QoS (dynamo_tpu.qos): JSON list of tenant classes
+    # ({name, weight, priority, maxInflight, apiKeys}) enabling the
+    # weighted-fair token-budget scheduler — over-budget tenants' requests
+    # defer admission and rank first for preemption under pressure. None
+    # reads the DYNAMO_TPU_TENANTS env (the operator materializes the
+    # manifest `tenants:` key into it); empty/absent disables QoS.
+    tenants: Optional[str] = None
+    # budget clamp: how many tokens of claim/debt a tenant can bank
+    qos_burst_tokens: int = 512
+
     # async scheduling: dispatch decode window k+1 BEFORE reading window k's
     # tokens back, overlapping the host sync with device compute (vLLM's
     # async scheduler analogue). Stop detection lags one window; membership
@@ -214,6 +224,13 @@ class EngineConfig:
                        default=_os.environ.get("DYNAMO_TPU_LORA_ADAPTERS"),
                        help="boot-time adapter registrations: "
                             "name=/path[,name2=/path2]")
+        # per-tenant QoS (the operator materializes the `tenants:`
+        # manifest key into DYNAMO_TPU_TENANTS on every component)
+        p.add_argument("--tenants",
+                       default=_os.environ.get("DYNAMO_TPU_TENANTS"),
+                       help="JSON list of tenant classes "
+                            '([{"name","weight","priority",...}])')
+        p.add_argument("--qos-burst-tokens", type=int, default=512)
         p.add_argument("--disaggregation-mode", default="agg",
                        choices=["agg", "prefill", "decode"])
         p.add_argument("--is-prefill-worker", action="store_true")
@@ -276,6 +293,8 @@ class EngineConfig:
             lora_slots=getattr(args, "lora_slots", 0),
             lora_rank=getattr(args, "lora_rank", 16),
             lora_adapters=getattr(args, "lora_adapters", None),
+            tenants=getattr(args, "tenants", None),
+            qos_burst_tokens=getattr(args, "qos_burst_tokens", 512),
             disaggregation_mode=mode,
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,

@@ -378,6 +378,27 @@ class Engine:
                 "boot-registered)", cfg.lora_slots, cfg.lora_rank,
                 len(self.lora.names()) or "none")
 
+        # --- per-tenant QoS (dynamo_tpu.qos) ---
+        # weighted-fair token budgets: each request carries the tenant the
+        # serving layer resolved; the accountant debits decoded tokens and
+        # credits total throughput by weight share. Over-budget tenants
+        # defer admission, lose group widening, and rank first as
+        # preemption victims. Disabled (None) without configured tenants —
+        # the scheduler then behaves byte-identically to the pre-QoS code.
+        from dynamo_tpu.qos.tenancy import TenantAccountant, TenantRegistry
+
+        self.tenant_registry = (TenantRegistry.from_json(cfg.tenants)
+                                if cfg.tenants else TenantRegistry.from_env())
+        self.qos: Optional[TenantAccountant] = None
+        if self.tenant_registry.enabled:
+            self.qos = TenantAccountant(
+                self.tenant_registry, burst_tokens=cfg.qos_burst_tokens)
+            log.info("per-tenant QoS: %d classes, burst %d tokens",
+                     len(self.tenant_registry.classes), self.qos.burst)
+        # request_id -> tenant, for budget accounting of TokenEvents whose
+        # sequence may already be gone by the time step() returns them
+        self._rid_tenant: Dict[str, str] = {}
+
         # --- batch slots (host-side mirrors of device batch state) ---
         b, pmax = cfg.max_num_seqs, cfg.max_pages_per_seq
         self.block_tables = np.zeros((b, pmax), dtype=np.int32)
@@ -993,19 +1014,174 @@ class Engine:
                 f"{self.cfg.num_pages - 1}"
             )
 
+    # ------------------------------------------------------ per-tenant QoS --
+
+    @staticmethod
+    def _tenant_of(req: GenRequest) -> str:
+        return req.tenant or "default"
+
+    def _queue_priority(self, req: GenRequest) -> int:
+        """STATIC queue-order priority: the request's own priority plus
+        its tenant class's priority offset. Static by construction (no
+        budget term) so the pending queue's sorted invariant cannot rot
+        as balances move."""
+        if self.qos is None:
+            return req.priority
+        return req.priority + self.qos.registry.cls(
+            self._tenant_of(req)).priority
+
+    def _rank_priority(self, req: GenRequest) -> int:
+        """Preemption-victim rank: queue priority plus the over-budget
+        penalty — an over-budget tenant's sequences are the preferred
+        victims under page/slot pressure, whatever their nominal class."""
+        p = self._queue_priority(req)
+        if self.qos is not None and self.qos.over_budget(
+                self._tenant_of(req)):
+            from dynamo_tpu.qos.tenancy import OVER_BUDGET_PENALTY
+
+            p += OVER_BUDGET_PENALTY
+        return p
+
+    def _qos_slot_state(self, pend) -> tuple:
+        """(held slots per tenant, demanding tenants, fair caps) over the
+        running set + `pend` (a snapshot of the pending queue)."""
+        held: Dict[str, int] = {}
+        for s in self.seqs.values():
+            t = self._tenant_of(s.req)
+            held[t] = held.get(t, 0) + 1
+        if self._inflight is not None:
+            t = self._tenant_of(self._inflight.req)
+            held[t] = held.get(t, 0) + 1
+        demand = set(held) | {self._tenant_of(r) for r in pend}
+        cap = {t: self.qos.slot_cap(t, self.cfg.max_num_seqs, demand)
+               for t in demand}
+        return held, demand, cap
+
+    def _qos_pick_index(self) -> int:
+        """Index of the next pending request to admit (caller holds
+        self._lock). With QoS on, requests whose tenant is over budget or
+        already holds its fair slot share are passed over while an
+        admissible tenant waits behind them; when EVERY pending tenant is
+        blocked the head admits anyway (work conservation — fairness must
+        never idle the chip)."""
+        if self.qos is None or len(self.pending) <= 1:
+            return 0
+        held, _, cap = self._qos_slot_state(self.pending)
+        deferred: set = set()
+        for i, r in enumerate(self.pending):
+            t = self._tenant_of(r)
+            if held.get(t, 0) >= cap[t] or self.qos.over_budget(t):
+                deferred.add(t)
+                continue
+            if i:
+                for t2 in deferred:
+                    self.qos.note_defer(t2)
+            return i
+        return 0
+
+    def _qos_admissible(self, req: GenRequest) -> bool:
+        """Group-widening gate: may `req` take a slot right now? (caller
+        holds self._lock)."""
+        if self.qos is None:
+            return True
+        t = self._tenant_of(req)
+        if self.qos.over_budget(t):
+            return False
+        held, _, cap = self._qos_slot_state(self.pending)
+        return held.get(t, 0) < cap[t]
+
+    def _pending_remove(self, req: GenRequest) -> None:
+        """Remove `req` from the pending queue by identity (caller holds
+        self._lock). Identity, not equality: the QoS pick may admit from
+        the middle of the queue, and inserts between lock windows shift
+        indices."""
+        for i, r in enumerate(self.pending):
+            if r is req:
+                del self.pending[i]
+                return
+
+    def _qos_preempt_for_admission(self) -> List[TokenEvent]:
+        """WFQ slot reallocation: when every decode slot is taken and a
+        well-behaved tenant queues below its fair share, preempt ONE
+        sequence (worst rank, then youngest) of an over-budget tenant
+        holding more than its share. At most one preemption per step
+        bounds recompute thrash; the freed slot admits the waiting
+        request in this same _admit pass."""
+        if (self.qos is None or self._free_slots
+                or self._inflight is not None or not self.seqs):
+            return []
+        with self._lock:
+            if not self.pending:
+                return []
+            pend = list(self.pending)
+        held, _, cap = self._qos_slot_state(pend)
+        cand = next(
+            (r for r in pend
+             if not self.qos.over_budget(self._tenant_of(r))
+             and held.get(self._tenant_of(r), 0) < cap[self._tenant_of(r)]),
+            None)
+        if cand is None:
+            return []
+        cand_t = self._tenant_of(cand)
+        victims = [
+            (slot, s) for slot, s in self.seqs.items()
+            if self._tenant_of(s.req) != cand_t
+            and self.qos.over_budget(self._tenant_of(s.req))
+            and held.get(self._tenant_of(s.req), 0)
+            > cap.get(self._tenant_of(s.req), 0)
+        ]
+        if not victims:
+            return []
+        # preemption frees pages an in-flight async window may still
+        # touch — drain the pipeline before any teardown
+        events = self._materialize_pending()
+        slot, seq = max(victims, key=lambda kv: (
+            self._rank_priority(kv[1].req), kv[1].req.arrival_time))
+        if self.seqs.get(slot) is seq:  # materializing may have finished it
+            self._preempt_slot(slot)
+        return events
+
+    def _qos_account(self, events: List[TokenEvent]) -> None:
+        """Bank one step's decoded tokens into the tenant budgets."""
+        if self.qos is None or not events:
+            return
+        produced: Dict[str, int] = {}
+        done: List[str] = []
+        for ev in events:
+            if ev.token_id >= 0:
+                t = self._rid_tenant.get(ev.request_id, "default")
+                produced[t] = produced.get(t, 0) + 1
+            if ev.finished:
+                done.append(ev.request_id)
+        if produced:
+            demand = {self._tenant_of(s.req) for s in self.seqs.values()}
+            with self._lock:
+                demand.update(self._tenant_of(r) for r in self.pending)
+            if self._inflight is not None:
+                demand.add(self._tenant_of(self._inflight.req))
+            demand.update(produced)
+            self.qos.account(produced, demand)
+        if done:
+            with self._lock:
+                for rid in done:
+                    self._rid_tenant.pop(rid, None)
+
     def _insert_pending(self, req: GenRequest, requeue: bool = False) -> None:
         """Priority-aware queue insertion (caller holds self._lock).
 
-        vLLM priority semantics: LOWER value admits sooner (0 default).
-        The queue stays ascending by priority with FIFO inside a level;
-        requeued requests predate same-level arrivals, so they re-insert
-        BEFORE their level's existing entries."""
+        vLLM priority semantics: LOWER value admits sooner (0 default);
+        with QoS on the ordering key is the request priority plus the
+        tenant class's offset (_queue_priority). The queue stays ascending
+        by that key with FIFO inside a level; requeued requests predate
+        same-level arrivals, so they re-insert BEFORE their level's
+        existing entries."""
+        p = self._queue_priority(req)
         if requeue:
             idx = next((i for i, r in enumerate(self.pending)
-                        if r.priority >= req.priority), None)
+                        if self._queue_priority(r) >= p), None)
         else:
             idx = next((i for i, r in enumerate(self.pending)
-                        if r.priority > req.priority), None)
+                        if self._queue_priority(r) > p), None)
         if idx is None:
             self.pending.append(req)
         else:
@@ -1021,6 +1197,7 @@ class Engine:
         self.validate_request(req)
         with self._lock:
             self._insert_pending(req)
+            self._rid_tenant[req.request_id] = self._tenant_of(req)
             self.metrics.num_requests += 1
 
     def abort_request(self, request_id: str) -> None:
@@ -1035,6 +1212,7 @@ class Engine:
             ids = [r.request_id for r in self.pending]
             self.pending.clear()
             self._aborted.clear()
+            self._rid_tenant.clear()
         self._pending_win = None  # unread tokens die with their sequences
         inf, self._inflight = self._inflight, None
         if inf is not None:
@@ -1078,6 +1256,9 @@ class Engine:
                     events.extend(self._decode_async())
                 else:
                     events.extend(self._decode_once())
+            # per-tenant QoS: bank this step's decoded tokens into the
+            # weighted-fair budgets (no-op without configured tenants)
+            self._qos_account(events)
             return events
 
     def _apply_aborts(self) -> List[TokenEvent]:
@@ -1123,12 +1304,18 @@ class Engine:
 
     def _admit(self) -> List[TokenEvent]:
         events: List[TokenEvent] = []
+        # per-tenant QoS: slots full + a well-behaved tenant waiting below
+        # its share -> preempt one over-share over-budget sequence first
+        events.extend(self._qos_preempt_for_admission())
         chunk = self.cfg.prefill_chunk_tokens
         while self._free_slots:
             with self._lock:
                 if not self.pending:
                     break
-                req = self.pending[0]
+                # QoS-aware pick: pass over tenants that are over budget
+                # or at their fair slot share while others wait (plain
+                # head-of-queue without configured tenants)
+                req = self.pending[self._qos_pick_index()]
             if req.adapter:
                 # resolve (and lazily device-load) the adapter BEFORE any
                 # allocation: from here to installation nothing else can
@@ -1141,7 +1328,7 @@ class Engine:
                 except KeyError:
                     # unregistered between submit and admission
                     with self._lock:
-                        self.pending.popleft()
+                        self._pending_remove(req)
                     events.append(
                         TokenEvent(req.request_id, -1, 0, True, "abort"))
                     continue
@@ -1162,7 +1349,7 @@ class Engine:
                     self.allocator.free(cached_pages)  # drop our refs
                 break  # wait for running sequences to release pages
             with self._lock:
-                self.pending.popleft()
+                self._pending_remove(req)
             # installing a slot invalidates the device carry: drain the
             # in-flight async window before membership changes
             events.extend(self._materialize_pending())
@@ -1217,6 +1404,8 @@ class Engine:
                 if not self.pending:
                     break
                 nxt = self.pending[0]
+                if not self._qos_admissible(nxt):
+                    break  # over-budget/over-share tenant: own pass later
             plen = len(nxt.prompt_token_ids)
             if chunk > 0 and plen > chunk:
                 break  # chunked path
@@ -1237,7 +1426,7 @@ class Engine:
                 break
             pending_need += n_pg
             with self._lock:
-                self.pending.popleft()
+                self._pending_remove(nxt)
             group.append(nxt)
         return group
 
@@ -1862,7 +2051,10 @@ class Engine:
         - streams: the serving layer keys on request_id and counts tokens
           itself, so the continuation's events append seamlessly."""
         def rank(q):  # vLLM order: WORSE = higher priority value, younger
-            return (q.req.priority if q.req else 0,
+            # with QoS on, _rank_priority folds in the tenant class offset
+            # plus the over-budget penalty, so an over-budget tenant's
+            # sequences are victimized before any well-behaved tenant's
+            return (self._rank_priority(q.req) if q.req else 0,
                     q.req.arrival_time if q.req else 0.0)
 
         protected = self.seqs.get(protect)
@@ -1901,6 +2093,8 @@ class Engine:
         self._finish_slot(slot, None)
         self.metrics.num_finished -= 1  # preempted, not finished
         self.metrics.num_preempted += 1
+        if self.qos is not None:
+            self.qos.note_preempt(self._tenant_of(old))
         with self._lock:
             self._insert_pending(cont, requeue=True)
 
@@ -2393,6 +2587,8 @@ class Engine:
         # worker started, so disagg sampling == agg sampling for a given seed
         self._install_slot(req, slot, pages, n_prompt, first_token,
                            self._request_key(req))
+        with self._lock:
+            self._rid_tenant[req.request_id] = self._tenant_of(req)
         self.metrics.num_requests += 1
         return False, None
 

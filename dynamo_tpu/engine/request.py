@@ -57,6 +57,13 @@ class GenRequest:
     # slot at admission — lazily loading the adapter if it isn't resident
     # — and carried across preemption/recovery continuations.
     adapter: Optional[str] = None
+    # per-tenant QoS (dynamo_tpu.qos): the tenant identity the serving
+    # layer resolved from the request's headers (None = the default
+    # tenant). Drives weighted-fair budget accounting, queue priority
+    # (tenant class priority adds to `priority`), and preemption-victim
+    # ranking; carried across preemption/recovery continuations and the
+    # disagg prefill RPC. Scheduling-only: sampling never reads it.
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -61,6 +61,7 @@ SCALAR_ENVS = {  # the one-default-target shorthand
 }
 _TARGET_KEYS = {  # accepted spec keys, camelCase (manifest) and snake_case
     "model": "model", "role": "role", "name": "name", "goal": "goal",
+    "tenant": "tenant",
     "ttft_ms": "ttft_ms", "ttftMs": "ttft_ms",
     "itl_ms": "itl_ms", "itlMs": "itl_ms",
     "error_rate": "error_rate", "errorRate": "error_rate",
@@ -69,12 +70,19 @@ _TARGET_KEYS = {  # accepted spec keys, camelCase (manifest) and snake_case
 
 @dataclasses.dataclass(frozen=True)
 class SLOTarget:
-    """One declarative objective set. `model`/`role` are exact-match
-    selectors ('*' = any); a '<base>:<adapter>' model selects the adapter's
-    own latency series on the frontend."""
+    """One declarative objective set. `model`/`role`/`tenant` are
+    exact-match selectors ('*' = any); a '<base>:<adapter>' model selects
+    the adapter's own latency series on the frontend. A non-wildcard
+    `tenant` selects the per-tenant latency series
+    (``dynamo_tenant_*``, dynamo_tpu.qos) instead of the model-labeled
+    ones — the signal the QoS plane's burn-aware admission and the
+    isolation chaos tests consume. Tenant selectors apply to the latency
+    objectives only (there is no per-tenant error counter), so an
+    error_rate on a tenant-scoped target emits no rows."""
 
     model: str = "*"
     role: str = "*"          # frontend | agg | prefill | decode | *
+    tenant: str = "*"        # per-tenant QoS selector (dynamo_tpu.qos)
     ttft_ms: Optional[float] = None
     itl_ms: Optional[float] = None
     error_rate: Optional[float] = None
@@ -91,7 +99,8 @@ class SLOTarget:
     def label(self) -> str:
         if self.name:
             return self.name
-        parts = [p for p in (self.model, self.role) if p != "*"]
+        parts = [p for p in (self.model, self.tenant, self.role)
+                 if p != "*"]
         return "/".join(parts) or "default"
 
     def objectives(self) -> List[Tuple[str, float, float]]:
@@ -122,7 +131,7 @@ def target_from_dict(spec: Mapping[str, Any]) -> SLOTarget:
     kw: Dict[str, Any] = {}
     for k, v in spec.items():
         field = _TARGET_KEYS[k]
-        if field in ("model", "role", "name"):
+        if field in ("model", "role", "name", "tenant"):
             kw[field] = str(v)
         else:
             kw[field] = float(v)
@@ -195,7 +204,8 @@ class SLOEngine:
         self._last_requests = 0.0
         self._lock = threading.Lock()
         r = metrics.registry
-        labelnames = ("slo", "objective", "window", "model", "role")
+        labelnames = ("slo", "objective", "window", "model", "role",
+                      "tenant")
         self.attainment_gauge = Gauge(
             "dynamo_slo_attainment",
             "Fraction of requests meeting the SLO objective over the "
@@ -260,13 +270,28 @@ class SLOEngine:
         for ti, t in enumerate(self.targets):
             if not t.matches_role(self.role):
                 continue
+            tenant_scoped = t.tenant != "*"
             for objective, threshold_s, _budget in t.objectives():
                 if objective == "error_rate":
+                    if tenant_scoped:
+                        continue  # no per-tenant error counter (docstring)
                     for model, reqs in req_by_model.items():
                         if not t.matches_model(model):
                             continue
                         self._bank(ti, objective, ("model", model),
                                    reqs, err_by_model.get(model, 0.0))
+                    continue
+                if tenant_scoped:
+                    # per-tenant QoS selector: the tenant-labeled latency
+                    # series (dynamo_tenant_*) are the source, so one
+                    # tenant's tail can't hide in the model aggregate
+                    hist = (m.tenant_ttft if objective == "ttft"
+                            else m.tenant_itl)
+                    for lbl, (good, total) in hist.good_total(
+                            threshold_s).items():
+                        if dict(lbl).get("tenant", "") != t.tenant:
+                            continue
+                        self._bank(ti, objective, lbl, total, total - good)
                     continue
                 hist = m.ttft if objective == "ttft" else m.itl
                 for lbl, (good, total) in hist.good_total(threshold_s).items():
@@ -320,6 +345,7 @@ class SLOEngine:
                             "window": WINDOW_LABELS.get(w, f"{w}s"),
                             "window_s": w,
                             "model": t.model,
+                            "tenant": t.tenant,
                             "role": self.role,
                             "threshold_s": threshold_s,
                             "requests": int(tot),
@@ -335,7 +361,7 @@ class SLOEngine:
         for row in self.evaluate(now):
             labels = dict(slo=row["slo"], objective=row["objective"],
                           window=row["window"], model=row["model"],
-                          role=row["role"])
+                          role=row["role"], tenant=row["tenant"])
             self.attainment_gauge.set(row["attainment"], **labels)
             self.burn_gauge.set(row["burn_rate"], **labels)
 

@@ -174,6 +174,12 @@ def _container(
     # their own role's (prefill TTFT / decode ITL) burn
     for name, value in slo_env(spec):
         env.append({"name": name, "value": value})
+    # per-tenant QoS (dynamo_tpu.qos): `tenants:` applies to EVERY
+    # component too — the frontend enforces weighted admission with it,
+    # workers budget decode throughput and resolve identity with the
+    # SAME classes, so edge and engine can never disagree on a weight
+    for name, value in tenant_env(spec):
+        env.append({"name": name, "value": value})
     if ctype != "frontend":
         env.append(
             {
@@ -298,6 +304,33 @@ def slo_env(spec: Dict[str, Any]) -> List[tuple]:
                  _json.dumps(tg, separators=(",", ":")))]
     raise ValueError("sloTargets must be a map of scalars or a list of "
                      "target specs")
+
+
+def tenant_env(spec: Dict[str, Any]) -> List[tuple]:
+    """The `tenants:` manifest key as (env name, value) pairs.
+
+    A list of tenant-class specs (docs/robustness.md "Per-tenant QoS"):
+
+        tenants:
+          - {name: acme, weight: 4, priority: 0, maxInflight: 64,
+             apiKeys: ["sk-acme-1"]}
+          - {name: free-tier, weight: 1, priority: 5}
+
+    Validated via the QoS plane's own parser so the operator rejects
+    exactly what the frontend/worker would reject; specs are normalized
+    (camelCase -> snake_case) before landing in DYNAMO_TPU_TENANTS."""
+    import json as _json
+
+    tg = spec.get("tenants")
+    if not tg:
+        return []
+    if not isinstance(tg, list):
+        raise ValueError("tenants must be a list of tenant-class specs")
+    from dynamo_tpu.qos.tenancy import tenant_from_dict
+
+    normalized = [tenant_from_dict(item).to_dict() for item in tg]
+    return [("DYNAMO_TPU_TENANTS",
+             _json.dumps(normalized, separators=(",", ":")))]
 
 
 def drain_seconds(spec: Dict[str, Any]) -> int:

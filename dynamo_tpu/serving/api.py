@@ -160,7 +160,13 @@ class GenerationHandle:
             prior_output_token_ids=prior,
             resume_key=(rec or {}).get("resume_key"),
             adapter=params.get("adapter"),
+            # per-tenant QoS: the identity the handler resolved from the
+            # request headers rides into the engine's weighted-fair
+            # scheduler (and across preemption/recovery continuations)
+            tenant=params.get("tenant"),
         )
+        self.tenant = self.req.tenant or "default"
+        ctx.metrics.tenant_requests.inc(tenant=self.tenant)
         if self.req.adapter and ctx.lora_requests_total is not None:
             ctx.lora_requests_total.inc(adapter=self.req.adapter)
             if ctx.engine.lora is not None:
@@ -312,9 +318,11 @@ class GenerationHandle:
             ex = self.span.trace_id if self.span.recording else None
             if t_prev is None:
                 m.ttft.observe(now - t0, exemplar=ex, model=model)
+                m.tenant_ttft.observe(now - t0, tenant=self.tenant)
                 decode_span = self._first_token_spans(ev, now - t0)
             else:
                 m.itl.observe(now - t_prev, exemplar=ex, model=model)
+                m.tenant_itl.observe(now - t_prev, tenant=self.tenant)
             t_prev = now
             delta = ""
             lp_entry = None
@@ -409,6 +417,11 @@ class ServingContext:
         self.served_model = served_model
         self.tokenizer = get_tokenizer(engine.cfg.model, engine.cfg.model_path)
         self.metrics = FrontendMetrics()
+        # per-tenant QoS identity (dynamo_tpu.qos): the engine built the
+        # registry from cfg.tenants / DYNAMO_TPU_TENANTS — handlers resolve
+        # every inference request's tenant against the same classes the
+        # weighted-fair scheduler budgets with
+        self.tenants = engine.tenant_registry
         self.kv_gauge = Gauge(
             "dynamo_worker_kv_free_pages", "Free KV pages", self.metrics.registry
         )
@@ -864,6 +877,10 @@ class _Handler(JsonHTTPHandler):
                 out["prefix_cache"] = pc.stats()
             if eng.lora is not None:
                 out["lora"] = eng.lora.stats()
+            if eng.qos is not None:
+                # per-tenant QoS: budget balances, token totals, and the
+                # defer/preempt counters the isolation tests assert on
+                out["qos"] = eng.qos.stats()
             if eng.kvbm is not None:
                 out["kvbm"] = eng.kvbm.stats()
                 if self.ctx.kvbm_source is not None:
@@ -906,11 +923,17 @@ class _Handler(JsonHTTPHandler):
         # nats_plane), else a fresh root seeded by x-request-id
         span = obs_tracing.NOOP_SPAN
         self._deadline = None
+        self._tenant = "default"
         if path in ("/v1/chat/completions", "/v1/completions",
                     "/disagg/prefill"):
             parent = obs_context.extract_context(self.headers)
             inbound_rid = ((self.headers.get("x-request-id") or "").strip()
                            or None)
+            # per-tenant QoS: trust the frontend's resolved identity
+            # (x-dynamo-tenant) when present, else resolve from the
+            # client's own headers — the agg single-pod path IS the edge
+            self._tenant = self.ctx.tenants.resolve(self.headers,
+                                                    trusted=True)
             # the propagated deadline budget (x-deadline) keeps counting
             # down on this hop; requests arriving already-exhausted shed
             # with 504 before taking an engine slot
@@ -924,6 +947,7 @@ class _Handler(JsonHTTPHandler):
                         self.ctx.engine.cfg.disaggregation_mode or "agg",
                     "deadline_s": round(self._deadline.budget_s, 3),
                     "model": self.ctx.served_model,
+                    "tenant.id": self._tenant,
                 })
             rid = inbound_rid or (span.trace_id if span.recording else None)
             if rid:
@@ -1015,7 +1039,15 @@ class _Handler(JsonHTTPHandler):
             # multi-LoRA: the decode role forwards its request's adapter so
             # the prefill runs under the same weights the decode will
             adapter=body.get("adapter") or None,
+            # per-tenant QoS: the decode role forwards the resolved tenant
+            # so prefill-side spans/metrics agree with the decode side
+            tenant=body.get("tenant") or None,
         )
+        if req.tenant:
+            self._tenant = req.tenant
+        else:
+            req.tenant = self._tenant  # header-resolved (x-dynamo-tenant)
+        self.ctx.metrics.tenant_requests.inc(tenant=self._tenant)
         self._span.set_attribute("request.id", rid)
         faults.sleep_point("worker.slow_prefill")
         if self._deadline is not None and self._deadline.expired:
@@ -1193,6 +1225,7 @@ class _Handler(JsonHTTPHandler):
     def _chat(self, body):
         p = proto.parse_chat_request(body)
         p["adapter"] = self._check_model(p["model"])
+        p["tenant"] = self._tenant
         tools, tc = p["tools"], p["tool_choice"]
         forced_tool = isinstance(tc, tuple)  # ("function", name)
         if forced_tool:
@@ -1336,6 +1369,7 @@ class _Handler(JsonHTTPHandler):
     def _completion(self, body):
         p = proto.parse_completion_request(body)
         p["adapter"] = self._check_model(p["model"])
+        p["tenant"] = self._tenant
         prompt_ids = self.ctx.tokenizer.encode(p["prompt"])
         # KV event plane: the frontend routes completions on the raw
         # prompt string — the same canonical text registered here

@@ -212,6 +212,9 @@ class DisaggDecodeClient:
             # multi-LoRA: prefill must run under the same adapter weights
             # the decode side will attach
             "adapter": req.adapter,
+            # per-tenant QoS: the prefill worker's spans/metrics carry the
+            # same tenant identity the decode side resolved
+            "tenant": req.tenant,
         }).encode()
         t0 = time.monotonic()
         rpc_span = ctx.tracer.start_span(

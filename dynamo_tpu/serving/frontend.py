@@ -29,6 +29,7 @@ from typing import List, Optional
 from dynamo_tpu.observability import context as obs_context
 from dynamo_tpu.observability import slo as obs_slo
 from dynamo_tpu.observability import tracing as obs_tracing
+from dynamo_tpu.qos import tenancy as qos_tenancy
 from dynamo_tpu.robustness import faults
 from dynamo_tpu.robustness.breaker import STATE_CODES
 from dynamo_tpu.robustness.deadline import Deadline
@@ -45,6 +46,11 @@ log = logging.getLogger("dynamo_tpu.frontend")
 # answered 429 + Retry-After instead of queueing unboundedly (0 = off)
 MAX_INFLIGHT_ENV = "DYNAMO_TPU_MAX_INFLIGHT"
 DEFAULT_MAX_INFLIGHT = 256
+# per-tenant QoS: shed over-share tenants when any matching SLO's fast
+# window burns above this rate (0 disables; only meaningful with tenants
+# configured AND SLO targets declared — docs/robustness.md)
+BURN_SHED_ENV = "DYNAMO_TPU_QOS_BURN_SHED"
+DEFAULT_BURN_SHED = 2.0
 
 
 def _env_max_inflight() -> int:
@@ -53,6 +59,14 @@ def _env_max_inflight() -> int:
                                          DEFAULT_MAX_INFLIGHT)))
     except ValueError:
         return DEFAULT_MAX_INFLIGHT
+
+
+def _env_burn_shed() -> float:
+    try:
+        return max(0.0, float(os.environ.get(BURN_SHED_ENV,
+                                             DEFAULT_BURN_SHED)))
+    except ValueError:
+        return DEFAULT_BURN_SHED
 
 # re-export: requests slower than this log a WARNING carrying their trace
 # id — the exemplar-style bridge from the dynamo_frontend_* latency series
@@ -97,10 +111,30 @@ class FrontendContext:
         # --- robustness plane (docs/robustness.md) ---
         self.max_inflight = (max_inflight if max_inflight is not None
                              else _env_max_inflight())
+        # --- per-tenant QoS (dynamo_tpu.qos; docs/robustness.md
+        # "Per-tenant QoS") --- tenant classes from DYNAMO_TPU_TENANTS;
+        # admission becomes per-tenant: weighted in-flight caps, SLO-burn
+        # shedding of over-share tenants, and a Retry-After derived from
+        # the shed tenant's own budget-refill time. With no tenants
+        # configured everything resolves to "default" and only the global
+        # bound applies — byte-identical to the pre-QoS frontend.
+        self.tenants = qos_tenancy.TenantRegistry.from_env()
+        self.tenant_admission = qos_tenancy.TenantAdmission(
+            self.tenants, self.max_inflight)
+        self.burn_shed_threshold = _env_burn_shed()
+        self._burn_cache: Optional[tuple] = None  # (monotonic ts, rows)
         self.admission_rejected = Counter(
             "dynamo_frontend_admission_rejected_total",
-            "Requests shed with 429 by the in-flight admission bound",
-            self.metrics.registry,
+            "Requests shed with 429 by admission control, by tenant and "
+            "reason (inflight = per-tenant weighted cap; budget = global "
+            "in-flight bound; slo_burn = SLO fast-burn shed of an "
+            "over-share tenant)",
+            self.metrics.registry, labelnames=("tenant", "reason"),
+        )
+        self.tenant_inflight_gauge = Gauge(
+            "dynamo_tenant_inflight",
+            "In-flight proxied requests by tenant",
+            self.metrics.registry, labelnames=("tenant",),
         )
         self.deadline_shed = Counter(
             "dynamo_frontend_deadline_shed_total",
@@ -175,9 +209,78 @@ class FrontendContext:
         if self.router.kv_index.apply(payload):
             self.kv_events_counter.inc()
 
+    # ----------------------------------------- per-tenant admission ----
+    def admit(self, tenant: str):
+        """Admission decision for one request. Returns
+        ``(admitted, reason, retry_after_s)``; an admitted request MUST be
+        paired with release(). Checks, in order: the tenant's weighted
+        in-flight cap, the SLO fast-burn shed (over-share tenants only —
+        shedding is by tenant, never global), then the global bound."""
+        adm = self.tenant_admission
+        if self.tenants.enabled:
+            if not adm.try_admit(tenant):
+                return False, "inflight", adm.retry_after_s(tenant)
+        else:
+            adm.admit_unchecked(tenant)
+        # the tenant slot is reserved: every shed below must release it
+        if self._slo_burn_shed(tenant):
+            adm.release(tenant)
+            return False, "slo_burn", adm.retry_after_s(tenant)
+        with self._inflight_lock:
+            if self.max_inflight and self._inflight >= self.max_inflight:
+                over = True
+            else:
+                self._inflight += 1
+                over = False
+        if over:
+            adm.release(tenant)
+            return False, "budget", adm.retry_after_s(tenant)
+        return True, "", 0.0
+
+    def release(self, tenant: str, duration_s: Optional[float] = None):
+        with self._inflight_lock:
+            self._inflight -= 1
+        self.tenant_admission.release(tenant, duration_s)
+
+    def _slo_burn_shed(self, tenant: str) -> bool:
+        """SLO-aware admission: when any matching SLO objective's FAST
+        window burns above the threshold, shed tenants holding more than
+        their weighted share of the in-flight load (the likely pressure
+        source); under-share tenants keep admitting — the burn must never
+        become a global gate."""
+        thr = self.burn_shed_threshold
+        if (thr <= 0 or not self.tenants.enabled
+                or not self.tenant_admission.over_share(tenant)):
+            return False
+        fast = min(self.slo.windows_s) if self.slo.windows_s else 0
+        for row in self._burn_rows():
+            if row.get("window_s") != fast:
+                continue
+            row_tenant = row.get("tenant", "*")
+            if row_tenant not in ("*", tenant):
+                continue
+            if row.get("burn_rate", 0.0) > thr:
+                return True
+        return False
+
+    def _burn_rows(self):
+        """SLO evaluations, cached ~1s — admission must not re-walk the
+        whole burn-bucket machinery on every request of a burst."""
+        now = time.monotonic()
+        if self._burn_cache is not None and now - self._burn_cache[0] < 1.0:
+            return self._burn_cache[1]
+        try:
+            rows = self.slo.evaluate()
+        except Exception:
+            log.exception("slo evaluation failed; burn shed skipped")
+            rows = []
+        self._burn_cache = (now, rows)
+        return rows
+
 
 class _FrontendHandler(JsonHTTPHandler):
     ctx: FrontendContext
+    _tenant = qos_tenancy.DEFAULT_TENANT  # set per-request in _proxy
 
     # ---------------------------------------------------------------- routes
     def do_GET(self):
@@ -203,6 +306,17 @@ class _FrontendHandler(JsonHTTPHandler):
             # by clock, not by an event anyone could have observed)
             for url, state in ctx.router.breakers.snapshot().items():
                 ctx.breaker_gauge.set(STATE_CODES[state], worker=url)
+            # per-tenant in-flight occupancy (tenants that drained to zero
+            # must read 0, not freeze at their last value)
+            inflight = ctx.tenant_admission.snapshot()["inflight"]
+            with ctx.tenant_inflight_gauge._lock:
+                known = [dict(lbl).get("tenant")
+                         for lbl in ctx.tenant_inflight_gauge._values]
+            for t in known:
+                if t not in inflight:
+                    ctx.tenant_inflight_gauge.set(0, tenant=t)
+            for t, n in inflight.items():
+                ctx.tenant_inflight_gauge.set(n, tenant=t)
             ctx.slo.refresh_gauges()
             body, ctype = ctx.metrics.registry.scrape(
                 self.headers.get("Accept"))
@@ -233,6 +347,14 @@ class _FrontendHandler(JsonHTTPHandler):
 
             qs = parse_qs(urlparse(self.path).query)
             self._json(200, obs_slo.debug_slo_payload(ctx.slo, qs))
+        elif path == "/debug/tenants":
+            # per-tenant QoS introspection: classes, caps, live in-flight
+            self._json(200, {
+                "enabled": ctx.tenants.enabled,
+                "classes": ctx.tenants.describe(),
+                "admission": ctx.tenant_admission.snapshot(),
+                "burn_shed_threshold": ctx.burn_shed_threshold,
+            })
         else:
             self._error(404, f"no route {path}")
 
@@ -288,6 +410,8 @@ class _FrontendHandler(JsonHTTPHandler):
                     if first:
                         m.ttft.observe(time.monotonic() - t0,
                                        exemplar=exemplar, model=model)
+                        m.tenant_ttft.observe(time.monotonic() - t0,
+                                              tenant=self._tenant)
                         first = False
                     self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
                     self.wfile.flush()
@@ -300,6 +424,8 @@ class _FrontendHandler(JsonHTTPHandler):
             payload = b"".join(chunks)
             m.ttft.observe(time.monotonic() - t0, exemplar=exemplar,
                            model=model)
+            m.tenant_ttft.observe(time.monotonic() - t0,
+                                  tenant=self._tenant)
             try:
                 usage = json.loads(payload).get("usage", {})
                 m.isl.observe(usage.get("prompt_tokens", 0), model=model)
@@ -318,28 +444,36 @@ class _FrontendHandler(JsonHTTPHandler):
     def _proxy(self, path: str):
         # in-flight accounting spans the WHOLE proxied exchange (SSE
         # passthrough included) — it is the queued-requests signal the
-        # operator's planner autoscales on. The same counter is the
-        # admission bound: overflow sheds with 429 + Retry-After instead
-        # of queueing work no worker slot exists for.
+        # operator's planner autoscales on. Admission is per-tenant
+        # (docs/robustness.md "Per-tenant QoS"): the tenant identity is
+        # resolved from the client's headers at this edge, weighted
+        # in-flight caps and the SLO-burn shed apply per tenant, and a
+        # shed response carries a Retry-After derived from THAT tenant's
+        # budget-refill time rather than the global jitter.
         ctx = self.ctx
-        with ctx._inflight_lock:
-            if ctx.max_inflight and ctx._inflight >= ctx.max_inflight:
-                admitted = False
-            else:
-                admitted = True
-                ctx._inflight += 1
+        tenant = ctx.tenants.resolve(self.headers)
+        self._tenant = tenant
+        ctx.metrics.tenant_requests.inc(tenant=tenant)
+        admitted, reason, retry_after = ctx.admit(tenant)
         if not admitted:
-            ctx.admission_rejected.inc()
+            ctx.admission_rejected.inc(tenant=tenant, reason=reason)
+            detail = {
+                "inflight": f"tenant {tenant!r} is at its in-flight cap "
+                            f"({ctx.tenant_admission.cap(tenant)})",
+                "budget": f"too many in-flight requests "
+                          f"(limit {ctx.max_inflight})",
+                "slo_burn": f"SLO budget is burning and tenant {tenant!r} "
+                            "is over its fair share",
+            }[reason]
             self._error(
-                429,
-                f"too many in-flight requests (limit {ctx.max_inflight}); "
-                "retry shortly", "rate_limit_exceeded")
+                429, f"{detail}; retry shortly", "rate_limit_exceeded",
+                headers={"Retry-After": f"{retry_after:.2f}"})
             return
+        t_admit = time.monotonic()
         try:
             self._proxy_inner(path)
         finally:
-            with ctx._inflight_lock:
-                ctx._inflight -= 1
+            ctx.release(tenant, time.monotonic() - t_admit)
 
     def _proxy_inner(self, path: str):
         ctx = self.ctx
@@ -372,7 +506,8 @@ class _FrontendHandler(JsonHTTPHandler):
             trace_seed=inbound_rid,
             attributes={"http.path": path, "model": model,
                         "deadline_s": round(deadline.budget_s, 3),
-                        "stream": bool(parsed.get("stream"))})
+                        "stream": bool(parsed.get("stream")),
+                        "tenant.id": self._tenant})
         rid = inbound_rid or (span.trace_id if span.recording else None)
         if rid:
             self.set_request_id(rid)
@@ -382,6 +517,11 @@ class _FrontendHandler(JsonHTTPHandler):
         obs_context.inject_context(
             span.context if span.recording else parent, trace_headers,
             request_id=rid)
+        # the resolved tenant identity rides EVERY downstream dispatch —
+        # worker POSTs, the NATS plane, and recovery-continuation
+        # re-dispatches all build their headers from trace_headers, so
+        # the edge's decision survives failover and mid-stream recovery
+        trace_headers[qos_tenancy.RESOLVED_HEADER] = self._tenant
         t_req = time.monotonic()
         try:
             self._route_and_forward(path, raw, body, prompt_text, affinity,
@@ -634,6 +774,8 @@ class _FrontendHandler(JsonHTTPHandler):
                     f"({type(e).__name__}); not retried", "bad_gateway")
                 return
             m.ttft.observe(time.monotonic() - t0, exemplar=ex, model=model)
+            m.tenant_ttft.observe(time.monotonic() - t0,
+                                  tenant=self._tenant)
             try:
                 usage = json.loads(payload).get("usage", {})
                 m.isl.observe(usage.get("prompt_tokens", 0), model=model)
@@ -691,11 +833,13 @@ class _FrontendHandler(JsonHTTPHandler):
             ex = span.trace_id if span.recording else None
             if first:
                 m.ttft.observe(now - t0, exemplar=ex, model=model)
+                m.tenant_ttft.observe(now - t0, tenant=self._tenant)
                 first = False
             elif t_prev is not None:
                 # client-visible inter-token latency (includes relay +
                 # network time the worker's own ITL histogram can't see)
                 m.itl.observe(now - t_prev, exemplar=ex, model=model)
+                m.tenant_itl.observe(now - t_prev, tenant=self._tenant)
             t_prev = now
             try:
                 payload = block + b"\n\n"

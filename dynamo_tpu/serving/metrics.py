@@ -360,3 +360,22 @@ class FrontendMetrics:
         self.queued = Gauge(
             "dynamo_frontend_queued_requests", "Requests queued or in flight", r
         )
+        # --- per-tenant QoS (dynamo_tpu.qos; docs/robustness.md) ---
+        # tenant-labeled latency series: the per-tenant SLO selectors
+        # (observability/slo.py SLOTarget.tenant) and the QoS isolation
+        # acceptance tests read THESE, so an aggressive tenant's tail
+        # can't hide inside the model-labeled aggregate. Labelnames are
+        # declared, so an untenanted deployment emits no phantom samples.
+        self.tenant_requests = Counter(
+            "dynamo_tenant_requests_total",
+            "Requests by resolved tenant identity", r,
+            labelnames=("tenant",),
+        )
+        self.tenant_ttft = Histogram(
+            "dynamo_tenant_time_to_first_token_seconds",
+            "Time to first token by tenant", r, labelnames=("tenant",),
+        )
+        self.tenant_itl = Histogram(
+            "dynamo_tenant_inter_token_latency_seconds",
+            "Inter-token latency by tenant", r, labelnames=("tenant",),
+        )

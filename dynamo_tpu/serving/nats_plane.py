@@ -39,6 +39,7 @@ import urllib.error
 import urllib.request
 from typing import Iterator, Optional, Tuple
 
+from dynamo_tpu.qos import tenancy as qos_tenancy
 from dynamo_tpu.robustness import deadline as ddl
 from dynamo_tpu.serving.nats import Msg, NatsClient, subject_token
 
@@ -92,7 +93,8 @@ class WorkerNatsPlane:
             # the worker's request span joins the frontend's trace and its
             # deadline keeps counting down
             inbound = msg.parsed_headers()
-            for h in ("traceparent", "x-request-id", ddl.DEADLINE_HEADER):
+            for h in ("traceparent", "x-request-id", ddl.DEADLINE_HEADER,
+                      qos_tenancy.RESOLVED_HEADER):
                 if inbound.get(h):
                     headers[h] = inbound[h]
             deadline = ddl.Deadline.from_headers(headers)

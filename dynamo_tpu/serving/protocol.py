@@ -22,6 +22,11 @@ def new_id(prefix: str = "chatcmpl") -> str:
 
 MAX_N = 8  # choices per request; bounded so one request can't hog the batch
 MAX_TOP_LOGPROBS = 5  # engine computes top-5 alternatives per step
+# request `priority` bounds (vLLM semantics: lower admits sooner). Bounded
+# so a client's raw JSON can never dominate the engine's preemption-victim
+# ranking — the tenant QoS plane reserves the space above this range for
+# its over-budget penalty (dynamo_tpu.qos.tenancy.OVER_BUDGET_PENALTY).
+PRIORITY_MIN, PRIORITY_MAX = -100, 100
 
 
 def _common_sampling(body: Dict[str, Any]) -> Dict[str, Any]:
@@ -41,8 +46,11 @@ def _common_sampling(body: Dict[str, Any]) -> Dict[str, Any]:
     if isinstance(n, bool) or not isinstance(n, int) or not 1 <= n <= MAX_N:
         raise BadRequest(f"'n' must be an integer in [1, {MAX_N}]")
     priority = body.get("priority", 0)
-    if isinstance(priority, bool) or not isinstance(priority, int):
-        raise BadRequest("'priority' must be an integer")
+    if isinstance(priority, bool) or not isinstance(priority, int) \
+            or not PRIORITY_MIN <= priority <= PRIORITY_MAX:
+        raise BadRequest(
+            f"'priority' must be an integer in "
+            f"[{PRIORITY_MIN}, {PRIORITY_MAX}]")
     min_p = _num(body, "min_p", 0.0)
     if not 0.0 <= min_p < 1.0:
         raise BadRequest("'min_p' must be in [0, 1)")

@@ -186,6 +186,106 @@ def test_scheduler_stress_tight_pool_deterministic():
     assert out1 == out2, "scheduler stress run is not deterministic"
 
 
+def test_preempt_for_never_victimizes_protected_slot():
+    """White-box: _preempt_for(need, protect=) must never take the
+    protected slot, however large the need — the caller self-preempts
+    instead (ISSUE 7 satellite)."""
+    eng = Engine(EngineConfig(**{**KW, "num_pages": 64}))
+    for r in _reqs():
+        eng.add_request(r)
+    while len(eng.seqs) < 2 and eng.has_work:
+        eng.step()
+    assert len(eng.seqs) == 2
+    # protect the WORSE-priority seq: the better one is not an eligible
+    # victim (floor check), so a huge need preempts nobody else
+    by_rid = {s.request_id: slot for slot, s in eng.seqs.items()}
+    eng._preempt_for(10**6, protect=by_rid["victim"])
+    assert by_rid["victim"] in eng.seqs, "protected slot was victimized"
+    assert "keep" in {s.request_id for s in eng.seqs.values()}, (
+        "better-priority seq preempted to feed a worse one")
+    # protect the BETTER one: the worse seq is fair game, the protected
+    # slot still survives an unbounded need
+    eng._preempt_for(10**6, protect=by_rid["keep"])
+    assert by_rid["keep"] in eng.seqs, "protected slot was victimized"
+    assert "victim" not in {s.request_id for s in eng.seqs.values()}
+    eng.abort_all()
+
+
+def test_self_preemption_when_grower_is_worst(monkeypatch):
+    """When the growing sequence is itself the worst remaining, no victim
+    exists below it — it must SELF-preempt (and later complete) rather
+    than kv_oom or preempt a better-priority peer."""
+    preempted = []
+    orig = Engine._preempt_slot
+
+    def spy(self, slot):
+        seq = self.seqs.get(slot)
+        if seq is not None:
+            preempted.append(seq.request_id)
+        orig(self, slot)
+
+    monkeypatch.setattr(Engine, "_preempt_slot", spy)
+    # 'worst' (priority 9) is the page-hungry grower; 'best' (priority 0)
+    # must never appear in the victim list
+    reqs = [
+        GenRequest("best", [3, 1, 4, 1, 5, 9, 2, 6], max_tokens=24,
+                   temperature=0.0, ignore_eos=True, priority=0),
+        GenRequest("worst", [2, 7, 1, 8, 2, 8, 1, 8], max_tokens=24,
+                   temperature=0.0, ignore_eos=True, priority=9),
+    ]
+    eng = Engine(EngineConfig(**{**KW, "num_pages": 12}))
+    for r in reqs:
+        eng.add_request(r)
+    out = {r.request_id: [] for r in reqs}
+    while eng.has_work:
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                out[ev.request_id].append(ev.token_id)
+            assert ev.finish_reason != "kv_oom"
+    assert preempted, "pressure never materialized"
+    assert set(preempted) == {"worst"}, preempted
+    assert len(out["best"]) == 24 and len(out["worst"]) == 24
+
+
+def test_fifo_tie_break_within_priority_level():
+    """Queue-order contract: FIFO within a priority level, requeues
+    re-enter BEFORE their level's existing entries, better priorities
+    jump ahead (ISSUE 7 satellite)."""
+    eng = Engine(EngineConfig(**{**KW, "num_pages": 64}))
+
+    def req(rid, priority):
+        return GenRequest(rid, [1, 2, 3], max_tokens=2, priority=priority)
+
+    with eng._lock:
+        for rid in ("a", "b", "c"):
+            eng._insert_pending(req(rid, priority=3))
+        assert [r.request_id for r in eng.pending] == ["a", "b", "c"]
+        # a requeued continuation predates same-level arrivals
+        eng._insert_pending(req("requeued", priority=3), requeue=True)
+        assert [r.request_id for r in eng.pending] == [
+            "requeued", "a", "b", "c"]
+        # a better (lower) priority jumps the level; a worse one appends
+        eng._insert_pending(req("vip", priority=0))
+        eng._insert_pending(req("bulk", priority=9))
+        assert [r.request_id for r in eng.pending] == [
+            "vip", "requeued", "a", "b", "c", "bulk"]
+    eng.pending.clear()
+
+    # end-to-end: with one slot, same-priority first tokens come out in
+    # submission order
+    eng2 = Engine(EngineConfig(**{**KW, "num_pages": 64,
+                                  "max_num_seqs": 1}))
+    for rid in ("f1", "f2", "f3"):
+        eng2.add_request(GenRequest(rid, [5, 6, 7], max_tokens=2,
+                                    ignore_eos=True, priority=3))
+    first_seen = []
+    while eng2.has_work:
+        for ev in eng2.step():
+            if ev.token_id >= 0 and ev.index == 0:
+                first_seen.append(ev.request_id)
+    assert first_seen == ["f1", "f2", "f3"]
+
+
 def test_preemption_preserves_guided_json_grammar():
     """A JSON-guided victim must resume MID-GRAMMAR after preemption: the
     continuation's first-token mask replays prior output (engine

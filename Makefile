@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -31,6 +31,7 @@ help:
 	@echo "  lora-check     multi-LoRA suite (registry LRU, mixed-batch parity, adapter routing)"
 	@echo "  obs-check      SLO/exemplar suite + live scrape validation (burn rates, OpenMetrics)"
 	@echo "  qos-check      per-tenant QoS suite (weighted-fair isolation, tenant admission, SLO-burn shed)"
+	@echo "  planner-check  coordinated autoscaling suite (pool planner, flash-crowd simulation, drain-before-shrink)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -115,6 +116,16 @@ obs-check:
 qos-check:
 	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
 		python -m pytest tests/test_qos.py -q -p no:randomly
+
+# Planner gate (docs/autoscaling.md): the coordinated pool-autoscaling
+# suite — forecast/capacity units, the deterministic flash-crowd
+# simulation acceptance (coordinated >= 99% TTFT+ITL attainment with
+# hitless drains vs the uncoordinated baseline violating both), the
+# 10k-stream adapter-skew scenario, and the operator integration
+# (joint pool scaling, drain-victim marking, /debug/planner). Entirely
+# fake-clock: no TPU, no sleeps, target < 30s.
+planner-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

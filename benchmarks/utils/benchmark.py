@@ -130,6 +130,50 @@ def summarize(results: List[RequestResult], wall_s: float, num_chips: int) -> Di
     }
 
 
+def _run_open_loop_scenario(args) -> int:
+    """One open-loop schedule run (--schedule): arrivals are paced by the
+    scenario curve, sheds are re-queued per Retry-After, and the report
+    carries shed/retry accounting next to the latency summary."""
+    from benchmarks.utils.loadgen import run_open_loop
+
+    if not args.duration_s:
+        print("[benchmark] --schedule requires --duration-s")
+        return 2
+    cfg = LoadConfig(
+        endpoint_url=args.endpoint_url, model=args.model,
+        input_len=args.isl, max_tokens=args.osl, timeout_s=args.timeout,
+        warmup_requests=(args.warmup_requests
+                         if args.warmup_requests is not None else 8),
+        duration_s=args.duration_s, schedule=args.schedule,
+        base_rps=args.base_rps, peak_rps=args.peak_rps,
+    )
+    print(f"[benchmark] {args.benchmark_name}: open-loop "
+          f"schedule={args.schedule} {args.base_rps}->{args.peak_rps} rps "
+          f"over {args.duration_s}s")
+    results, wall = run_open_loop(cfg)
+    summary = summarize(results, wall, args.num_chips)
+    summary["schedule"] = {
+        "kind": args.schedule, "base_rps": args.base_rps,
+        "peak_rps": args.peak_rps, "duration_s": args.duration_s,
+        "arrivals": len(results),
+        "shed_final": sum(1 for r in results if r.shed),
+        "retries_total": sum(r.retries for r in results),
+    }
+    summary["server_histogram"] = (
+        server_histogram_pctls(args.endpoint_url) or None)
+    out_path = os.path.join(
+        args.output_dir, f"{args.benchmark_name}_{args.schedule}.json")
+    with open(out_path, "w") as f:
+        json.dump({"summary": summary,
+                   "results": [dataclasses.asdict(r) for r in results]},
+                  f, indent=2)
+    print(f"[benchmark] wrote {out_path} "
+          f"({summary['schedule']['arrivals']} arrivals, "
+          f"{summary['schedule']['shed_final']} shed, "
+          f"{summary['schedule']['retries_total']} retries)")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="benchmarks.utils.benchmark")
     p.add_argument("--benchmark-name", required=True)
@@ -153,9 +197,21 @@ def main(argv=None) -> int:
                    default=int(os.environ.get("NUM_CHIPS", "1")),
                    help="chips behind the endpoint, for tok/s/chip")
     p.add_argument("--timeout", type=float, default=300.0)
+    # open-loop scenario mode (docs/autoscaling.md): arrivals follow a
+    # planner scenario schedule instead of closing the loop on
+    # completions — the knob that actually exercises an autoscaler, and
+    # the SAME schedule math the CI simulator replays
+    p.add_argument("--schedule", default=None,
+                   choices=["steady", "ramp", "spike", "diurnal"],
+                   help="open-loop arrival schedule (requires "
+                        "--duration-s; replaces the concurrency sweep)")
+    p.add_argument("--base-rps", type=float, default=1.0)
+    p.add_argument("--peak-rps", type=float, default=10.0)
     args = p.parse_args(argv)
 
     os.makedirs(args.output_dir, exist_ok=True)
+    if args.schedule:
+        return _run_open_loop_scenario(args)
     levels = [int(c) for c in args.concurrency.split(",") if c.strip()]
     sweep = []
     # a falsy --duration-s (0) means count mode everywhere, so the log line,

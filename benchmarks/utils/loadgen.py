@@ -11,10 +11,12 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import random
 import threading
 import time
+import urllib.error
 import urllib.request
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -26,6 +28,10 @@ class RequestResult:
     input_tokens: int = 0
     output_tokens: int = 0
     error: str = ""
+    status: int = 0              # HTTP status of the LAST attempt
+    shed: bool = False           # last attempt was a 429/503 admission shed
+    retry_after_s: float = 0.0   # server's Retry-After on that shed
+    retries: int = 0             # re-queues before this result
 
 
 @dataclasses.dataclass
@@ -45,6 +51,22 @@ class LoadConfig:
     # num_requests (p99 over 32 samples is noise)
     warmup_requests: int = 0
     duration_s: Optional[float] = None
+    # admission-shed etiquette: a 429/503 with Retry-After is the server
+    # MANAGING load, not failing — honor it with a jittered re-queue
+    # (±20%, mirroring the server's own retry_after_value jitter) instead
+    # of counting a hard failure; max_retries bounds the patience
+    honor_retry_after: bool = True
+    max_retries: int = 3
+    # open-loop arrival schedule (run_open_loop): arrivals follow the
+    # planner scenario schedules (dynamo_tpu.planner.scenarios — the SAME
+    # math the autoscaling simulator replays) instead of closing the loop
+    # on completions. kinds: steady | ramp | spike | diurnal
+    schedule: Optional[str] = None
+    base_rps: float = 1.0
+    peak_rps: float = 10.0
+    schedule_params: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    max_outstanding: int = 1024   # open-loop thread-safety valve
 
 
 def _synthetic_prompt(n_words: int, seed: int) -> str:
@@ -120,10 +142,48 @@ def run_one(cfg: LoadConfig, seed: int) -> RequestResult:
         res.ok = res.output_tokens > 0
         if not res.ok:
             res.error = "no tokens streamed"
+    except urllib.error.HTTPError as e:
+        res.latency_s = time.perf_counter() - start
+        res.status = e.code
+        res.error = f"HTTP {e.code}"
+        if e.code in (429, 503):
+            # admission shed: the server is load-managing, not broken —
+            # record its Retry-After so the caller can re-queue
+            res.shed = True
+            try:
+                res.retry_after_s = float(e.headers.get("Retry-After")
+                                          or 1.0)
+            except (TypeError, ValueError):
+                res.retry_after_s = 1.0
+        try:
+            e.close()
+        except Exception:  # noqa: BLE001
+            pass
     except Exception as e:  # noqa: BLE001 — load gen records, never raises
         res.latency_s = time.perf_counter() - start
         res.error = f"{type(e).__name__}: {e}"
     return res
+
+
+def run_one_with_retries(cfg: LoadConfig, seed: int,
+                         deadline: Optional[float] = None) -> RequestResult:
+    """run_one plus Retry-After etiquette: a 429/503 shed re-queues after
+    the server's own Retry-After (jittered ±20% so a synchronized shed
+    doesn't return as a synchronized retry stampede), up to
+    cfg.max_retries times or until `deadline`."""
+    attempts = 0
+    while True:
+        res = run_one(cfg, seed)
+        res.retries = attempts
+        if (not res.shed or not cfg.honor_retry_after
+                or attempts >= cfg.max_retries):
+            return res
+        wait = max(0.05, res.retry_after_s) * random.uniform(0.8, 1.2)
+        if deadline is not None \
+                and time.perf_counter() + wait >= deadline:
+            return res  # no budget left to honor the hint
+        time.sleep(wait)
+        attempts += 1
 
 
 def _run_phase(cfg: LoadConfig, n_requests: Optional[int],
@@ -146,7 +206,8 @@ def _run_phase(cfg: LoadConfig, n_requests: Optional[int],
                     return
                 rid = next_id[0]
                 next_id[0] += 1
-            r = run_one(cfg, seed_base + rid)
+            r = run_one_with_retries(cfg, seed_base + rid,
+                                     deadline=deadline)
             with lock:
                 results.append(r)
 
@@ -177,3 +238,69 @@ def run_load_timed(cfg: LoadConfig) -> tuple:
 
 def run_load(cfg: LoadConfig) -> List[RequestResult]:
     return run_load_timed(cfg)[0]
+
+
+# ------------------------------------------------------------- open loop --
+def run_open_loop(cfg: LoadConfig) -> tuple:
+    """Open-loop phase: arrivals follow cfg.schedule (steady / ramp /
+    spike / diurnal — dynamo_tpu.planner.scenarios, the SAME schedule
+    math the autoscaling simulator replays in CI) regardless of how fast
+    the server answers, which is what actually exercises an autoscaler:
+    a closed loop self-throttles exactly when the system is saturated.
+
+    Every arrival gets its own thread (bounded by cfg.max_outstanding —
+    past the bound arrivals are recorded as local sheds rather than
+    silently dropped). Returns (results, wall_s). Requires cfg.duration_s
+    and cfg.schedule."""
+    from dynamo_tpu.planner.scenarios import schedule_rate
+
+    if not cfg.schedule or not cfg.duration_s:
+        raise ValueError("run_open_loop needs cfg.schedule and "
+                         "cfg.duration_s")
+    if cfg.warmup_requests > 0:
+        _run_phase(cfg, cfg.warmup_requests, None, seed_base=1_000_000)
+    results: List[RequestResult] = []
+    lock = threading.Lock()
+    outstanding = [0]
+    threads: List[threading.Thread] = []
+
+    def fire(rid: int, deadline: float):
+        r = run_one_with_retries(cfg, rid, deadline=deadline)
+        with lock:
+            results.append(r)
+            outstanding[0] -= 1
+
+    t0 = time.perf_counter()
+    deadline = t0 + cfg.duration_s
+    acc = 0.0
+    rid = 0
+    tick_s = 0.05
+    while True:
+        now = time.perf_counter()
+        if now >= deadline:
+            break
+        rate = schedule_rate(cfg.schedule, now - t0, cfg.duration_s,
+                             cfg.base_rps, cfg.peak_rps,
+                             **cfg.schedule_params)
+        acc += rate * tick_s
+        n = int(acc)
+        acc -= n
+        for _ in range(n):
+            with lock:
+                if outstanding[0] >= cfg.max_outstanding:
+                    shed = RequestResult(
+                        ok=False, shed=True,
+                        error="loadgen max_outstanding reached")
+                    results.append(shed)
+                    continue
+                outstanding[0] += 1
+            t = threading.Thread(target=fire, args=(rid, deadline),
+                                 daemon=True,
+                                 name=f"loadgen-open-{rid}")
+            rid += 1
+            t.start()
+            threads.append(t)
+        time.sleep(tick_s)
+    for t in threads:  # in-flight arrivals run to completion (no censor)
+        t.join(timeout=cfg.timeout_s)
+    return results, time.perf_counter() - t0

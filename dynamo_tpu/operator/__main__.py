@@ -48,6 +48,11 @@ def main(argv=None) -> None:
                         "is an HA pair (one active reconciler)")
     p.add_argument("--leader-identity",
                    default=os.environ.get("POD_NAME") or None)
+    p.add_argument("--debug-port", type=int,
+                   default=int(os.environ.get("OPERATOR_DEBUG_PORT",
+                                              "8081")),
+                   help="planner debug/metrics server port "
+                        "(/debug/planner, /metrics; 0 disables)")
     args = p.parse_args(argv)
 
     from dynamo_tpu.operator import materialize as mat
@@ -62,6 +67,14 @@ def main(argv=None) -> None:
         scope = args.namespace or "all namespaces"
         print(f"reconciled {n} custom resources in {scope}")
         return
+    if args.debug_port:
+        from dynamo_tpu.operator.debug_server import OperatorDebugServer
+
+        try:
+            OperatorDebugServer(ctrl, port=args.debug_port).start()
+        except OSError as e:  # port taken: the operator still reconciles
+            logging.getLogger("dynamo_tpu.operator").warning(
+                "debug server disabled (port %d: %s)", args.debug_port, e)
     leader = None
     if args.leader_elect:
         import socket

@@ -26,6 +26,14 @@ from typing import Any, Dict, List, Optional
 
 from dynamo_tpu.operator import materialize as mat
 from dynamo_tpu.operator.k8s_client import ApiError, K8sClient
+from dynamo_tpu.planner import planner as planner_mod
+from dynamo_tpu.planner.signals import (
+    Forecaster,
+    PoolSignals,
+    SignalsCollector,
+    parse_metrics_text,
+)
+from dynamo_tpu.serving.metrics import Counter, Gauge, Registry
 
 log = logging.getLogger("dynamo_tpu.operator")
 
@@ -35,6 +43,13 @@ log = logging.getLogger("dynamo_tpu.operator")
 # terminationGracePeriod — and only deleted on a later pass once its
 # pods are gone. The annotation records that phase 1 happened.
 DRAIN_ANNOTATION = f"{mat.GROUP}/drain-before-delete"
+# drain-before-shrink (planner v2 scale-down): the chosen victim pod is
+# annotated so (a) the Deployment controller deletes IT rather than a
+# random peer (pod-deletion-cost) and (b) operators can see who is
+# draining; the controller also best-effort POSTs /internal/drain so the
+# pod starts shedding before its SIGTERM even arrives.
+DRAIN_VICTIM_ANNOTATION = f"{mat.GROUP}/drain-victim"
+POD_DELETION_COST = "controller.kubernetes.io/pod-deletion-cost"
 
 
 def _yaml_load(text: str) -> Dict[str, Any]:
@@ -65,6 +80,33 @@ class Controller:
         # (namespace, dgd, service). Flows into materialize() as
         # replica_overrides so reconciles never revert a scale.
         self._planner: Dict[tuple, Dict[str, Any]] = {}
+        # planner v2 (dynamo_tpu.planner): one coordinated PoolPlanner +
+        # traffic Forecaster per DGD that declares pool-aware autoscaling
+        # (`autoscaling.role`/`autoscaling.pool`), keyed (namespace, dgd)
+        self._pool_planners: Dict[tuple, planner_mod.PoolPlanner] = {}
+        self._forecasters: Dict[tuple, Forecaster] = {}
+        # hardened signal scrapes: per-URL last-good cache with a
+        # staleness bound + error counting (ISSUE 8 satellite)
+        self.collector = SignalsCollector()
+        self._scrape_err_seen = 0
+        self._decisions_seen: Dict[tuple, int] = {}
+        self.registry = Registry()
+        self.target_gauge = Gauge(
+            "dynamo_planner_target_replicas",
+            "Planner's current per-service replica target", self.registry,
+            labelnames=("namespace", "dgd", "service"))
+        self.forecast_gauge = Gauge(
+            "dynamo_planner_forecast_rps",
+            "Short-horizon forecast demand routed to the pool (rps)",
+            self.registry, labelnames=("namespace", "dgd", "service"))
+        self.decisions_counter = Counter(
+            "dynamo_planner_decisions_total",
+            "Applied planner replica changes", self.registry,
+            labelnames=("namespace", "dgd", "service", "direction"))
+        self.scrape_errors_counter = Counter(
+            "dynamo_planner_scrape_errors_total",
+            "Planner signal scrapes that failed (served from last-good "
+            "cache when within the staleness bound)", self.registry)
 
     @staticmethod
     def _ns(cr: Dict[str, Any]) -> str:
@@ -384,15 +426,26 @@ class Controller:
     # -------------------------------------------------------------- planner --
     def planner_tick(self, now: Optional[float] = None) -> int:
         """Live-metrics autoscaling pass (the Dynamo planner analogue,
-        beyond the reference repo's static DGDR sizing): for every DGD
-        service with an `autoscaling` block, read the graph frontend's
-        queued-requests gauge and resize toward
-        ceil(queued / targetQueuedPerReplica), clamped to
-        [minReplicas, maxReplicas]. Scale-UP applies immediately;
-        scale-DOWN waits out scaleDownDelaySeconds of sustained low load
-        (flapping costs real TPU warmup time). Returns the number of
-        services whose decision changed; reconcile applies the decisions
-        via materialize(replica_overrides=...)."""
+        beyond the reference repo's static DGDR sizing).
+
+        Two generations share the actuation path (replica_overrides +
+        plannerReplicas status persistence):
+
+        - v1 (queue-proportional): services with a plain `autoscaling`
+          block resize toward ceil(queued / targetQueuedPerReplica),
+          clamped to [minReplicas, maxReplicas], with the SLO-burn boost.
+        - v2 (pool-aware, dynamo_tpu.planner): services that declare
+          `autoscaling.role`/`autoscaling.pool` are planned per DGD by a
+          coordinated PoolPlanner — forecast demand from the frontend's
+          request-rate ring, per-pool roofline capacity, prefill/decode
+          scaled jointly in one tick, scale-down stepping one drained
+          victim at a time (`_mark_drain_victims`).
+
+        Scale-UP applies immediately; scale-DOWN waits out
+        scaleDownDelaySeconds of sustained low load (flapping costs real
+        TPU warmup time). Returns the number of services whose decision
+        changed; reconcile applies the decisions via
+        materialize(replica_overrides=...)."""
         now = time.monotonic() if now is None else now
         changed = 0
         try:
@@ -401,11 +454,13 @@ class Controller:
         except ApiError:
             return 0
         live = set()
+        live_v2 = set()
         # gather first, then scrape every unique URL CONCURRENTLY: the
         # tick runs on the reconcile thread, and N serially-unreachable
         # frontends (exactly the state during an initial rollout) must
         # not stall reconciles by N x timeout
         work = []
+        v2_dgds: Dict[tuple, Dict[str, Any]] = {}
         urls: Dict[tuple, str] = {}
         for cr in dgds:
             ns, name = self._ns(cr), cr["metadata"]["name"]
@@ -415,19 +470,16 @@ class Controller:
                 if not auto.get("enabled"):
                     continue
                 live.add((ns, name, svc_name))
-                work.append((cr, ns, name, svc_name, spec, auto))
                 urls[(ns, name, svc_name)] = auto.get("metricsUrl") or (
                     f"http://{mat.frontend_host(cr)}.{ns}:"
                     f"{mat.FRONTEND_PORT}/metrics")
-        scrapes: Dict[str, Optional[Dict[str, float]]] = {}
-        unique = sorted(set(urls.values()))
-        if unique:
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=min(8, len(unique))) as ex:
-                for url, val in zip(unique,
-                                    ex.map(self._scrape_signals, unique)):
-                    scrapes[url] = val
+                if planner_mod.is_pool_autoscaling(auto):
+                    d = v2_dgds.setdefault((ns, name),
+                                           {"cr": cr, "pools": []})
+                    d["pools"].append((svc_name, spec, auto))
+                else:
+                    work.append((cr, ns, name, svc_name, spec, auto))
+        scrapes = self._scrape_all(set(urls.values()))
         for cr, ns, name, svc_name, spec, auto in work:
             lo = max(1, int(auto.get("minReplicas", 1)))
             hi = max(lo, int(auto.get("maxReplicas",
@@ -477,6 +529,8 @@ class Controller:
                 st["replicas"] = want
                 st["low_since"] = None
                 changed += 1
+                self.decisions_counter.inc(namespace=ns, dgd=name,
+                                           service=svc_name, direction="up")
             elif want < st["replicas"]:
                 if st["low_since"] is None:
                     st["low_since"] = now
@@ -487,50 +541,259 @@ class Controller:
                     st["replicas"] = want
                     st["low_since"] = None
                     changed += 1
+                    self.decisions_counter.inc(namespace=ns, dgd=name,
+                                               service=svc_name,
+                                               direction="down")
             else:
                 st["low_since"] = None
+            self.target_gauge.set(st["replicas"], namespace=ns, dgd=name,
+                                  service=svc_name)
+        for key2, info in v2_dgds.items():
+            live_v2.add(key2)
+            try:
+                changed += self._pool_tick(key2[0], key2[1], info, urls,
+                                           scrapes, now)
+            except Exception:
+                log.exception("planner: pool tick for %s/%s failed", *key2)
         for key in [k for k in self._planner if k not in live]:
             del self._planner[key]  # DGD/service removed or autoscaling off
+        for key2 in [k for k in self._pool_planners if k not in live_v2]:
+            del self._pool_planners[key2]
+            self._forecasters.pop(key2, None)
+        # surface collector-side scrape failures on the operator registry
+        delta = self.collector.scrape_errors_total - self._scrape_err_seen
+        if delta > 0:
+            self.scrape_errors_counter.inc(delta)
+            self._scrape_err_seen = self.collector.scrape_errors_total
         return changed
 
-    @staticmethod
-    def _scrape_signals(url: str) -> Optional[Dict[str, float]]:
+    # --------------------------------------------------------- planner v2 --
+    def _scrape_all(self, urls) -> Dict[str, Optional[Dict[str, Any]]]:
+        """Scrape every unique URL concurrently with PER-FUTURE failure
+        isolation: one scrape raising (or timing out) must lose only its
+        own pool's fresh signals for the tick, never the whole batch —
+        and even then the collector serves its last-good result while it
+        is within the staleness bound (ISSUE 8 satellite: the old
+        `ex.map` zip dropped every service's signals when any one scrape
+        raised mid-executor)."""
+        out: Dict[str, Optional[Dict[str, Any]]] = {}
+        unique = sorted(urls)
+        if not unique:
+            return out
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(unique))) as ex:
+            futs = {url: ex.submit(self._scrape_signals, url)
+                    for url in unique}
+            for url, fut in futs.items():
+                try:
+                    out[url] = fut.result()
+                except Exception:  # noqa: BLE001 — isolation boundary
+                    log.exception("planner: scrape of %s raised", url)
+                    self.collector.scrape_errors_total += 1
+                    out[url] = self.collector.recall(url)
+        return out
+
+    def _scrape_signals(self, url: str) -> Optional[Dict[str, Any]]:
         """Planner inputs from one Prometheus text page: the
-        queued-requests gauge plus the worst fast-window SLO burn rate
-        (`dynamo_slo_burn_rate{...,window="5m"}`, observability/slo.py).
-        Returns None when the page is unreachable or carries no queue
-        gauge (hold the last decision)."""
+        queued-requests gauge, the fast-window SLO burn rates split by
+        objective, and per-tenant inflight (planner/signals.py does the
+        parsing). Returns None when the page is unreachable past the
+        last-good staleness bound, or carries no queue gauge (hold the
+        last decision)."""
+        parsed = self.collector.scrape_metrics(url)
+        if parsed is None or parsed.get("queued") is None:
+            return None
+        return parsed
+
+    def _pool_tick(self, ns: str, name: str, info: Dict[str, Any],
+                   urls: Dict[tuple, str],
+                   scrapes: Dict[str, Optional[Dict[str, Any]]],
+                   now: float) -> int:
+        """One coordinated planning pass for a DGD's pool-aware services."""
+        cr = info["cr"]
+        specs: List[planner_mod.PoolSpec] = []
+        autos: Dict[str, Dict[str, Any]] = {}
+        for svc_name, spec, auto in info["pools"]:
+            try:
+                ps = planner_mod.pool_spec_from_manifest(svc_name, spec)
+            except ValueError as e:
+                log.warning("planner: %s/%s.%s invalid pool autoscaling "
+                            "(%s); service skipped this tick", ns, name,
+                            svc_name, e)
+                continue
+            if ps is not None:
+                specs.append(ps)
+                autos[svc_name] = auto
+        if not specs:
+            return 0
+        key2 = (ns, name)
+        persisted = (cr.get("status") or {}).get("plannerReplicas") or {}
+        services = cr.get("spec", {}).get("services") or {}
+        pl = self._pool_planners.get(key2)
+        if pl is None or set(pl.pools) != {p.name for p in specs}:
+            pl = planner_mod.PoolPlanner(specs)
+            for p in specs:
+                # a restarted/failover operator resumes the standing
+                # scale from the DGD status rollup — seeding is not a
+                # decision (no journal entry, no changed count)
+                seed = persisted.get(p.name) or (
+                    services.get(p.name) or {}).get("replicas",
+                                                    p.min_replicas)
+                pl.seed(p.name, int(seed))
+            self._pool_planners[key2] = pl
+        else:
+            for p in specs:  # manifest edits take effect next tick
+                pl.pools[p.name] = p
+
+        fc = self._forecasters.get(key2)
+        if fc is None:
+            fc = self._forecasters[key2] = Forecaster()
+        hist_url = None
+        for svc_name in autos:
+            hist_url = autos[svc_name].get("historyUrl") or hist_url
+        if hist_url is None:
+            hist_url = (f"http://{mat.frontend_host(cr)}.{ns}:"
+                        f"{mat.FRONTEND_PORT}/debug/slo?history=1")
+        payload = self.collector.scrape_history(hist_url)
+        if payload:
+            fc.ingest_history(payload.get("history") or [],
+                              payload.get("bucket_s"))
+        horizon = max(p.forecast_horizon_s for p in specs)
+        forecast = fc.forecast(horizon)
+
+        signals: Dict[str, PoolSignals] = {}
+        for p in specs:
+            scraped = scrapes.get(urls.get((ns, name, p.name), ""))
+            if scraped is None:
+                continue  # unreachable + stale: pool holds its decision
+            signals[p.name] = PoolSignals(
+                role=p.role,
+                queued=float(scraped.get("queued") or 0.0),
+                inflight=float(scraped.get("inflight") or 0.0),
+                burn_ttft=float(scraped.get("burn_ttft") or 0.0),
+                burn_itl=float(scraped.get("burn_itl") or 0.0),
+                burn=float(scraped.get("burn") or 0.0),
+                tenant_inflight=dict(scraped.get("tenant_inflight") or {}),
+                rps=fc.rate(), forecast_rps=forecast, ts=now,
+                stale=bool(scraped.get("stale")))
+
+        targets = pl.tick(signals, now)
+        changed = 0
+        for svc_name, target in targets.items():
+            key = (ns, name, svc_name)
+            st = self._planner.get(key)
+            if st is None:
+                seed = int(persisted.get(svc_name) or (
+                    services.get(svc_name) or {}).get("replicas", target))
+                st = self._planner[key] = {"replicas": seed,
+                                           "low_since": None}
+            prev = int(st["replicas"])
+            if target != prev:
+                log.info("planner: %s/%s.%s pool %d -> %d "
+                         "(forecast=%.1frps)", ns, name, svc_name, prev,
+                         target, pl.last_forecast.get(svc_name, 0.0))
+                if target < prev:
+                    self._mark_drain_victims(ns, name, svc_name,
+                                             prev - target)
+                st["replicas"] = target
+                changed += 1
+                self.decisions_counter.inc(
+                    namespace=ns, dgd=name, service=svc_name,
+                    direction="up" if target > prev else "down")
+            self.target_gauge.set(target, namespace=ns, dgd=name,
+                                  service=svc_name)
+            self.forecast_gauge.set(
+                round(pl.last_forecast.get(svc_name, 0.0), 3),
+                namespace=ns, dgd=name, service=svc_name)
+        return changed
+
+    def _mark_drain_victims(self, ns: str, dgd: str, svc_name: str,
+                            n: int) -> List[str]:
+        """Pick and mark `n` victim pods for a hitless scale-down BEFORE
+        the Deployment shrinks: newest pods first (least accumulated KV /
+        prefix-cache value), annotated with a negative pod-deletion-cost
+        so the ReplicaSet controller deletes exactly them, plus a
+        best-effort pre-drain POST so shedding/handoff/KV-demotion starts
+        ahead of the SIGTERM. Purely advisory — any failure here degrades
+        to the plain SIGTERM drain the pod runs anyway."""
+        sel = (f"{mat.COMPONENT_LABEL}={svc_name.lower()},"
+               f"{mat.NS_LABEL}={mat.discovery_label_value(ns, dgd)}")
+        try:
+            pods = self.k8s.list("v1", "pods", ns, label_selector=sel)
+        except ApiError as e:
+            log.debug("planner: victim listing failed (%s)", e)
+            return []
+        fresh = [p for p in pods
+                 if not ((p["metadata"].get("annotations") or {})
+                         .get(DRAIN_VICTIM_ANNOTATION))]
+        fresh.sort(key=lambda p: (p["metadata"].get("creationTimestamp")
+                                  or "", p["metadata"]["name"]),
+                   reverse=True)
+        marked = []
+        for pod in fresh[:max(0, n)]:
+            pod_name = pod["metadata"]["name"]
+            try:
+                self.k8s.merge_patch("v1", "pods", ns, pod_name, {
+                    "metadata": {"annotations": {
+                        DRAIN_VICTIM_ANNOTATION: "true",
+                        POD_DELETION_COST: "-1000",
+                    }},
+                })
+            except ApiError as e:
+                log.warning("planner: marking victim %s/%s failed: %s",
+                            ns, pod_name, e)
+                continue
+            marked.append(pod_name)
+            self._predrain_pod(pod)
+        if marked:
+            log.info("planner: marked %s for drain-before-shrink "
+                     "(%s/%s.%s)", marked, ns, dgd, svc_name)
+        return marked
+
+    @staticmethod
+    def _predrain_pod(pod: Dict[str, Any]) -> None:
+        """Best-effort POST /internal/drain to the victim so admission
+        stops and journaled streams begin handing off immediately."""
+        ip = (pod.get("status") or {}).get("podIP")
+        if not ip:
+            return
         import urllib.request
 
         try:
-            with urllib.request.urlopen(url, timeout=1.5) as r:
-                text = r.read().decode("utf-8", "replace")
-        except Exception:
-            return None
-        queued: Optional[float] = None
-        burn = 0.0
-        for ln in text.splitlines():
-            if ln.startswith("dynamo_frontend_queued_requests"):
-                try:
-                    queued = float(ln.split()[-1])
-                except ValueError:
-                    pass
-            elif (ln.startswith("dynamo_slo_burn_rate")
-                  and 'window="5m"' in ln):
-                try:
-                    burn = max(burn, float(ln.split()[-1]))
-                except ValueError:
-                    pass
-        if queued is None:
-            return None
-        return {"queued": queued, "burn": burn}
+            req = urllib.request.Request(
+                f"http://{ip}:{mat.WORKER_PORT}/internal/drain",
+                data=b"{}", method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=1.0):
+                pass
+        except Exception:  # noqa: BLE001 — SIGTERM drain still runs
+            log.debug("planner: pre-drain of %s unreachable", ip)
+
+    def planner_debug_payload(self) -> Dict[str, Any]:
+        """The GET /debug/planner body (operator debug server): per-DGD
+        pool targets + the bounded decision journal, plus v1 decisions."""
+        return {
+            "pools": {f"{ns}/{name}": pl.debug_payload()
+                      for (ns, name), pl in self._pool_planners.items()},
+            "services": {f"{ns}/{name}/{svc}": st.get("replicas")
+                         for (ns, name, svc), st in self._planner.items()},
+            "scrape_errors_total": self.collector.scrape_errors_total,
+        }
 
     @staticmethod
     def _scrape_queued(url: str) -> Optional[float]:
         """dynamo_frontend_queued_requests from a Prometheus text page
         (kept for tooling; planner_tick uses _scrape_signals)."""
-        signals = Controller._scrape_signals(url)
-        return None if signals is None else signals["queued"]
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url, timeout=1.5) as r:
+                parsed = parse_metrics_text(r.read().decode("utf-8",
+                                                            "replace"))
+        except Exception:
+            return None
+        return parsed.get("queued")
 
     # ----------------------------------------------------------------- loop --
     def reconcile_once(self) -> int:

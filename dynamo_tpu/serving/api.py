@@ -977,6 +977,23 @@ class _Handler(JsonHTTPHandler):
                             self._read_json_body()))
                     except ValueError as e:
                         raise proto.BadRequest(str(e))
+                elif path == "/internal/drain":
+                    # planner v2 pre-drain: the operator marks this pod a
+                    # scale-down victim and asks it to start shedding /
+                    # handing off BEFORE the Deployment shrink delivers
+                    # SIGTERM (which runs the same, idempotent drain)
+                    try:
+                        body = self._read_json_body()
+                    except Exception:  # noqa: BLE001 — body is optional
+                        body = {}
+                    self.ctx.begin_drain()
+                    if body.get("handoff"):
+                        self.ctx.request_handoff()
+                    self._json(200, {"draining": True,
+                                     "active_seqs":
+                                         self.ctx.engine.num_active,
+                                     "pending":
+                                         len(self.ctx.engine.pending)})
                 else:
                     self._error(404, f"no route {path}")
             except Exception as e:

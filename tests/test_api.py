@@ -217,3 +217,38 @@ def test_user_stop_token_ids_are_additional_to_model_eos(server_url):
     })
     assert out["usage"]["completion_tokens"] == 1
     assert out["choices"][0]["finish_reason"] == "stop"
+
+
+def test_internal_drain_predrain_endpoint():
+    """Planner v2 drain-before-shrink: POST /internal/drain (the
+    operator's pre-drain to a marked scale-down victim) flips admission
+    off immediately — new inference requests shed 503 ahead of the
+    SIGTERM that runs the full drain — while control-plane routes stay
+    reachable and the call is idempotent. Own server: the shared fixture
+    must not inherit the drained state."""
+    engine = Engine(
+        EngineConfig(model=MODEL, page_size=4, num_pages=64,
+                     max_num_seqs=2, max_seq_len=64))
+    ctx = ServingContext(engine, MODEL)
+    srv = make_server(ctx, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        out = post(url, "/internal/drain", {})
+        assert out["draining"] is True
+        assert ctx.draining.is_set()
+        # admission is OFF: a new request sheds 503 + Retry-After
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(url, "/v1/completions",
+                 {"model": MODEL, "prompt": "x", "max_tokens": 2})
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        # idempotent repeat (the SIGTERM drain calls begin_drain again),
+        # optional handoff flag accepted
+        out = post(url, "/internal/drain", {"handoff": True})
+        assert out["draining"] is True and ctx.drain_handoff.is_set()
+        # control plane stays reachable while draining
+        assert json.loads(get(url, "/worker/stats"))["model"] == MODEL
+    finally:
+        srv.shutdown()
+        ctx.close()

@@ -278,6 +278,45 @@ def test_lora_example_materializes_adapter_env():
     assert not any(n.startswith("DYNAMO_TPU_LORA") for n in fe_env)
 
 
+# ---- planner v2 pool autoscaling -------------------------------------------
+
+
+def test_disagg_autoscale_example_declares_valid_pools():
+    """examples/deploy/jetstream/disagg-autoscale.yaml: both worker pools
+    must parse through the planner's own manifest parser (the operator
+    plans with exactly these PoolSpecs), with the roofline-derived and
+    explicit capacity paths each exercised once, and pool-scoped
+    sloTargets matching each pool's SLO currency."""
+    from dynamo_tpu.planner import pool_spec_from_manifest
+
+    docs = dict(_dgd_docs())
+    doc = docs["examples/deploy/jetstream/disagg-autoscale.yaml"]
+    svcs = doc["spec"]["services"]
+
+    prefill = pool_spec_from_manifest("JetstreamPrefillWorker",
+                                      svcs["JetstreamPrefillWorker"])
+    assert prefill.role == "prefill"
+    assert prefill.coordinate_with == "JetstreamDecodeWorker"
+    assert prefill.capacity.source == "roofline"
+    assert prefill.capacity.prompts_per_s > 0
+
+    decode = pool_spec_from_manifest("JetstreamDecodeWorker",
+                                     svcs["JetstreamDecodeWorker"])
+    assert decode.role == "decode"
+    assert decode.capacity.source == "explicit"
+    assert decode.capacity.tokens_per_s == 5000
+    assert decode.capacity.max_streams == 32
+
+    # pool-scoped SLOs: prefill burns TTFT budget, decode burns ITL
+    pre_slo = svcs["JetstreamPrefillWorker"]["sloTargets"][0]
+    dec_slo = svcs["JetstreamDecodeWorker"]["sloTargets"][0]
+    assert pre_slo["role"] == "prefill" and "ttftMs" in pre_slo
+    assert dec_slo["role"] == "decode" and "itlMs" in dec_slo
+
+    # the frontend (no autoscaling block) is not a pool
+    assert pool_spec_from_manifest("Frontend", svcs["Frontend"]) is None
+
+
 def test_lora_adapter_env_shapes():
     from dynamo_tpu.operator.materialize import lora_adapter_env
 

@@ -1,0 +1,857 @@
+"""Planner v2: coordinated SLA autoscaling + deterministic traffic sim.
+
+Three layers, all under fake clocks (no TPU, no sleeps beyond HTTP
+round-trips):
+
+- unit: forecaster, capacity parsing, pool-spec validation, the
+  coordinated decision rules (joint scale-up, backlog-flush coordination,
+  hysteresis anti-flapping, burn-boost opt-out, restart seeding).
+- simulation acceptance (ISSUE 8): under the flash-crowd scenario the
+  coordinated planner keeps simulated TTFT and ITL SLO attainment >= 99%
+  while scaling prefill and decode pools JOINTLY (same tick), and every
+  scale-down completes via the drain path with zero simulated mid-stream
+  drops; the same scenario with coordination disabled measurably
+  violates BOTH SLOs. Plus adapter-skew at 10k+ concurrent streams,
+  diurnal efficiency, and the abrupt-kill counterfactual.
+- operator integration: the controller plans pools from scraped signals
+  + the /debug/slo history ring against the fake K8s apiserver, marks
+  drain victims before a shrink, survives restarts without spurious
+  decisions, isolates scrape failures per future, and exposes
+  /debug/planner + dynamo_planner_* metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.planner import (
+    Forecaster,
+    PoolCapacity,
+    PoolPlanner,
+    PoolSignals,
+    PoolSpec,
+    capacity_from_roofline,
+    capacity_from_spec,
+    pool_spec_from_manifest,
+)
+from dynamo_tpu.planner.scenarios import (
+    adapter_skew,
+    diurnal,
+    flash_crowd,
+    schedule_rate,
+)
+from dynamo_tpu.planner.signals import parse_metrics_text
+from dynamo_tpu.planner.sim import SimPoolCfg, Simulator
+
+pytestmark = pytest.mark.planner
+
+
+# ------------------------------------------------------------ forecaster --
+def test_forecaster_tracks_ramp_with_lead():
+    """On a linear ramp the Holt trend must extrapolate AHEAD of the
+    current rate — the lead time that covers the provisioning delay."""
+    fc = Forecaster(alpha=0.5, beta=0.5, bucket_s=10.0)
+    for i in range(30):
+        fc.observe(10.0 + 2.0 * i)  # +2 rps per bucket
+    assert fc.rate() > 55.0                      # level tracks the ramp
+    assert fc.forecast(60.0) > fc.rate() + 8.0   # trend projects ahead
+    # steady traffic: forecast converges to the level, no phantom trend
+    fc2 = Forecaster(bucket_s=10.0)
+    for _ in range(30):
+        fc2.observe(20.0)
+    assert abs(fc2.forecast(120.0) - 20.0) < 0.5
+
+
+def test_forecaster_history_ingest_is_idempotent():
+    fc = Forecaster(bucket_s=10.0)
+    rows = [{"t": 10 * i, "requests": 100} for i in range(10)]
+    assert fc.ingest_history(rows) == 10
+    level = fc.rate()
+    # re-feeding the same ring (every tick re-scrapes it) adds nothing
+    assert fc.ingest_history(rows) == 0
+    assert fc.rate() == level
+    # partial (current) buckets are skipped, new complete ones consumed
+    rows.append({"t": 100, "requests": 120})
+    rows.append({"t": 110, "requests": 3, "partial": True})
+    assert fc.ingest_history(rows) == 1
+
+
+def test_parse_metrics_text_extracts_planner_inputs():
+    page = "\n".join([
+        "dynamo_frontend_queued_requests 7",
+        'dynamo_slo_burn_rate{slo="d",objective="ttft",window="5m",'
+        'model="*",role="frontend",tenant="*"} 2.5',
+        'dynamo_slo_burn_rate{slo="d",objective="itl",window="5m",'
+        'model="*",role="frontend",tenant="*"} 0.4',
+        'dynamo_slo_burn_rate{slo="d",objective="ttft",window="1h",'
+        'model="*",role="frontend",tenant="*"} 99.0',  # slow window: no
+        'dynamo_tenant_inflight{tenant="acme"} 12',
+        'dynamo_tenant_inflight{tenant="free"} 3',
+    ])
+    got = parse_metrics_text(page)
+    assert got["queued"] == 7
+    assert got["burn_ttft"] == 2.5 and got["burn_itl"] == 0.4
+    assert got["burn"] == 2.5
+    assert got["inflight"] == 15
+    assert got["tenant_inflight"] == {"acme": 12, "free": 3}
+    # a worker page without the frontend queue gauge still yields burns
+    assert parse_metrics_text(
+        'dynamo_slo_burn_rate{objective="itl",window="5m"} 1.5'
+    )["queued"] is None
+
+
+# -------------------------------------------------------------- capacity --
+def test_capacity_from_roofline_scales_with_system():
+    small = capacity_from_roofline("Qwen/Qwen3-0.6B", system="v5e-4",
+                                   tp=4, batch=32, isl=1024, osl=256)
+    big = capacity_from_roofline("Qwen/Qwen3-0.6B", system="v5e-8",
+                                 tp=4, batch=32, isl=1024, osl=256)
+    assert small.prompts_per_s > 0 and small.tokens_per_s > 0
+    assert small.source == "roofline"
+    # twice the chips at the same tp = twice the data-parallel replicas
+    assert big.tokens_per_s == pytest.approx(2 * small.tokens_per_s)
+    assert big.max_streams == 2 * small.max_streams
+
+
+def test_capacity_from_spec_shapes():
+    cap = capacity_from_spec({"promptsPerSPerReplica": 12.5,
+                              "tokensPerSPerReplica": 4000,
+                              "maxStreamsPerReplica": 64})
+    assert cap.prompts_per_s == 12.5 and cap.max_streams == 64
+    roof = capacity_from_spec({"model": "Qwen/Qwen3-0.6B",
+                               "tpuSystem": "v5e-4", "tp": 4,
+                               "batch": 32, "isl": 512, "osl": 128})
+    assert roof.source == "roofline" and roof.tokens_per_s > 0
+    with pytest.raises(ValueError, match="unknown autoscaling.pool"):
+        capacity_from_spec({"promptsPerSecond": 5})  # typo'd key
+    with pytest.raises(ValueError, match="mixes explicit"):
+        capacity_from_spec({"model": "x", "tokensPerSPerReplica": 1})
+    with pytest.raises(ValueError):
+        capacity_from_spec({})
+
+
+def test_pool_spec_from_manifest_validation():
+    svc = {
+        "subComponentType": "prefill",
+        "replicas": 2,
+        "autoscaling": {
+            "enabled": True,
+            "role": "prefill",
+            "minReplicas": 2, "maxReplicas": 8,
+            "targetUtilization": 0.6,
+            "coordinateWith": "Decode",
+            "pool": {"promptsPerSPerReplica": 10},
+        },
+    }
+    spec = pool_spec_from_manifest("Prefill", svc)
+    assert spec.role == "prefill" and spec.coordinate_with == "Decode"
+    assert spec.capacity.prompts_per_s == 10
+    # v1 blocks (no role/pool) are not pool specs
+    assert pool_spec_from_manifest(
+        "W", {"autoscaling": {"enabled": True, "maxReplicas": 3}}) is None
+    with pytest.raises(ValueError, match="unknown autoscaling keys"):
+        pool_spec_from_manifest("W", {"autoscaling": {
+            "enabled": True, "role": "decode", "pool": {},
+            "coolDownSeconds": 3}})
+    with pytest.raises(ValueError, match="pool"):
+        pool_spec_from_manifest("W", {"autoscaling": {
+            "enabled": True, "role": "decode"}})
+
+
+# ---------------------------------------------------------- decision loop --
+def _prefill_spec(**kw) -> PoolSpec:
+    kw.setdefault("name", "prefill")
+    kw.setdefault("role", "prefill")
+    kw.setdefault("capacity", PoolCapacity(prompts_per_s=10.0,
+                                           tokens_per_s=0.0, max_streams=0))
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 16)
+    kw.setdefault("target_utilization", 0.5)
+    kw.setdefault("osl", 64)
+    kw.setdefault("scale_down_delay_s", 60.0)
+    kw.setdefault("coordinate_with", "decode")
+    return PoolSpec(**kw)
+
+
+def _decode_spec(**kw) -> PoolSpec:
+    kw.setdefault("name", "decode")
+    kw.setdefault("role", "decode")
+    kw.setdefault("capacity", PoolCapacity(
+        prompts_per_s=0.0, tokens_per_s=1000.0, max_streams=16,
+        itl_s=0.016))
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 16)
+    kw.setdefault("target_utilization", 0.5)
+    kw.setdefault("osl", 64)
+    kw.setdefault("scale_down_delay_s", 60.0)
+    return PoolSpec(**kw)
+
+
+def test_coordinated_forecast_scales_both_pools_same_tick():
+    pl = PoolPlanner([_prefill_spec(), _decode_spec()], coordinate=True)
+    sig = {
+        "prefill": PoolSignals(role="prefill", forecast_rps=40.0),
+        "decode": PoolSignals(role="decode", forecast_rps=40.0),
+    }
+    targets = pl.tick(sig, now=100.0)
+    # prefill: 40 / (10 * 0.5) = 8; decode: 40*64 / (1000*0.5) = 5.12 -> 6
+    assert targets == {"prefill": 8, "decode": 6}
+    ups = [d for d in pl.journal if d.direction == "up"]
+    assert {d.pool for d in ups} == {"prefill", "decode"}
+    assert len({d.t for d in ups}) == 1  # SAME tick
+
+
+def test_uncoordinated_decode_ignores_forecast():
+    """coordinate=False is the v1 baseline: each pool reacts only to its
+    own queue/inflight — the forecast spike moves neither pool."""
+    pl = PoolPlanner([_prefill_spec(), _decode_spec()], coordinate=False)
+    sig = {
+        "prefill": PoolSignals(role="prefill", forecast_rps=40.0),
+        "decode": PoolSignals(role="decode", forecast_rps=40.0),
+    }
+    assert pl.tick(sig, now=1.0) == {"prefill": 1, "decode": 1}
+    # ...but a real backlog still scales it reactively
+    sig["decode"] = PoolSignals(role="decode", inflight=40.0)
+    assert pl.tick(sig, now=2.0)["decode"] == 5  # 40/(16*0.5)
+
+
+def test_prefill_backlog_flush_raises_decode_same_tick():
+    """The coordination clamp: a queue-floor prefill scale-up re-projects
+    the flush's admission rate onto the partner decode pool — decode
+    must be sized for the flood BEFORE it arrives, not a provisioning
+    delay after."""
+    pl = PoolPlanner([_prefill_spec(target_queued_per_replica=4),
+                      _decode_spec()], coordinate=True)
+    sig = {
+        "prefill": PoolSignals(role="prefill", queued=32.0,
+                               forecast_rps=2.0),
+        "decode": PoolSignals(role="decode", inflight=2.0,
+                              forecast_rps=2.0),
+    }
+    targets = pl.tick(sig, now=10.0)
+    assert targets["prefill"] == 8       # 32 queued / 4 per replica
+    # flush admits 8*10 = 80 rps -> decode needs 80*64/(1000*0.5) = 11
+    assert targets["decode"] == 11
+    assert any(d.pool == "decode" and d.reason == "coordination"
+               for d in pl.journal)
+
+
+def test_hysteresis_cooldown_prevents_flapping():
+    """ISSUE 8 satellite: an oscillating queue (high one tick, empty the
+    next, faster than the cooldown) must produce exactly ONE scale-up and
+    NO scale-down churn; sustained low load then steps down one replica
+    per tick."""
+    pl = PoolPlanner([_prefill_spec(coordinate_with="")], coordinate=True)
+    now = 0.0
+    for i in range(10):  # 10 oscillation cycles, 15s apart (< 60s delay)
+        queued = 32.0 if i % 2 == 0 else 0.0
+        pl.tick({"prefill": PoolSignals(role="prefill", queued=queued)},
+                now)
+        now += 15.0
+    ups = [d for d in pl.journal if d.direction == "up"]
+    downs = [d for d in pl.journal if d.direction == "down"]
+    assert len(ups) == 1 and not downs, list(pl.journal)
+    assert pl.targets()["prefill"] == 8
+    # sustained low: the first step waits out the 60s cooldown (armed at
+    # the last oscillation tick), then steps ONE replica per tick
+    steps = []
+    for _ in range(12):
+        t = pl.tick({"prefill": PoolSignals(role="prefill", queued=0.0)},
+                    now)
+        steps.append(t["prefill"])
+        now += 15.0
+    assert steps[:3] == [8, 8, 8]   # cooldown still holds
+    assert steps[3] == 7            # then one drained victim per tick
+    assert pl.targets()["prefill"] == 1
+    downs = [d for d in pl.journal if d.direction == "down"]
+    assert all(d.from_replicas - d.to_replicas == 1 for d in downs)
+
+
+def test_burn_boost_and_optout():
+    boosted = _decode_spec(name="d1")
+    optout = _decode_spec(name="d2", slo_burn_boost=False)
+    pl = PoolPlanner([boosted, optout], coordinate=True)
+    sig = {
+        "d1": PoolSignals(role="decode", burn_itl=2.5, burn=2.5),
+        "d2": PoolSignals(role="decode", burn_itl=2.5, burn=2.5),
+    }
+    targets = pl.tick(sig, now=5.0)
+    assert targets["d1"] == 2    # +1 at burn onset
+    assert targets["d2"] == 1    # sloBurnBoost: false still opts out
+    # mid-burn: no re-boost racing to max, and no shrink
+    assert pl.tick(sig, now=20.0)["d1"] == 2
+    # prefill-currency burn must NOT boost a decode pool
+    pl2 = PoolPlanner([_decode_spec(name="d3")], coordinate=True)
+    assert pl2.tick(
+        {"d3": PoolSignals(role="decode", burn_ttft=9.0, burn=9.0)},
+        now=1.0)["d3"] == 1
+
+
+def test_seed_adopts_scale_without_decision():
+    """ISSUE 8 satellite: a restarted operator seeds pool targets from
+    status without a spurious scale event."""
+    pl = PoolPlanner([_prefill_spec(), _decode_spec()], coordinate=True)
+    pl.seed("prefill", 8)
+    pl.seed("decode", 6)
+    assert pl.targets() == {"prefill": 8, "decode": 6}
+    assert not pl.journal
+    # a tick whose demand matches the seeded scale changes nothing
+    sig = {
+        "prefill": PoolSignals(role="prefill", forecast_rps=40.0),
+        "decode": PoolSignals(role="decode", forecast_rps=40.0),
+    }
+    assert pl.tick(sig, now=1.0) == {"prefill": 8, "decode": 6}
+    assert not pl.journal
+
+
+def test_journal_is_bounded():
+    pl = PoolPlanner([_prefill_spec(coordinate_with="",
+                                    scale_down_delay_s=0.0)],
+                     journal_maxlen=16)
+    now = 0.0
+    for _ in range(10):  # surge + full step-down = 16 decisions per cycle
+        pl.tick({"prefill": PoolSignals(role="prefill", queued=120.0)},
+                now)
+        now += 100.0
+        for _ in range(17):
+            pl.tick({"prefill": PoolSignals(role="prefill", queued=0.0)},
+                    now)
+            now += 100.0
+    assert sum(pl.decisions_total.values()) > 16
+    assert len(pl.journal) == 16
+
+
+# ------------------------------------------------------------- simulation --
+def _flash_crowd_sim(coordinate: bool, hitless: bool = True) -> Simulator:
+    """The acceptance topology: 10 prompts/s prefill replicas, 64-slot /
+    1280 tok/s decode replicas, 30s provisioning, 10s drain."""
+    prefill = PoolSpec(
+        name="prefill", role="prefill",
+        capacity=PoolCapacity(prompts_per_s=10.0, tokens_per_s=0.0,
+                              max_streams=0),
+        min_replicas=3, max_replicas=16, target_utilization=0.6,
+        osl=64, target_queued_per_replica=8, scale_down_delay_s=60.0,
+        coordinate_with="decode", forecast_horizon_s=90.0)
+    decode = PoolSpec(
+        name="decode", role="decode",
+        capacity=PoolCapacity(prompts_per_s=0.0, tokens_per_s=1280.0,
+                              max_streams=64, itl_s=0.05),
+        min_replicas=2, max_replicas=12, target_utilization=0.7,
+        osl=64, scale_down_delay_s=60.0, forecast_horizon_s=90.0)
+    planner = PoolPlanner([prefill, decode], coordinate=coordinate)
+    return Simulator(
+        flash_crowd(),
+        [SimPoolCfg(prefill, provision_delay_s=30.0, drain_s=10.0,
+                    hitless=hitless),
+         SimPoolCfg(decode, provision_delay_s=30.0, drain_s=10.0,
+                    hitless=hitless)],
+        planner, ttft_slo_s=2.5, itl_slo_s=0.1, goal=0.99,
+        forecaster=Forecaster(alpha=0.5, beta=0.5, bucket_s=10.0))
+
+
+def test_flash_crowd_coordinated_meets_both_slos_with_hitless_drain():
+    """THE acceptance criterion (ISSUE 8): coordinated planning holds
+    >= 99% attainment on TTFT and ITL through a 10x flash crowd, scales
+    prefill and decode jointly (same tick), and every scale-down goes
+    through the drain path with zero simulated mid-stream drops."""
+    report = _flash_crowd_sim(coordinate=True).run()
+    assert report.requests_total > 20000
+    assert report.ttft_attainment >= 0.99, report.summary()
+    assert report.itl_attainment >= 0.99, report.summary()
+    # joint scaling: the FIRST crowd-driven scale-up raises both pools
+    # in the same planner tick
+    ups = [d for d in report.decisions if d["direction"] == "up"]
+    first_prefill = min(d["t"] for d in ups if d["pool"] == "prefill")
+    first_decode = min(d["t"] for d in ups if d["pool"] == "decode")
+    assert first_prefill == first_decode
+    # hitless scale-down: events exist (the crowd subsides), all drained,
+    # zero mid-stream drops, and the fleet returns to baseline
+    assert report.scale_down_events
+    assert all(e.drained for e in report.scale_down_events)
+    assert report.dropped_streams == 0
+    assert report.final_replicas == {"prefill": 3, "decode": 2}
+
+
+def test_flash_crowd_uncoordinated_violates_slos():
+    """Coordination disabled = independent per-pool reactive scaling (the
+    v1 loop per pool). The same scenario then measurably violates BOTH
+    SLOs: prefill scales only after the queue already exploded, and the
+    eventual backlog flush floods decode a provisioning-delay before its
+    own inflight signal reacts — the bottleneck just moves."""
+    report = _flash_crowd_sim(coordinate=False).run()
+    assert report.ttft_attainment < 0.99, report.summary()
+    assert report.itl_attainment < 0.99, report.summary()
+
+
+def test_simulation_is_deterministic():
+    a = _flash_crowd_sim(coordinate=True).run()
+    b = _flash_crowd_sim(coordinate=True).run()
+    assert a.summary() == b.summary()
+    assert a.decisions == b.decisions
+
+
+def test_abrupt_scale_down_drops_streams():
+    """The counterfactual for the drain path: the SAME scenario with
+    hitless drain disabled kills victims' streams mid-flight — proving
+    the drain integration, not luck, is what makes scale-down safe."""
+    report = _flash_crowd_sim(coordinate=True, hitless=False).run()
+    assert report.scale_down_events
+    assert report.dropped_streams > 0
+    assert not any(e.drained for e in report.scale_down_events)
+
+
+def test_adapter_skew_10k_streams():
+    """Adapter-skewed multi-tenant mix at 10k+ concurrent streams: the
+    planner sizes each decode pool from ITS traffic share — the
+    adapter-pinned pool (70% of traffic) scales well past the base pool
+    — while both SLOs hold."""
+    prefill = PoolSpec(
+        name="prefill", role="prefill",
+        capacity=PoolCapacity(prompts_per_s=50.0, tokens_per_s=0.0,
+                              max_streams=0),
+        min_replicas=5, max_replicas=32, target_utilization=0.6,
+        osl=400, target_queued_per_replica=16, scale_down_delay_s=60.0,
+        coordinate_with="adapter", forecast_horizon_s=90.0)
+    base = PoolSpec(
+        name="decode", role="decode",
+        capacity=PoolCapacity(prompts_per_s=0.0, tokens_per_s=12800.0,
+                              max_streams=512, itl_s=0.04),
+        min_replicas=2, max_replicas=16, target_utilization=0.7,
+        osl=400, share=0.3, scale_down_delay_s=60.0,
+        forecast_horizon_s=90.0)
+    adapter = PoolSpec(
+        name="adapter", role="adapter",
+        capacity=PoolCapacity(prompts_per_s=0.0, tokens_per_s=12800.0,
+                              max_streams=512, itl_s=0.04),
+        min_replicas=4, max_replicas=32, target_utilization=0.7,
+        osl=400, share=0.7, scale_down_delay_s=60.0,
+        forecast_horizon_s=90.0)
+    planner = PoolPlanner([prefill, base, adapter], coordinate=True)
+    report = Simulator(
+        adapter_skew(),
+        [SimPoolCfg(prefill), SimPoolCfg(base), SimPoolCfg(adapter)],
+        planner, ttft_slo_s=2.5, itl_slo_s=0.08, goal=0.99,
+        forecaster=Forecaster(alpha=0.5, beta=0.5, bucket_s=10.0)).run()
+    assert report.max_concurrent_streams >= 10_000, report.summary()
+    assert report.ttft_attainment >= 0.99
+    assert report.itl_attainment >= 0.99
+    stats = report.pool_stats
+    assert stats["adapter"].peak_replicas > stats["decode"].peak_replicas
+    assert report.dropped_streams == 0
+
+
+def test_diurnal_tracks_load_efficiently():
+    """A compressed day: the planner must FOLLOW the curve — attainment
+    held while spending well under the replica-hours of static
+    peak-provisioning (the reason to autoscale at all)."""
+    prefill = PoolSpec(
+        name="prefill", role="prefill",
+        capacity=PoolCapacity(prompts_per_s=10.0, tokens_per_s=0.0,
+                              max_streams=0),
+        min_replicas=2, max_replicas=16, target_utilization=0.6,
+        osl=64, target_queued_per_replica=8, scale_down_delay_s=60.0,
+        coordinate_with="decode", forecast_horizon_s=90.0)
+    decode = PoolSpec(
+        name="decode", role="decode",
+        capacity=PoolCapacity(prompts_per_s=0.0, tokens_per_s=1280.0,
+                              max_streams=64, itl_s=0.05),
+        min_replicas=2, max_replicas=12, target_utilization=0.7,
+        osl=64, scale_down_delay_s=60.0, forecast_horizon_s=90.0)
+    planner = PoolPlanner([prefill, decode], coordinate=True)
+    report = Simulator(
+        diurnal(),
+        [SimPoolCfg(prefill), SimPoolCfg(decode)],
+        planner, ttft_slo_s=2.5, itl_slo_s=0.1, goal=0.99,
+        forecaster=Forecaster(alpha=0.5, beta=0.5, bucket_s=10.0)).run()
+    assert report.ttft_attainment >= 0.99
+    assert report.itl_attainment >= 0.99
+    for name, stats in report.pool_stats.items():
+        static = stats.peak_replicas * report.duration_s
+        assert stats.replica_seconds < 0.8 * static, (name, stats)
+
+
+# ------------------------------------------------------ operator plumbing --
+class _FakeSignalsServer:
+    """Settable /metrics + /debug/slo?history=1 endpoints — what the
+    controller's planner scrapes from a graph frontend."""
+
+    def __init__(self):
+        import http.server
+
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/debug/slo"):
+                    body = json.dumps({
+                        "bucket_s": 10,
+                        "history": list(outer.history),
+                    }).encode()
+                    ctype = "application/json"
+                else:
+                    body = (
+                        f"dynamo_frontend_queued_requests {outer.queued}\n"
+                        'dynamo_slo_burn_rate{objective="ttft",'
+                        f'window="5m",role="frontend"}} {outer.burn_ttft}\n'
+                        'dynamo_slo_burn_rate{objective="itl",'
+                        f'window="5m",role="frontend"}} {outer.burn_itl}\n'
+                    ).encode()
+                    ctype = "text/plain"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.queued = 0.0
+        self.burn_ttft = 0.0
+        self.burn_itl = 0.0
+        self.history = []
+        import http.server as hs
+
+        self.srv = hs.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+        self.base = f"http://127.0.0.1:{self.srv.server_address[1]}"
+        self.metrics_url = self.base + "/metrics"
+        self.history_url = self.base + "/debug/slo?history=1"
+
+    def set_rate(self, rps: float, buckets: int = 30,
+                 start_t: int = 0) -> None:
+        """Publish a flat-rate history ring (10s buckets)."""
+        self.history = [{"t": start_t + 10 * i, "requests": rps * 10}
+                        for i in range(buckets)]
+
+    def close(self):
+        self.srv.shutdown()
+
+
+def _pool_dgd(metrics_url: str, history_url: str):
+    from dynamo_tpu.operator import materialize as mat
+
+    return {
+        "apiVersion": mat.API_VERSION,
+        "kind": mat.DGD_KIND,
+        "metadata": {"name": "scale2", "namespace": "dynamo",
+                     "uid": "u-p2"},
+        "spec": {"services": {
+            "Frontend": {"componentType": "frontend", "replicas": 1},
+            "PrefillWorker": {
+                "componentType": "worker",
+                "subComponentType": "prefill",
+                "replicas": 1,
+                "autoscaling": {
+                    "enabled": True, "role": "prefill",
+                    "minReplicas": 1, "maxReplicas": 8,
+                    "targetUtilization": 0.5, "expectedOsl": 64,
+                    "forecastHorizonSeconds": 60,
+                    "scaleDownDelaySeconds": 30,
+                    "coordinateWith": "DecodeWorker",
+                    "metricsUrl": metrics_url,
+                    "historyUrl": history_url,
+                    "pool": {"promptsPerSPerReplica": 10},
+                },
+            },
+            "DecodeWorker": {
+                "componentType": "worker",
+                "subComponentType": "decode",
+                "replicas": 1,
+                "autoscaling": {
+                    "enabled": True, "role": "decode",
+                    "minReplicas": 1, "maxReplicas": 8,
+                    "targetUtilization": 0.5, "expectedOsl": 64,
+                    "forecastHorizonSeconds": 60,
+                    "scaleDownDelaySeconds": 30,
+                    "metricsUrl": metrics_url,
+                    "historyUrl": history_url,
+                    "pool": {"tokensPerSPerReplica": 1000,
+                             "maxStreamsPerReplica": 16},
+                },
+            },
+        }},
+    }
+
+
+@pytest.fixture()
+def pool_stack():
+    from dynamo_tpu.operator import materialize as mat
+    from dynamo_tpu.operator.controller import Controller
+    from dynamo_tpu.operator.k8s_client import K8sClient
+    from tests.fake_k8s import FakeK8s
+
+    signals = _FakeSignalsServer()
+    fake = FakeK8s()
+    fake.__enter__()
+    client = K8sClient(fake.url)
+    ctrl = Controller(client, namespace=None)
+    client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                  _pool_dgd(signals.metrics_url, signals.history_url))
+    try:
+        yield signals, fake, client, ctrl
+    finally:
+        signals.close()
+        fake.__exit__(None, None, None)
+
+
+def _replicas(client, name: str) -> int:
+    dep = client.get("apps/v1", "deployments", "dynamo", f"scale2-{name}")
+    return dep["spec"]["replicas"]
+
+
+def test_controller_scales_pools_jointly_and_marks_drain_victims(
+        pool_stack):
+    from dynamo_tpu.operator import materialize as mat
+    from dynamo_tpu.operator.controller import (
+        DRAIN_VICTIM_ANNOTATION, POD_DELETION_COST)
+
+    signals, fake, client, ctrl = pool_stack
+    ctrl.reconcile_once()
+    assert _replicas(client, "prefillworker") == 1
+
+    # demand spike in the history ring: 40 rps sustained
+    signals.set_rate(40.0)
+    assert ctrl.planner_tick(now=1000.0) == 2   # BOTH pools, one tick
+    ctrl.reconcile_once()
+    # prefill: 40/(10*0.5) = 8; decode: 40*64/(1000*0.5) = 5.12 -> 6
+    assert _replicas(client, "prefillworker") == 8
+    assert _replicas(client, "decodeworker") == 6
+
+    # planner surface: metrics + debug payload
+    page = ctrl.registry.expose()
+    assert 'dynamo_planner_target_replicas{' in page
+    assert 'service="PrefillWorker"} 8' in page
+    assert "dynamo_planner_decisions_total" in page
+    assert "dynamo_planner_forecast_rps" in page
+    payload = ctrl.planner_debug_payload()
+    pools = payload["pools"]["dynamo/scale2"]["pools"]
+    assert pools["PrefillWorker"]["target_replicas"] == 8
+    assert pools["PrefillWorker"]["coordinate_with"] == "DecodeWorker"
+    assert payload["pools"]["dynamo/scale2"]["decisions"]
+
+    # scale-down: victim pods are marked for drain BEFORE the shrink
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": "scale2-prefillworker-abc",
+            "namespace": "dynamo",
+            "creationTimestamp": "2026-08-04T10:00:00Z",
+            "labels": {
+                mat.COMPONENT_LABEL: "prefillworker",
+                mat.NS_LABEL: mat.discovery_label_value("dynamo",
+                                                        "scale2"),
+            },
+        },
+        "status": {},  # no podIP: pre-drain POST is skipped, not fatal
+    }
+    fake.put_object("v1", "dynamo", "pods", pod)
+    signals.set_rate(1.0, start_t=1000)   # demand collapses
+    ctrl.planner_tick(now=1100.0)         # arms the cooldown
+    assert ctrl.planner_tick(now=1140.0) >= 1   # steps down one replica
+    marked = fake.get_object("v1", "dynamo", "pods",
+                             "scale2-prefillworker-abc")
+    ann = marked["metadata"]["annotations"]
+    assert ann[DRAIN_VICTIM_ANNOTATION] == "true"
+    assert ann[POD_DELETION_COST] == "-1000"
+
+
+def test_controller_restart_seeds_pools_without_spurious_event(
+        pool_stack):
+    from dynamo_tpu.operator.controller import Controller
+    from dynamo_tpu.operator.k8s_client import K8sClient
+
+    signals, fake, client, ctrl = pool_stack
+    signals.set_rate(40.0)
+    assert ctrl.planner_tick(now=1000.0) == 2
+    ctrl.reconcile_once()   # persists plannerReplicas into DGD status
+
+    fresh = Controller(K8sClient(fake.url), namespace=None)
+    assert fresh.planner_tick(now=2000.0) == 0, (
+        "restart must seed pool targets from status, not re-decide")
+    assert not fresh._pool_planners[("dynamo", "scale2")].journal
+    fresh.reconcile_once()
+    assert _replicas(client, "prefillworker") == 8
+
+
+def test_scrape_failures_are_isolated_per_future(pool_stack):
+    signals, fake, client, ctrl = pool_stack
+    signals.set_rate(40.0)
+    assert ctrl.planner_tick(now=1000.0) == 2
+
+    # one scrape RAISING mid-executor must not lose the tick: the
+    # last-good cache serves the failing URL (within staleness) and the
+    # error is counted
+    orig = ctrl._scrape_signals
+    bad_url = signals.metrics_url
+
+    def flaky(url):
+        if url == bad_url:
+            raise RuntimeError("boom mid-ThreadPoolExecutor")
+        return orig(url)
+
+    ctrl._scrape_signals = flaky
+    before = ctrl.collector.scrape_errors_total
+    assert ctrl.planner_tick(now=1010.0) == 0   # held, not lost
+    assert ctrl.collector.scrape_errors_total == before + 1
+    assert ctrl.planner_debug_payload()["scrape_errors_total"] >= 1
+    assert "dynamo_planner_scrape_errors_total 1" in ctrl.registry.expose()
+    # targets unchanged (decisions held on stale-but-bounded signals)
+    pl = ctrl._pool_planners[("dynamo", "scale2")]
+    assert pl.targets() == {"PrefillWorker": 8, "DecodeWorker": 6}
+
+    # ...but past the staleness bound the cache may NOT stand in: the
+    # pool holds its last decision and nothing crashes
+    ctrl.collector.staleness_s = 0.0
+    assert ctrl.planner_tick(now=1020.0) == 0
+    assert pl.targets() == {"PrefillWorker": 8, "DecodeWorker": 6}
+
+
+def test_operator_debug_server_serves_planner_state(pool_stack):
+    from dynamo_tpu.operator.debug_server import OperatorDebugServer
+
+    signals, fake, client, ctrl = pool_stack
+    signals.set_rate(40.0)
+    ctrl.planner_tick(now=1000.0)
+    srv = OperatorDebugServer(ctrl, port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/planner",
+                timeout=5) as r:
+            payload = json.loads(r.read())
+        assert payload["pools"]["dynamo/scale2"]["decisions"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            page = r.read().decode()
+        assert "dynamo_planner_target_replicas" in page
+        from tests.metrics_lint import assert_valid_scrape
+
+        assert_valid_scrape(page, openmetrics=False)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
+            assert json.loads(r.read())["status"] == "ok"
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------- loadgen --
+class _SheddingEndpoint:
+    """OpenAI-ish streaming endpoint that sheds the first N attempts per
+    request id with 429 + Retry-After, then serves one token."""
+
+    def __init__(self, shed_first: int = 2, retry_after: str = "0.05"):
+        import http.server
+
+        outer = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                rid = body["messages"][0]["content"]
+                with outer.lock:
+                    outer.attempts[rid] = outer.attempts.get(rid, 0) + 1
+                    shed = outer.attempts[rid] <= outer.shed_first
+                if shed:
+                    payload = b'{"error":"shed"}'
+                    self.send_response(429)
+                    self.send_header("Retry-After", outer.retry_after)
+                    self.send_header("Content-Length",
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                chunks = (
+                    'data: {"choices":[{"delta":{"content":"ok"},'
+                    '"index":0}],"usage":{"prompt_tokens":1,'
+                    '"completion_tokens":1}}\n\n'
+                    "data: [DONE]\n\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Content-Length", str(len(chunks)))
+                self.end_headers()
+                self.wfile.write(chunks)
+
+        import http.server as hs
+
+        self.shed_first = shed_first
+        self.retry_after = retry_after
+        self.attempts = {}
+        self.lock = threading.Lock()
+        self.srv = hs.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+
+    def close(self):
+        self.srv.shutdown()
+
+
+def test_loadgen_honors_retry_after_on_shed():
+    """ISSUE 8 satellite: a 429/503 with Retry-After is a jittered
+    re-queue, not a hard failure."""
+    from benchmarks.utils.loadgen import (
+        LoadConfig, run_one, run_one_with_retries)
+
+    ep = _SheddingEndpoint(shed_first=2)
+    try:
+        cfg = LoadConfig(endpoint_url=ep.url, model="m", prompt="r1",
+                         max_tokens=1, timeout_s=10.0)
+        res = run_one_with_retries(cfg, seed=0)
+        assert res.ok and res.retries == 2 and not res.shed
+        # etiquette off (or patience exhausted): the shed is recorded
+        # with the server's hint, not counted as a silent failure
+        cfg2 = LoadConfig(endpoint_url=ep.url, model="m", prompt="r2",
+                          max_tokens=1, timeout_s=10.0, max_retries=0)
+        res2 = run_one(cfg2, seed=1)
+        assert not res2.ok and res2.shed and res2.status == 429
+        assert res2.retry_after_s == pytest.approx(0.05)
+    finally:
+        ep.close()
+
+
+def test_loadgen_open_loop_schedule():
+    """Open-loop arrivals follow the scenario schedule (the simulator's
+    own math) regardless of completions."""
+    from benchmarks.utils.loadgen import LoadConfig, run_open_loop
+
+    ep = _SheddingEndpoint(shed_first=0)
+    try:
+        cfg = LoadConfig(
+            endpoint_url=ep.url, model="m", max_tokens=1,
+            timeout_s=10.0, schedule="steady", base_rps=20.0,
+            peak_rps=20.0, duration_s=1.0)
+        results, wall = run_open_loop(cfg)
+        ok = [r for r in results if r.ok]
+        # ~20 arrivals in 1s of steady 20 rps (pacing quantizes a little)
+        assert 14 <= len(results) <= 26, len(results)
+        assert len(ok) == len(results)
+        with pytest.raises(ValueError):
+            run_open_loop(LoadConfig(endpoint_url=ep.url, model="m"))
+    finally:
+        ep.close()
+
+
+def test_schedule_rate_shapes():
+    assert schedule_rate("steady", 50, 100, 5, 50) == 5
+    assert schedule_rate("ramp", 50, 100, 0, 50) == pytest.approx(25)
+    # spike: base before, peak during hold, base after
+    kw = dict(spike_start_s=10, spike_ramp_s=10, spike_hold_s=10,
+              spike_fall_s=10)
+    assert schedule_rate("spike", 5, 100, 2, 20, **kw) == 2
+    assert schedule_rate("spike", 25, 100, 2, 20, **kw) == 20
+    assert schedule_rate("spike", 99, 100, 2, 20, **kw) == 2
+    assert schedule_rate("diurnal", 0, 100, 3, 30,
+                         period_s=100) == pytest.approx(3)
+    assert schedule_rate("diurnal", 50, 100, 3, 30,
+                         period_s=100) == pytest.approx(30)
+    with pytest.raises(ValueError):
+        schedule_rate("bursty", 0, 1, 1, 1)

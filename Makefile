@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -32,6 +32,7 @@ help:
 	@echo "  obs-check      SLO/exemplar suite + live scrape validation (burn rates, OpenMetrics)"
 	@echo "  qos-check      per-tenant QoS suite (weighted-fair isolation, tenant admission, SLO-burn shed)"
 	@echo "  planner-check  coordinated autoscaling suite (pool planner, flash-crowd simulation, drain-before-shrink)"
+	@echo "  rpa-check      unified ragged-step suite (kernel parity, mixed/classic identity, bench contract)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -126,6 +127,16 @@ qos-check:
 # fake-clock: no TPU, no sleeps, target < 30s.
 planner-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py -q -p no:randomly
+
+# Unified-ragged-step gate (docs/perf.md "Unified ragged step"): the `rpa`
+# marker suite — Pallas ragged-kernel parity vs the XLA composition (incl.
+# int8 pools and page-boundary-crossing mid-prefill rows), engine
+# mixed-vs-classic token identity (LoRA, preemption, namespaced prefix
+# cache), the jitted acceptance tests (slow-marked, so tier-1 stays light;
+# the direct file invocation here runs them), and the prefill_interference
+# bench contract smoke.
+rpa-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_ragged_attention.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

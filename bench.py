@@ -545,6 +545,136 @@ def bench_multi_tenant_skew(on_tpu: bool) -> dict:
     }
 
 
+def bench_prefill_interference(on_tpu: bool) -> dict:
+    """Unified-ragged-step A/B (docs/perf.md "Unified ragged step"):
+    decode ITL p50/p95 for live streams while a stream of long prompts
+    arrives, with the mixed step on (--mixed-batch-tokens packs each
+    prefill chunk into the same program as the decode rows) vs off (the
+    classic chunk/decode alternation, where every chunk is a full stall
+    between decode windows). Both arms use the SAME chunk budget, so the
+    A/B isolates scheduling, not chunk geometry; a first untimed pass of
+    the identical traffic shape compiles every program the timed section
+    hits. Reports both latency sources side by side — the engine's
+    decode_step histogram (mixed steps feed it too: they ARE the ITL
+    step) and bench-layer wall-clock per-step samples — plus the ragged
+    composition stats. Deterministic: greedy, fixed prompts,
+    single-threaded step loop.
+
+    Env: BENCH_MIX_STREAMS (live decode streams, default 3),
+    BENCH_MIX_PROMPTS (interfering long prompts, default 4),
+    BENCH_MIX_PROMPT_TOKENS (default 192), BENCH_MIX_TOKENS (decode
+    tokens per stream, default 48), BENCH_MIX_BUDGET (chunk/mixed token
+    budget, default 64)."""
+    import time as _time
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    model = os.environ.get("BENCH_MODEL",
+                           "llama-3.2-1b-instruct" if on_tpu else "tiny-debug")
+    streams = int(os.environ.get("BENCH_MIX_STREAMS", "3"))
+    prompts = int(os.environ.get("BENCH_MIX_PROMPTS", "4"))
+    plen = int(os.environ.get("BENCH_MIX_PROMPT_TOKENS", "192"))
+    steps = int(os.environ.get("BENCH_MIX_TOKENS", "48"))
+    budget = int(os.environ.get("BENCH_MIX_BUDGET", "64"))
+
+    def pctl(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    def run(mixed_on: bool, params=None):
+        eng = Engine(EngineConfig(
+            model=model, page_size=16, num_pages=512,
+            max_num_seqs=streams + 1, max_seq_len=plen + steps + 96,
+            seed=7, enable_prefix_caching=False,
+            prefill_chunk_tokens=budget,
+            mixed_batch_tokens=budget if mixed_on else 0), params=params)
+
+        def drive(tag):
+            itl = []
+            for i in range(streams):
+                eng.add_request(GenRequest(
+                    f"{tag}-live{i}",
+                    [(i * 17 + j * 3) % 199 + 1 for j in range(24)],
+                    max_tokens=steps, temperature=0.0, ignore_eos=True))
+            # live batch reaches steady state before interference starts
+            for _ in range(streams + 2):
+                eng.step()
+            for i in range(prompts):
+                eng.add_request(GenRequest(
+                    f"{tag}-long{i}",
+                    [(i * 29 + j * 7) % 199 + 1 for j in range(plen)],
+                    max_tokens=1, temperature=0.0, ignore_eos=True))
+            last = _time.perf_counter()
+            while eng.has_work:
+                evs = eng.step()
+                # true ITL: time BETWEEN consecutive live-token emissions.
+                # In the classic arm a chunk-only step emits no live token,
+                # so its stall accrues into the next sample — that is
+                # precisely the interference under test. (The engine's
+                # decode_step histogram cannot see it: chunks are a
+                # separate phase there.)
+                if any(e.request_id.startswith(f"{tag}-live")
+                       and e.token_id >= 0 for e in evs):
+                    now = _time.perf_counter()
+                    itl.append(now - last)
+                    last = now
+            return itl
+
+        drive("warm")  # compile everything the timed shape hits
+        eng.reset_metrics()
+        itl = drive("timed")
+        ph = eng.metrics.phases["decode_step"]
+        snap = eng.metrics.snapshot()
+        res = {
+            "engine": {
+                "source": "engine_histogram",
+                "itl_p50_ms": ph.quantile_ms(0.5),
+                "itl_p95_ms": ph.quantile_ms(0.95),
+            },
+            "measured": {
+                "source": "bench_wall_clock",
+                "itl_p50_ms": round(1e3 * pctl(itl, 0.5), 3),
+                "itl_p95_ms": round(1e3 * pctl(itl, 0.95), 3),
+            },
+            "mixed_steps": eng.metrics.mixed_count,
+            "mixed_frac_mean": snap["mixed_frac_mean"],
+            "chunk_steps": eng.metrics.phases["prefill_chunk"].count,
+        }
+        for d in (res["engine"], res["measured"]):
+            d["itl_p95_p50_ratio"] = round(
+                d["itl_p95_ms"] / max(d["itl_p50_ms"], 1e-9), 3)
+        return res, eng.params
+
+    on_res, params = run(True)
+    off_res, _ = run(False, params=params)
+    return {
+        "metric": "prefill_interference_itl_p95",
+        # headline uses the wall-clock source: only it sees the classic
+        # arm's chunk stalls between decode steps (engine histogram books
+        # those under prefill_chunk, not decode_step)
+        "value": on_res["measured"]["itl_p95_ms"],
+        "unit": "ms",
+        "scenario": "prefill_interference",
+        "model": model,
+        "live_streams": streams,
+        "long_prompts": prompts,
+        "prompt_tokens": plen,
+        "mixed_budget_tokens": budget,
+        "mixed_on": on_res,
+        "mixed_off": off_res,
+        "itl_p95_speedup": round(
+            off_res["measured"]["itl_p95_ms"]
+            / max(on_res["measured"]["itl_p95_ms"], 1e-9), 3),
+        # CPU-fallback latency is never comparable to the TPU north star
+        # (standing ROADMAP constraint)
+        "comparable": bool(on_tpu),
+    }
+
+
 def main() -> None:
     backend = _init_backend()
     import jax
@@ -557,6 +687,10 @@ def main() -> None:
     if os.environ.get("BENCH_SCENARIO") == "multi_tenant_skew":
         # per-tenant QoS isolation A/B: one JSON line, same contract
         print(json.dumps(bench_multi_tenant_skew(on_tpu)))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "prefill_interference":
+        # unified ragged step A/B: one JSON line, same contract
+        print(json.dumps(bench_prefill_interference(on_tpu)))
         return
     dev = jax.devices()[0]
     chip = _chip_spec(dev) if on_tpu else None

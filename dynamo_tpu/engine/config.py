@@ -84,6 +84,15 @@ class EngineConfig:
     # 0 disables. Rounded up to a page multiple at engine init.
     prefill_chunk_tokens: int = 256
 
+    # unified ragged step (RPA, PAPERS.md arxiv 2604.15464): > 0 packs up
+    # to this many prefill-chunk tokens into the SAME program as the active
+    # decode slots, so a long admission no longer stalls decode between
+    # fused windows (the ITL p95 tail). The budget is the chunk size of the
+    # mixed step; rounded up to a page multiple at engine init, and implies
+    # chunked prefill (prefill_chunk_tokens defaults to the same budget
+    # when unset). 0 keeps the classic alternating chunk/decode dispatch.
+    mixed_batch_tokens: int = 0
+
     # multi-step decode: fuse this many decode iterations into one jit
     # dispatch (lax.scan with on-device sampling). Amortises per-step host
     # round-trips — the dominant cost on networked TPU backends — at the cost
@@ -199,6 +208,7 @@ class EngineConfig:
         p.add_argument("--enable-prefix-caching",
                        action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("--prefill-chunk-tokens", type=int, default=256)
+        p.add_argument("--mixed-batch-tokens", type=int, default=0)
         p.add_argument("--max-prefill-batch", type=int, default=4)
         # KVBM host tier (deploy manifests size it via the
         # DYNAMO_TPU_KVBM_HOST_BLOCKS env the operator materializes)
@@ -285,6 +295,7 @@ class EngineConfig:
             enable_prefix_caching=getattr(args, "enable_prefix_caching",
                                           True),
             prefill_chunk_tokens=getattr(args, "prefill_chunk_tokens", 256),
+            mixed_batch_tokens=getattr(args, "mixed_batch_tokens", 0),
             max_prefill_batch=getattr(args, "max_prefill_batch", 4),
             kvbm_host_blocks=getattr(args, "kvbm_host_blocks", 0),
             kvbm_gate=getattr(args, "kvbm_gate", "auto"),

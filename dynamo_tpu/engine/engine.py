@@ -146,7 +146,8 @@ class PhaseTimer:
 class EngineMetrics:
     """Counters + per-phase timing histograms surfaced via /worker/stats."""
 
-    _PHASES = ("prefill", "prefill_chunk", "decode_window", "decode_step")
+    _PHASES = ("prefill", "prefill_chunk", "decode_window", "decode_step",
+               "mixed_step")
     # decode-window batch occupancy (active slots / max_num_seqs) —
     # persistently low occupancy means max_num_seqs is oversized (padded
     # rows burn HBM stream for nothing); the exposition bridge
@@ -170,6 +171,14 @@ class EngineMetrics:
         self.occupancy_buckets = [0] * (len(self._OCC_EDGES) + 1)
         self.occupancy_sum = 0.0
         self.occupancy_count = 0
+        # unified ragged step composition: fraction of each mixed window's
+        # rows that were prefill-chunk tokens (persistently high fractions
+        # mean --mixed-batch-tokens crowds decode; near-zero means the
+        # budget is slack and admission latency is chunk-bound)
+        self.mixed_buckets = [0] * (len(self._OCC_EDGES) + 1)
+        self.mixed_sum = 0.0
+        self.mixed_count = 0
+        self.mixed_prefill_tokens = 0
         self.phases: Dict[str, PhaseTimer] = {p: PhaseTimer()
                                               for p in self._PHASES}
 
@@ -189,6 +198,21 @@ class EngineMetrics:
         self.occupancy_sum += frac
         self.occupancy_count += 1
 
+    def observe_mixed(self, prefill_tokens: int, decode_rows: int) -> None:
+        """One unified ragged step's composition: prefill-token fraction
+        of the window's total rows (same cumulative-bucket scheme as
+        occupancy; the exposition bridge serves both as histograms)."""
+        frac = prefill_tokens / max(prefill_tokens + decode_rows, 1)
+        for i, edge in enumerate(self._OCC_EDGES):
+            if frac <= edge:
+                self.mixed_buckets[i] += 1
+                break
+        else:
+            self.mixed_buckets[-1] += 1
+        self.mixed_sum += frac
+        self.mixed_count += 1
+        self.mixed_prefill_tokens += prefill_tokens
+
     def reset_phases(self, *names: str) -> None:
         """Re-zero selected phase histograms (bench section boundaries)."""
         for n in names:
@@ -196,11 +220,14 @@ class EngineMetrics:
 
     def snapshot(self) -> Dict[str, float]:
         out = {k: v for k, v in self.__dict__.items()
-               if k not in ("phases", "occupancy_buckets")}
+               if k not in ("phases", "occupancy_buckets", "mixed_buckets")}
         out["phases"] = {p: t.snapshot() for p, t in self.phases.items()}
         out["occupancy_mean"] = (
             round(self.occupancy_sum / self.occupancy_count, 4)
             if self.occupancy_count else 0.0)
+        out["mixed_frac_mean"] = (
+            round(self.mixed_sum / self.mixed_count, 4)
+            if self.mixed_count else 0.0)
         return out
 
 
@@ -328,6 +355,24 @@ class Engine:
         )
         self.allocator = PageAllocator(cfg.num_pages)
         self.prefix_cache: Optional[PrefixCache] = None
+        if cfg.mixed_batch_tokens > 0:
+            # the unified ragged step packs prefill-chunk tokens into the
+            # same program as the decode rows, so the budget must be
+            # page-aligned for the same whole-page KV-scatter reason as
+            # prefill_chunk_tokens below. Mixed mode IMPLIES chunked
+            # prefill (the packed tokens ARE chunks): an unset chunk size
+            # inherits the mixed budget so both paths agree on chunk
+            # geometry and the A/B bench compares scheduling, not shapes.
+            import dataclasses as _dc
+
+            mixed = -(-cfg.mixed_batch_tokens
+                      // cfg.page_size) * cfg.page_size
+            chunk = cfg.prefill_chunk_tokens or mixed
+            if (mixed != cfg.mixed_batch_tokens
+                    or chunk != cfg.prefill_chunk_tokens):
+                cfg = _dc.replace(cfg, mixed_batch_tokens=mixed,
+                                  prefill_chunk_tokens=chunk)
+                self.cfg = cfg
         if cfg.sequence_parallel > 1 and cfg.prefill_chunk_tokens > 0:
             # chunked prefill routes through the paged chunk op, which the
             # ring/Ulysses path does not serve — a long-context sp worker
@@ -338,9 +383,14 @@ class Engine:
                 "sequence_parallel=%d disables chunked prefill (ring "
                 "attention serves whole-prompt prefills)",
                 cfg.sequence_parallel)
-            cfg = _dc.replace(cfg, prefill_chunk_tokens=0)
+            cfg = _dc.replace(cfg, prefill_chunk_tokens=0,
+                              mixed_batch_tokens=0)
             self.cfg = cfg
-        if cfg.enable_prefix_caching and cfg.prefill_chunk_tokens > 0:
+        # prefix caching historically required chunked prefill (cache hits
+        # re-enter as mid-prompt chunks); the ragged mixed step serves the
+        # same mid-prompt shapes, so either path lifts the exclusion
+        if cfg.enable_prefix_caching and (cfg.prefill_chunk_tokens > 0
+                                          or cfg.mixed_batch_tokens > 0):
             self.prefix_cache = PrefixCache(self.allocator, cfg.page_size)
         # KVBM tiered block manager: evicted prefix pages demote to a
         # bounded host-RAM pool (and optionally disk) instead of dying;
@@ -664,6 +714,67 @@ class Engine:
             (True, True): make_decode_window(n_multi, True),
         }
 
+        def make_mixed_step(with_logprobs: bool):
+            """One unified ragged step (RPA, PAPERS.md arxiv 2604.15464):
+            every decode slot advances ONE token while up to
+            mixed_batch_tokens of the inflight prefill chunk ride the SAME
+            program — llama.mixed_step routes both row kinds through
+            ragged_mixed_attention, so a long admission stops preempting
+            decode ITL. The leading 18 operands match window_fn exactly
+            (the donation tuple carries over unchanged); the chunk
+            operands trail and are fresh uploads each call."""
+
+            def mixed_fn(
+                params, tokens, positions, context_lens, active, block_tables,
+                temperature, top_p, top_k, presence, frequency, min_p,
+                bias_ids, bias_vals, slot_keys, counts, k_pages, v_pages,
+                *extra,
+            ):
+                # extra layout: [adapter_slots]? + (p_tokens, p_start,
+                # p_len, p_pages) + [p_adapter_slot]? — decode adapter
+                # slots ride first when lora is on, like the windows
+                aslots = None
+                if lora_on:
+                    aslots, extra = extra[0], extra[1:]
+                p_tokens, p_start, p_len, p_pages = extra[:4]
+                p_aslot = extra[4] if lora_on else None
+                state = smp.SamplingState(
+                    temperature, top_p, top_k, presence, frequency,
+                    min_p, bias_ids, bias_vals,
+                )
+                step = active.astype(positions.dtype)
+                b = tokens.shape[0]
+                out = llama.mixed_step(
+                    mcfg, params, tokens, positions, block_tables,
+                    context_lens, p_tokens, p_start, p_len, p_pages,
+                    k_pages, v_pages, page_size=page_size,
+                    adapter_slots=aslots, chunk_adapter_slot=p_aslot,
+                )
+                # decode rows sample exactly like a 1-step window: same
+                # fold_in(slot_key, position) chain, same count update —
+                # token identity vs the classic path is by construction
+                keys = smp.fold_positions(slot_keys, positions)
+                if with_logprobs:
+                    nxt, chosen, tids, tvals = smp.sample_with_logprobs(
+                        out.logits, state, keys, counts
+                    )
+                    y = (nxt[None], chosen[None], tids[None], tvals[None])
+                else:
+                    nxt = smp.sample(out.logits, state, keys, counts)
+                    y = (nxt[None],)
+                counts = counts.at[jnp.arange(b), nxt].add(
+                    step.astype(counts.dtype)
+                )
+                # chunk_logits go back raw: the host samples the first
+                # token only on the FINAL chunk (same tail as chunk_fn)
+                return (rep(y), rep(out.chunk_logits), nxt,
+                        positions + step, context_lens + step, counts,
+                        out.k_pages, out.v_pages)
+
+            return mixed_fn
+
+        mixed_fns = {lp: make_mixed_step(lp) for lp in (False, True)}
+
         def spec_fn(params, tokens, drafts, positions, context_lens, active,
                     block_tables, temperature, top_p, top_k, presence,
                     frequency, min_p, bias_ids, bias_vals, slot_keys, counts,
@@ -760,6 +871,7 @@ class Engine:
             self._prefill_batch = ctx(prefill_batch_fn)
             self._prefill_chunk = ctx(chunk_fn)
             self._windows = {k: ctx(f) for k, f in window_fns.items()}
+            self._mixed = {k: ctx(f) for k, f in mixed_fns.items()}
             self._spec = ctx(spec_fn)
             self._sample_first = ctx(sample_first)
             self._sample_first_batch = ctx(sample_first_batch)
@@ -791,6 +903,11 @@ class Engine:
             jc = jax.jit(chunk_fn, donate_argnums=(4, 5))
             jw = {k: jax.jit(f, donate_argnums=window_donate)
                   for k, f in window_fns.items()}
+            # the mixed step's leading operands are the window's, so the
+            # same donation tuple applies; the trailing chunk operands are
+            # per-call uploads and stay undonated
+            jm = {k: jax.jit(f, donate_argnums=window_donate)
+                  for k, f in mixed_fns.items()}
             # same intent as window_donate: tokens/pos/ctx/counts/k/v (the
             # reused bias/key arrays at 13-15 must NOT be donated)
             jspec = jax.jit(spec_fn, donate_argnums=(1, 3, 4, 16, 18, 19))
@@ -801,6 +918,7 @@ class Engine:
             self._prefill_batch = ctx(jpb)
             self._prefill_chunk = ctx(jc)
             self._windows = {k: ctx(f) for k, f in jw.items()}
+            self._mixed = {k: ctx(f) for k, f in jm.items()}
             self._spec = ctx(jspec)
             self._sample_first = ctx(js)
             self._sample_first_batch = ctx(jsb)
@@ -839,6 +957,9 @@ class Engine:
                                  "reset_count": jr, "import": ji,
                                  **{f"window_{m}_{l}": f
                                     for (m, l), f in jw.items()}}
+            if cfg.mixed_batch_tokens > 0:
+                for l, f in jm.items():
+                    self._jit_handles[f"mixed_{l}"] = f
             if cfg.speculative_mode != "off":
                 self._jit_handles["spec"] = jspec
 
@@ -965,6 +1086,28 @@ class Engine:
                         self.add_request(GenRequest(
                             f"__warm_g{bucket}_{lane}", toks, max_tokens=1,
                             temperature=0.0, ignore_eos=True))
+                    while self.has_work:
+                        self.step()
+            if cfg.mixed_batch_tokens > 0 and cfg.speculative_mode == "off":
+                # unified ragged step: an anchor sequence keeps decode
+                # slots live while one prompt per bucket streams in, so
+                # the mixed program compiles at every page-table width
+                # (plus the logprobs twin) before /ready flips
+                for lp in (None, 1):
+                    tag = "lp" if lp else "t"
+                    self.add_request(GenRequest(
+                        f"__warm_m_{tag}", [5, 6, 7], max_tokens=4096,
+                        temperature=0.0, ignore_eos=True, logprobs=lp))
+                    self.step()  # admit the anchor (idle -> full prefill)
+                    for bucket in sorted(buckets):
+                        p = min(bucket, cfg.max_seq_len - 2)
+                        toks = [(bucket * 11 + j) % 83 + 1 for j in range(p)]
+                        self.add_request(GenRequest(
+                            f"__warm_m_{tag}{bucket}", toks, max_tokens=1,
+                            temperature=0.0, ignore_eos=True))
+                        while self._inflight is not None or self.pending:
+                            self.step()  # chunks ride mixed steps
+                    self.abort_request(f"__warm_m_{tag}")
                     while self.has_work:
                         self.step()
         if cfg.disaggregation_mode == "decode":
@@ -1243,6 +1386,13 @@ class Engine:
         with self._exec_lock:
             events: List[TokenEvent] = []
             events.extend(self._apply_aborts())
+            if self._mixed_eligible():
+                # unified ragged step: the inflight chunk rides the decode
+                # window — one dispatch serves both, so there is no
+                # separate decode this iteration
+                events.extend(self._mixed_step())
+                self._qos_account(events)
+                return events
             if self._inflight is not None:
                 # one chunk per step: decode windows run between chunks, so
                 # a long admission never monopolizes the chip
@@ -1354,10 +1504,16 @@ class Engine:
             # in-flight async window before membership changes
             events.extend(self._materialize_pending())
             if chunk > 0 and (n_cached > 0
-                              or len(req.prompt_token_ids) > chunk):
+                              or len(req.prompt_token_ids) > chunk
+                              or (self.cfg.mixed_batch_tokens > 0
+                                  and bool(self.seqs))):
                 # long (or partially cached) prompt: prefill the remainder
                 # in chunks across subsequent step()s instead of stalling
-                # every active stream (FIFO holds: later admissions wait)
+                # every active stream (FIFO holds: later admissions wait).
+                # Mixed mode routes EVERY prompt here while decode slots
+                # are live — the chunks then ride the unified ragged step
+                # instead of preempting it (an idle engine still takes the
+                # faster full/batched prefill below).
                 self._start_inflight(req, cached_pages, n_cached)
                 break
             group = self._widen_group(req, chunk)
@@ -1861,12 +2017,11 @@ class Engine:
         total = max(1, -(-prompt_len // cfg.page_size))
         pages = list(cached_pages or [])
         pages += self.allocator.alloc(total - len(pages))
-        # The page table carries (chunk_pages - 1) trailing TRASH slots: a
-        # chunk may start at any page boundary (cached prefixes are page-,
-        # not chunk-, aligned), so the final padded chunk window can extend
-        # past the bucket — its page slice must land on trash page 0, never
-        # clamp back onto real (possibly SHARED) pages.
-        width = bucket // cfg.page_size + (chunk // cfg.page_size - 1)
+        # trailing TRASH slots sized for the widest window either path
+        # (classic chunk or unified ragged step) can run — see
+        # KVCacheSpec.page_table_width for the boundary argument
+        width = self.kv_spec.page_table_width(
+            bucket, max(chunk, cfg.mixed_batch_tokens))
         pages_arr = np.zeros((width,), dtype=np.int32)
         pages_arr[: len(pages)] = pages
         slot = self._free_slots.pop()
@@ -1934,6 +2089,134 @@ class Engine:
             self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
         if finished:
             self._finish_slot(slot, reason)
+        events.append(ev)
+        return events
+
+    def _mixed_eligible(self) -> bool:
+        """The unified ragged step serves this iteration iff a chunked
+        prefill is inflight AND decode slots are live — otherwise the
+        classic paths are strictly better (full/batched prefill when
+        idle, plain fused windows when nothing is admitting). Speculative
+        and guided decode keep the classic alternation: the mixed program
+        carries neither draft nor grammar operands (the inflight
+        request's OWN guide still applies — its first token is masked
+        host-side by _first_token, same as the chunk path)."""
+        return (self.cfg.mixed_batch_tokens > 0
+                and self._inflight is not None
+                and bool(self.seqs)
+                and self.cfg.speculative_mode == "off"
+                and not any(s.guide is not None
+                            for s in self.seqs.values()))
+
+    def _mixed_step(self) -> List[TokenEvent]:
+        """One unified ragged step: a single dispatch advances every
+        decode slot by one token AND pushes the inflight prefill forward
+        by up to mixed_batch_tokens (the RPA continuous-batching shape,
+        PAPERS.md arxiv 2604.15464). Decode ITL stops paying for whole
+        prefill chunks between windows — the chunk tokens fill the same
+        program's ragged tail, and on the final chunk the first token
+        installs from the fused program's own last-row logits."""
+        inf = self._inflight
+        cfg = self.cfg
+        events: List[TokenEvent] = []
+        # the mixed program extends the decode carry like a 1-step
+        # window: drain any in-flight async window first, then provision
+        # decode pages for the one token this step writes
+        if self._pending_win is not None:
+            events.extend(self._materialize_pending())
+        self._grow_pages(1, events)
+        if not self.seqs:
+            # page pressure killed the whole batch: the chunk still has
+            # its reserved pages — advance it on the classic path
+            events.extend(self._advance_chunk())
+            return events
+        c = cfg.mixed_batch_tokens
+        start = inf.done
+        take = min(c, inf.prompt_len - start)
+        p_tokens = np.zeros((c,), dtype=np.int32)
+        p_tokens[:take] = inf.req.prompt_token_ids[start:start + take]
+
+        t0 = time.monotonic()
+        self._ensure_dev_state()
+        want_lp = any(s.logprobs is not None for s in self.seqs.values())
+        cur, pos, ctx_lens, active_dev = self._dev_state
+        (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
+         keys) = self._dev_sampling
+        lx = (self._dev_adapters,) if self.lora is not None else ()
+        px = (jnp.int32(inf.aslot),) if self.lora is not None else ()
+        (ys, chunk_logits, cur, pos, ctx_lens, self.token_counts,
+         self.k_pages, self.v_pages) = self._mixed[want_lp](
+            self.params, cur, pos, ctx_lens, active_dev,
+            self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
+            bias_ids, bias_vals, keys, self.token_counts,
+            self.k_pages, self.v_pages, *lx,
+            jnp.asarray(p_tokens), jnp.int32(start), jnp.int32(take),
+            jnp.asarray(inf.pages_arr), *px,
+        )
+        self._dev_state = (cur, pos, ctx_lens, active_dev)
+        slots = list(self.seqs)
+        next_np = np.asarray(ys[0])  # [1, B]
+        if want_lp:
+            chosen_np = np.asarray(ys[1])
+            tids_np = np.asarray(ys[2])
+            tvals_np = np.asarray(ys[3])
+        dt = time.monotonic() - t0
+        inf.done += take
+        # the mixed dispatch IS this iteration's decode step — it feeds
+        # the same ITL histograms (that is exactly what the A/B measures)
+        # plus its own phase and the ragged-composition histogram
+        self.metrics.decode_steps += 1
+        self.metrics.decode_time_s += dt
+        self.metrics.observe_phase("mixed_step", dt)
+        self.metrics.observe_phase("decode_window", dt)
+        self.metrics.observe_phase("decode_step", dt)
+        self.metrics.observe_occupancy(len(slots), cfg.max_num_seqs)
+        self.metrics.observe_mixed(take, len(slots))
+        for slot in slots:
+            seq = self.seqs.get(slot)
+            if seq is None:
+                continue
+            tok = int(next_np[0, slot])
+            seq.num_tokens += 1
+            seq.output_tokens.append(tok)
+            self.cur_tokens[slot] = tok
+            self.metrics.output_tokens += 1
+            finished, reason = self._check_stop(seq, tok)
+            ev = TokenEvent(seq.request_id, tok,
+                            len(seq.output_tokens) - 1, finished, reason)
+            if want_lp and seq.logprobs is not None:
+                self._decorate_lp(ev, seq, chosen_np[0, slot],
+                                  tids_np[0, slot], tvals_np[0, slot])
+            events.append(ev)
+            if finished:
+                self._finish_slot(slot, reason)
+        if inf.done < inf.prompt_len:
+            return events
+
+        # final chunk rode this window: same installation tail as
+        # _advance_chunk, with the ragged program's last-token logits
+        self._inflight = None
+        self.metrics.prompt_tokens += inf.prompt_len
+        req = inf.req
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
+                                     namespace=req.adapter or "")
+        first, req_key, lp = self._first_token(req, chunk_logits,
+                                               inf.prompt_len)
+        seq = self._install_slot(req, inf.slot, inf.pages, inf.prompt_len,
+                                 first, req_key)
+        finished, reason = self._check_stop(seq, first)
+        now = time.monotonic()
+        self.metrics.observe_phase("prefill", now - inf.t_start)
+        ev = TokenEvent(req.request_id, first, 0, finished, reason)
+        ev.phase = {
+            "queue_s": max(0.0, inf.t_start - req.arrival_time),
+            "prefill_s": max(0.0, now - inf.t_start),
+        }
+        if req.logprobs is not None:
+            self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
+        if finished:
+            self._finish_slot(inf.slot, reason)
         events.append(ev)
         return events
 

@@ -102,6 +102,22 @@ class KVCacheSpec:
         itemsize = jnp.dtype(self.dtype).itemsize
         return 2 * self.num_layers * self.lane_width * itemsize
 
+    def page_table_width(self, bucket_tokens: int,
+                         chunk_tokens: int) -> int:
+        """Page-table width for a chunked (or unified ragged) prefill at
+        this bucket: the bucket's pages plus (chunk_pages - 1) trailing
+        TRASH slots. A chunk may start at any page boundary (cached
+        prefixes are page-, not chunk-, aligned), so the final padded
+        chunk window can extend past the bucket — its page slice must
+        land on trash page 0, never clamp back onto real (possibly
+        SHARED) pages. Mixed mode sizes chunk_tokens as
+        max(prefill_chunk_tokens, mixed_batch_tokens): either path may
+        advance the same inflight prompt (engine._mixed_step falls back
+        to _advance_chunk when the decode batch empties), and both must
+        fit one program's widest window."""
+        ps = self.page_size
+        return bucket_tokens // ps + (max(chunk_tokens, ps) // ps - 1)
+
 
 def alloc_kv_pages(spec: KVCacheSpec, sharding=None):
     """Allocate zeroed K/V page pools (optionally with a NamedSharding)."""

@@ -787,3 +787,101 @@ def decode_step(
     )
     logits = _logits(cfg, params, x)
     return DecodeOut(logits, k_pages, v_pages)
+
+
+class MixedOut(NamedTuple):
+    logits: jax.Array  # [B, V] decode-slot logits
+    chunk_logits: jax.Array  # [V] logits at the chunk's last valid token
+    k_pages: jax.Array
+    v_pages: jax.Array
+
+
+def mixed_step(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B] current token per decode slot
+    positions: jax.Array,  # [B] position of that token
+    block_tables: jax.Array,  # [B, Pmax]
+    context_lens: jax.Array,  # [B] length INCLUDING current token
+    chunk_tokens: jax.Array,  # [C] one prefill chunk, page-multiple padded
+    chunk_start: jax.Array,  # scalar int32: absolute position of chunk[0]
+    chunk_len: jax.Array,  # scalar int32: valid tokens in this chunk
+    chunk_pages: jax.Array,  # [Wp] ALL page ids of the chunk's sequence
+    k_pages: jax.Array,  # [L, P, ps, KV*D]
+    v_pages: jax.Array,
+    *,
+    page_size: int,
+    adapter_slots=None,  # [B] int32 per-slot LoRA slots, or None
+    chunk_adapter_slot=None,  # scalar int32 LoRA slot of the chunk's seq
+) -> MixedOut:
+    """ONE ragged step: every decode slot advances a token AND one prefill
+    chunk makes progress, in a single forward (the RPA unification — the
+    chunk no longer preempts decode between fused windows, which was the
+    ITL p95 tail in the TPU snapshot).
+
+    Row layout is decode-first: [B decode rows | C chunk rows]. All
+    projections, rope, LoRA deltas, and the MLP are per-token, so running
+    them over the concatenated batch is bit-identical to the separate
+    decode_step + prefill_chunk dispatches; attention routes through
+    ops.attention.ragged_mixed_attention, whose XLA composition is the
+    exact per-path reference (and whose Pallas kernel serves both row
+    kinds from one grid on TPU). KV writes stay disjoint: decode tokens
+    scatter through their block tables, chunk rows through the chunk's
+    own pages (prefix-cached pages are read-only full pages, and chunk
+    starts are page-aligned, so a shared prefix is never rewritten).
+
+    MoE note: dispatch uses decode semantics (dense, no capacity gather)
+    for ALL rows — capacity dropping keys on batch composition, which
+    would break mixed-vs-separate token identity.
+    """
+    b = tokens.shape[0]
+    c = chunk_tokens.shape[0]
+    all_pos = jnp.concatenate([positions, chunk_start + jnp.arange(c)])
+    token_mask = jnp.concatenate(
+        [jnp.ones((b,), bool), jnp.arange(c) < chunk_len])
+    write_pages = jax.lax.dynamic_slice(
+        chunk_pages, (chunk_start // page_size,), (c // page_size,)
+    )
+    slots = None
+    if adapter_slots is not None:
+        ca = (jnp.int32(0) if chunk_adapter_slot is None
+              else chunk_adapter_slot)
+        slots = jnp.concatenate(
+            [adapter_slots.astype(jnp.int32),
+             jnp.full((c,), ca, jnp.int32)])
+    x = _embed_rows(cfg, params, jnp.concatenate([tokens, chunk_tokens]))
+
+    def body(x, kp, vp, lp, page_off):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
+        q, k, v = _qkv(cfg, lp, h, all_pos,
+                       rope=_layer_rope(cfg, page_off,
+                                        k_pages.shape[1]),
+                       lora_slots=slots)
+        tables = block_tables + page_off
+        kp, vp = att.write_kv_token(
+            kp, vp, k[:b], v[:b], tables, positions, page_size=page_size
+        )
+        kp, vp = att.write_kv_prefill(
+            kp, vp, k[b:], v[b:], write_pages + page_off,
+            page_size=page_size
+        )
+        o = att.ragged_mixed_attention(
+            q, kp, vp, tables, context_lens, chunk_pages + page_off,
+            chunk_start, page_size=page_size,
+            num_kv_heads=cfg.cache_kv_heads, num_decode=b,
+            **_attn_kwargs(cfg, page_off, k_pages.shape[1]),
+        )
+        x = x + _post(cfg, lp, "post_attn_norm",
+                      _attn_out(cfg, lp, o, lora_slots=slots))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
+        x = x + _post(cfg, lp, "post_mlp_norm",
+                      _mlp(cfg, lp, h, token_mask=token_mask))
+        return x, kp, vp
+
+    x, k_pages, v_pages = _scan_layers_paged(
+        params, body, x, k_pages, v_pages, cfg.num_layers
+    )
+    last = jnp.take(x[b:], chunk_len - 1, axis=0)[None]  # [1, E]
+    rows = jnp.concatenate([x[:b], last])
+    logits = _logits(cfg, params, rows)
+    return MixedOut(logits[:b], logits[b], k_pages, v_pages)

@@ -7,10 +7,19 @@ Prometheus series so Grafana/alerting see per-phase latency without a
 second observation path:
 
 - `dynamo_engine_phase_seconds{phase}` — prefill / prefill_chunk /
-  decode_window / decode_step histograms (PhaseTimer's quarter-octave
-  buckets downsampled to octaves: 0.25ms..8.2s, 16 edges);
+  decode_window / decode_step / mixed_step histograms (PhaseTimer's
+  quarter-octave buckets downsampled to octaves: 0.25ms..8.2s, 16 edges);
 - `dynamo_engine_batch_occupancy` — decode-window batch occupancy
   (active slots / max_num_seqs) histogram;
+- `dynamo_engine_mixed_prefill_fraction` — unified ragged step
+  composition: the prefill-token fraction of each mixed window's rows
+  (docs/perf.md "Unified ragged step"; persistently high fractions mean
+  --mixed-batch-tokens crowds decode, near-zero means the budget is
+  slack);
+- `dynamo_pallas_fallback_total{op,reason}` — Pallas→XLA demotions the
+  head/lane gates (and int8 lane-blocking / seq-parallel mesh checks)
+  made silently before; each label pair also logs one warning at first
+  occurrence (ops/attention._note_fallback);
 - `dynamo_engine_jit_programs` — compiled executables across the jit
   caches (steady-state growth = recompiles, the thing the bucketed
   shapes exist to prevent) + `dynamo_engine_warmup_seconds`;
@@ -35,6 +44,7 @@ from typing import Optional
 
 from dynamo_tpu.serving.metrics import (
     CallbackCounter,
+    CallbackCounterVec,
     CallbackHistogram,
     Gauge,
     Registry,
@@ -90,6 +100,31 @@ def _occupancy_series(engine):
     return [({}, edges, cum, round(m.occupancy_sum, 6), total)]
 
 
+def _mixed_series(engine):
+    """Ragged-batch composition (EngineMetrics.observe_mixed): prefill-
+    token fraction per unified mixed window, same cumulative-bucket
+    scheme as occupancy."""
+    m = engine.metrics
+    edges = list(m._OCC_EDGES)
+    cum = []
+    running = 0
+    for c in m.mixed_buckets[:-1]:
+        running += c
+        cum.append(running)
+    total = running + m.mixed_buckets[-1]
+    cum.append(total)  # +Inf
+    return [({}, edges, cum, round(m.mixed_sum, 6), total)]
+
+
+def _fallback_counts():
+    """dynamo_pallas_fallback_total labels from the attention dispatch's
+    demotion bookkeeping (process-wide; each pair warned once)."""
+    from dynamo_tpu.ops import attention as att
+
+    return {(("op", op), ("reason", reason)): v
+            for (op, reason), v in att.pallas_fallback_counts().items()}
+
+
 def resolve_chip():
     """The chip spec live utilization is judged against: env override
     first (`DYNAMO_TPU_CHIP`), else the jax device kind."""
@@ -127,6 +162,17 @@ class EngineMetricsBridge:
             "dynamo_engine_batch_occupancy",
             "Decode-window batch occupancy (active slots / max_num_seqs)",
             registry, lambda: _occupancy_series(self.engine))
+        CallbackHistogram(
+            "dynamo_engine_mixed_prefill_fraction",
+            "Unified ragged step composition: prefill-token fraction of "
+            "each mixed window's rows",
+            registry, lambda: _mixed_series(self.engine))
+        CallbackCounterVec(
+            "dynamo_pallas_fallback_total",
+            "Pallas kernels demoted to the XLA path by the head/lane "
+            "gates, int8 lane-blocking, or a sequence-parallel mesh "
+            "(each op/reason pair also warns once at first occurrence)",
+            registry, _fallback_counts, labelnames=("op", "reason"))
         CallbackCounter(
             "dynamo_engine_jit_programs",
             "Compiled executables across the engine's jit caches "

@@ -437,24 +437,18 @@ def chunk_attention(
         if backend in ("pallas", "pallas_interpret") \
                 and k_pages.dtype == jnp.int8 \
                 and not _pa.CHUNK_KERNEL_INT8_HW_VALIDATED:
-            if _explicit_backend() is not None:
-                import logging
-
-                logging.getLogger("dynamo_tpu.ops").warning(
-                    "pallas chunk attention on int8 KV is not yet "
-                    "hardware-validated; using the XLA gather path (set "
-                    "DYNAMO_TPU_CHUNK_ATTENTION=pallas to force)")
+            _note_fallback(
+                "chunk attention", "int8_not_validated",
+                "int8 dequant-in-chunk awaits its on-chip parity case; "
+                "set DYNAMO_TPU_CHUNK_ATTENTION=pallas to force")
             backend = "xla"
     if window is not None or logit_cap:
         backend = "xla"  # sliding window / softcap: kernel doesn't model them
     if backend in ("pallas", "pallas_interpret") \
             and _seq_parallel_mesh() is not None:
         # see the decode dispatch's seq-mesh note
-        import logging
-
-        logging.getLogger("dynamo_tpu.ops").warning(
-            "pallas chunk attention is unavailable under a "
-            "sequence-parallel mesh; using the XLA gather path")
+        _note_fallback("chunk attention", "seq_mesh",
+                       "sequence-parallel mesh shards the pool under GSPMD")
         backend = "xla"
     if backend in ("pallas", "pallas_interpret"):
         quantized = k_pages.dtype == jnp.int8
@@ -469,12 +463,9 @@ def chunk_attention(
         )
         if quantized and lb != max(tp, 1):
             # the kernel reads single-block rows (see decode dispatch)
-            import logging
-
-            logging.getLogger("dynamo_tpu.ops").warning(
-                "pallas chunk attention on int8 KV needs the mesh TP (%d) "
-                "to equal the pool's lane blocking (%d); using the XLA "
-                "gather path", tp, lb)
+            _note_fallback(
+                "chunk attention", "int8_lane_blocks",
+                f"mesh TP ({tp}) != pool lane blocking ({lb})")
             aligned = False
         if aligned:
             from dynamo_tpu.ops import pallas_attention as pa
@@ -500,6 +491,26 @@ def chunk_attention(
                 out_specs=P(None, "model", None),
                 check_vma=False,
             )(q, k_pages, v_pages, pages, st)
+    return chunk_attention_xla(
+        q, k_pages, v_pages, pages, start, page_size=page_size,
+        num_kv_heads=num_kv_heads, window=window, logit_cap=logit_cap)
+
+
+def chunk_attention_xla(
+    q: jax.Array,  # [C, H, D]
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    pages: jax.Array,  # [Pbucket] page ids of THIS sequence (0-padded tail)
+    start,  # scalar int32: absolute position of q[0]
+    *,
+    page_size: int,
+    num_kv_heads=None,
+    window=None,
+    logit_cap: float = 0.0,
+) -> jax.Array:
+    """Reference chunk attention (gather + masked softmax): the CPU/tier-1
+    fallback for chunk_attention, and one leg of the ragged mixed step's XLA
+    composition. GSPMD places the gather/einsums under a mesh."""
     c, n_heads, head_dim = q.shape
     n_kv = _pool_kv_heads(k_pages, head_dim, num_kv_heads)
     s_ctx = pages.shape[0] * page_size
@@ -520,6 +531,119 @@ def chunk_attention(
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("hcs,shd->chd", probs, v)
+
+
+def ragged_mixed_attention(
+    q: jax.Array,  # [B + C, H, D] — B decode rows first, then one C-chunk
+    k_pages: jax.Array,  # [P, ps, KV*D] (or int8 packed rows)
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [B, Pmax] decode page tables
+    context_lens: jax.Array,  # [B] horizons incl. the token written this step
+    p_pages: jax.Array,  # [Wp] the chunk's page ids (trash-padded tail)
+    p_start,  # scalar int32: absolute position of the chunk's first token
+    *,
+    page_size: int,
+    num_kv_heads=None,
+    num_decode: int,
+    window=None,
+    logit_cap: float = 0.0,
+) -> jax.Array:
+    """Mixed ragged-batch attention: B decode rows AND one prefill chunk in
+    a single program (the RPA unification — see ops/ragged_attention.py).
+
+    Decode rows attend their paged context through their block tables; the
+    chunk's rows attend causally over its own page list. Inactive decode
+    slots must carry context_lens >= 1 and zero tables (the engine's
+    existing inactive-slot contract).
+
+    Dispatch mirrors chunk_attention: DYNAMO_TPU_RAGGED_ATTENTION wins when
+    set; otherwise the Pallas kernel is selected by the scoped backend once
+    RAGGED_KERNEL_HW_VALIDATED flips (until then the XLA composition —
+    decode gather + chunk gather — serves every backend). The same
+    head/lane gates guard the kernel, with demotions counted via
+    _note_fallback.
+    """
+    backend = os.environ.get("DYNAMO_TPU_RAGGED_ATTENTION")
+    if not backend:
+        from dynamo_tpu.ops import ragged_attention as _ra
+
+        backend = (_resolve_backend() if _ra.RAGGED_KERNEL_HW_VALIDATED
+                   else "xla")
+    if window is not None or logit_cap:
+        backend = "xla"  # sliding window / softcap: kernel doesn't model them
+    if backend in ("pallas", "pallas_interpret") \
+            and _seq_parallel_mesh() is not None:
+        _note_fallback("ragged attention", "seq_mesh",
+                       "sequence-parallel mesh shards the pool under GSPMD")
+        backend = "xla"
+    n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
+    b = num_decode
+    c = q.shape[0] - b
+    if backend in ("pallas", "pallas_interpret"):
+        quantized = k_pages.dtype == jnp.int8
+        lb = _kv_lane_blocks() if quantized else 1
+        mesh = _mesh_for_shard_map()
+        tp = _mesh_tp(mesh)
+        span = n_kv * q.shape[2] if quantized else k_pages.shape[2]
+        aligned = (
+            _pallas_head_gate(q.shape[1], n_kv, tp, "ragged attention")
+            and _pallas_lane_gate(span, tp, "ragged attention")
+        )
+        if quantized and lb != max(tp, 1):
+            # the kernel reads single-block rows (see decode dispatch)
+            _note_fallback(
+                "ragged attention", "int8_lane_blocks",
+                f"mesh TP ({tp}) != pool lane blocking ({lb})")
+            aligned = False
+        if aligned:
+            from dynamo_tpu.ops import ragged_attention as ra
+
+            interp = backend == "pallas_interpret"
+            n_kv_call = n_kv // max(tp, 1)
+            # unified descriptor set: one page-table row per decode slot
+            # plus a final row for the chunk, all zero-(trash-)padded to a
+            # common width
+            pmax = block_tables.shape[1]
+            wp = p_pages.shape[0]
+            w = max(pmax, wp)
+            tabs = jnp.zeros((b + 1, w), jnp.int32)
+            tabs = tabs.at[:b, :pmax].set(block_tables.astype(jnp.int32))
+            tabs = tabs.at[b, :wp].set(p_pages.astype(jnp.int32))
+            cl = context_lens.astype(jnp.int32)
+            st = jnp.asarray(p_start, jnp.int32)
+            kv_lens = jnp.concatenate([cl, (st + c).reshape(1)])
+            q_starts = jnp.concatenate(
+                [jnp.maximum(cl - 1, 0), st.reshape(1)])
+
+            def call(q, kp, vp, tb, kl, qs):
+                return ra.ragged_paged_attention(
+                    q, kp, vp, tb, kl, qs, page_size=page_size,
+                    num_kv_heads=n_kv_call, num_decode=b,
+                    interpret=interp,
+                )
+
+            if mesh is None:
+                return call(q, k_pages, v_pages, tabs, kv_lens, q_starts)
+            return _shard_map(
+                call,
+                mesh=mesh,
+                in_specs=(P(None, "model", None), P(None, None, "model"),
+                          P(None, None, "model"), P(None, None), P(None),
+                          P(None)),
+                out_specs=P(None, "model", None),
+                check_vma=False,
+            )(q, k_pages, v_pages, tabs, kv_lens, q_starts)
+    # XLA composition: the decode gather and chunk gather reference paths,
+    # concatenated — token-identical to the separate-program paths by
+    # construction, which is what the mixed-step parity tests pin.
+    dec = paged_attention_decode_xla(
+        q[:b], k_pages, v_pages, block_tables, context_lens,
+        page_size=page_size, num_kv_heads=n_kv,
+        window=window, logit_cap=logit_cap)
+    chk = chunk_attention_xla(
+        q[b:], k_pages, v_pages, p_pages, p_start, page_size=page_size,
+        num_kv_heads=n_kv, window=window, logit_cap=logit_cap)
+    return jnp.concatenate([dec, chk], axis=0)
 
 
 def verify_attention(
@@ -578,32 +702,56 @@ def _mesh_tp(mesh) -> int:
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
 
 
+# Pallas -> XLA demotion visibility: the shape gates below used to demote
+# silently (or log per trace, unconditionally). _note_fallback gives every
+# demotion ONE log line per (op, reason) plus a process-wide counter that
+# observability/engine_metrics.py exports as dynamo_pallas_fallback_total.
+# Gates run at TRACE time, so counts are per compiled shape, not per step —
+# a nonzero count means some program is permanently off the kernel path.
+_FALLBACK_COUNTS: dict = {}
+_FALLBACK_LOGGED: set = set()
+
+
+def _note_fallback(op: str, reason: str, detail: str = "") -> None:
+    key = (op, reason)
+    _FALLBACK_COUNTS[key] = _FALLBACK_COUNTS.get(key, 0) + 1
+    if key not in _FALLBACK_LOGGED:
+        _FALLBACK_LOGGED.add(key)
+        import logging
+
+        logging.getLogger("dynamo_tpu.ops").warning(
+            "pallas %s demoted to the XLA path [%s]%s — counted in "
+            "dynamo_pallas_fallback_total, logged once", op, reason,
+            f": {detail}" if detail else "")
+
+
+def pallas_fallback_counts() -> dict:
+    """{(op, reason): trace-time demotion count}; exported by
+    observability/engine_metrics.attach_engine_metrics."""
+    return dict(_FALLBACK_COUNTS)
+
+
 def _pallas_head_gate(n_heads: int, n_kv: int, tp: int, op: str) -> bool:
     """True when tp divides both query and KV heads, i.e. the explicit
-    head-parallel shard_map can split the kernel. Logs the violated
-    constraint so fallbacks name their actual cause (trace-time only)."""
+    head-parallel shard_map can split the kernel. Demotions name the
+    violated constraint (trace-time only)."""
     if tp <= 1 or (n_kv % tp == 0 and n_heads % tp == 0):
         return True
-    import logging
-
-    logging.getLogger("dynamo_tpu.ops").warning(
-        "pallas %s: tp=%d does not divide query heads (%d) / KV heads (%d); "
-        "using the XLA path", op, tp, n_heads, n_kv,
-    )
+    _note_fallback(
+        op, "head_gate",
+        f"tp={tp} does not divide query heads ({n_heads}) / "
+        f"KV heads ({n_kv})")
     return False
 
 
 def _pallas_lane_gate(kvd: int, tp: int, op: str) -> bool:
     """True when the per-shard fused KV*D lane dim is 128-aligned — the TPU
-    DMA constraint both paged Pallas kernels share."""
+    DMA constraint all paged Pallas kernels share."""
     if (kvd // max(tp, 1)) % 128 == 0:
         return True
-    import logging
-
-    logging.getLogger("dynamo_tpu.ops").warning(
-        "pallas %s needs the per-shard KV*D lane dim 128-aligned (got %d "
-        "over tp=%d); falling back to the XLA gather path", op, kvd, tp,
-    )
+    _note_fallback(
+        op, "lane_gate",
+        f"per-shard KV*D lane dim not 128-aligned (KV*D={kvd}, tp={tp})")
     return False
 
 
@@ -627,12 +775,8 @@ def paged_attention_decode(
         # long-context (seq) mesh: the pool is GSPMD-sharded on `model`,
         # and an unannotated pallas_call would force an all-gather of the
         # whole pool per step — the XLA gather path partitions cleanly
-        if _explicit_backend() is not None:
-            import logging
-
-            logging.getLogger("dynamo_tpu.ops").warning(
-                "pallas decode is unavailable under a sequence-parallel "
-                "mesh; using the XLA gather path")
+        _note_fallback("decode", "seq_mesh",
+                       "sequence-parallel mesh shards the pool under GSPMD")
         backend = "xla"
     mesh = _mesh_for_shard_map()
     if windowed:
@@ -665,13 +809,9 @@ def paged_attention_decode(
         # count must equal the layout blocking (each shard then sees its own
         # [values | scales | pad] block). Engine-built configs always match;
         # mismatches (e.g. head gate dropped the mesh) fall back.
-        if _explicit_backend() is not None:
-            import logging
-
-            logging.getLogger("dynamo_tpu.ops").warning(
-                "pallas decode on int8 KV needs the mesh TP (%d) to equal "
-                "the pool's lane blocking (%d); using the XLA gather path",
-                _mesh_tp(mesh), lb)
+        _note_fallback(
+            "decode", "int8_lane_blocks",
+            f"mesh TP ({_mesh_tp(mesh)}) != pool lane blocking ({lb})")
         backend = "xla"
     tp_eff = _mesh_tp(mesh)
     n_kv_call = n_kv // tp_eff  # per-shard KV heads seen by the inner call

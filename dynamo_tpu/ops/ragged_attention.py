@@ -1,0 +1,332 @@
+"""Pallas TPU ragged paged-attention kernel: one mixed prefill+decode launch.
+
+The RPA-style unification (PAPERS.md, arxiv 2604.15464): instead of separate
+decode / chunk-prefill programs, ONE kernel serves a ragged batch described by
+per-sequence `(q_start, q_len, kv_len)` descriptors over the same paged KV
+pool. Decode rows are length-1 "chunks"; a prefill chunk is a long row. Both
+are cut into query blocks and laid on a single sequential grid, so prefill
+tokens ride the same launch as decode slots instead of preempting them — the
+scheduling shape that collapses the engine's fused-window zoo (see
+`dynamo_tpu.engine` mixed step).
+
+Kernel anatomy is deliberately identical to `_chunk_kernel` /`_decode_kernel`
+in `pallas_attention.py` (page-major fused-head KV, multi-page superblock DMA
+ring pipelined across a sequential grid via a persistent SMEM cursor,
+block-diagonal GQA matmuls, int8 packed-scale rows dequantized in-VMEM):
+
+- Grid is `(num_q_blocks, nk_max)` where the first `num_decode` query blocks
+  are the decode slots (one real row each, padded to `block_q`) and the rest
+  tile the prefill chunk `block_q` tokens at a time.
+- Scalar-prefetched descriptor arrays drive everything ragged:
+  `tables_ref [R, W]` (row r = sequence r's page table, trash-padded; the
+  last row is the chunk's), `kvlen_ref [R]` (attention horizon per sequence,
+  INCLUDING the tokens written this step) and `qstart_ref [R]` (absolute
+  position of the sequence's first query token).
+- The per-query-block KV block count is derived from its causal horizon
+  clamped to the sequence's kv_len, so decode blocks fetch exactly their
+  context and chunk blocks exactly their prefix — the DMA pipeline crosses
+  sequence boundaries without bubbles, which is the whole point: short decode
+  rows and long prefill rows share one software pipeline.
+- Masking is causal in absolute positions (`tok <= q_pos`) AND bounded by the
+  sequence horizon (`tok < kv_len`), which keeps the decode padding rows
+  (whose outputs are discarded) from touching garbage pages past their
+  context.
+
+NaN-safety mirrors the house kernels: token 0 is unmasked for every row of
+every sequence at its first KV block (`q_start >= 0`, `kv_len >= 1`), so the
+running max is finite from the first `_flash_update` on.
+
+Hardware-validation gating follows the CHUNK_KERNEL convention: while
+`RAGGED_KERNEL_HW_VALIDATED` is False the dispatch in `attention.py` keeps
+the XLA composition as default and the kernel is env-opt-in
+(`DYNAMO_TPU_RAGGED_ATTENTION=pallas`); interpret mode cannot validate the
+Mosaic lowering, only an on-chip parity battery can flip the flag.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dynamo_tpu.ops.pallas_attention import (
+    DEFAULT_BLOCK_PAGES,
+    DEFAULT_NUM_BUFS,
+    NEG_INF,
+    _CompilerParams,
+    _dequant_rows,
+    _flash_normalize,
+    _flash_reset,
+    _flash_update,
+)
+
+# Flipped True once the TPU battery's ragged_kernel_parity case (mixed
+# decode+chunk batch vs the XLA composition, bf16 and int8) passes on a real
+# chip. Until then `ragged_mixed_attention` defaults to the XLA path on every
+# backend and DYNAMO_TPU_RAGGED_ATTENTION=pallas opts in for the battery run.
+RAGGED_KERNEL_HW_VALIDATED = False
+
+
+def _ragged_kernel(
+    # scalar prefetch
+    tables_ref,  # [R, W] int32 page tables (row R-1 = the prefill chunk's)
+    kvlen_ref,  # [R] int32 attention horizon per sequence (incl. this step)
+    qstart_ref,  # [R] int32 absolute position of the first query token
+    # inputs
+    q_ref,  # [1, BQ, H, D] VMEM block (one ragged query block)
+    k_hbm,  # [P, ps, KVD] in ANY/HBM — manually DMA'd
+    v_hbm,  # [P, ps, KVD]
+    o_ref,  # [1, BQ, H, D]
+    # scratch (persistent across the sequential grid)
+    kbuf,  # [NBUF, SB, ps, KVD]
+    vbuf,  # [NBUF, SB, ps, KVD]
+    qbd_ref,  # [BQ*H, KVD] f32 — block-diagonal queries, built once per qb
+    m_ref,  # [BQ*H, 128] f32
+    l_ref,  # [BQ*H, 128] f32
+    acc_ref,  # [BQ*H, KVD] f32
+    ptr_ref,  # SMEM [4]: consumed count, issue cursor (qb, kb), issued count
+    sem,  # DMA semaphores [NBUF, 2, SB]
+    *,
+    page_size: int,
+    table_width: int,
+    block_pages: int,
+    block_q: int,
+    num_bufs: int,
+    num_decode: int,
+    n_kv: int,
+    scale: float,
+    lane_width: int,
+    quantized: bool,
+):
+    qb = pl.program_id(0)
+    kb = pl.program_id(1)
+    nq = pl.num_programs(0)
+    tokens_per_block = block_pages * page_size
+    h, d = q_ref.shape[2], q_ref.shape[3]
+    group = h // n_kv
+    rows = block_q * h
+    kvd = n_kv * d
+
+    def seq_row(qq):
+        # query blocks 0..num_decode-1 are the decode slots; every later
+        # block belongs to the single prefill chunk (descriptor row
+        # num_decode)
+        return jnp.minimum(qq, num_decode)
+
+    def q_off(qq):
+        # the block's token offset within its sequence's query span
+        return jnp.maximum(qq - num_decode, 0) * block_q
+
+    def block_copies(qq, kk, slot):
+        r = seq_row(qq)
+        out = []
+        for j in range(block_pages):
+            pg = tables_ref[
+                r, jnp.minimum(kk * block_pages + j, table_width - 1)]
+            out.append(pltpu.make_async_copy(
+                k_hbm.at[pg], kbuf.at[slot, j], sem.at[slot, 0, j]))
+            out.append(pltpu.make_async_copy(
+                v_hbm.at[pg], vbuf.at[slot, j], sem.at[slot, 1, j]))
+        return out
+
+    def n_blocks(qq):
+        # causal horizon of block qq clamped to its sequence's kv length
+        # (a decode block stops at its context; a chunk block never reads
+        # past the chunk end). Clamped >= 1 so every block owns at least
+        # one pipeline step — breaking issue/consume pairing would corrupt
+        # the DMA slot parity.
+        r = seq_row(qq)
+        horizon = jnp.minimum(qstart_ref[r] + q_off(qq) + block_q,
+                              kvlen_ref[r])
+        horizon = jnp.maximum(horizon, 1)
+        return (horizon + tokens_per_block - 1) // tokens_per_block
+
+    def issue_one():
+        iq, ik = ptr_ref[1], ptr_ref[2]
+
+        @pl.when(iq < nq)
+        def _():
+            slot = jax.lax.rem(ptr_ref[3], num_bufs)
+            for c in block_copies(iq, ik, slot):
+                c.start()
+            ptr_ref[3] = ptr_ref[3] + 1
+            nxt = ik + 1
+            done = nxt >= n_blocks(iq)
+            ptr_ref[1] = jnp.where(done, iq + 1, iq)
+            ptr_ref[2] = jnp.where(done, 0, nxt)
+
+    nb_q = n_blocks(qb)
+
+    @pl.when((qb == 0) & (kb == 0))
+    def _init():
+        ptr_ref[0] = 0  # consumed-block count
+        ptr_ref[1] = 0  # issue cursor: query block
+        ptr_ref[2] = 0  # issue cursor: kv block within it
+        ptr_ref[3] = 0  # issued-block count
+        for _ in range(num_bufs - 1):
+            issue_one()
+
+    @pl.when(kb < nb_q)
+    def _active():
+        cnt = ptr_ref[0]
+        cur = jax.lax.rem(cnt, num_bufs)
+        issue_one()
+        for c in block_copies(qb, kb, cur):
+            c.wait()
+        ptr_ref[0] = cnt + 1
+
+        row_kv = (jax.lax.broadcasted_iota(jnp.int32, (rows, kvd), 0)
+                  % h) // group
+        lane_kv = jax.lax.broadcasted_iota(jnp.int32, (rows, kvd), 1) // d
+        bd_mask = row_kv == lane_kv
+
+        @pl.when(kb == 0)
+        def _reset():
+            _flash_reset(m_ref, l_ref, acc_ref)
+            q = q_ref[0].astype(jnp.float32).reshape(rows, d) * scale
+            qbd_ref[...] = jnp.where(bd_mask, jnp.tile(q, (1, n_kv)), 0.0)
+
+        if quantized:
+            k = _dequant_rows(kbuf[cur].reshape(tokens_per_block, lane_width),
+                              n_kv, d, lane_width)
+            v = _dequant_rows(vbuf[cur].reshape(tokens_per_block, lane_width),
+                              n_kv, d, lane_width)
+        else:
+            k = kbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+            v = vbuf[cur].reshape(tokens_per_block, kvd).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qbd_ref[...], k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rows, T]
+        tok = kb * tokens_per_block + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        r = seq_row(qb)
+        qpos = qstart_ref[r] + q_off(qb) + (
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // h
+        )
+        s = jnp.where((tok <= qpos) & (tok < kvlen_ref[r]), s, NEG_INF)
+        _flash_update(m_ref, l_ref, acc_ref, s, v)
+
+        @pl.when(kb == nb_q - 1)
+        def _finalize():
+            out = _flash_normalize(l_ref, acc_ref)  # [rows, KVD]
+            out = jnp.where(bd_mask, out, 0.0)
+            folded = out[:, 0:d]
+            for kv in range(1, n_kv):
+                folded = folded + out[:, kv * d:(kv + 1) * d]
+            o_ref[0] = folded.reshape(block_q, h, d).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(
+    q: jax.Array,  # [num_decode + C, H, D] — decode rows first, then chunk
+    k_pages: jax.Array,  # [P, ps, KV*D] (or int8 packed single-block rows)
+    v_pages: jax.Array,
+    tables: jax.Array,  # [num_decode + 1, W] int32 (last row = chunk pages)
+    kv_lens: jax.Array,  # [num_decode + 1] int32 horizons incl. this step
+    q_starts: jax.Array,  # [num_decode + 1] int32 first-query positions
+    *,
+    page_size: int,
+    num_kv_heads: int,
+    num_decode: int,
+    block_q: int = 8,
+    block_pages: int = DEFAULT_BLOCK_PAGES,
+    num_bufs: int = DEFAULT_NUM_BUFS,
+    interpret: bool = False,
+) -> jax.Array:
+    """Mixed ragged batch: `num_decode` single-token rows (one padded query
+    block each) plus ONE prefill chunk of C tokens tiled into blocks, all on
+    one sequential grid. Returns [num_decode + C, H, D]."""
+    total, n_heads, head_dim = q.shape
+    c = total - num_decode
+    assert c >= 1, "ragged batch needs a prefill chunk (use decode kernel)"
+    lane_width = k_pages.shape[2]
+    quantized = k_pages.dtype == jnp.int8
+    kvd = num_kv_heads * head_dim
+    if quantized:
+        assert lane_width >= kvd + 2 * num_kv_heads, (lane_width, kvd)
+    else:
+        assert lane_width == kvd, (lane_width, num_kv_heads, head_dim)
+    width = tables.shape[1]
+    assert tables.shape[0] == num_decode + 1, tables.shape
+    block_pages = max(1, min(block_pages, width))
+    num_bufs = max(2, num_bufs)
+    # largest power-of-two divisor of c not exceeding the requested block
+    # (chunks are page multiples, not necessarily block_q multiples)
+    block_q = max(1, min(block_q, c))
+    while c % block_q != 0:
+        block_q //= 2
+    n_chunk_blocks = c // block_q
+    nbq = num_decode + n_chunk_blocks
+    nk_max = -(-width // block_pages)
+    scale = 1.0 / (head_dim**0.5)
+    rows = block_q * n_heads
+
+    # decode rows each get their own zero-padded query block; the chunk is
+    # tiled block_q tokens per block
+    q_dec = jnp.zeros((num_decode, block_q, n_heads, head_dim), q.dtype)
+    if num_decode:
+        q_dec = q_dec.at[:, 0].set(q[:num_decode])
+    q4 = jnp.concatenate(
+        [q_dec,
+         q[num_decode:].reshape(n_chunk_blocks, block_q, n_heads, head_dim)],
+        axis=0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nbq, nk_max),
+        in_specs=[
+            pl.BlockSpec((1, block_q, n_heads, head_dim),
+                         lambda qb, kb, tb, kl, qs: (qb, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, n_heads, head_dim),
+            lambda qb, kb, tb, kl, qs: (qb, 0, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((num_bufs, block_pages, page_size, lane_width),
+                       k_pages.dtype),
+            pltpu.VMEM((num_bufs, block_pages, page_size, lane_width),
+                       v_pages.dtype),
+            pltpu.VMEM((rows, kvd), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, kvd), jnp.float32),
+            pltpu.SMEM((4,), jnp.int32),
+            pltpu.SemaphoreType.DMA((num_bufs, 2, block_pages)),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_kernel,
+        page_size=page_size,
+        table_width=width,
+        block_pages=block_pages,
+        block_q=block_q,
+        num_bufs=num_bufs,
+        num_decode=num_decode,
+        n_kv=num_kv_heads,
+        scale=scale,
+        lane_width=lane_width,
+        quantized=quantized,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nbq, block_q, n_heads, head_dim),
+                                       q.dtype),
+        compiler_params=_CompilerParams(
+            # sequential on purpose: the DMA pipeline carries state across
+            # grid steps (see module docstring)
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      q_starts.astype(jnp.int32), q4, k_pages, v_pages)
+    return jnp.concatenate(
+        [out[:num_decode, 0],
+         out[num_decode:].reshape(c, n_heads, head_dim)], axis=0)

@@ -132,6 +132,37 @@ class CallbackCounter(_Metric):
                 f"# TYPE {self.name} counter", f"{self.name} {v}"]
 
 
+class CallbackCounterVec(_Metric):
+    """Labeled CallbackCounter: the callback returns a mapping from a
+    label tuple (or dict) to a cumulative value, read at scrape time —
+    for per-label-set counts kept in another subsystem's own bookkeeping
+    (e.g. the attention dispatch's Pallas→XLA demotion counts by
+    op/reason, ops/attention.pallas_fallback_counts)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help_, registry, fn,
+                 labelnames: Sequence[str] = ()):
+        super().__init__(name, help_, registry, labelnames)
+        self._fn = fn
+
+    def expose(self, openmetrics: bool = False) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        try:
+            items = self._fn() or {}
+        except Exception:
+            items = {}
+        rows = []
+        for lbl, v in items.items():
+            if isinstance(lbl, dict):
+                lbl = tuple(sorted(lbl.items()))
+            rows.append((tuple(lbl), float(v)))
+        for lbl, v in sorted(rows) or self._default_items():
+            out.append(f"{self.name}{_fmt_labels(lbl)} {v}")
+        return out
+
+
 class Gauge(_Metric):
     kind = "gauge"
 

@@ -1,0 +1,457 @@
+"""Unified ragged paged-attention step suite (make rpa-check, marker `rpa`).
+
+Three layers, mirroring the subsystem (docs/perf.md "Unified ragged step"):
+
+- op level: the Pallas ragged kernel (interpret mode) against the XLA
+  composition (decode gather + chunk gather) on mixed batches whose
+  mid-prefill rows cross page boundaries, bf16-pool and int8-packed;
+- engine level (enforce_eager, cheap for tier-1): the mixed step's greedy
+  outputs are token-identical to the classic chunk/decode alternation —
+  plain, LoRA-mixed, under preemption/recovery, and with namespaced
+  prefix-cache hits re-entering mid-chunk;
+- acceptance (jitted, marker `slow`, still run by `make rpa-check` /
+  `make test-full`): the same identity through the donated jit programs,
+  LoRA and int8-KV included, plus the prefill_interference bench contract.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+
+pytestmark = pytest.mark.rpa
+
+PROMPT = [(i * 11) % 300 + 1 for i in range(50)]
+
+
+def _mk(mixed, **kw):
+    base = dict(model="tiny-debug", page_size=4, num_pages=256,
+                max_num_seqs=4, max_seq_len=256, enforce_eager=True,
+                prefill_chunk_tokens=8, mixed_batch_tokens=mixed)
+    base.update(kw)
+    return Engine(EngineConfig(**base))
+
+
+def _collect(out, evs):
+    for ev in evs:
+        if ev.token_id >= 0:
+            out.setdefault(ev.request_id, []).append(ev.token_id)
+
+
+def _interference(eng, prompt=None, live_tokens=10, long_tokens=4):
+    """A live greedy stream + a long prompt arriving mid-decode: the shape
+    that exercises the mixed step (or the classic alternation when off).
+    EVERY step's events are collected — the two A/B arms admit on different
+    steps, so dropping warm-up events would skew one arm's token list."""
+    out = {"live": [], "long": []}
+    eng.add_request(GenRequest("live", [1, 2, 3], max_tokens=live_tokens,
+                               temperature=0.0, ignore_eos=True))
+    for _ in range(3):
+        _collect(out, eng.step())
+    eng.add_request(GenRequest("long", prompt or PROMPT,
+                               max_tokens=long_tokens,
+                               temperature=0.0, ignore_eos=True))
+    while eng.has_work:
+        _collect(out, eng.step())
+    return out
+
+
+# ------------------------------------------------------------- op parity --
+
+
+def _mixed_inputs(rng, quantized, ps=16, n_pool=64, b=3, h=8, n_kv=2, d=64,
+                  pmax=6, c=32, start=16, wp=5):
+    """Mixed ragged batch whose rows cross page boundaries: a 1-token
+    context, a mid-page context (2 pages + 5), a full-table context, plus a
+    32-token chunk starting mid-prompt at token 16 (page 1)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops import attention as att
+
+    kf = rng.normal(size=(n_pool * ps, n_kv, d)).astype(np.float32)
+    vf = rng.normal(size=(n_pool * ps, n_kv, d)).astype(np.float32)
+    if quantized:
+        w = att.kv_lane_width(n_kv, d, True)
+        kp = att.pack_kv_rows(jnp.asarray(kf), w).reshape(n_pool, ps, w)
+        vp = att.pack_kv_rows(jnp.asarray(vf), w).reshape(n_pool, ps, w)
+    else:
+        kp = jnp.asarray(kf.reshape(n_pool, ps, n_kv * d))
+        vp = jnp.asarray(vf.reshape(n_pool, ps, n_kv * d))
+    q = jnp.asarray(rng.normal(size=(b + c, h, d)), jnp.float32)
+    # disjoint non-zero page ids per sequence; trash-padded tails
+    tables = np.zeros((b, pmax), np.int32)
+    tables[0, :1] = [1]
+    tables[1, :3] = [2, 3, 4]
+    tables[2, :pmax] = np.arange(10, 10 + pmax)
+    ctx = jnp.asarray([1, 2 * ps + 5, ps * pmax], jnp.int32)
+    p_pages = jnp.asarray([20, 21, 22, 23, 24][:wp], jnp.int32)
+    return q, kp, vp, jnp.asarray(tables), ctx, p_pages, start
+
+
+@pytest.mark.parametrize("quantized", [False, True],
+                         ids=["f32_pool", "int8_pool"])
+def test_ragged_kernel_matches_xla_composition(monkeypatch, quantized):
+    """The Pallas ragged kernel (interpret mode) is numerically equivalent
+    to the per-path reference composition on a mixed batch with
+    page-boundary-crossing mid-prefill rows — bf16 and int8-packed pools."""
+    from dynamo_tpu.ops import attention as att
+
+    rng = np.random.default_rng(17)
+    q, kp, vp, tabs, ctx, pp, start = _mixed_inputs(rng, quantized)
+
+    def run(backend):
+        monkeypatch.setenv("DYNAMO_TPU_RAGGED_ATTENTION", backend)
+        return att.ragged_mixed_attention(
+            q, kp, vp, tabs, ctx, pp, start, page_size=16,
+            num_kv_heads=2, num_decode=3)
+
+    ref = run("xla")
+    out = run("pallas_interpret")
+    assert not np.any(np.isnan(np.asarray(out)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_kernel_gated_until_hw_validated(monkeypatch):
+    """With no env override the ragged dispatch stays on the XLA
+    composition until RAGGED_KERNEL_HW_VALIDATED flips; once flipped it
+    follows the engine's scoped attention backend (CHUNK_KERNEL idiom)."""
+    from dynamo_tpu.ops import attention as att
+    from dynamo_tpu.ops import ragged_attention as ra
+
+    rng = np.random.default_rng(3)
+    q, kp, vp, tabs, ctx, pp, start = _mixed_inputs(rng, False)
+    monkeypatch.delenv("DYNAMO_TPU_RAGGED_ATTENTION", raising=False)
+
+    calls = []
+    real = ra.ragged_paged_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(ra, "ragged_paged_attention", spy)
+    with att.attention_context("pallas_interpret", None):
+        monkeypatch.setattr(ra, "RAGGED_KERNEL_HW_VALIDATED", False)
+        att.ragged_mixed_attention(q, kp, vp, tabs, ctx, pp, start,
+                                   page_size=16, num_kv_heads=2,
+                                   num_decode=3)
+        assert not calls  # not validated: XLA path even under pallas ctx
+        monkeypatch.setattr(ra, "RAGGED_KERNEL_HW_VALIDATED", True)
+        att.ragged_mixed_attention(q, kp, vp, tabs, ctx, pp, start,
+                                   page_size=16, num_kv_heads=2,
+                                   num_decode=3)
+        assert calls  # validated: follows the engine backend
+
+
+def test_ragged_gate_demotion_is_counted(monkeypatch):
+    """A lane-gate demotion (64-lane KV span, below the 128-lane minimum)
+    lands in pallas_fallback_counts under ("ragged attention", ...) — the
+    series dynamo_pallas_fallback_total exposes (observability satellite)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops import attention as att
+
+    rng = np.random.default_rng(5)
+    ps, n_kv, d, h = 4, 2, 32, 4  # span 64: fails the lane gate
+    kp = jnp.asarray(rng.normal(size=(16, ps, n_kv * d)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(2 + 4, h, d)), jnp.float32)
+    tabs = jnp.asarray([[1, 0], [2, 3]], jnp.int32)
+    ctx = jnp.asarray([2, 7], jnp.int32)
+    pp = jnp.asarray([4, 5], jnp.int32)
+    monkeypatch.setenv("DYNAMO_TPU_RAGGED_ATTENTION", "pallas_interpret")
+    before = dict(att.pallas_fallback_counts())
+    out = att.ragged_mixed_attention(q, kp, kp, tabs, ctx, pp, 0,
+                                     page_size=ps, num_kv_heads=n_kv,
+                                     num_decode=2)
+    assert out.shape == q.shape
+    after = att.pallas_fallback_counts()
+    ragged_keys = [k for k in after if k[0] == "ragged attention"
+                   and after[k] > before.get(k, 0)]
+    assert ragged_keys, f"no ragged demotion counted: {after}"
+
+
+# -------------------------------------------------- engine mixed (eager) --
+
+
+def test_mixed_config_normalization():
+    """mixed_batch_tokens page-aligns at init and an unset chunk size
+    inherits the budget (mixed implies chunked prefill)."""
+    eng = _mk(10, prefill_chunk_tokens=0)
+    assert eng.cfg.mixed_batch_tokens == 12  # ceil(10/4)*4
+    assert eng.cfg.prefill_chunk_tokens == 12
+
+
+def test_mixed_step_matches_classic_greedy():
+    """Tentpole identity: live stream + long prompt through the unified
+    ragged step produce exactly the classic chunk/decode tokens, and the
+    mixed path actually ran (mixed_step phase + composition stats)."""
+    classic = _interference(_mk(0), prompt=PROMPT[:32])
+    eng = _mk(8)
+    mixed = _interference(eng, prompt=PROMPT[:32])
+    assert mixed == classic
+    assert eng.metrics.mixed_count >= 3
+    snap = eng.metrics.snapshot()
+    assert snap["phases"]["mixed_step"]["count"] == eng.metrics.mixed_count
+    assert 0.0 < snap["mixed_frac_mean"] <= 1.0
+
+
+def test_mixed_idle_engine_single_request_matches():
+    """An idle engine still takes the full-prefill fast path under mixed
+    mode; output identity with the classic engine holds trivially."""
+    ref = _mk(0).generate(GenRequest("r", PROMPT[:32], max_tokens=6,
+                                     temperature=0.0, ignore_eos=True))
+    out = _mk(8).generate(GenRequest("r", PROMPT[:32], max_tokens=6,
+                                     temperature=0.0, ignore_eos=True))
+    assert out == ref
+
+
+def test_mixed_seeded_sampling_matches_classic():
+    """Same fold_in(slot_key, position) PRNG chains ride the mixed program:
+    seeded non-greedy sampling is identical too."""
+    kw = dict(max_tokens=6, temperature=0.8, top_p=0.9, seed=123,
+              ignore_eos=True)
+    classic, mixed = [], []
+    for dst, m in ((classic, 0), (mixed, 8)):
+        eng = _mk(m)
+        toks = {}
+        eng.add_request(GenRequest("live", [9, 8, 7], **kw))
+        for _ in range(3):
+            _collect(toks, eng.step())
+        eng.add_request(GenRequest("long", PROMPT[:32], **kw))
+        while eng.has_work:
+            _collect(toks, eng.step())
+        dst.append(toks)
+    assert mixed == classic
+
+
+def test_mixed_lora_parity_with_classic():
+    """LoRA threads through the mixed program unchanged: adapter + base
+    streams decoding while an adapter prompt prefills give the classic
+    path's tokens exactly."""
+    import jax
+
+    from dynamo_tpu.lora import apply as lora_apply
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    mcfg = ModelConfig()
+    params = llama.init_params(mcfg, jax.random.PRNGKey(0))
+    ada = lora_apply.random_adapter(mcfg, rank=4, seed=1, scale=0.3)
+
+    def run(mixed):
+        eng = Engine(EngineConfig(
+            model="tiny-debug", page_size=4, num_pages=128, max_num_seqs=4,
+            max_seq_len=96, enforce_eager=True, prefill_chunk_tokens=8,
+            mixed_batch_tokens=mixed, lora_slots=2, lora_rank=4),
+            params=dict(params))
+        eng.lora.register("ada", tensors=ada, rank=4)
+        out = {}
+        eng.add_request(GenRequest("base", [1, 2, 3], max_tokens=8,
+                                   temperature=0.0, ignore_eos=True))
+        eng.add_request(GenRequest("alive", [4, 5, 6], max_tokens=8,
+                                   temperature=0.0, ignore_eos=True,
+                                   adapter="ada"))
+        for _ in range(4):
+            _collect(out, eng.step())
+        eng.add_request(GenRequest("along", PROMPT[:32], max_tokens=4,
+                                   temperature=0.0, ignore_eos=True,
+                                   adapter="ada"))
+        while eng.has_work:
+            _collect(out, eng.step())
+        return out, eng
+
+    classic, _ = run(0)
+    mixed, eng = run(8)
+    assert mixed == classic
+    assert eng.metrics.mixed_count >= 1
+
+
+def test_mixed_preemption_recovery_matches_classic():
+    """Page pressure mid-mixed-step: preemption + automatic recovery leave
+    greedy outputs identical to the classic path under the same pressure."""
+    kw = dict(num_pages=16, max_num_seqs=3, max_seq_len=96)
+
+    def run(mixed):
+        eng = _mk(mixed, **kw)
+        reqs = [GenRequest(f"s{i}", [(i * 7 + j) % 90 + 1 for j in range(6)],
+                           max_tokens=14, temperature=0.0, ignore_eos=True)
+                for i in range(2)]
+        out = {}
+        for r in reqs:
+            eng.add_request(r)
+        for _ in range(3):
+            _collect(out, eng.step())
+        eng.add_request(GenRequest("long", PROMPT[:32], max_tokens=4,
+                                   temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            _collect(out, eng.step())
+        return out, eng.metrics.num_preempted
+
+    classic, pre_c = run(0)
+    mixed, pre_m = run(8)
+    assert mixed == classic
+    assert pre_m >= 1, "scenario must actually exercise preemption"
+
+
+def test_prefix_cache_namespaces_hold_through_mixed_chunks():
+    """Satellite: the prefix-caching x chunked-prefill exclusion is lifted
+    for the ragged path; cached prefixes re-enter as mid-prompt chunks
+    through the mixed step, and adapter namespaces never cross."""
+    import jax
+
+    from dynamo_tpu.lora import apply as lora_apply
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    mcfg = ModelConfig()
+    params = llama.init_params(mcfg, jax.random.PRNGKey(0))
+    eng = Engine(EngineConfig(
+        model="tiny-debug", page_size=4, num_pages=128, max_num_seqs=4,
+        max_seq_len=96, enforce_eager=True, enable_prefix_caching=True,
+        mixed_batch_tokens=8, lora_slots=2, lora_rank=4),
+        params=dict(params))
+    assert eng.prefix_cache is not None  # exclusion lifted for mixed mode
+    eng.lora.register("ada",
+                      tensors=lora_apply.random_adapter(mcfg, rank=4,
+                                                        seed=1, scale=0.3),
+                      rank=4)
+    prompt = PROMPT[:24]
+
+    def gen(rid, adapter):
+        # a live stream keeps the batch busy so the prompt's chunks (cached
+        # prefix re-entry included) ride the mixed step, not idle prefill
+        eng.add_request(GenRequest(f"{rid}-live", [1, 2, 3], max_tokens=6,
+                                   temperature=0.0, ignore_eos=True))
+        for _ in range(2):
+            eng.step()
+        eng.add_request(GenRequest(rid, prompt, max_tokens=4,
+                                   temperature=0.0, ignore_eos=True,
+                                   adapter=adapter))
+        toks = []
+        while eng.has_work:
+            for ev in eng.step():
+                if ev.request_id == rid and ev.token_id >= 0:
+                    toks.append(ev.token_id)
+        return toks
+
+    first = gen("a1", "ada")
+    hits_after_insert = eng.prefix_cache.hits
+    base = gen("b1", None)  # same tokens, base namespace: must NOT hit
+    assert eng.prefix_cache.hits == hits_after_insert, \
+        "base request hit an adapter-namespaced prefix"
+    assert base  # base run completed (its own namespace, fresh prefill)
+    second = gen("a2", "ada")  # same namespace: hits, identical tokens
+    assert eng.prefix_cache.hits > hits_after_insert
+    assert second == first
+    assert eng.metrics.mixed_count >= 1
+
+
+def test_mixed_abort_mid_prefill_releases_pages():
+    eng = _mk(8)
+    eng.add_request(GenRequest("live", [1, 2, 3], max_tokens=20,
+                               temperature=0.0, ignore_eos=True))
+    eng.step()
+    free0 = eng.allocator.free_pages
+    eng.add_request(GenRequest("long", PROMPT, max_tokens=4,
+                               temperature=0.0, ignore_eos=True))
+    for _ in range(2):
+        eng.step()  # inflight started, at least one mixed step ran
+    assert eng._inflight is not None
+    eng.abort_request("long")
+    evs = eng.step()
+    assert any(e.request_id == "long" and e.finish_reason == "abort"
+               for e in evs)
+    assert eng._inflight is None
+    eng.abort_request("live")
+    while eng.has_work:
+        eng.step()
+    assert eng.allocator.free_pages >= free0
+
+
+# ------------------------------------------------- jitted acceptance bar --
+
+
+@pytest.mark.slow
+def test_mixed_jit_acceptance_matches_classic():
+    """Acceptance: the donated jitted mixed program (LoRA in-batch) is
+    token-identical to the classic jitted chunk/decode path."""
+    import jax
+
+    from dynamo_tpu.lora import apply as lora_apply
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    mcfg = ModelConfig()
+    params = llama.init_params(mcfg, jax.random.PRNGKey(0))
+    ada = lora_apply.random_adapter(mcfg, rank=4, seed=1, scale=0.3)
+
+    def run(mixed):
+        eng = Engine(EngineConfig(
+            model="tiny-debug", page_size=4, num_pages=128, max_num_seqs=4,
+            max_seq_len=96, prefill_chunk_tokens=8,
+            mixed_batch_tokens=mixed, lora_slots=2, lora_rank=4),
+            params=dict(params))
+        eng.lora.register("ada", tensors=ada, rank=4)
+        out = {}
+        eng.add_request(GenRequest("live", [1, 2, 3], max_tokens=12,
+                                   temperature=0.0, ignore_eos=True))
+        eng.add_request(GenRequest("alive", [4, 5, 6], max_tokens=12,
+                                   temperature=0.0, ignore_eos=True,
+                                   adapter="ada"))
+        for _ in range(4):
+            _collect(out, eng.step())
+        eng.add_request(GenRequest("long", PROMPT, max_tokens=6,
+                                   temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            _collect(out, eng.step())
+        return out, eng
+
+    classic, _ = run(0)
+    mixed, eng = run(8)
+    assert mixed == classic
+    assert eng.metrics.mixed_count >= 1
+    assert "mixed_False" in eng._jit_handles or eng._jit_handles
+
+
+@pytest.mark.slow
+def test_mixed_jit_int8_kv_matches_classic():
+    """Acceptance: identity holds with an int8-quantized KV pool riding the
+    jitted mixed program."""
+    kw = dict(model="tiny-debug", page_size=4, num_pages=128,
+              max_num_seqs=3, max_seq_len=96, prefill_chunk_tokens=8,
+              kv_cache_dtype="int8")
+    classic = _interference(Engine(EngineConfig(mixed_batch_tokens=0, **kw)))
+    eng = Engine(EngineConfig(mixed_batch_tokens=8, **kw))
+    mixed = _interference(eng)
+    assert mixed == classic
+    assert eng.metrics.mixed_count >= 1
+
+
+# ------------------------------------------------------------ bench smoke --
+
+
+def test_prefill_interference_bench_cpu_smoke(monkeypatch):
+    """The A/B scenario runs end-to-end on CPU and honors the result
+    contract; CPU numbers are flagged non-comparable (ROADMAP constraint)."""
+    import bench
+
+    for k, v in (("BENCH_MIX_STREAMS", "2"), ("BENCH_MIX_PROMPTS", "1"),
+                 ("BENCH_MIX_PROMPT_TOKENS", "24"), ("BENCH_MIX_TOKENS", "4"),
+                 ("BENCH_MIX_BUDGET", "8")):
+        monkeypatch.setenv(k, v)
+    res = bench.bench_prefill_interference(on_tpu=False)
+    assert res["scenario"] == "prefill_interference"
+    assert res["comparable"] is False
+    assert res["metric"] == "prefill_interference_itl_p95"
+    assert res["value"] > 0
+    for arm in ("mixed_on", "mixed_off"):
+        for src in ("engine", "measured"):
+            assert res[arm][src]["itl_p95_ms"] >= res[arm][src]["itl_p50_ms"]
+    assert res["mixed_on"]["mixed_steps"] >= 1
+    assert res["mixed_off"]["mixed_steps"] == 0
+    assert res["itl_p95_speedup"] > 0

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -33,6 +33,7 @@ help:
 	@echo "  qos-check      per-tenant QoS suite (weighted-fair isolation, tenant admission, SLO-burn shed)"
 	@echo "  planner-check  coordinated autoscaling suite (pool planner, flash-crowd simulation, drain-before-shrink)"
 	@echo "  rpa-check      unified ragged-step suite (kernel parity, mixed/classic identity, bench contract)"
+	@echo "  ha-check       HA frontend plane suite (replicated journal, cross-frontend resume, fleet QoS)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -137,6 +138,18 @@ planner-check:
 # bench contract smoke.
 rpa-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_ragged_attention.py -q -p no:randomly
+
+# HA frontend plane gate (docs/robustness.md "HA frontend plane"): the
+# `ha` marker suite — /healthz readiness gating, the resume refusal
+# matrix (stale cursors must never duplicate tokens), single-winner
+# resume claims, registration-churn fix, gossip staleness — plus the
+# chaos acceptance drills: kill a frontend replica mid-stream and resume
+# byte-identically through a peer, and 10k admission decisions proving
+# per-tenant caps hold fleet-wide. Direct -m invocation, no slow filter:
+# the kill drill runs here even though tier-1 demotes it.
+ha-check:
+	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
+		python -m pytest tests/test_ha.py tests/test_chaos.py -m ha -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

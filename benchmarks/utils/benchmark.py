@@ -141,6 +141,7 @@ def _run_open_loop_scenario(args) -> int:
         return 2
     cfg = LoadConfig(
         endpoint_url=args.endpoint_url, model=args.model,
+        endpoint_urls=args.endpoint_urls,
         input_len=args.isl, max_tokens=args.osl, timeout_s=args.timeout,
         warmup_requests=(args.warmup_requests
                          if args.warmup_requests is not None else 8),
@@ -177,7 +178,11 @@ def _run_open_loop_scenario(args) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="benchmarks.utils.benchmark")
     p.add_argument("--benchmark-name", required=True)
-    p.add_argument("--endpoint-url", required=True)
+    p.add_argument("--endpoint-url", required=True,
+                   help="endpoint base URL; a comma-separated list "
+                        "round-robins across frontend replicas (HA plane: "
+                        "results carry the serving target and mid-stream "
+                        "resets resume on the next replica)")
     p.add_argument("--model", required=True)
     p.add_argument("--output-dir", required=True)
     p.add_argument("--concurrency", default="1,2,4,8",
@@ -208,6 +213,12 @@ def main(argv=None) -> int:
     p.add_argument("--base-rps", type=float, default=1.0)
     p.add_argument("--peak-rps", type=float, default=10.0)
     args = p.parse_args(argv)
+    # comma-separated --endpoint-url fans out across HA frontend replicas;
+    # the first target keeps serving the single-URL paths (server histogram
+    # scrape, report header)
+    args.endpoint_urls = [u.strip() for u in args.endpoint_url.split(",")
+                          if u.strip()]
+    args.endpoint_url = args.endpoint_urls[0]
 
     os.makedirs(args.output_dir, exist_ok=True)
     if args.schedule:
@@ -222,6 +233,7 @@ def main(argv=None) -> int:
                   else max(8, 2 * conc))
         cfg = LoadConfig(
             endpoint_url=args.endpoint_url,
+            endpoint_urls=args.endpoint_urls,
             model=args.model,
             num_requests=args.requests_per_level,
             concurrency=conc,
@@ -265,6 +277,7 @@ def main(argv=None) -> int:
     report = {
         "benchmark_name": args.benchmark_name,
         "endpoint_url": args.endpoint_url,
+        "endpoint_urls": args.endpoint_urls,
         "model": args.model,
         "num_chips": args.num_chips,
         "isl_words": args.isl,

@@ -10,8 +10,10 @@ cluster image. Consumed by `benchmarks.utils.benchmark`
 from __future__ import annotations
 
 import dataclasses
+import http.client
 import json
 import random
+import socket
 import threading
 import time
 import urllib.error
@@ -32,6 +34,8 @@ class RequestResult:
     shed: bool = False           # last attempt was a 429/503 admission shed
     retry_after_s: float = 0.0   # server's Retry-After on that shed
     retries: int = 0             # re-queues before this result
+    target: str = ""             # frontend URL that served the LAST attempt
+    resumes: int = 0             # mid-stream reconnects (dynamo_resume)
 
 
 @dataclasses.dataclass
@@ -67,6 +71,27 @@ class LoadConfig:
     schedule_params: Dict[str, Any] = dataclasses.field(
         default_factory=dict)
     max_outstanding: int = 1024   # open-loop thread-safety valve
+    # HA frontend plane: N frontend replicas behind one logical service.
+    # endpoint_urls (when non-empty) overrides endpoint_url; requests
+    # round-robin across them, results carry the serving target, and a
+    # mid-stream connection reset reconnects to the NEXT replica with a
+    # dynamo_resume cursor (docs/robustness.md "HA frontend plane")
+    endpoint_urls: List[str] = dataclasses.field(default_factory=list)
+    resume_on_reset: bool = True
+    _rr: List[int] = dataclasses.field(
+        default_factory=lambda: [0], repr=False)
+    _rr_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False)
+
+    def targets(self) -> List[str]:
+        return [u for u in self.endpoint_urls if u] or [self.endpoint_url]
+
+    def next_target(self) -> str:
+        urls = self.targets()
+        with self._rr_lock:
+            i = self._rr[0]
+            self._rr[0] = (i + 1) % len(urls)
+        return urls[i % len(urls)]
 
 
 def _synthetic_prompt(n_words: int, seed: int) -> str:
@@ -80,89 +105,131 @@ def _synthetic_prompt(n_words: int, seed: int) -> str:
 
 def run_one(cfg: LoadConfig, seed: int) -> RequestResult:
     prompt = cfg.prompt or _synthetic_prompt(cfg.input_len, seed)
-    body = json.dumps({
+    base_body: Dict[str, Any] = {
         "model": cfg.model,
         "messages": [{"role": "user", "content": prompt}],
         "max_tokens": cfg.max_tokens,
         "temperature": 0,
         "stream": True,
         "stream_options": {"include_usage": True},
-    }).encode()
-    req = urllib.request.Request(
-        cfg.endpoint_url.rstrip("/") + "/v1/chat/completions",
-        data=body, headers={"Content-Type": "application/json"}, method="POST",
-    )
-    res = RequestResult(ok=False)
+    }
+    target = cfg.next_target()
+    res = RequestResult(ok=False, target=target)
     start = time.perf_counter()
     last_tok: Optional[float] = None
     n_deltas = 0
     usage_tokens: Optional[int] = None
-    try:
-        with urllib.request.urlopen(req, timeout=cfg.timeout_s) as resp:
-            for raw in resp:
-                line = raw.decode("utf-8", "replace").strip()
-                if not line.startswith("data:"):
-                    continue
-                payload = line[len("data:"):].strip()
-                if payload == "[DONE]":
-                    break
-                try:
-                    chunk = json.loads(payload)
-                except json.JSONDecodeError:
-                    continue
-                usage = chunk.get("usage")
-                if usage:
-                    res.input_tokens = usage.get("prompt_tokens", 0)
-                    usage_tokens = usage.get("completion_tokens")
-                choices = chunk.get("choices") or []
-                if not choices:
-                    continue
-                delta = (choices[0].get("delta") or {}).get("content")
-                if delta:
-                    now = time.perf_counter()
-                    if last_tok is None:
-                        res.ttft_s = now - start
-                    else:
-                        res.itl_s.append(now - last_tok)
-                    last_tok = now
-                    n_deltas += 1
-                elif (choices[0].get("finish_reason") is not None
-                        and last_tok is None):
-                    # a stream can legally finish with NO visible text (the
-                    # detokenizer holds back bytes that never complete a
-                    # codepoint); the finish chunk is then the first — and
-                    # only — token-arrival signal, so TTFT lands there
-                    # instead of reading 0
-                    res.ttft_s = time.perf_counter() - start
-        res.latency_s = time.perf_counter() - start
-        # exact server-side count when stream usage is on; delta count otherwise
-        # (deltas may under-count: servers can batch tokens per SSE event, and
-        # some token ids decode to empty text)
-        res.output_tokens = usage_tokens if usage_tokens is not None else n_deltas
-        res.ok = res.output_tokens > 0
-        if not res.ok:
-            res.error = "no tokens streamed"
-    except urllib.error.HTTPError as e:
-        res.latency_s = time.perf_counter() - start
-        res.status = e.code
-        res.error = f"HTTP {e.code}"
-        if e.code in (429, 503):
-            # admission shed: the server is load-managing, not broken —
-            # record its Retry-After so the caller can re-queue
-            res.shed = True
-            try:
-                res.retry_after_s = float(e.headers.get("Retry-After")
-                                          or 1.0)
-            except (TypeError, ValueError):
-                res.retry_after_s = 1.0
+    # HA resume state: the client counts its OWN delivered content chars
+    # and remembers the stream's response id; on a mid-stream connection
+    # reset it re-POSTs the original body + a dynamo_resume cursor to the
+    # NEXT frontend replica, which re-emits exactly the chars past the
+    # cursor from the replicated journal (serving/ha.py)
+    response_id: Optional[str] = None
+    delivered_chars = 0
+    while True:
+        body_obj = dict(base_body)
+        if res.resumes:
+            body_obj["dynamo_resume"] = {
+                "response_id": response_id,
+                "delivered_chars": delivered_chars,
+            }
+        req = urllib.request.Request(
+            target.rstrip("/") + "/v1/chat/completions",
+            data=json.dumps(body_obj).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        saw_done = False
+        mid_stream_err: Optional[str] = None
         try:
-            e.close()
-        except Exception:  # noqa: BLE001
-            pass
-    except Exception as e:  # noqa: BLE001 — load gen records, never raises
+            with urllib.request.urlopen(req, timeout=cfg.timeout_s) as resp:
+                for raw in resp:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data:"):
+                        continue
+                    payload = line[len("data:"):].strip()
+                    if payload == "[DONE]":
+                        saw_done = True
+                        break
+                    try:
+                        chunk = json.loads(payload)
+                    except json.JSONDecodeError:
+                        continue
+                    if response_id is None and chunk.get("id"):
+                        response_id = str(chunk["id"])
+                    usage = chunk.get("usage")
+                    if usage:
+                        res.input_tokens = usage.get("prompt_tokens", 0)
+                        usage_tokens = usage.get("completion_tokens")
+                    choices = chunk.get("choices") or []
+                    if not choices:
+                        continue
+                    delta = (choices[0].get("delta") or {}).get("content")
+                    if delta:
+                        now = time.perf_counter()
+                        if last_tok is None:
+                            res.ttft_s = now - start
+                        else:
+                            res.itl_s.append(now - last_tok)
+                        last_tok = now
+                        n_deltas += 1
+                        delivered_chars += len(delta)
+                    elif (choices[0].get("finish_reason") is not None
+                            and last_tok is None):
+                        # a stream can legally finish with NO visible text
+                        # (the detokenizer holds back bytes that never
+                        # complete a codepoint); the finish chunk is then
+                        # the first — and only — token-arrival signal, so
+                        # TTFT lands there instead of reading 0
+                        res.ttft_s = time.perf_counter() - start
+        except urllib.error.HTTPError as e:
+            res.latency_s = time.perf_counter() - start
+            res.status = e.code
+            res.error = f"HTTP {e.code}"
+            if e.code in (429, 503):
+                # admission shed: the server is load-managing, not broken
+                # — record its Retry-After so the caller can re-queue
+                res.shed = True
+                try:
+                    res.retry_after_s = float(e.headers.get("Retry-After")
+                                              or 1.0)
+                except (TypeError, ValueError):
+                    res.retry_after_s = 1.0
+            try:
+                e.close()
+            except Exception:  # noqa: BLE001
+                pass
+            return res
+        except (ConnectionResetError, BrokenPipeError, ConnectionError,
+                http.client.HTTPException, socket.error) as e:
+            mid_stream_err = f"{type(e).__name__}: {e}"
+        except Exception as e:  # noqa: BLE001 — load gen records, never raises
+            res.latency_s = time.perf_counter() - start
+            res.error = f"{type(e).__name__}: {e}"
+            return res
+        if saw_done:
+            res.latency_s = time.perf_counter() - start
+            # exact server-side count when stream usage is on; delta count
+            # otherwise (deltas may under-count: servers can batch tokens
+            # per SSE event, and some token ids decode to empty text)
+            res.output_tokens = (usage_tokens if usage_tokens is not None
+                                 else n_deltas)
+            res.ok = res.output_tokens > 0
+            if not res.ok:
+                res.error = "no tokens streamed"
+            return res
+        # connection dropped (reset, or EOF without [DONE]): the frontend
+        # replica died mid-stream. Resume through the next replica if the
+        # stream is identifiable; otherwise record the failure
+        if (cfg.resume_on_reset and response_id is not None
+                and res.resumes < cfg.max_retries
+                and len(cfg.targets()) > 0):
+            res.resumes += 1
+            target = cfg.next_target()
+            res.target = target
+            continue
         res.latency_s = time.perf_counter() - start
-        res.error = f"{type(e).__name__}: {e}"
-    return res
+        res.error = mid_stream_err or "stream ended without [DONE]"
+        return res
 
 
 def run_one_with_retries(cfg: LoadConfig, seed: int,

@@ -9,6 +9,7 @@ import logging
 import os
 import signal
 import threading
+import time
 
 from dynamo_tpu.serving.frontend import FrontendContext, make_frontend_server
 
@@ -52,9 +53,37 @@ def main(argv=None):
                      ttl_s=int(args.heartbeat_ttl)).start()
     ctx = FrontendContext(router, nats_url=args.nats_url)
     srv = make_frontend_server(ctx, args.host, args.port)
+    log = logging.getLogger("dynamo_tpu.frontend")
+
+    def drain_then_stop():
+        # SIGTERM (rolling restart / scale-down): flip /healthz to 503 so
+        # the Service stops sending new streams here, then wait for
+        # in-flight requests to finish before stopping the server. Streams
+        # cut off by the hard stop are client-resumable through any peer
+        # replica (serving/ha.py).
+        ctx.draining = True
+        budget = float(os.environ.get("FRONTEND_DRAIN_S", "5"))
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            with ctx._inflight_lock:
+                n = ctx._inflight
+            if n == 0:
+                break
+            time.sleep(0.2)
+        else:
+            with ctx._inflight_lock:
+                n = ctx._inflight
+            if n:
+                log.warning("drain budget %.1fs exhausted with %d request(s)"
+                            " in flight; stopping anyway", budget, n)
+        srv.shutdown()
 
     def shutdown(*_):
-        threading.Thread(target=srv.shutdown, daemon=True).start()
+        if ctx.draining:
+            # second signal: operator means it — stop immediately
+            threading.Thread(target=srv.shutdown, daemon=True).start()
+            return
+        threading.Thread(target=drain_then_stop, daemon=True).start()
 
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)

@@ -161,6 +161,16 @@ def _container(
             c["command"] = ["python3", "-m", "dynamo_tpu.frontend"]
         else:
             c["command"] = ["python3", "-m", "dynamo_tpu.jetstream"]
+    if ctype == "frontend" and not c.get("readinessProbe"):
+        # HA frontend plane: /healthz is a REAL readiness gate (unready
+        # while the NATS subscription is down, the worker registry is
+        # empty, or the replica is draining) — the Service only routes to
+        # replicas that can actually serve
+        c["readinessProbe"] = {
+            "httpGet": {"path": "/healthz", "port": FRONTEND_PORT},
+            "periodSeconds": 5,
+            "failureThreshold": 2,
+        }
 
     env: List[Dict[str, Any]] = [
         {
@@ -169,6 +179,18 @@ def _container(
         },
         {"name": "DYNAMO_COMPONENT", "value": svc_name},
     ]
+    if ctype == "frontend":
+        # stable replica identity for journal-record origin + gossip
+        # subjects (serving/ha.py frontend_id)
+        env.append({
+            "name": "DYNAMO_TPU_FRONTEND_ID",
+            "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
+        })
+        # SIGTERM drain budget: healthz flips 503, in-flight streams get
+        # this long to finish before the hard stop (cut streams resume
+        # through a peer replica via the replicated journal)
+        env.append({"name": "FRONTEND_DRAIN_S",
+                    "value": str(drain_seconds(spec))})
     # SLO targets (observability/slo.py): `sloTargets` applies to EVERY
     # component type — the frontend tracks end-to-end burn, workers track
     # their own role's (prefill TTFT / decode ITL) burn
@@ -356,6 +378,10 @@ def _pod_spec(
         # _container) plus deregister/demote margin, or rolling restarts
         # SIGKILL pods mid-handoff
         pod["terminationGracePeriodSeconds"] = drain_seconds(spec) + 15
+    else:
+        # frontend drain (FRONTEND_DRAIN_S in _container) + margin: the
+        # replica answers 503 on /healthz while in-flight streams finish
+        pod["terminationGracePeriodSeconds"] = drain_seconds(spec) + 10
     volumes = []
     for pvc in spec.get("pvcs") or []:
         # pvcs[].create: false references an existing claim
@@ -630,6 +656,23 @@ def build_service(
     return svc
 
 
+def build_frontend_headless_service(
+    cr: Dict[str, Any], svc_name: str, spec: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Per-replica addressing for an HA frontend plane (replicas > 1).
+
+    The ClusterIP Service is the VIP clients use; this companion headless
+    Service resolves to EVERY frontend pod individually — what the chaos
+    harness, per-replica drains, and debugging (`curl <pod>.<name>-
+    headless/healthz`) need. publishNotReadyAddresses keeps draining
+    replicas resolvable so their in-flight streams stay reachable."""
+    svc = build_service(cr, svc_name, spec)
+    svc["metadata"]["name"] = svc["metadata"]["name"] + "-headless"
+    svc["spec"]["clusterIP"] = "None"
+    svc["spec"]["publishNotReadyAddresses"] = True
+    return svc
+
+
 def build_pvcs(cr: Dict[str, Any]) -> List[Dict[str, Any]]:
     """PVCs with create: true are materialized by the operator."""
     namespace = cr["metadata"].get("namespace", "default")
@@ -694,6 +737,9 @@ def materialize(
             )
         svcs.append(build_service(cr, svc_name, spec))
         ctype = spec.get("componentType", "worker")
+        if ctype == "frontend" and int(spec.get("replicas", 1)) > 1:
+            # HA frontend plane: VIP + per-replica headless companion
+            svcs.append(build_frontend_headless_service(cr, svc_name, spec))
         if gang and _gang_eligible(spec, ctype):
             podgroups.append(build_pod_group(cr, svc_name, spec))
     return {

@@ -327,7 +327,14 @@ class TenantAdmission:
     process). ``retry_after_s`` is the shed tenant's own budget-refill
     time: the EWMA of its request durations divided by its in-flight
     count — the expected wait until one of ITS slots frees — replacing
-    the global jittered constant for tenant sheds."""
+    the global jittered constant for tenant sheds.
+
+    **Fleet-wide counters (serving/ha.py)**: with N frontend replicas,
+    ``peer_counts_fn`` (wired to TenantGossip.peer_counts) folds the
+    other replicas' gossiped per-tenant in-flight into the cap and
+    over-share checks, so a tenant cannot multiply its budget by N by
+    spraying the VIP — the caps hold FLEET-wide within the gossip
+    staleness bound. Decisions stay local; only the counters widen."""
 
     EWMA_ALPHA = 0.2
 
@@ -337,6 +344,19 @@ class TenantAdmission:
         self._inflight: Dict[str, int] = {}
         self._ewma_s: Dict[str, float] = {}
         self._lock = threading.Lock()
+        # optional () -> {tenant: peer in-flight} (bounded-staleness
+        # approximate; never raises — a broken plane degrades to local)
+        self.peer_counts_fn = None
+
+    def _peer_counts(self) -> Dict[str, int]:
+        fn = self.peer_counts_fn
+        if fn is None:
+            return {}
+        try:
+            return dict(fn())
+        except Exception:
+            log.exception("tenant gossip peer view failed; using local")
+            return {}
 
     def cap(self, tenant: str) -> int:
         """Per-tenant in-flight cap (0 = unbounded)."""
@@ -354,11 +374,15 @@ class TenantAdmission:
 
     def try_admit(self, tenant: str) -> bool:
         """Reserve one in-flight slot for `tenant` unless it is at its
-        cap. The caller MUST pair a True return with release()."""
+        cap — counting gossiped peer-replica in-flight, so the cap is a
+        fleet bound, not a per-process one the tenant can multiply by
+        spraying replicas. The caller MUST pair a True return with
+        release()."""
         cap = self.cap(tenant)
+        peers = self._peer_counts().get(tenant, 0) if cap else 0
         with self._lock:
             n = self._inflight.get(tenant, 0)
-            if cap and n >= cap:
+            if cap and n + peers >= cap:
                 return False
             self._inflight[tenant] = n + 1
             return True
@@ -387,10 +411,12 @@ class TenantAdmission:
         is burning, only tenants over their share are shed.)"""
         if not self.registry.enabled:
             return False
+        peers = self._peer_counts()
         with self._lock:
-            total = sum(self._inflight.values())
-            mine = self._inflight.get(tenant, 0)
-            ws = self.registry.weights(set(self._inflight) | {tenant})
+            total = sum(self._inflight.values()) + sum(peers.values())
+            mine = (self._inflight.get(tenant, 0) + peers.get(tenant, 0))
+            ws = self.registry.weights(
+                set(self._inflight) | set(peers) | {tenant})
         wsum = sum(ws.values()) or 1.0
         return total > 0 and mine > (total * ws.get(tenant, 1.0) / wsum)
 
@@ -406,9 +432,12 @@ class TenantAdmission:
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "inflight": dict(sorted(self._inflight.items())),
                 "ewma_duration_s": {t: round(v, 4)
                                     for t, v in sorted(self._ewma_s.items())},
                 "caps": {t: self.cap(t) for t in sorted(self.registry.classes)},
             }
+        if self.peer_counts_fn is not None:
+            out["peer_inflight"] = dict(sorted(self._peer_counts().items()))
+        return out

@@ -63,7 +63,11 @@ class PrefillPool:
                  refresh_interval: float = 5.0):
         self._static = [u.strip() for u in (static_urls or []) if u.strip()]
         self._discovered: List[str] = []
-        self._frontend_url = frontend_url
+        # HA frontend plane: frontend_url may name N replicas
+        # (comma-separated); discovery asks each in turn until one answers
+        # — every replica's registry is complete on its own
+        self._frontend_urls = [u.strip() for u in (frontend_url or "").split(",")
+                               if u.strip()]
         self._lock = threading.Lock()
         if frontend_url:
             t = threading.Thread(target=self._refresh_loop,
@@ -73,17 +77,20 @@ class PrefillPool:
 
     def _refresh_loop(self, interval: float):
         while True:
-            try:
-                with urllib.request.urlopen(
-                    self._frontend_url.rstrip("/") + "/internal/workers",
-                    timeout=5,
-                ) as resp:
-                    workers = json.loads(resp.read())["workers"]
-                urls = [w["url"] for w in workers if w.get("mode") == "prefill"]
-                with self._lock:
-                    self._discovered = urls
-            except Exception as e:
-                log.debug("prefill discovery failed: %s", e)
+            for fe in self._frontend_urls:
+                try:
+                    with urllib.request.urlopen(
+                        fe.rstrip("/") + "/internal/workers",
+                        timeout=5,
+                    ) as resp:
+                        workers = json.loads(resp.read())["workers"]
+                    urls = [w["url"] for w in workers
+                            if w.get("mode") == "prefill"]
+                    with self._lock:
+                        self._discovered = urls
+                    break
+                except Exception as e:
+                    log.debug("prefill discovery via %s failed: %s", fe, e)
             time.sleep(interval)
 
     def urls(self) -> List[str]:

@@ -33,6 +33,7 @@ from dynamo_tpu.qos import tenancy as qos_tenancy
 from dynamo_tpu.robustness import faults
 from dynamo_tpu.robustness.breaker import STATE_CODES
 from dynamo_tpu.robustness.deadline import Deadline
+from dynamo_tpu.serving import ha
 from dynamo_tpu.serving import protocol as proto
 from dynamo_tpu.serving import recovery
 from dynamo_tpu.serving.http_base import JsonHTTPHandler, make_http_server
@@ -77,7 +78,8 @@ slow_request_threshold_s = obs_tracing.slow_request_threshold_s
 class FrontendContext:
     def __init__(self, router: Optional[Router] = None,
                  nats_url: Optional[str] = None,
-                 max_inflight: Optional[int] = None):
+                 max_inflight: Optional[int] = None,
+                 gossip_interval_s: Optional[float] = None):
         self.router = router or Router()
         self.metrics = FrontendMetrics()
         self.worker_gauge = Gauge(
@@ -144,8 +146,11 @@ class FrontendContext:
         )
         self.expired_counter = Counter(
             "dynamo_frontend_worker_expired_total",
-            "Workers purged because their heartbeat TTL lapsed",
-            self.metrics.registry,
+            "Workers purged because their registration refresh lapsed, by "
+            "the registration path that went quiet (direct = the worker's "
+            "own heartbeat; peer = another frontend's NATS worker-gossip "
+            "relay; etcd = a registry merge record)",
+            self.metrics.registry, labelnames=("reason",),
         )
         self.router.expired_counter = self.expired_counter
         self.breaker_open_counter = Counter(
@@ -192,6 +197,51 @@ class FrontendContext:
         # /root/reference/install-dynamo-1node.sh:241-242); HTTP remains the
         # fallback when the plane is down or unset
         self.nats = None
+        # --- HA frontend plane (serving/ha.py; docs/robustness.md "HA
+        # frontend plane") — replicated journal, resume claims, gossiped
+        # tenant counters, worker-membership relay. All of it rides the
+        # NATS plane; without a nats_url this frontend is standalone and
+        # behaves byte-identically to the pre-HA stack.
+        self.frontend_id = ha.frontend_id()
+        self.journal_plane: Optional[ha.JournalPlane] = None
+        self.tenant_gossip: Optional[ha.TenantGossip] = None
+        self.worker_gossip: Optional[ha.WorkerGossip] = None
+        self.draining = False  # flipped by SIGTERM; /healthz goes 503
+        self.ha_journal_records = Counter(
+            "dynamo_frontend_ha_journal_records_total",
+            "Recovery-journal records re-published to / applied from the "
+            "NATS journal plane, by direction",
+            self.metrics.registry, labelnames=("direction",),
+        )
+        self.ha_journal_streams = Gauge(
+            "dynamo_frontend_ha_journal_streams",
+            "Streams tracked in the replicated journal store",
+            self.metrics.registry,
+        )
+        self.ha_resumes = Counter(
+            "dynamo_frontend_ha_resumes_total",
+            "Cross-frontend stream resume attempts by outcome (resumed | "
+            "unknown = no journal record for the response id | stale_cursor "
+            "= record behind the client's delivered chars | invalid = "
+            "n-gap/missing start record | completed = stream already done | "
+            "lost_claim = another frontend won the resume | no_worker)",
+            self.metrics.registry, labelnames=("outcome",),
+        )
+        self.ha_gossip = Counter(
+            "dynamo_frontend_ha_gossip_messages_total",
+            "Tenant-counter gossip snapshots by direction",
+            self.metrics.registry, labelnames=("direction",),
+        )
+        self.ha_peer_frontends = Gauge(
+            "dynamo_frontend_ha_peer_frontends",
+            "Peer frontends with a fresh tenant-gossip snapshot",
+            self.metrics.registry,
+        )
+        self.ha_peer_inflight = Gauge(
+            "dynamo_frontend_ha_peer_inflight",
+            "Gossiped peer-replica in-flight requests by tenant",
+            self.metrics.registry, labelnames=("tenant",),
+        )
         if nats_url:
             from dynamo_tpu.serving.nats import NatsClient
 
@@ -200,6 +250,20 @@ class FrontendContext:
             # events; the router's KVEventIndex turns them into the
             # primary kv_overlap routing source (ledger = fallback)
             self.nats.subscribe("dynamo.kv_events.>", self._on_kv_event)
+            self.journal_plane = ha.JournalPlane(self.nats, self.frontend_id)
+            self.journal_plane.published_counter = self.ha_journal_records
+            self.journal_plane.applied_counter = self.ha_journal_records
+            self.tenant_gossip = ha.TenantGossip(
+                self.nats, self.frontend_id, self.tenant_admission,
+                interval_s=gossip_interval_s)
+            self.tenant_gossip.gossip_counter = self.ha_gossip
+            # fold gossiped peer counts into admission: caps/over-share
+            # become fleet-wide within the gossip staleness bound
+            self.tenant_admission.peer_counts_fn = (
+                self.tenant_gossip.peer_counts)
+            self.worker_gossip = ha.WorkerGossip(self.nats,
+                                                 self.frontend_id,
+                                                 self.router)
 
     def _on_kv_event(self, msg) -> None:
         try:
@@ -277,6 +341,25 @@ class FrontendContext:
         self._burn_cache = (now, rows)
         return rows
 
+    # ------------------------------------------------------- readiness ----
+    def readiness(self) -> tuple:
+        """(ready, detail) for /healthz — a REAL gate, not a liveness ping:
+        unready while draining, while the NATS journal/KV-event/gossip
+        subscriptions are down (this replica would journal nothing and see
+        stale counters), or while the worker registry is empty (nothing to
+        route to). The VIP's readinessProbe stops sending traffic here."""
+        workers = len(self.router.alive(("agg", "prefill", "decode")))
+        nats_ok = self.nats is None or self.nats.connected
+        detail = {
+            "workers": workers,
+            "nats": ("unconfigured" if self.nats is None
+                     else ("connected" if nats_ok else "disconnected")),
+            "draining": self.draining,
+            "frontend_id": self.frontend_id,
+        }
+        ready = workers > 0 and nats_ok and not self.draining
+        return ready, detail
+
 
 class _FrontendHandler(JsonHTTPHandler):
     ctx: FrontendContext
@@ -317,6 +400,21 @@ class _FrontendHandler(JsonHTTPHandler):
                     ctx.tenant_inflight_gauge.set(0, tenant=t)
             for t, n in inflight.items():
                 ctx.tenant_inflight_gauge.set(n, tenant=t)
+            # HA plane gauges are scrape-time truth (store size and peer
+            # freshness both move without any local event)
+            if ctx.journal_plane is not None:
+                ctx.ha_journal_streams.set(len(ctx.journal_plane))
+            if ctx.tenant_gossip is not None:
+                ctx.ha_peer_frontends.set(ctx.tenant_gossip.live_peers())
+                peer = ctx.tenant_gossip.peer_counts()
+                with ctx.ha_peer_inflight._lock:
+                    known = [dict(lbl).get("tenant")
+                             for lbl in ctx.ha_peer_inflight._values]
+                for t in known:
+                    if t not in peer:
+                        ctx.ha_peer_inflight.set(0, tenant=t)
+                for t, n in peer.items():
+                    ctx.ha_peer_inflight.set(n, tenant=t)
             ctx.slo.refresh_gauges()
             body, ctype = ctx.metrics.registry.scrape(
                 self.headers.get("Accept"))
@@ -328,6 +426,14 @@ class _FrontendHandler(JsonHTTPHandler):
             code = 200 if path != "/ready" or workers > 0 else 503
             self._json(code, {"status": "ok" if code == 200 else "no-workers",
                               "workers": workers})
+        elif path == "/healthz":
+            # the readiness gate the VIP probes (operator readinessProbe):
+            # unlike /health it goes 503 whenever this replica could not
+            # actually serve — NATS subscriptions down, no workers, or
+            # draining (docs/robustness.md "HA frontend plane")
+            ready, detail = ctx.readiness()
+            detail["status"] = "ready" if ready else "unready"
+            self._json(200 if ready else 503, detail)
         elif path == "/internal/workers":
             self._json(200, {
                 "workers": [
@@ -367,12 +473,22 @@ class _FrontendHandler(JsonHTTPHandler):
                     body["url"], body.get("model", "?"),
                     body.get("mode", "agg"), body.get("stats"),
                 )
+                if self.ctx.worker_gossip is not None:
+                    # relay the DIRECT heartbeat to peer frontends so a
+                    # worker heartbeating here is never TTL-purged by a
+                    # replica that can't hear it (serving/ha.py)
+                    self.ctx.worker_gossip.publish_register(
+                        body["url"], body.get("model", "?"),
+                        body.get("mode", "agg"), body.get("stats"))
                 self._json(200, {"ok": True})
             elif path == "/internal/deregister":
                 # graceful worker drain (SIGTERM): stop routing to it NOW
                 # instead of waiting out the heartbeat TTL
                 body = self._read_json_body()
                 self.ctx.router.deregister(body["url"])
+                if self.ctx.worker_gossip is not None:
+                    # a drain is authoritative fleet-wide
+                    self.ctx.worker_gossip.publish_deregister(body["url"])
                 self._json(200, {"ok": True})
             elif path == "/internal/faults":
                 try:
@@ -524,8 +640,16 @@ class _FrontendHandler(JsonHTTPHandler):
         trace_headers[qos_tenancy.RESOLVED_HEADER] = self._tenant
         t_req = time.monotonic()
         try:
-            self._route_and_forward(path, raw, body, prompt_text, affinity,
+            if body.get(ha.RESUME_BODY_KEY) is not None:
+                # a client resuming a stream whose original frontend died
+                # (serving/ha.py): any replica can pick it up from the
+                # replicated journal
+                self._resume_stream(path, body, prompt_text, affinity,
                                     model, span, trace_headers, deadline)
+            else:
+                self._route_and_forward(path, raw, body, prompt_text,
+                                        affinity, model, span,
+                                        trace_headers, deadline)
         except Exception as e:
             span.set_status("ERROR", f"{type(e).__name__}: {e}")
             raise
@@ -794,13 +918,156 @@ class _FrontendHandler(JsonHTTPHandler):
             self.wfile.write(payload)
         m.duration.observe(time.monotonic() - t0, exemplar=ex, model=model)
 
+    # --------------------------------------------- cross-frontend resume --
+    def _resume_stream(self, path: str, body: dict, prompt_text: str,
+                       affinity: str, model: str, span, trace_headers: dict,
+                       deadline: Deadline) -> None:
+        """Resume a stream whose original frontend died (serving/ha.py).
+
+        The client re-POSTs its ORIGINAL request body plus a
+        ``dynamo_resume`` key naming the response id and how many content
+        chars it already received. Any frontend replica can serve it: the
+        replicated journal plane holds the seam cursor, so the surviving
+        frontend claims the resume (single winner fleet-wide), re-picks a
+        worker preferring journaled-prefix KV overlap, and dispatches a
+        PR 4 continuation — the worker re-emits exactly the chars past the
+        client's cursor, byte-identical for greedy/seeded streams."""
+        ctx = self.ctx
+        plane = ctx.journal_plane
+
+        def refuse(code: int, outcome: str, msg: str, etype: str) -> None:
+            ctx.ha_resumes.inc(outcome=outcome)
+            if code >= 500:
+                ctx.metrics.errors_total.inc(model=model, code=str(code))
+            span.set_status("ERROR", f"resume refused: {outcome}")
+            span.set_attribute("resume.outcome", outcome)
+            self._error(code, msg, etype)
+
+        if plane is None:
+            ctx.ha_resumes.inc(outcome="invalid")
+            raise proto.BadRequest(
+                "stream resume requires the replicated journal plane "
+                "(frontend started without --nats-url)")
+        try:
+            spec = ha.normalize_resume(body.get(ha.RESUME_BODY_KEY))
+        except ValueError as e:
+            ctx.ha_resumes.inc(outcome="invalid")
+            raise proto.BadRequest(f"bad {ha.RESUME_BODY_KEY}: {e}")
+        rid, delivered = spec["response_id"], spec["delivered_chars"]
+        span.set_attribute("resume.response_id", rid)
+        rec = plane.lookup(rid)
+        if rec is None:
+            refuse(404, "unknown",
+                   f"no replicated journal for response {rid!r} "
+                   "(expired, never journaled, or a different cluster)",
+                   "not_found")
+            return
+        if rec.done:
+            refuse(409, "completed",
+                   f"response {rid!r} already delivered its [DONE]; "
+                   "nothing to resume", "conflict")
+            return
+        if not rec.resumable:
+            refuse(409, "invalid",
+                   f"journal for response {rid!r} is not resumable "
+                   "(inconsistent checkpoint sequence)", "conflict")
+            return
+        if delivered > rec.checkpoint_chars:
+            # the replicated journal is BEHIND what the client saw: a
+            # continuation from this cursor would re-sample the gap —
+            # refuse rather than risk duplicated or diverging output
+            refuse(409, "stale_cursor",
+                   f"replicated journal for {rid!r} is behind the client "
+                   f"({rec.checkpoint_chars} < {delivered} chars); "
+                   "cannot resume without risking duplicate output",
+                   "conflict")
+            return
+        if not plane.claim(rid):
+            refuse(409, "lost_claim",
+                   f"another frontend won the resume claim for {rid!r}; "
+                   "retry there or wait", "conflict")
+            return
+        # pre-seed a journal at the replicated seam; the relay's own
+        # accounting continues from the client's cursor, and the worker's
+        # continuation checkpoints (cumulative n) extend it consistently
+        journal = recovery.RequestJournal(enabled_=True)
+        journal.tokens = list(rec.tokens)
+        journal.delivered_chars = delivered
+        journal.checkpoint_chars = rec.checkpoint_chars
+        journal.data_seen = True  # the client already holds the role chunk
+        journal.response_id = rec.rid
+        journal.seed = rec.seed
+        journal.resume_key = (list(rec.resume_key)
+                              if rec.resume_key else None)
+
+        clean = {k: v for k, v in body.items()
+                 if k != ha.RESUME_BODY_KEY}
+        base, adapter = split_adapter(model, ctx.router.models())
+        m = ctx.metrics
+        m.requests_total.inc(model=model)
+        t0 = time.monotonic()
+        tried: List[str] = []
+        resp = None
+        worker = None
+        attempt = 0
+        for attempt in range(recovery.MAX_ATTEMPTS):
+            if deadline.expired:
+                plane.release_claim(rid)
+                self._shed_deadline(span, "during resume", model)
+                return
+            worker = ctx.router.pick(base or model, affinity,
+                                     prompt_text=prompt_text,
+                                     exclude=tried, relaxed_overlap=True,
+                                     adapter=adapter)
+            if worker is None:
+                break
+            cont = dict(clean)
+            cont[recovery.RECOVERY_BODY_KEY] = journal.continuation()
+            headers = deadline.propagate({
+                "Content-Type": "application/json",
+                recovery.JOURNAL_HEADER: "1", **trace_headers})
+            req = urllib.request.Request(
+                worker.url.rstrip("/") + path,
+                data=json.dumps(cont).encode(), headers=headers,
+                method="POST")
+            try:
+                resp = urllib.request.urlopen(req,
+                                              timeout=deadline.timeout())
+                ctx.router.breakers.record_success(worker.url)
+                break
+            except urllib.error.HTTPError as e:
+                e.read()
+                ctx.router.breakers.record_success(worker.url)
+                tried.append(worker.url)
+            except (urllib.error.URLError, socket.error):
+                ctx.router.breakers.record_failure(worker.url)
+                tried.append(worker.url)
+        if resp is None:
+            plane.release_claim(rid)
+            refuse(503, "no_worker",
+                   f"no healthy worker to resume response {rid!r}",
+                   "service_unavailable")
+            return
+        ctx.ha_resumes.inc(outcome="resumed")
+        span.set_attribute("resume.outcome", "resumed")
+        span.add_event("stream_resumed", {
+            "response_id": rid, "worker.url": worker.url,
+            "seam_token_index": journal.seam_token_index})
+        self._relay_sse(resp, worker, path, clean, prompt_text, affinity,
+                        model, span, trace_headers, deadline, tried,
+                        attempt, True, t0, base=base, adapter=adapter,
+                        journal=journal)
+        m.duration.observe(time.monotonic() - t0, model=model)
+
     # ----------------------------------------------- mid-stream recovery --
     def _relay_sse(self, resp, worker, path: str, body: dict,
                    prompt_text: str, affinity: str, model: str, span,
                    trace_headers: dict, deadline: Deadline,
                    tried: List[str], attempt: int, journal_on: bool,
                    t0: float, base: Optional[str] = None,
-                   adapter: Optional[str] = None) -> None:
+                   adapter: Optional[str] = None,
+                   journal: Optional[recovery.RequestJournal] = None,
+                   ) -> None:
         """SSE relay with mid-stream recovery (serving/recovery.py).
 
         The worker stream is parsed into event blocks instead of being
@@ -815,7 +1082,12 @@ class _FrontendHandler(JsonHTTPHandler):
         run. Non-journaled streams keep PR 2's truncate semantics."""
         ctx = self.ctx
         m = ctx.metrics
-        journal = recovery.RequestJournal(enabled_=journal_on)
+        # a cross-frontend resume arrives with a journal pre-seeded from
+        # the replicated journal plane (serving/ha.py); everything else
+        # starts from a blank one
+        if journal is None:
+            journal = recovery.RequestJournal(enabled_=journal_on)
+        plane = ctx.journal_plane
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -861,6 +1133,14 @@ class _FrontendHandler(JsonHTTPHandler):
                 bkind, extra = recovery.parse_block(block)
                 if bkind == "journal":
                     journal.apply_comment(extra)
+                    # HA: replicate the raw checkpoint to every peer
+                    # frontend BEFORE the content it covers is forwarded,
+                    # preserving the journal-runs-ahead seam invariant
+                    # fleet-wide (a peer's copy is never behind what this
+                    # frontend delivered at the time of the checkpoint)
+                    if (plane is not None and journal.enabled
+                            and journal.response_id):
+                        plane.publish_record(journal.response_id, extra)
                 elif bkind == "done":
                     return (("done", None) if forward(block)
                             else ("client_gone", None))
@@ -953,6 +1233,12 @@ class _FrontendHandler(JsonHTTPHandler):
             span.set_attribute("recovery.seam_token_index",
                                journal.seam_token_index)
             span.set_attribute("worker.url", worker.url)
+        if (plane is not None and journal.enabled and journal.response_id
+                and outcome == "done"):
+            # tombstone only on a [DONE] delivered to the client — a
+            # client that vanished mid-stream must still be able to
+            # resume through any peer frontend
+            plane.publish_done(journal.response_id)
         try:
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()

@@ -168,6 +168,9 @@ class NatsClient:
         self._sub_specs: Dict[int, Tuple[str, Optional[str]]] = {}
         self._next_sid = 1
         self._closed = False
+        # readiness signal (the frontend's /healthz gate): True while a
+        # live connection is up, False from disconnect until redial lands
+        self._connected = False
         self._connect()
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="nats-reader")
@@ -209,6 +212,7 @@ class NatsClient:
         with self._wlock:
             self.sock = sock
             self._reader = reader
+        self._connected = True
 
     # ------------------------------------------------------------------ io --
     def _send(self, data: bytes) -> None:
@@ -314,6 +318,7 @@ class NatsClient:
             except (ConnectionError, OSError):
                 if self._closed:
                     return
+                self._connected = False
                 log.warning("nats disconnected; redialing %s", self._url)
             try:
                 # release the dead connection: a half-open socket pins the
@@ -333,6 +338,10 @@ class NatsClient:
                                   self.RECONNECT_MAX_BACKOFF_S)
 
     # ------------------------------------------------------------- surface --
+    @property
+    def connected(self) -> bool:
+        return self._connected and not self._closed
+
     def publish(self, subject: str, data: bytes,
                 reply: Optional[str] = None,
                 headers: Optional[Dict[str, str]] = None) -> None:

@@ -34,8 +34,10 @@ class WorkerInfo:
     mode: str = "agg"  # agg | prefill | decode
     last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
     stats: Dict = dataclasses.field(default_factory=dict)
-    # "direct" = heartbeated straight to this frontend; "etcd" = merged from a
-    # peer replica's registry record. Only direct workers are re-published.
+    # "direct" = heartbeated straight to this frontend; "etcd" = merged from
+    # a peer replica's etcd registry record; "peer" = relayed by another
+    # frontend over NATS worker gossip (serving/ha.py). Only direct workers
+    # are re-published/relayed — non-direct records never loop.
     source: str = "direct"
 
     @property
@@ -288,11 +290,12 @@ class Router:
                                                 stats=stats or {},
                                                 source=source)
                 return
-            if (source == "etcd" and w.source == "direct"
+            if (source != "direct" and w.source == "direct"
                     and w.last_heartbeat >= time.monotonic() - self.ttl):
-                # a live direct heartbeat is fresher than any peer's record;
-                # an expired one may be resurrected by a peer that still
-                # hears the worker (e.g. it re-registered elsewhere)
+                # a live direct heartbeat is fresher than any peer's record
+                # (etcd merge or NATS worker gossip); an expired one may be
+                # resurrected by a peer that still hears the worker (e.g.
+                # it re-registered elsewhere)
                 return
             w.model, w.mode = model, mode
             w.source = source
@@ -319,17 +322,23 @@ class Router:
         """Drop workers whose heartbeat TTL lapsed (alive() only FILTERS
         them; without this, a worker that died silently lingers in
         _workers forever and its expiry is invisible operationally).
-        Called on every pick(); emits the worker_expired metric."""
+        Called on every pick(); emits the worker_expired metric, labeled
+        by the registration path whose refresh lapsed (reason="direct" is
+        a worker that really went silent; reason="peer"/"etcd" means only
+        the relay feeding this replica stopped — with NATS worker gossip
+        up, a worker live ANYWHERE keeps every replica's last-seen fresh,
+        so a one-frontend purge no longer churns fleet membership)."""
         cutoff = time.monotonic() - self.ttl
         with self._lock:
-            dead = [u for u, w in self._workers.items()
+            dead = [(u, w.source) for u, w in self._workers.items()
                     if w.last_heartbeat < cutoff]
-            for u in dead:
+            for u, _src in dead:
                 del self._workers[u]
             self.expired_total += len(dead)
             if dead and self.expired_counter is not None:
-                self.expired_counter.inc(len(dead))
-        for u in dead:
+                for u, src in dead:
+                    self.expired_counter.inc(reason=src)
+        for u, _src in dead:
             self.kv_index.drop_worker(u)
         return len(dead)
 

@@ -78,7 +78,12 @@ def _self_url(host: str, port: int) -> str:
 
 def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                    interval: float, stop: threading.Event):
-    payload_url = frontend_url.rstrip("/") + "/internal/register"
+    # HA frontend plane: --frontend-url may name N replicas
+    # (comma-separated). The worker heartbeats to EVERY one so each
+    # replica's registry is complete on its own — no replica depends on
+    # another being alive to know this worker exists.
+    payload_urls = [u.strip().rstrip("/") + "/internal/register"
+                    for u in frontend_url.split(",") if u.strip()]
     first = True
     while True:
         if not first and stop.wait(interval):
@@ -106,16 +111,19 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                    if eng.lora is not None else {}),
             },
         }).encode()
-        try:
-            urllib.request.urlopen(
-                urllib.request.Request(
-                    payload_url, data=body,
-                    headers={"Content-Type": "application/json"}, method="POST",
-                ),
-                timeout=5,
-            )
-        except Exception as e:
-            log.warning("heartbeat to %s failed: %s", payload_url, e)
+        for payload_url in payload_urls:
+            try:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        payload_url, data=body,
+                        headers={"Content-Type": "application/json"},
+                        method="POST",
+                    ),
+                    timeout=5,
+                )
+            except Exception as e:
+                # one dead replica must not starve the others of beats
+                log.warning("heartbeat to %s failed: %s", payload_url, e)
 
 
 def build_parser(backend_name: str) -> argparse.ArgumentParser:
@@ -314,20 +322,29 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
                         # an IN-FLIGHT heartbeat register must land before
                         # the deregister, or it re-adds this worker
                         hb_thread.join(timeout=6.0)
-                    try:
-                        urllib.request.urlopen(
-                            urllib.request.Request(
-                                args.frontend_url.rstrip("/")
-                                + "/internal/deregister",
-                                data=json.dumps({"url": self_url}).encode(),
-                                headers={"Content-Type": "application/json"},
-                                method="POST",
-                            ),
-                            timeout=3,
-                        ).close()
-                    except Exception as e:
-                        log.warning("deregister failed (%s); frontend will "
-                                    "expire the heartbeat", e)
+                    # deregister from EVERY frontend replica the worker
+                    # heartbeats to — a replica that misses the explicit
+                    # deregister keeps routing here until the TTL expires
+                    for fe in args.frontend_url.split(","):
+                        fe = fe.strip()
+                        if not fe:
+                            continue
+                        try:
+                            urllib.request.urlopen(
+                                urllib.request.Request(
+                                    fe.rstrip("/") + "/internal/deregister",
+                                    data=json.dumps(
+                                        {"url": self_url}).encode(),
+                                    headers={
+                                        "Content-Type": "application/json"},
+                                    method="POST",
+                                ),
+                                timeout=3,
+                            ).close()
+                        except Exception as e:
+                            log.warning("deregister from %s failed (%s); "
+                                        "that frontend will expire the "
+                                        "heartbeat", fe, e)
                 # grace: a request routed a moment before the deregister may
                 # be accepted but not yet submitted — let it reach the
                 # engine before the first empty check

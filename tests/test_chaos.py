@@ -16,7 +16,9 @@ the final coverage assertion (cumulative fired_total), which is why the
 Makefile target passes -p no:randomly.
 """
 
+import http.client
 import json
+import os
 import socket
 import threading
 import time
@@ -615,6 +617,314 @@ def test_drain_handoff_completes_inflight_stream(drain_stack):
         plane.clear()
         ctx_a.draining.clear()
         ctx_a.drain_handoff.clear()
+
+
+# --------------------------------------------------------------------------
+# HA frontend plane (ISSUE 11 acceptance; docs/robustness.md "HA frontend
+# plane"): three frontend replicas over one NATS broker — worker membership
+# relays fleet-wide, a frontend killed mid-stream is resumable through a
+# peer byte-identically, and per-tenant QoS caps hold across the fleet.
+# --------------------------------------------------------------------------
+HA_TENANTS = json.dumps([
+    {"name": "burst", "max_inflight": 4},
+    {"name": "steady", "max_inflight": 0},   # 0 = uncapped
+])
+
+
+def _sse_events(text):
+    return [b.strip()[len("data: "):] for b in text.split("\n\n")
+            if b.strip().startswith("data: ")]
+
+
+def _sse_content(events):
+    return "".join(
+        (c.get("delta") or {}).get("content") or ""
+        for e in events if e != "[DONE]"
+        for c in json.loads(e)["choices"])
+
+
+def _make_ha_frontends(broker_url, n=3):
+    """n FrontendContexts sharing one NATS broker, gossip threads off
+    (tests drive publish_now() for determinism). The chaos workers speak
+    HTTP only, so the NATS *request* plane is disarmed after construction
+    (else every proxy stalls on its 5s dead-letter head timeout); the HA
+    planes hold their own client reference and keep replicating."""
+    saved = {k: os.environ.get(k)
+             for k in ("DYNAMO_TPU_FRONTEND_ID", "DYNAMO_TPU_TENANTS")}
+    os.environ["DYNAMO_TPU_TENANTS"] = HA_TENANTS
+    fronts = []
+    try:
+        for i in range(n):
+            os.environ["DYNAMO_TPU_FRONTEND_ID"] = f"fe-chaos-{i}"
+            fctx = FrontendContext(router=Router(heartbeat_ttl=600.0),
+                                   nats_url=broker_url,
+                                   gossip_interval_s=0)
+            nc = fctx.nats
+            fctx.nats = None  # HTTP relay only; HA planes keep `nc`
+            srv = make_frontend_server(fctx, "127.0.0.1", 0)
+            serve_forever_in_thread(srv)
+            fronts.append({
+                "ctx": fctx, "srv": srv, "nc": nc,
+                "url": f"http://127.0.0.1:{srv.server_address[1]}"})
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return fronts
+
+
+def _close_ha_frontends(fronts):
+    for f in fronts:
+        if not f.get("dead"):
+            f["srv"].shutdown()
+        try:
+            f["nc"].close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+
+@pytest.fixture(scope="module")
+def ha_fleet():
+    """Socket-light HA plane: broker + three frontend replicas, NO
+    engines. Covers membership gossip and fleet-wide QoS in tier-1."""
+    from dynamo_tpu.serving.nats import MiniNatsBroker
+
+    broker = MiniNatsBroker()
+    fronts = _make_ha_frontends(broker.url)
+    yield {"broker": broker, "fronts": fronts}
+    _close_ha_frontends(fronts)
+    broker.close()
+
+
+@pytest.fixture(scope="module")
+def ha_stack():
+    """Full HA topology for the kill-a-frontend drill: three replicas plus
+    TWO agg workers SHARING params (so a cross-frontend resume is
+    comparable byte-for-byte). Workers register on replica A ONLY — B and
+    C must learn them through the worker-membership relay."""
+    from dynamo_tpu.serving.nats import MiniNatsBroker
+
+    broker = MiniNatsBroker()
+    eng_a = Engine(EngineConfig(**KW))
+    eng_b = Engine(EngineConfig(**KW), params=eng_a.params)
+    wctxs, wsrvs, wurls = [], [], []
+    for eng in (eng_a, eng_b):
+        ctx = ServingContext(eng, MODEL)
+        srv = make_server(ctx, "127.0.0.1", 0)
+        serve_forever_in_thread(srv)
+        wctxs.append(ctx)
+        wsrvs.append(srv)
+        wurls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+    fronts = _make_ha_frontends(broker.url)
+    for wurl in wurls:
+        post(fronts[0]["url"], "/internal/register", {
+            "url": wurl, "model": MODEL, "mode": "agg",
+            "stats": {"max_num_seqs": 4, "free_pages": 100,
+                      "total_pages": 128}})
+    yield {"broker": broker, "fronts": fronts, "workers": wurls,
+           "wctxs": wctxs}
+    _close_ha_frontends(fronts)
+    for srv in wsrvs:
+        srv.shutdown()
+    for ctx in wctxs:
+        ctx.close()
+    broker.close()
+
+
+def _wait_for(pred, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+@pytest.mark.ha
+def test_ha_worker_membership_gossips_to_all_replicas(ha_fleet):
+    """A register heard by ONE replica lands on all of them (source=peer);
+    an explicit deregister is authoritative fleet-wide."""
+    fronts = ha_fleet["fronts"]
+    url = "http://192.0.2.10:8000"  # TEST-NET: registered, never dialed
+    post(fronts[1]["url"], "/internal/register", {
+        "url": url, "model": MODEL, "mode": "agg",
+        "stats": {"max_num_seqs": 4, "free_pages": 9, "total_pages": 16}})
+    for f in fronts:
+        _wait_for(lambda f=f: url in [w.url for w in
+                                      f["ctx"].router.alive(("agg",))],
+                  what=f"register relay to {f['ctx'].frontend_id}")
+    # the receiving replica holds a direct registration; its peers peer-
+    # sourced copies (the TTL-churn fix keys purge accounting off this)
+    with fronts[1]["ctx"].router._lock:
+        assert fronts[1]["ctx"].router._workers[url].source == "direct"
+    with fronts[0]["ctx"].router._lock:
+        assert fronts[0]["ctx"].router._workers[url].source == "peer"
+    post(fronts[1]["url"], "/internal/deregister", {"url": url})
+    for f in fronts:
+        _wait_for(lambda f=f: url not in [w.url for w in
+                                          f["ctx"].router.alive(("agg",))],
+                  what="deregister relay")
+
+
+@pytest.mark.ha
+def test_ha_fleet_wide_tenant_qos_over_10k_streams(ha_fleet):
+    """10k admission decisions sprayed round-robin across the three
+    replicas: the `burst` tenant (cap 4) holds every stream it wins and
+    must end up with exactly FOUR fleet-wide — not 4 per replica — while
+    the uncapped `steady` tenant is never shed. Drives the same
+    FrontendContext.admit()/release() path the HTTP edge uses; gossip is
+    flushed with publish_now() after every burst admission so the test is
+    deterministic rather than staleness-window dependent."""
+    ctxs = [f["ctx"] for f in ha_fleet["fronts"]]
+
+    def fleet_view(ctx, tenant):
+        local = ctx.tenant_admission.snapshot()["inflight"].get(tenant, 0)
+        return local + ctx.tenant_gossip.peer_counts().get(tenant, 0)
+
+    holders, shed_burst, steady_ok = [], 0, 0
+    for i in range(10_000):
+        ctx = ctxs[i % 3]
+        if i % 2 == 0:
+            ok, reason, retry_after = ctx.admit("burst")
+            if ok:
+                holders.append(ctx)
+                ctx.tenant_gossip.publish_now()
+                want = len(holders)
+                for peer in ctxs:
+                    _wait_for(
+                        lambda peer=peer: fleet_view(peer, "burst") == want,
+                        what=f"gossip convergence at {want} in-flight")
+            else:
+                shed_burst += 1
+                assert reason == "inflight"
+                assert retry_after > 0
+        else:
+            ok, reason, _ = ctx.admit("steady")
+            assert ok, (f"steady tenant shed at i={i} ({reason}): "
+                        "fleet-wide caps must never leak across tenants")
+            ctx.release("steady")
+            steady_ok += 1
+        if i % 1000 == 999:  # keep snapshots inside the staleness bound
+            for c in ctxs:
+                c.tenant_gossip.publish_now()
+    assert len(holders) == 4, \
+        f"burst cap must bind FLEET-wide (got {len(holders)} admitted)"
+    assert shed_burst == 5_000 - 4
+    assert steady_ok == 5_000
+    for ctx in ctxs:
+        assert ctx.tenant_gossip.live_peers() == 2
+    for ctx in holders:
+        ctx.release("burst")
+        ctx.tenant_gossip.publish_now()
+    _wait_for(lambda: all(fleet_view(c, "burst") == 0 for c in ctxs),
+              what="release convergence")
+
+
+@pytest.mark.ha
+def test_ha_kill_frontend_mid_stream_resumes_byte_identical(ha_stack):
+    """THE acceptance drill: kill replica A mid-stream; the client
+    reconnects to replica B with a `dynamo_resume` cursor and the spliced
+    stream is byte-identical to a fault-free run. B learned the workers
+    only via gossip and the seam only via the replicated journal — nothing
+    from A survives except what rode NATS."""
+    fronts = ha_stack["fronts"]
+    a, b, c = fronts[0], fronts[1], fronts[2]
+    for f in fronts:
+        _wait_for(lambda f=f: len(f["ctx"].router.alive(("agg",))) == 2,
+                  what="worker membership relay")
+    body = chat_body("ha kill-frontend probe", max_tokens=96, stream=True)
+
+    # fault-free reference through replica C
+    ref = post(c["url"], "/v1/chat/completions", body, raw=True,
+               timeout=120).read().decode()
+    ref_events = _sse_events(ref)
+    assert ref_events[-1] == "[DONE]"
+    ref_content = _sse_content(ref_events)
+    assert len(ref_content) > 8, "reference stream too short to cut"
+
+    # stream through replica A, reading incrementally off the raw socket;
+    # cut as early as possible (first content chars) so the worker is
+    # still generating when the replica dies
+    port_a = int(a["url"].rsplit(":", 1)[1])
+    conn = http.client.HTTPConnection("127.0.0.1", port_a, timeout=60)
+    conn.request("POST", "/v1/chat/completions", json.dumps(body).encode(),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    rid, delivered = None, ""
+    while rid is None or len(delivered) < 2:
+        line = resp.readline().decode("utf-8", "replace").strip()
+        assert line != "data: [DONE]", "stream finished before the kill"
+        if not line.startswith("data:"):
+            continue
+        chunk = json.loads(line[len("data:"):].strip())
+        if rid is None and chunk.get("id"):
+            rid = str(chunk["id"])
+        for ch in chunk.get("choices") or []:
+            delivered += (ch.get("delta") or {}).get("content") or ""
+    # hard-kill A: sever the client socket AND stop the listener — from
+    # here on, everything the resume needs must come from the NATS planes
+    try:
+        conn.sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    conn.sock.close()
+    a["srv"].shutdown()
+    a["dead"] = True
+
+    # the checkpoint-before-data invariant: B's replicated journal must
+    # already cover every char the client saw
+    def journal_ready():
+        rec = b["ctx"].journal_plane.lookup(rid)
+        return (rec is not None and rec.resumable
+                and rec.checkpoint_chars >= len(delivered))
+    _wait_for(journal_ready, what="journal replication past the seam")
+
+    resume_body = dict(body)
+    resume_body["dynamo_resume"] = {"response_id": rid,
+                                    "delivered_chars": len(delivered)}
+    tail_events = _sse_events(
+        post(b["url"], "/v1/chat/completions", resume_body, raw=True,
+             timeout=120).read().decode())
+    assert tail_events[-1] == "[DONE]", "resumed stream must COMPLETE"
+    for e in tail_events:
+        if e != "[DONE]":
+            assert json.loads(e)["id"] == rid, \
+                "the continuation must keep the original response id"
+    tail = _sse_content(tail_events)
+    assert delivered + tail == ref_content, \
+        "cross-frontend resume must be byte-identical to the fault-free run"
+
+    # B re-published the tombstone: a second resume of the same stream is
+    # refused fleet-wide instead of re-running generation past EOS
+    _wait_for(lambda: getattr(
+        c["ctx"].journal_plane.lookup(rid), "done", False),
+        what="done tombstone replication")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(b["url"], "/v1/chat/completions", resume_body)
+    assert ei.value.code == 409
+    metrics = urllib.request.urlopen(b["url"] + "/metrics",
+                                     timeout=10).read().decode()
+    assert 'dynamo_frontend_ha_resumes_total{outcome="resumed"}' in metrics
+
+
+@pytest.mark.ha
+def test_ha_frontend_metrics_scrape_valid(ha_fleet):
+    """The new dynamo_frontend_ha_* families must pass the exposition
+    validator in both classic and OpenMetrics form."""
+    from metrics_lint import assert_valid_scrape
+
+    base = ha_fleet["fronts"][1]["url"]
+    for accept, om in ((None, False),
+                       ("application/openmetrics-text", True)):
+        req = urllib.request.Request(base + "/metrics")
+        if accept:
+            req.add_header("Accept", accept)
+        text = urllib.request.urlopen(req, timeout=30).read().decode()
+        assert_valid_scrape(text, openmetrics=om)
+        assert "dynamo_frontend_ha_journal_streams" in text
 
 
 # --------------------------------------------------------------------------

@@ -331,3 +331,50 @@ def test_lora_adapter_env_shapes():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         lora_adapter_env({"loraAdapters": [{"name": "x"}]})
+
+
+# ---- HA frontend plane (ISSUE 11) -------------------------------------------
+
+
+def test_agg_ha_example_materializes_ha_frontend_plane():
+    """examples/deploy/jetstream/agg-ha.yaml: 3 frontend replicas get the
+    /healthz readiness gate, a per-replica headless companion Service,
+    drain/identity env, and a termination grace that outlasts the drain."""
+    docs = dict(_dgd_docs())
+    doc = docs["examples/deploy/jetstream/agg-ha.yaml"]
+    assert doc["spec"]["services"]["Frontend"]["replicas"] == 3
+    out = materialize(doc)
+
+    fe = next(d for d in out["deployments"]
+              if "frontend" in d["metadata"]["name"])
+    assert fe["spec"]["replicas"] == 3
+    tpl = fe["spec"]["template"]["spec"]
+    c = tpl["containers"][0]
+    probe = c["readinessProbe"]["httpGet"]
+    assert probe["path"] == "/healthz"
+    env = {e["name"]: e for e in c["env"]}
+    # stable replica identity from the pod name; drain budget from
+    # drainSeconds rides into the entrypoint's FRONTEND_DRAIN_S
+    assert (env["DYNAMO_TPU_FRONTEND_ID"]["valueFrom"]["fieldRef"]
+               ["fieldPath"] == "metadata.name")
+    assert env["FRONTEND_DRAIN_S"]["value"] == "10"
+    assert tpl["terminationGracePeriodSeconds"] > 10
+
+    # VIP + headless companion, headless publishing draining replicas
+    names = {s["metadata"]["name"]: s for s in out["services"]}
+    fe_name = fe["metadata"]["name"]
+    assert fe_name in names
+    assert names[fe_name]["spec"].get("clusterIP") != "None"
+    headless = names[fe_name + "-headless"]
+    assert headless["spec"]["clusterIP"] == "None"
+    assert headless["spec"]["publishNotReadyAddresses"] is True
+
+
+def test_single_replica_frontend_has_no_headless_companion():
+    """The headless companion only appears for replicas > 1 — single-
+    frontend graphs keep their exact pre-HA service set."""
+    docs = dict(_dgd_docs())
+    doc = docs["examples/deploy/jetstream/agg.yaml"]
+    out = materialize(doc)
+    assert not any(s["metadata"]["name"].endswith("-headless")
+                   for s in out["services"])

@@ -1,0 +1,545 @@
+"""HA frontend plane suite (ISSUE 11; docs/robustness.md "HA frontend
+plane"; `make ha-check`).
+
+Unit/integration coverage for serving/ha.py and the frontend's HA wiring,
+no engines involved:
+
+- /healthz is a REAL readiness gate: 503 while the registry is empty,
+  while draining, and while the NATS planes are down;
+- resume refusal matrix — garbage cursor 400, unknown stream 404, already
+  completed / stale cursor / inconsistent journal 409 — and the invariant
+  behind it: a stale seam cursor must NEVER duplicate tokens;
+- resume-claim races elect a single winner fleet-wide, released claims
+  don't ghost-block later resumes;
+- the duplicate-registration churn fix: a worker heartbeating ONE replica
+  stays registered on all of them via the gossip relay, peer records never
+  clobber a fresh direct heartbeat, and expiry accounting carries the
+  registration path (`dynamo_frontend_worker_expired_total{reason=...}`);
+- tenant gossip: seq rewinds are ignored, dead peers age out of the fold
+  within the staleness bound;
+- the loadgen client survives a mid-stream frontend death by re-POSTing a
+  `dynamo_resume` cursor to the NEXT round-robin target.
+
+The full kill-a-frontend-mid-stream byte-identity drill (real engines)
+lives in tests/test_chaos.py::test_ha_kill_frontend_mid_stream_resumes_*.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dynamo_tpu.qos import tenancy as qos_tenancy
+from dynamo_tpu.serving import ha
+from dynamo_tpu.serving.frontend import FrontendContext, make_frontend_server
+from dynamo_tpu.serving.http_base import serve_forever_in_thread
+from dynamo_tpu.serving.nats import MiniNatsBroker, NatsClient
+from dynamo_tpu.serving.router import Router
+
+pytestmark = pytest.mark.ha
+
+MODEL = "tiny-debug"
+
+
+def post(url, path, body, timeout=10):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def wait_for(pred, timeout_s=5.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def chat_resume_body(rid, delivered):
+    """The client's re-POST: its ORIGINAL streaming body + the cursor."""
+    return {"model": MODEL, "stream": True,
+            "messages": [{"role": "user", "content": "resume me"}],
+            "max_tokens": 8, "temperature": 0,
+            ha.RESUME_BODY_KEY: {"response_id": rid,
+                                 "delivered_chars": delivered}}
+
+
+# --------------------------------------------------------------------------
+# /healthz: a readiness gate, not a liveness ping
+# --------------------------------------------------------------------------
+def test_healthz_gates_on_registry_drain_and_nats():
+    broker = MiniNatsBroker()
+    fctx = FrontendContext(nats_url=broker.url, gossip_interval_s=0)
+    srv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def healthz():
+        try:
+            resp = urllib.request.urlopen(url + "/healthz", timeout=10)
+            return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, detail = healthz()  # empty registry: nothing to route to
+        assert code == 503 and detail["status"] == "unready"
+        assert detail["workers"] == 0 and detail["nats"] == "connected"
+
+        post(url, "/internal/register", {
+            "url": "http://192.0.2.7:8000", "model": MODEL, "mode": "agg",
+            "stats": {"max_num_seqs": 4, "free_pages": 9,
+                      "total_pages": 16}})
+        code, detail = healthz()
+        assert code == 200 and detail["status"] == "ready"
+        assert detail["frontend_id"] == fctx.frontend_id
+
+        fctx.draining = True  # SIGTERM flips this before the drain wait
+        code, detail = healthz()
+        assert code == 503 and detail["draining"] is True
+        fctx.draining = False
+        assert healthz()[0] == 200
+
+        broker.close()  # journal/gossip/kv-event planes all dark
+        wait_for(lambda: not fctx.readiness()[0],
+                 what="NATS loss to flip readiness")
+        code, detail = healthz()
+        assert code == 503 and detail["nats"] == "disconnected"
+    finally:
+        srv.shutdown()
+        try:
+            fctx.nats.close()
+        except Exception:  # noqa: BLE001
+            pass
+        broker.close()
+
+
+def test_standalone_frontend_healthz_needs_no_nats():
+    """Without --nats-url the HA plane is off and NATS must NOT gate
+    readiness — a standalone frontend is its own quorum."""
+    fctx = FrontendContext()
+    fctx.router.register("http://192.0.2.8:8000", MODEL, "agg")
+    ready, detail = fctx.readiness()
+    assert ready and detail["nats"] == "unconfigured"
+
+
+# --------------------------------------------------------------------------
+# resume refusal matrix (against a journal seeded over real NATS)
+# --------------------------------------------------------------------------
+@pytest.fixture()
+def resume_rig():
+    """Replica B plus a fake 'replica A' journal publisher. A tiny claim
+    window keeps the refusal matrix fast."""
+    broker = MiniNatsBroker()
+    fctx = FrontendContext(nats_url=broker.url, gossip_interval_s=0)
+    fctx.journal_plane.claim_window_s = 0.02
+    srv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    pub_nc = NatsClient(broker.url, name="fake-replica-a")
+    pub = ha.JournalPlane(pub_nc, "fe-fake-a", claim_window_s=0.02)
+    rig = {"url": f"http://127.0.0.1:{srv.server_address[1]}",
+           "fctx": fctx, "pub": pub}
+    yield rig
+    srv.shutdown()
+    for nc in (fctx.nats, pub_nc):
+        try:
+            nc.close()
+        except Exception:  # noqa: BLE001
+            pass
+    broker.close()
+
+
+def seed_journal(rig, rid, tokens=(11, 12, 13), chars=12, seed=7):
+    """Publish the records replica A would have relayed for `rid`: the
+    start record then one cumulative checkpoint, and wait for replica B's
+    plane to apply them."""
+    pub = rig["pub"]
+    pub.publish_record(rid, json.dumps(
+        {"start": {"id": rid, "seed": seed}}).encode())
+    pub.publish_record(rid, json.dumps(
+        {"n": len(tokens), "c": chars, "t": list(tokens),
+         "key": [3, 4]}).encode())
+    wait_for(lambda: (
+        (rec := rig["fctx"].journal_plane.lookup(rid)) is not None
+        and rec.checkpoint_chars == chars),
+        what=f"journal replication for {rid}")
+
+
+def resume_code(rig, body):
+    try:
+        post(rig["url"], "/v1/chat/completions", body)
+        return 200
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+
+
+def test_resume_garbage_cursor_is_400(resume_rig):
+    for cursor in ("nope", 7, {"response_id": ""},
+                   {"response_id": "r", "delivered_chars": -1},
+                   {"response_id": "r", "delivered_chars": True},
+                   {"response_id": "x" * 81}):
+        body = chat_resume_body("r", 0)
+        body[ha.RESUME_BODY_KEY] = cursor
+        assert resume_code(resume_rig, body) == 400, cursor
+
+
+def test_resume_unknown_stream_is_404(resume_rig):
+    assert resume_code(resume_rig,
+                       chat_resume_body("resp-never-existed", 0)) == 404
+
+
+def test_resume_completed_stream_is_409(resume_rig):
+    seed_journal(resume_rig, "resp-done")
+    resume_rig["pub"].publish_done("resp-done")
+    wait_for(lambda: resume_rig["fctx"].journal_plane.lookup(
+        "resp-done").done, what="done tombstone")
+    assert resume_code(resume_rig, chat_resume_body("resp-done", 4)) == 409
+
+
+def test_resume_stale_cursor_is_409_never_duplicates(resume_rig):
+    """The journal is BEHIND what the client saw (checkpoint 12 chars,
+    client delivered 20): a continuation from there would re-emit the gap
+    — the frontend must refuse, and must refuse BEFORE picking a worker
+    (no generation may start)."""
+    seed_journal(resume_rig, "resp-stale", chars=12)
+    m = resume_rig["fctx"].metrics.requests_total
+    assert resume_code(resume_rig,
+                       chat_resume_body("resp-stale", 20)) == 409
+    with m._lock:
+        dispatched = sum(m._values.values())
+    assert dispatched == 0, "a stale cursor must never reach a worker"
+    # the boundary cursor (exactly at the checkpoint) is NOT stale: it
+    # fails later — 503, no live worker registered — proving the cursor
+    # check passed
+    assert resume_code(resume_rig,
+                       chat_resume_body("resp-stale", 12)) == 503
+
+
+def test_resume_inconsistent_journal_is_409(resume_rig):
+    """A replica that missed a checkpoint (cumulative n != applied token
+    count) holds a corrupt seam and must refuse rather than resume."""
+    pub = resume_rig["pub"]
+    pub.publish_record("resp-gap", json.dumps(
+        {"start": {"id": "resp-gap", "seed": 1}}).encode())
+    pub.publish_record("resp-gap", json.dumps(
+        {"n": 5, "c": 20, "t": [1, 2]}).encode())  # 3 tokens went missing
+    wait_for(lambda: (
+        (rec := resume_rig["fctx"].journal_plane.lookup("resp-gap"))
+        is not None and rec.tokens), what="gap record")
+    assert not resume_rig["fctx"].journal_plane.lookup("resp-gap").resumable
+    assert resume_code(resume_rig, chat_resume_body("resp-gap", 0)) == 409
+
+
+def test_resume_missing_start_record_is_409(resume_rig):
+    """A replica that joined mid-stream never saw the start record (and
+    so has no pinned seed): not resumable."""
+    resume_rig["pub"].publish_record("resp-midjoin", json.dumps(
+        {"n": 2, "c": 8, "t": [5, 6]}).encode())
+    wait_for(lambda: resume_rig["fctx"].journal_plane.lookup(
+        "resp-midjoin") is not None, what="mid-join record")
+    assert resume_code(resume_rig,
+                       chat_resume_body("resp-midjoin", 0)) == 409
+
+
+# --------------------------------------------------------------------------
+# resume claims: single winner, no ghost blocking
+# --------------------------------------------------------------------------
+def test_claim_race_single_winner_and_release():
+    broker = MiniNatsBroker()
+    ncs = [NatsClient(broker.url, name=f"fe-{i}") for i in range(3)]
+    planes = [ha.JournalPlane(nc, f"fe-claim-{i}", claim_window_s=0.25)
+              for i, nc in enumerate(ncs)]
+    try:
+        start = json.dumps({"start": {"id": "resp-race", "seed": 1}})
+
+        def seeded():
+            # re-publish each poll: the peers' wildcard SUBs may still be
+            # in flight on the first publish (start records are idempotent)
+            planes[0].publish_record("resp-race", start.encode())
+            return all(p.lookup("resp-race") for p in planes)
+        wait_for(seeded, what="record on all planes")
+        results = {}
+        barrier = threading.Barrier(len(planes))
+
+        def racer(p):
+            barrier.wait()
+            results[p.fid] = p.claim("resp-race")
+
+        threads = [threading.Thread(target=racer, args=(p,))
+                   for p in planes]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        winners = [fid for fid, won in results.items() if won]
+        assert len(winners) == 1, f"split brain: {results}"
+
+        # released claims must not ghost-block the next resume attempt:
+        # release is local to the winner; peers age the ghost out of the
+        # election once it falls past the freshness horizon (1s floor)
+        winner = next(p for p in planes if p.fid == winners[0])
+        loser = next(p for p in planes if p.fid != winners[0])
+        winner.release_claim("resp-race")
+        time.sleep(1.05)
+        assert loser.claim("resp-race"), \
+            "a released/expired claim must not block later resumes"
+    finally:
+        for nc in ncs:
+            nc.close()
+        broker.close()
+
+
+def test_claim_release_is_local_only_but_stale_claims_age_out():
+    """Even if the release never reaches a peer (worst-case partition),
+    the freshness horizon ages the ghost claim out of the election."""
+    plane = ha.JournalPlane(None, "fe-solo", claim_window_s=0.0)
+    rec = ha.JournalRecord("resp-ghost")
+    rec.claims["fe-dead"] = ("0000", time.monotonic() - 3600.0)
+    plane._records["resp-ghost"] = rec
+    assert plane.claim("resp-ghost"), \
+        "an hours-old claim from a crashed frontend must not win"
+
+
+# --------------------------------------------------------------------------
+# worker registration churn fix
+# --------------------------------------------------------------------------
+class _ReasonCounter:
+    def __init__(self):
+        self.calls = []
+
+    def inc(self, value=1, **labels):
+        self.calls.append(labels)
+
+
+def test_peer_relay_never_clobbers_fresh_direct_heartbeat():
+    r = Router(heartbeat_ttl=15.0)
+    url = "http://192.0.2.20:8000"
+    r.register(url, MODEL, "agg",
+               stats={"free_pages": 50, "total_pages": 64})
+    # the gossip relay echoes the registration back (possibly stale stats)
+    r.register(url, MODEL, "agg", stats={"free_pages": 1}, source="peer")
+    with r._lock:
+        w = r._workers[url]
+        assert w.source == "direct"
+        assert w.stats["free_pages"] == 50, \
+            "a peer echo must not regress fresh direct stats"
+
+
+def test_worker_heartbeating_one_replica_survives_on_all():
+    """The churn fix: replica B never hears the worker directly, only the
+    relay. The relayed beats must keep refreshing B's TTL — before the
+    fix B expired-then-relearned the worker forever, flapping routing."""
+    r = Router(heartbeat_ttl=0.25)
+    counter = _ReasonCounter()
+    r.expired_counter = counter
+    url = "http://192.0.2.21:8000"
+    for _ in range(4):  # relayed heartbeats at half-TTL cadence
+        r.register(url, MODEL, "agg", source="peer")
+        time.sleep(0.12)
+        r.purge_expired()
+        assert [w.url for w in r.alive(("agg",))] == [url]
+    assert counter.calls == [], "relay-refreshed worker must never expire"
+    # the relay stops (its source replica died) -> TTL expiry, attributed
+    # to the path that went quiet
+    time.sleep(0.3)
+    assert r.purge_expired() == 1
+    assert counter.calls == [{"reason": "peer"}]
+    # ...and an expired direct registration is attributed as direct
+    r.register(url, MODEL, "agg")
+    time.sleep(0.3)
+    r.purge_expired()
+    assert counter.calls[-1] == {"reason": "direct"}
+
+
+def test_peer_can_resurrect_expired_direct_registration():
+    """A worker that re-registered on a different replica after this
+    replica's TTL lapsed must come back through the relay."""
+    r = Router(heartbeat_ttl=0.2)
+    url = "http://192.0.2.22:8000"
+    r.register(url, MODEL, "agg")
+    time.sleep(0.25)
+    assert r.alive(("agg",)) == []
+    r.register(url, MODEL, "agg", source="peer")
+    assert [w.url for w in r.alive(("agg",))] == [url]
+    with r._lock:
+        assert r._workers[url].source == "peer"
+
+
+# --------------------------------------------------------------------------
+# tenant gossip: seq guard + staleness bound
+# --------------------------------------------------------------------------
+class _Msg:
+    def __init__(self, obj):
+        self.data = json.dumps(obj).encode()
+
+
+def _gossip(stale_s=5.0):
+    adm = qos_tenancy.TenantAdmission(qos_tenancy.TenantRegistry(), 0)
+    return ha.TenantGossip(None, "fe-local", adm, interval_s=0,
+                           stale_s=stale_s)
+
+
+def test_gossip_seq_rewind_is_ignored():
+    g = _gossip()
+    g._on_msg(_Msg({"fid": "fe-peer", "seq": 9, "inflight": {"acme": 3}}))
+    assert g.peer_counts() == {"acme": 3}
+    # a late, reordered core-NATS delivery must not rewind the view
+    g._on_msg(_Msg({"fid": "fe-peer", "seq": 8, "inflight": {"acme": 9}}))
+    assert g.peer_counts() == {"acme": 3}
+    g._on_msg(_Msg({"fid": "fe-peer", "seq": 10, "inflight": {"acme": 1}}))
+    assert g.peer_counts() == {"acme": 1}
+
+
+def test_gossip_own_echo_and_garbage_are_ignored():
+    g = _gossip()
+    g._on_msg(_Msg({"fid": "fe-local", "seq": 1, "inflight": {"a": 5}}))
+    g._on_msg(_Msg({"fid": "fe-peer", "seq": 1, "inflight": "nope"}))
+    g._on_msg(_Msg({"fid": "fe-peer", "seq": "x", "inflight": {}}))
+    g._on_msg(_Msg({"fid": "fe-peer", "seq": 2,
+                    "inflight": {"a": -4, "b": True, "c": 2}}))
+    assert g.peer_counts() == {"c": 2}, \
+        "negative/bool counts must be dropped, valid ones kept"
+
+
+def test_gossip_dead_peer_ages_out_within_staleness_bound():
+    """The bounded-staleness promise: a crashed replica's in-flight load
+    stops counting against fleet caps within stale_s."""
+    g = _gossip(stale_s=0.15)
+    g._on_msg(_Msg({"fid": "fe-peer", "seq": 1, "inflight": {"acme": 4}}))
+    assert g.peer_counts() == {"acme": 4} and g.live_peers() == 1
+    time.sleep(0.2)
+    assert g.peer_counts() == {} and g.live_peers() == 0
+
+
+# --------------------------------------------------------------------------
+# loadgen: round-robin targets + resume-on-reset
+# --------------------------------------------------------------------------
+class _SseHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # noqa: ARG002
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        self.server.bodies.append(body)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.end_headers()  # HTTP/1.0 close-framing: EOF ends the stream
+        self.server.respond(self, body)
+
+
+def _sse_server(respond):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _SseHandler)
+    srv.bodies = []
+    srv.respond = respond
+    serve_forever_in_thread(srv)
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _chunk(handler, obj):
+    handler.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+    handler.wfile.flush()
+
+
+def test_loadgen_resumes_on_next_replica_after_mid_stream_death():
+    from benchmarks.utils.loadgen import LoadConfig, run_one
+
+    def die_mid_stream(handler, body):
+        # "replica A": three chars of content, then the process dies —
+        # no [DONE], the connection just ends
+        _chunk(handler, {"id": "resp-lg-1",
+                         "choices": [{"delta": {"content": "Hel"}}]})
+
+    def serve_tail(handler, body):
+        # "replica B": a resume cursor must ride in; replay past the seam
+        assert body.get("dynamo_resume") == {"response_id": "resp-lg-1",
+                                             "delivered_chars": 3}
+        _chunk(handler, {"id": "resp-lg-1",
+                         "choices": [{"delta": {"content": "lo"}}]})
+        _chunk(handler, {"id": "resp-lg-1", "choices": [],
+                         "usage": {"prompt_tokens": 5,
+                                   "completion_tokens": 2}})
+        handler.wfile.write(b"data: [DONE]\n\n")
+        handler.wfile.flush()
+
+    srv_a, url_a = _sse_server(die_mid_stream)
+    srv_b, url_b = _sse_server(serve_tail)
+    try:
+        cfg = LoadConfig(endpoint_url=url_a, model=MODEL, num_requests=1,
+                         concurrency=1, max_tokens=4, prompt="hi",
+                         endpoint_urls=[url_a, url_b])
+        res = run_one(cfg, seed=0)
+        assert res.ok, res.error
+        assert res.resumes == 1
+        assert res.target == url_b, \
+            "the resume must go to the NEXT round-robin replica"
+        assert res.output_tokens == 2 and res.input_tokens == 5
+        assert "dynamo_resume" not in srv_a.bodies[0], \
+            "the first attempt must not carry a cursor"
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+
+
+def test_loadgen_reset_without_response_id_fails_cleanly():
+    """A stream cut before ANY chunk has no identity to resume — the
+    loadgen must record the failure, not loop."""
+    from benchmarks.utils.loadgen import LoadConfig, run_one
+
+    def die_instantly(handler, body):  # noqa: ARG001 — headers only
+        pass
+
+    srv, url = _sse_server(die_instantly)
+    try:
+        cfg = LoadConfig(endpoint_url=url, model=MODEL, prompt="hi")
+        res = run_one(cfg, seed=0)
+        assert not res.ok and res.resumes == 0
+    finally:
+        srv.shutdown()
+
+
+def test_loadgen_round_robin_targets():
+    from benchmarks.utils.loadgen import LoadConfig
+
+    cfg = LoadConfig(endpoint_url="http://one", model=MODEL)
+    assert cfg.targets() == ["http://one"]
+    assert cfg.next_target() == "http://one"
+    cfg = LoadConfig(endpoint_url="http://one", model=MODEL,
+                     endpoint_urls=["http://a", "http://b", "http://c"])
+    assert [cfg.next_target() for _ in range(4)] == [
+        "http://a", "http://b", "http://c", "http://a"]
+
+
+# --------------------------------------------------------------------------
+# cursor validation + continuation construction units
+# --------------------------------------------------------------------------
+def test_normalize_resume_accepts_and_rejects():
+    ok = ha.normalize_resume({"response_id": "resp-1",
+                              "delivered_chars": 42})
+    assert ok == {"response_id": "resp-1", "delivered_chars": 42}
+    assert ha.normalize_resume(
+        {"response_id": "r"})["delivered_chars"] == 0
+    for bad in (None, [], "x", {"response_id": 7},
+                {"response_id": "r", "delivered_chars": "9"},
+                {"response_id": "r", "delivered_chars": -1}):
+        with pytest.raises(ValueError):
+            ha.normalize_resume(bad)
+
+
+def test_build_continuation_uses_client_cursor_not_journal():
+    """The dying frontend's delivered count died with it: the client's own
+    cursor is the seam, the journal supplies tokens/seed/sampler key."""
+    rec = ha.JournalRecord("resp-c")
+    rec.apply({"start": {"id": "resp-c", "seed": 99}})
+    rec.apply({"n": 3, "c": 11, "t": [7, 8, 9], "key": [1, 2]})
+    cont = ha.build_continuation(rec, delivered_chars=6)
+    assert cont == {"prior_tokens": [7, 8, 9], "delivered_chars": 6,
+                    "seed": 99, "resume_key": [1, 2],
+                    "response_id": "resp-c", "role_sent": True}

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -34,6 +34,7 @@ help:
 	@echo "  planner-check  coordinated autoscaling suite (pool planner, flash-crowd simulation, drain-before-shrink)"
 	@echo "  rpa-check      unified ragged-step suite (kernel parity, mixed/classic identity, bench contract)"
 	@echo "  ha-check       HA frontend plane suite (replicated journal, cross-frontend resume, fleet QoS)"
+	@echo "  spec-check     speculative decoding v2 suite (ragged-verify identity, LoRA/sampling/QoS composition)"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -150,6 +151,15 @@ rpa-check:
 ha-check:
 	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
 		python -m pytest tests/test_ha.py tests/test_chaos.py -m ha -q -p no:randomly
+
+# Speculative decoding v2 gate (docs/perf.md "Speculative decoding v2"):
+# the `spec` marker suite — greedy AND seeded-sampled byte-identity spec
+# on/off, the jitted mixed-ragged + LoRA composition acceptance tests
+# (slow-marked, so tier-1 stays light; the direct file invocation here
+# runs them), recovery-mid-speculation chain resume, and the
+# QoS-debits-accepted-only accounting check.
+spec-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

@@ -153,14 +153,26 @@ class EngineConfig:
 
     # speculative decoding: "off" | "ngram" (prompt-lookup drafts from each
     # sequence's own token history — no draft model, the same capability the
-    # reference's vLLM/TRT-LLM engines ship). Greedy no-penalty sequences
-    # accept the longest draft prefix the verify forward agrees with; other
-    # sequences still get their one sampled token per verify step. Takes the
-    # place of multi-step windows when on.
+    # reference's vLLM/TRT-LLM engines ship). v2 semantics (docs/perf.md
+    # "Speculative decoding v2"): acceptance replays the per-slot PRNG
+    # chain, so GREEDY AND SEEDED-SAMPLED sequences both speculate with
+    # byte-identical output vs spec-off; LoRA-adapter sequences verify
+    # through their adapter (gathered einsum); speculating slots ride the
+    # unified ragged mixed step as K+1-wide rows alongside prefill chunks.
+    # Penalized (presence/frequency) and guided-grammar sequences demote to
+    # one token per step — counted in
+    # dynamo_pallas_fallback_total{op="spec"}. Takes the place of
+    # multi-step windows when on.
     speculative_mode: str = "off"
+    # drafts per verify window (K). Engine init validates 1 <= K <
+    # page_size: the K+1-token verify window must fit one KV page (and one
+    # ragged query block). Tune against the live acceptance-length
+    # histogram (dynamo_engine_spec_accept_length) — mean near K means
+    # raise it, near 0 means the workload doesn't repeat and spec costs
+    # K+1x compute per emitted token.
     num_speculative_tokens: int = 4
     # draft proposer: length of the history n-gram matched to find a
-    # continuation to propose
+    # continuation to propose (engine init validates >= 1)
     ngram_lookup: int = 2
 
     # runtime
@@ -200,9 +212,16 @@ class EngineConfig:
         p.add_argument("--moe-capacity-factor", type=float, default=0.0)
         p.add_argument("--num-scheduler-steps", type=int, default=1)
         p.add_argument("--speculative-mode", default="off",
-                       choices=["off", "ngram"])
-        p.add_argument("--num-speculative-tokens", type=int, default=4)
-        p.add_argument("--ngram-lookup", type=int, default=2)
+                       choices=["off", "ngram"],
+                       help="prompt-lookup speculative decoding (v2: "
+                            "composes with the mixed ragged step, LoRA, "
+                            "and seeded sampling; docs/perf.md)")
+        p.add_argument("--num-speculative-tokens", type=int, default=4,
+                       help="drafts per verify window (K); engine init "
+                            "enforces 1 <= K < --page-size")
+        p.add_argument("--ngram-lookup", type=int, default=2,
+                       help="history n-gram length the draft proposer "
+                            "matches (>= 1)")
         p.add_argument("--async-scheduling",
                        action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("--enable-prefix-caching",

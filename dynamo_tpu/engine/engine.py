@@ -44,6 +44,7 @@ from dynamo_tpu.engine.request import GenRequest, TokenEvent
 from dynamo_tpu.engine import sampling as smp
 from dynamo_tpu.lora.registry import NoFreeAdapterSlot
 from dynamo_tpu.models import llama
+from dynamo_tpu.ops import attention as att_ops
 from dynamo_tpu.ops import json_guide
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
@@ -153,6 +154,12 @@ class EngineMetrics:
     # rows burn HBM stream for nothing); the exposition bridge
     # (observability/engine_metrics.py) serves it as a histogram
     _OCC_EDGES = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+    # accepted-draft count per speculating slot per verify step (0 = the
+    # window emitted only its non-speculative token). K is bounded by
+    # page_size, so fixed small-integer edges cover every configuration;
+    # the exposition bridge serves this as
+    # dynamo_engine_spec_accepted_length (observability/engine_metrics.py)
+    _SPEC_EDGES = (0, 1, 2, 3, 4, 6, 8)
 
     def __init__(self):
         self.num_requests = 0
@@ -168,6 +175,9 @@ class EngineMetrics:
         # = accepted / drafted; bonus tokens not counted in either)
         self.spec_draft_tokens = 0
         self.spec_accepted_tokens = 0
+        self.spec_accept_buckets = [0] * (len(self._SPEC_EDGES) + 1)
+        self.spec_accept_sum = 0
+        self.spec_accept_count = 0
         self.occupancy_buckets = [0] * (len(self._OCC_EDGES) + 1)
         self.occupancy_sum = 0.0
         self.occupancy_count = 0
@@ -198,6 +208,18 @@ class EngineMetrics:
         self.occupancy_sum += frac
         self.occupancy_count += 1
 
+    def observe_spec_accept(self, n_acc: int) -> None:
+        """One speculating slot's accepted-draft count for one verify step
+        (same cumulative-bucket scheme as occupancy)."""
+        for i, edge in enumerate(self._SPEC_EDGES):
+            if n_acc <= edge:
+                self.spec_accept_buckets[i] += 1
+                break
+        else:
+            self.spec_accept_buckets[-1] += 1
+        self.spec_accept_sum += n_acc
+        self.spec_accept_count += 1
+
     def observe_mixed(self, prefill_tokens: int, decode_rows: int) -> None:
         """One unified ragged step's composition: prefill-token fraction
         of the window's total rows (same cumulative-bucket scheme as
@@ -220,8 +242,12 @@ class EngineMetrics:
 
     def snapshot(self) -> Dict[str, float]:
         out = {k: v for k, v in self.__dict__.items()
-               if k not in ("phases", "occupancy_buckets", "mixed_buckets")}
+               if k not in ("phases", "occupancy_buckets", "mixed_buckets",
+                            "spec_accept_buckets")}
         out["phases"] = {p: t.snapshot() for p, t in self.phases.items()}
+        out["spec_accept_mean"] = (
+            round(self.spec_accept_sum / self.spec_accept_count, 4)
+            if self.spec_accept_count else 0.0)
         out["occupancy_mean"] = (
             round(self.occupancy_sum / self.occupancy_count, 4)
             if self.occupancy_count else 0.0)
@@ -266,6 +292,25 @@ class Engine:
         on DISJOINT sub-meshes of the same host this way (None = the
         process-global jax.devices(), the single-role default)."""
         self.cfg = cfg
+        if cfg.speculative_mode != "off":
+            # fail fast with the constraint, not a downstream shape error:
+            # K bounds the verify window (the ragged verify row must fit
+            # one padded query block, so K+1 <= page_size; see
+            # ops/ragged_attention.py) and the proposer needs >= 1 pattern
+            # token
+            k = cfg.num_speculative_tokens
+            if k <= 0:
+                raise ValueError(
+                    f"--num-speculative-tokens must be >= 1 when "
+                    f"--speculative-mode is on (got {k})")
+            if k >= cfg.page_size:
+                raise ValueError(
+                    f"--num-speculative-tokens ({k}) must be < --page-size "
+                    f"({cfg.page_size}): the K+1-token verify window must "
+                    f"fit one KV page / ragged query block")
+            if cfg.ngram_lookup < 1:
+                raise ValueError(
+                    f"--ngram-lookup must be >= 1 (got {cfg.ngram_lookup})")
         backend = jax.default_backend()
         default_dtype = "float32" if backend == "cpu" else "bfloat16"
         if model_cfg is None:
@@ -775,45 +820,21 @@ class Engine:
 
         mixed_fns = {lp: make_mixed_step(lp) for lp in (False, True)}
 
-        def spec_fn(params, tokens, drafts, positions, context_lens, active,
-                    block_tables, temperature, top_p, top_k, presence,
-                    frequency, min_p, bias_ids, bias_vals, slot_keys, counts,
-                    room, k_pages, v_pages):
-            """One speculative verify step: current + K draft tokens through
-            a single forward, longest-prefix acceptance for pure-greedy
-            slots, the normal sampler for the rest (they emit one token per
-            verify step). Per-request output is IDENTICAL to sequential
-            decoding: accepted drafts match the greedy chain by
-            construction, and position-0 sampling uses the same
-            fold_in(slot_key, position) key the one-token path uses."""
+        def _spec_accept(logits, drafts, tokens, positions, context_lens,
+                         active, state, slot_keys, counts, room):
+            """Shared acceptance tail of the two verify programs: replay
+            the per-position sampling chain (smp.verify_accept), bank the
+            emitted tokens into the penalty counts, and advance the carried
+            batch state by n_acc + 1 per active slot. Penalized slots are
+            ineligible (their counts snapshot goes stale mid-window) but
+            still emit their exact position-0 token."""
             b, k = drafts.shape
             k1 = k + 1
-            toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
-            out = llama.decode_verify(
-                mcfg, params, toks, positions, block_tables, room,
-                k_pages, v_pages, page_size=page_size,
-            )
-            state = smp.SamplingState(
-                temperature, top_p, top_k, presence, frequency,
-                min_p, bias_ids, bias_vals,
-            )
-            keys = smp.fold_positions(slot_keys, positions)
-            t0 = smp.sample(out.logits[:, 0], state, keys, counts)
-            greedy_all = jnp.argmax(
-                out.logits.astype(jnp.float32), axis=-1
-            )  # [B, K1]
-            # acceptance only where sampling is pure greedy (no temperature,
-            # no penalties): there sample() == argmax, so the accepted chain
-            # reproduces sequential decoding exactly
-            # bias shifts argmax, so biased slots must not take the raw
-            # greedy-acceptance shortcut (min_p is moot at temperature 0)
-            eligible = ((temperature <= 0.0) & (presence == 0.0)
-                        & (frequency == 0.0)
-                        & jnp.all(bias_ids < 0, axis=1) & room & active)
-            match = drafts == greedy_all[:, :-1]
-            acc = jnp.cumprod(match.astype(jnp.int32), axis=1)
-            n_acc = jnp.where(eligible, acc.sum(axis=1), 0)
-            emitted = jnp.concatenate([t0[:, None], greedy_all[:, 1:]], axis=1)
+            eligible = ((state.presence_penalty == 0.0)
+                        & (state.frequency_penalty == 0.0) & room & active)
+            emitted, n_acc = smp.verify_accept(
+                logits, drafts, state, slot_keys, positions, eligible,
+                counts)
             emit_mask = ((jnp.arange(k1)[None, :] <= n_acc[:, None])
                          & active[:, None])
             rows = jnp.repeat(jnp.arange(b), k1)
@@ -823,8 +844,77 @@ class Engine:
             step = jnp.where(active, n_acc + 1, 0).astype(positions.dtype)
             last = jnp.take_along_axis(emitted, n_acc[:, None], axis=1)[:, 0]
             tokens_new = jnp.where(active, last, tokens)
-            return (rep((emitted, n_acc)), tokens_new, positions + step,
-                    context_lens + step, counts, out.k_pages, out.v_pages)
+            return (emitted, n_acc, tokens_new, positions + step,
+                    context_lens + step, counts)
+
+        def spec_fn(params, tokens, drafts, positions, context_lens, active,
+                    block_tables, temperature, top_p, top_k, presence,
+                    frequency, min_p, bias_ids, bias_vals, slot_keys, counts,
+                    room, k_pages, v_pages, *aslot):
+            """One speculative verify step: current + K draft tokens through
+            a single forward, longest-prefix acceptance via the replayed
+            sampling chain (smp.verify_accept). Per-request output is
+            IDENTICAL to sequential decoding for greedy AND seeded-sampled
+            slots: every window row samples with the same
+            fold_in(slot_key, position) key the one-token path would use at
+            that position, so a draft is accepted exactly when the chain
+            draws it. LoRA slots verify against their adapter's logits
+            (gathered einsum inside decode_verify)."""
+            toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            out = llama.decode_verify(
+                mcfg, params, toks, positions, block_tables, room,
+                k_pages, v_pages, page_size=page_size,
+                adapter_slots=aslot[0] if aslot else None,
+            )
+            state = smp.SamplingState(
+                temperature, top_p, top_k, presence, frequency,
+                min_p, bias_ids, bias_vals,
+            )
+            (emitted, n_acc, tokens_new, pos_new, ctx_new,
+             counts) = _spec_accept(out.logits, drafts, tokens, positions,
+                                    context_lens, active, state, slot_keys,
+                                    counts, room)
+            return (rep((emitted, n_acc)), tokens_new, pos_new, ctx_new,
+                    counts, out.k_pages, out.v_pages)
+
+        def mixed_spec_fn(params, tokens, drafts, positions, context_lens,
+                          active, block_tables, temperature, top_p, top_k,
+                          presence, frequency, min_p, bias_ids, bias_vals,
+                          slot_keys, counts, room, k_pages, v_pages, *extra):
+            """ONE ragged step where every decode slot runs a speculative
+            verify window AND the inflight prefill chunk rides the same
+            program — spec_fn x mixed_fn (llama.mixed_verify_step routes
+            both row kinds through ragged_verify_attention). The leading
+            operands match spec_fn exactly so its donation tuple carries
+            over; the chunk operands trail and are fresh uploads each
+            call, like mixed_fn's."""
+            # extra layout: [adapter_slots]? + (p_tokens, p_start, p_len,
+            # p_pages) + [p_adapter_slot]? — like mixed_fn
+            aslots = None
+            if lora_on:
+                aslots, extra = extra[0], extra[1:]
+            p_tokens, p_start, p_len, p_pages = extra[:4]
+            p_aslot = extra[4] if lora_on else None
+            toks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            out = llama.mixed_verify_step(
+                mcfg, params, toks, positions, block_tables, room,
+                p_tokens, p_start, p_len, p_pages, k_pages, v_pages,
+                page_size=page_size, adapter_slots=aslots,
+                chunk_adapter_slot=p_aslot,
+            )
+            state = smp.SamplingState(
+                temperature, top_p, top_k, presence, frequency,
+                min_p, bias_ids, bias_vals,
+            )
+            (emitted, n_acc, tokens_new, pos_new, ctx_new,
+             counts) = _spec_accept(out.logits, drafts, tokens, positions,
+                                    context_lens, active, state, slot_keys,
+                                    counts, room)
+            # chunk_logits go back raw: the host samples the first token
+            # only on the FINAL chunk (same tail as mixed_fn)
+            return (rep((emitted, n_acc)), rep(out.chunk_logits),
+                    tokens_new, pos_new, ctx_new, counts,
+                    out.k_pages, out.v_pages)
 
         def sample_first(logits, temperature, top_p, top_k, min_p,
                          bias_ids, bias_vals, req_key, pos):
@@ -873,6 +963,7 @@ class Engine:
             self._windows = {k: ctx(f) for k, f in window_fns.items()}
             self._mixed = {k: ctx(f) for k, f in mixed_fns.items()}
             self._spec = ctx(spec_fn)
+            self._mixed_spec = ctx(mixed_spec_fn)
             self._sample_first = ctx(sample_first)
             self._sample_first_batch = ctx(sample_first_batch)
             self._reset_count = ctx(reset_count_fn)
@@ -911,6 +1002,10 @@ class Engine:
             # same intent as window_donate: tokens/pos/ctx/counts/k/v (the
             # reused bias/key arrays at 13-15 must NOT be donated)
             jspec = jax.jit(spec_fn, donate_argnums=(1, 3, 4, 16, 18, 19))
+            # the mixed-spec leading operands are spec_fn's, so the same
+            # donation tuple applies; chunk operands trail undonated
+            jms = jax.jit(mixed_spec_fn,
+                          donate_argnums=(1, 3, 4, 16, 18, 19))
             js = jax.jit(sample_first)
             jr = jax.jit(reset_count_fn, donate_argnums=(0,))
             ji = jax.jit(import_fn, donate_argnums=(0, 1))
@@ -920,6 +1015,7 @@ class Engine:
             self._windows = {k: ctx(f) for k, f in jw.items()}
             self._mixed = {k: ctx(f) for k, f in jm.items()}
             self._spec = ctx(jspec)
+            self._mixed_spec = ctx(jms)
             self._sample_first = ctx(js)
             self._sample_first_batch = ctx(jsb)
             self._reset_count = ctx(jr)
@@ -962,6 +1058,8 @@ class Engine:
                     self._jit_handles[f"mixed_{l}"] = f
             if cfg.speculative_mode != "off":
                 self._jit_handles["spec"] = jspec
+                if cfg.mixed_batch_tokens > 0:
+                    self._jit_handles["mixed_spec"] = jms
 
     def set_kv_event_sink(self, sink) -> None:
         """Attach the cluster KV event plane: `sink(kind, [hash bytes],
@@ -1088,11 +1186,15 @@ class Engine:
                             temperature=0.0, ignore_eos=True))
                     while self.has_work:
                         self.step()
-            if cfg.mixed_batch_tokens > 0 and cfg.speculative_mode == "off":
+            if cfg.mixed_batch_tokens > 0:
                 # unified ragged step: an anchor sequence keeps decode
                 # slots live while one prompt per bucket streams in, so
                 # the mixed program compiles at every page-table width
-                # (plus the logprobs twin) before /ready flips
+                # (plus the logprobs twin) before /ready flips. With
+                # speculation on, the lp=None pass routes through
+                # _mixed_spec_step and compiles the mixed-verify program
+                # instead; the lp pass still compiles mixed[True] (the
+                # logprobs demotion path)
                 for lp in (None, 1):
                     tag = "lp" if lp else "t"
                     self.add_request(GenRequest(
@@ -1389,8 +1491,23 @@ class Engine:
             if self._mixed_eligible():
                 # unified ragged step: the inflight chunk rides the decode
                 # window — one dispatch serves both, so there is no
-                # separate decode this iteration
-                events.extend(self._mixed_step())
+                # separate decode this iteration. With speculation on the
+                # verify windows ride the same program (mixed_spec) unless
+                # a logprobs request demotes the step to plain mixed
+                # (per-position logprob extraction isn't wired through
+                # verify — counted like the other spec demotions).
+                if self.cfg.speculative_mode != "off":
+                    if any(s.logprobs is not None
+                           for s in self.seqs.values()):
+                        att_ops._note_fallback(
+                            "spec", "logprobs",
+                            "logprobs request in the batch: mixed step "
+                            "runs without verify windows")
+                        events.extend(self._mixed_step())
+                    else:
+                        events.extend(self._mixed_spec_step())
+                else:
+                    events.extend(self._mixed_step())
                 self._qos_account(events)
                 return events
             if self._inflight is not None:
@@ -2096,15 +2213,16 @@ class Engine:
         """The unified ragged step serves this iteration iff a chunked
         prefill is inflight AND decode slots are live — otherwise the
         classic paths are strictly better (full/batched prefill when
-        idle, plain fused windows when nothing is admitting). Speculative
-        and guided decode keep the classic alternation: the mixed program
-        carries neither draft nor grammar operands (the inflight
-        request's OWN guide still applies — its first token is masked
-        host-side by _first_token, same as the chunk path)."""
+        idle, plain fused windows when nothing is admitting). Speculation
+        composes: step() routes to _mixed_spec_step, whose program carries
+        the draft operands as ragged verify rows. Guided decode keeps the
+        classic alternation — neither mixed program carries grammar
+        operands (the inflight request's OWN guide still applies: its
+        first token is masked host-side by _first_token, same as the
+        chunk path)."""
         return (self.cfg.mixed_batch_tokens > 0
                 and self._inflight is not None
                 and bool(self.seqs)
-                and self.cfg.speculative_mode == "off"
                 and not any(s.guide is not None
                             for s in self.seqs.values()))
 
@@ -2195,6 +2313,126 @@ class Engine:
 
         # final chunk rode this window: same installation tail as
         # _advance_chunk, with the ragged program's last-token logits
+        self._inflight = None
+        self.metrics.prompt_tokens += inf.prompt_len
+        req = inf.req
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
+                                     namespace=req.adapter or "")
+        first, req_key, lp = self._first_token(req, chunk_logits,
+                                               inf.prompt_len)
+        seq = self._install_slot(req, inf.slot, inf.pages, inf.prompt_len,
+                                 first, req_key)
+        finished, reason = self._check_stop(seq, first)
+        now = time.monotonic()
+        self.metrics.observe_phase("prefill", now - inf.t_start)
+        ev = TokenEvent(req.request_id, first, 0, finished, reason)
+        ev.phase = {
+            "queue_s": max(0.0, inf.t_start - req.arrival_time),
+            "prefill_s": max(0.0, now - inf.t_start),
+        }
+        if req.logprobs is not None:
+            self._decorate_lp(ev, seq, lp[0], lp[1], lp[2])
+        if finished:
+            self._finish_slot(inf.slot, reason)
+        events.append(ev)
+        return events
+
+    def _mixed_spec_step(self) -> List[TokenEvent]:
+        """One unified ragged step WITH speculation: every decode slot runs
+        a K+1-token verify window, the inflight prefill chunk rides the
+        same dispatch, and each speculating slot emits 1..K+1 tokens — the
+        composition the roadmap called the biggest gap (the fastest
+        scheduler and the fastest decoder were mutually exclusive). The
+        spec program is dispatched even when no slot drafted this step
+        (n_acc = 0 everywhere reduces it to plain mixed semantics) so the
+        compiled-program set stays bounded and warm."""
+        inf = self._inflight
+        cfg = self.cfg
+        events: List[TokenEvent] = []
+        if self._pending_win is not None:
+            events.extend(self._materialize_pending())
+        k = cfg.num_speculative_tokens
+        k1 = k + 1
+        got = self._grow_pages(k1, events)
+        if not self.seqs:
+            # page pressure killed the whole batch: the chunk still has
+            # its reserved pages — advance it on the classic path
+            events.extend(self._advance_chunk())
+            return events
+        drafts, room = self._spec_drafts(got)
+        c = cfg.mixed_batch_tokens
+        start = inf.done
+        take = min(c, inf.prompt_len - start)
+        p_tokens = np.zeros((c,), dtype=np.int32)
+        p_tokens[:take] = inf.req.prompt_token_ids[start:start + take]
+
+        t0 = time.monotonic()
+        self._ensure_dev_state()
+        cur, pos, ctx_lens, active_dev = self._dev_state
+        (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
+         keys) = self._dev_sampling
+        d_drafts, d_room = self._upload(drafts, room)
+        lx = (self._dev_adapters,) if self.lora is not None else ()
+        px = (jnp.int32(inf.aslot),) if self.lora is not None else ()
+        (ys, chunk_logits, cur, pos, ctx_lens, self.token_counts,
+         self.k_pages, self.v_pages) = self._mixed_spec(
+            self.params, cur, d_drafts, pos, ctx_lens, active_dev,
+            self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
+            bias_ids, bias_vals, keys, self.token_counts, d_room,
+            self.k_pages, self.v_pages, *lx,
+            jnp.asarray(p_tokens), jnp.int32(start), jnp.int32(take),
+            jnp.asarray(inf.pages_arr), *px,
+        )
+        self._dev_state = (cur, pos, ctx_lens, active_dev)
+        slots = list(self.seqs)
+        emitted_np = np.asarray(ys[0])  # [B, K1]
+        nacc_np = np.asarray(ys[1])  # [B]
+        dt = time.monotonic() - t0
+        inf.done += take
+        total = sum(int(nacc_np[s]) + 1 for s in slots)
+        self.metrics.decode_steps += 1
+        self.metrics.decode_time_s += dt
+        self.metrics.spec_draft_tokens += int(room[slots].sum()) * k
+        self.metrics.spec_accepted_tokens += int(nacc_np[slots].sum())
+        for s in slots:
+            if room[s]:
+                self.metrics.observe_spec_accept(int(nacc_np[s]))
+        self.metrics.observe_phase("mixed_step", dt)
+        self.metrics.observe_phase("decode_window", dt)
+        self.metrics.observe_occupancy(len(slots), cfg.max_num_seqs)
+        self.metrics.observe_mixed(take, len(slots))
+        # weight = effective steps this verify advanced (same vote scheme
+        # as _decode_spec, so spec and plain windows share the histogram)
+        eff_steps = max(1, -(-total // len(slots)))
+        self.metrics.observe_phase("decode_step", dt / eff_steps,
+                                   weight=eff_steps)
+        for slot in slots:
+            seq = self.seqs.get(slot)
+            if seq is None:
+                continue
+            for j in range(int(nacc_np[slot]) + 1):
+                tok = int(emitted_np[slot, j])
+                seq.num_tokens += 1
+                seq.output_tokens.append(tok)
+                self.cur_tokens[slot] = tok
+                self.metrics.output_tokens += 1
+                finished, reason = self._check_stop(seq, tok)
+                events.append(TokenEvent(
+                    seq.request_id, tok, len(seq.output_tokens) - 1,
+                    finished, reason,
+                ))
+                if finished:
+                    # mid-chain stop: later accepted tokens are discarded;
+                    # _finish_slot invalidates device state, so the stale
+                    # advanced position is rebuilt from mirrors next step
+                    self._finish_slot(slot, reason)
+                    break
+        if inf.done < inf.prompt_len:
+            return events
+
+        # final chunk rode this window: same installation tail as
+        # _mixed_step, with the ragged program's last-token logits
         self._inflight = None
         self.metrics.prompt_tokens += inf.prompt_len
         req = inf.req
@@ -2402,17 +2640,69 @@ class Engine:
                     break
         return [hist[-1] if hist else 0] * k
 
+    def _spec_demoted(self):
+        """Batch-wide speculation demotions: reasons the whole verify step
+        must fall back to the classic window path, counted and one-shot
+        logged through the pallas-fallback plumbing
+        (dynamo_pallas_fallback_total{op="spec",reason})."""
+        if any(s.guide is not None for s in self.seqs.values()):
+            att_ops._note_fallback(
+                "spec", "guided",
+                "verify samples from unmasked logits — drafts could "
+                "escape the grammar")
+            return True
+        if any(s.logprobs is not None for s in self.seqs.values()):
+            att_ops._note_fallback(
+                "spec", "logprobs",
+                "per-position logprob extraction is not wired through "
+                "verify")
+            return True
+        return False
+
+    def _spec_drafts(self, got: int):
+        """Host-side draft gate for one verify step: n-gram proposals for
+        every slot whose acceptance can be nonzero. Sampled and LoRA slots
+        draft (acceptance replays the per-position sampling chain);
+        penalized slots don't — their counts snapshot would go stale
+        mid-window — and neither do slots whose pages/limits can't cover
+        K+1 tokens ahead. Per-slot demotions are counted (reason-keyed,
+        one-shot-logged) instead of silently drafting nothing."""
+        cfg = self.cfg
+        k = cfg.num_speculative_tokens
+        k1 = k + 1
+        limit = min(cfg.max_seq_len,
+                    cfg.max_pages_per_seq * cfg.page_size)
+        drafts = np.zeros((cfg.max_num_seqs, k), np.int32)
+        room = np.zeros((cfg.max_num_seqs,), np.bool_)
+        for slot, seq in self.seqs.items():
+            if (self.presence[slot] != 0.0
+                    or self.frequency[slot] != 0.0):
+                att_ops._note_fallback(
+                    "spec", "penalties",
+                    "presence/frequency counts go stale mid-window; the "
+                    "slot emits one token per verify step")
+                continue
+            if not (got == k1 and seq.num_tokens + k1 <= limit
+                    and len(seq.pages) * cfg.page_size
+                    >= seq.num_tokens + k1):
+                att_ops._note_fallback(
+                    "spec", "page_shortfall",
+                    "pool/table/length limits can't cover K+1 tokens "
+                    "ahead")
+                continue
+            room[slot] = True
+            drafts[slot] = self._propose_ngram(seq)
+        return drafts, room
+
     def _decode_spec(self) -> List[TokenEvent]:
         """Speculative decode step: one verify dispatch emits 1..K+1 tokens
-        per greedy sequence (vLLM/TRT-LLM's n-gram speculation analogue).
-        Logprobs requests fall back to the classic window path for the step
-        (per-position logprob extraction is not wired through verify);
-        JSON-guided requests likewise — the verify forward samples from
-        unmasked logits, which would let drafts escape the grammar; and
-        LoRA-attached sequences — the verify forward runs base-model
-        logits, so drafts would be accepted against the wrong model."""
-        if any(s.logprobs is not None or s.guide is not None
-               or s.adapter_slot for s in self.seqs.values()):
+        per speculating sequence (vLLM/TRT-LLM's n-gram speculation
+        analogue). Greedy, seeded-sampled, and LoRA-attached sequences all
+        speculate — acceptance replays the per-position sampling chain and
+        the verify forward applies gathered adapter deltas. Logprobs and
+        JSON-guided requests demote the step to the classic window path
+        (counted via _spec_demoted)."""
+        if self._spec_demoted():
             return self._decode_once()
         events: List[TokenEvent] = []
         cfg = self.cfg
@@ -2421,25 +2711,13 @@ class Engine:
         got = self._grow_pages(k1, events)
         if not self.seqs:
             return events
-        limit = min(cfg.max_seq_len,
-                    cfg.max_pages_per_seq * cfg.page_size)
-        drafts = np.zeros((cfg.max_num_seqs, k), np.int32)
-        room = np.zeros((cfg.max_num_seqs,), np.bool_)
-        for slot, seq in self.seqs.items():
-            # draft only for slots whose acceptance can be nonzero: pure
-            # greedy (the device forces n_acc = 0 for everything else)
-            greedy = (seq.temperature <= 0.0 and self.presence[slot] == 0.0
-                      and self.frequency[slot] == 0.0
-                      and self.bias_ids[slot].max() < 0)
-            if (got == k1 and greedy and seq.num_tokens + k1 <= limit
-                    and len(seq.pages) * cfg.page_size >= seq.num_tokens + k1):
-                room[slot] = True
-                drafts[slot] = self._propose_ngram(seq)
+        drafts, room = self._spec_drafts(got)
 
         if not room.any():
-            # nothing drafted (all-sampled batch, page shortfall): the
+            # nothing drafted (all-penalized batch, page shortfall): the
             # verify forward would cost (K+1)x a decode step to emit the
             # same one token per slot — use the plain window path instead
+            # (the per-slot demotions were counted by _spec_drafts)
             events.extend(self._decode_once())
             return events
 
@@ -2449,12 +2727,13 @@ class Engine:
         (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
          keys) = self._dev_sampling
         d_drafts, d_room = self._upload(drafts, room)
+        lx = (self._dev_adapters,) if self.lora is not None else ()
         (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
          self.v_pages) = self._spec(
             self.params, cur, d_drafts, pos, ctx_lens, active_dev,
             self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
             bias_ids, bias_vals, keys, self.token_counts, d_room,
-            self.k_pages, self.v_pages,
+            self.k_pages, self.v_pages, *lx,
         )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
         slots = list(self.seqs)
@@ -2466,6 +2745,9 @@ class Engine:
         self.metrics.decode_time_s += dt
         self.metrics.spec_draft_tokens += int(room[slots].sum()) * k
         self.metrics.spec_accepted_tokens += int(nacc_np[slots].sum())
+        for s in slots:
+            if room[s]:
+                self.metrics.observe_spec_accept(int(nacc_np[s]))
         self.metrics.observe_phase("decode_window", dt)
         self.metrics.observe_occupancy(len(slots), self.cfg.max_num_seqs)
         # weight = effective steps this verify advanced, so spec verifies

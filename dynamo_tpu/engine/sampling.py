@@ -187,6 +187,49 @@ def fold_positions(keys: jax.Array, positions: jax.Array) -> jax.Array:
     return jax.vmap(jax.random.fold_in)(keys, positions)
 
 
+def verify_accept(
+    logits: jax.Array,  # [B, K1, V] verify logits at every window position
+    drafts: jax.Array,  # [B, K] proposed draft tokens
+    state: SamplingState,
+    keys: jax.Array,  # [B, 2] per-slot chain roots (NOT step keys)
+    positions: jax.Array,  # [B] absolute position of the window's first row
+    eligible: jax.Array,  # [B] bool: slot may accept drafts at all
+    counts: jax.Array | None = None,  # [B, V] output-token counts
+) -> Tuple[jax.Array, jax.Array]:
+    """Longest-prefix draft acceptance that REPLAYS the sequential chain:
+    row j of slot b is sampled with key `fold_in(slot_key, position + j)` —
+    exactly the key non-speculative decode would use at that position — and
+    the window accepts while `sampled == draft`. Returns (emitted [B, K1],
+    n_acc [B]): `emitted[b, :n_acc[b] + 1]` are the tokens the slot
+    produces this step.
+
+    Because the n-gram proposer is a deterministic point proposal, this IS
+    the rejection-sampling acceptance rule collapsed to its draft == sample
+    case: a draft token is accepted iff the target chain at that position
+    draws it, and the first rejected position emits the chain's own draw —
+    so seeded runs produce byte-identical streams with speculation on or
+    off, and greedy (temp 0) reduces to the argmax-prefix rule.
+
+    Position 0 (the non-speculative token every slot emits) is sampled WITH
+    `counts`, byte-identical to a plain decode step. Rows 1..K are sampled
+    without penalty counts: within a window the counts snapshot would go
+    stale as tokens are accepted, so penalized slots must be passed
+    eligible=False (they still emit their exact position-0 token). All
+    other sampling params (temperature, top-k/p, min_p, logit bias) are
+    static per-slot and replay exactly.
+    """
+    b, k1, v = logits.shape
+    t0 = sample(logits[:, 0], state, fold_positions(keys, positions), counts)
+    rep = SamplingState(*[jnp.repeat(f, k1, axis=0) for f in state])
+    pos_grid = (positions[:, None] + jnp.arange(k1)[None, :]).reshape(-1)
+    grid_keys = fold_positions(jnp.repeat(keys, k1, axis=0), pos_grid)
+    grid = sample(logits.reshape(b * k1, v), rep, grid_keys).reshape(b, k1)
+    emitted = jnp.concatenate([t0[:, None], grid[:, 1:]], axis=1)
+    match = (drafts == emitted[:, :-1]).astype(jnp.int32)
+    n_acc = jnp.where(eligible, jnp.cumprod(match, axis=1).sum(axis=1), 0)
+    return emitted, n_acc
+
+
 def key_snapshot(key) -> list:
     """Serialize a per-request PRNG chain root as its raw uint32 pair.
 

@@ -27,6 +27,13 @@ Targets are the attention projections q/k/v/o (the high-leverage LoRA
 placement; MLP targets can stack on the same scheme later). MLA models are
 rejected at engine init — their absorbed-latent projections need a
 different placement.
+
+The per-token slot broadcast is what lets speculative verify forwards run
+through adapters (v2, docs/perf.md "Speculative decoding v2"): a K+1-wide
+verify window repeats its sequence's slot index per window position
+(llama.decode_verify / mixed_verify_step), so adapter sequences accept
+drafts scored by their OWN weights — the round-3 base-logits fallback and
+its acceptance penalty are gone.
 """
 
 from __future__ import annotations

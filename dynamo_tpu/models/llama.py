@@ -689,11 +689,16 @@ def decode_verify(
     v_pages: jax.Array,
     *,
     page_size: int,
+    adapter_slots=None,  # [B] int32 per-slot LoRA slots, or None
 ) -> VerifyOut:
     """Speculative-decoding verification step: run current + K draft tokens
     per sequence through one forward, returning logits at every position so
     the sampler can accept the longest draft prefix the model agrees with
     (vLLM/TRT-LLM ship the same capability on the reference's engines).
+    Adapter sequences keep their gathered-LoRA deltas inside the verify
+    forward (each slot's adapter applied to all K1 of its rows), so drafts
+    are verified against the same adapted distribution decode would sample
+    from — the PR 5 base-logits fallback is gone.
 
     Draft K/V is written into the sequence's pages before attending (like
     prefill_chunk); rejected drafts leave garbage K/V past the accepted
@@ -713,13 +718,16 @@ def decode_verify(
     valid = (jnp.arange(b * k1) % k1 == 0) | jnp.repeat(room, k1)
     flat_pos = jnp.where(valid, flat_pos, 0)
     flat_tables = jnp.where(valid[:, None], flat_tables, 0)
+    slots = (None if adapter_slots is None
+             else jnp.repeat(adapter_slots.astype(jnp.int32), k1))
     x = _embed_rows(cfg, params, tokens.reshape(b * k1))
 
     def body(x, kp, vp, lp, page_off):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         q, k, v = _qkv(cfg, lp, h, flat_pos,
                        rope=_layer_rope(cfg, page_off,
-                                        k_pages.shape[1]))
+                                        k_pages.shape[1]),
+                       lora_slots=slots)
         kp, vp = att.write_kv_token(
             kp, vp, k, v, flat_tables + page_off, flat_pos,
             page_size=page_size,
@@ -731,7 +739,8 @@ def decode_verify(
             **_attn_kwargs(cfg, page_off, k_pages.shape[1]),
         )
         x = x + _post(cfg, lp, "post_attn_norm",
-                  _attn_out(cfg, lp, o.reshape(b * k1, *o.shape[2:])))
+                  _attn_out(cfg, lp, o.reshape(b * k1, *o.shape[2:]),
+                            lora_slots=slots))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
         x = x + _post(cfg, lp, "post_mlp_norm", _mlp(cfg, lp, h))
         return x, kp, vp
@@ -885,3 +894,106 @@ def mixed_step(
     rows = jnp.concatenate([x[:b], last])
     logits = _logits(cfg, params, rows)
     return MixedOut(logits[:b], logits[b], k_pages, v_pages)
+
+
+class MixedVerifyOut(NamedTuple):
+    logits: jax.Array  # [B, K1, V] — verify logits at every window position
+    chunk_logits: jax.Array  # [V] logits at the chunk's last valid token
+    k_pages: jax.Array
+    v_pages: jax.Array
+
+
+def mixed_verify_step(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, K1] current token + K drafts per decode slot
+    positions: jax.Array,  # [B] absolute position of tokens[:, 0]
+    block_tables: jax.Array,  # [B, Pmax]
+    room: jax.Array,  # [B] bool: pages/limits cover all K draft writes
+    chunk_tokens: jax.Array,  # [C] one prefill chunk, page-multiple padded
+    chunk_start: jax.Array,  # scalar int32: absolute position of chunk[0]
+    chunk_len: jax.Array,  # scalar int32: valid tokens in this chunk
+    chunk_pages: jax.Array,  # [Wp] ALL page ids of the chunk's sequence
+    k_pages: jax.Array,  # [L, P, ps, KV*D]
+    v_pages: jax.Array,
+    *,
+    page_size: int,
+    adapter_slots=None,  # [B] int32 per-slot LoRA slots, or None
+    chunk_adapter_slot=None,  # scalar int32 LoRA slot of the chunk's seq
+) -> MixedVerifyOut:
+    """ONE ragged step where every decode slot runs a K+1-token speculative
+    verify window AND one prefill chunk makes progress — the spec-decode
+    extension of mixed_step (a speculating slot is just a ragged row of
+    q_len = K+1 instead of 1; see ops/ragged_attention.py).
+
+    Row layout is windows-first: [B*K1 verify rows | C chunk rows].
+    Per-token math (projections, rope, LoRA deltas, MLP) over the
+    concatenated batch is bit-identical to the separate decode_verify +
+    prefill_chunk dispatches; attention routes through
+    ops.attention.ragged_verify_attention, whose XLA composition is the
+    exact per-path reference. KV writes follow decode_verify's room
+    contract (roomless slots divert draft writes to the trash page and
+    behave as plain decode for position 0) plus mixed_step's disjoint
+    chunk-page scatter. MoE rows use dense dispatch for identity, as in
+    mixed_step.
+    """
+    b, k1 = tokens.shape
+    c = chunk_tokens.shape[0]
+    n = b * k1
+    pos2 = positions[:, None] + jnp.arange(k1)[None, :]  # [B, K1]
+    flat_pos = pos2.reshape(n)
+    flat_tables = jnp.repeat(block_tables, k1, axis=0)  # [B*K1, Pmax]
+    valid = (jnp.arange(n) % k1 == 0) | jnp.repeat(room, k1)
+    flat_pos = jnp.where(valid, flat_pos, 0)
+    flat_tables = jnp.where(valid[:, None], flat_tables, 0)
+    all_pos = jnp.concatenate([flat_pos, chunk_start + jnp.arange(c)])
+    token_mask = jnp.concatenate(
+        [jnp.ones((n,), bool), jnp.arange(c) < chunk_len])
+    write_pages = jax.lax.dynamic_slice(
+        chunk_pages, (chunk_start // page_size,), (c // page_size,)
+    )
+    slots = None
+    if adapter_slots is not None:
+        ca = (jnp.int32(0) if chunk_adapter_slot is None
+              else chunk_adapter_slot)
+        slots = jnp.concatenate(
+            [jnp.repeat(adapter_slots.astype(jnp.int32), k1),
+             jnp.full((c,), ca, jnp.int32)])
+    x = _embed_rows(cfg, params,
+                    jnp.concatenate([tokens.reshape(n), chunk_tokens]))
+
+    def body(x, kp, vp, lp, page_off):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
+        q, k, v = _qkv(cfg, lp, h, all_pos,
+                       rope=_layer_rope(cfg, page_off,
+                                        k_pages.shape[1]),
+                       lora_slots=slots)
+        kp, vp = att.write_kv_token(
+            kp, vp, k[:n], v[:n], flat_tables + page_off, flat_pos,
+            page_size=page_size,
+        )
+        kp, vp = att.write_kv_prefill(
+            kp, vp, k[n:], v[n:], write_pages + page_off,
+            page_size=page_size
+        )
+        o = att.ragged_verify_attention(
+            q, kp, vp, block_tables + page_off, positions,
+            chunk_pages + page_off, chunk_start, page_size=page_size,
+            num_kv_heads=cfg.cache_kv_heads, num_verify=b, verify_width=k1,
+            **_attn_kwargs(cfg, page_off, k_pages.shape[1]),
+        )
+        x = x + _post(cfg, lp, "post_attn_norm",
+                      _attn_out(cfg, lp, o, lora_slots=slots))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, cfg.rms_norm_unit_offset)
+        x = x + _post(cfg, lp, "post_mlp_norm",
+                      _mlp(cfg, lp, h, token_mask=token_mask))
+        return x, kp, vp
+
+    x, k_pages, v_pages = _scan_layers_paged(
+        params, body, x, k_pages, v_pages, cfg.num_layers
+    )
+    last = jnp.take(x[n:], chunk_len - 1, axis=0)[None]  # [1, E]
+    rows = jnp.concatenate([x[:n], last])
+    logits = _logits(cfg, params, rows)
+    return MixedVerifyOut(logits[:n].reshape(b, k1, -1), logits[n],
+                          k_pages, v_pages)

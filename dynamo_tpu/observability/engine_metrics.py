@@ -16,6 +16,12 @@ second observation path:
   (docs/perf.md "Unified ragged step"; persistently high fractions mean
   --mixed-batch-tokens crowds decode, near-zero means the budget is
   slack);
+- `dynamo_engine_spec_draft_tokens_total` /
+  `dynamo_engine_spec_accepted_tokens_total` /
+  `dynamo_engine_spec_accept_length` — speculative decoding v2 health:
+  accepted/draft is the live acceptance rate, and the acceptance-length
+  histogram (0..K integer buckets) shows whether --num-speculative-tokens
+  is tuned to the workload (docs/perf.md "Speculative decoding v2");
 - `dynamo_pallas_fallback_total{op,reason}` — Pallas→XLA demotions the
   head/lane gates (and int8 lane-blocking / seq-parallel mesh checks)
   made silently before; each label pair also logs one warning at first
@@ -116,6 +122,23 @@ def _mixed_series(engine):
     return [({}, edges, cum, round(m.mixed_sum, 6), total)]
 
 
+def _spec_series(engine):
+    """Speculative acceptance length per verify window
+    (EngineMetrics.observe_spec_accept): how many of the K drafted tokens
+    the target chain accepted, integer edges 0..K. Same cumulative-bucket
+    scheme as occupancy; mean acceptance = _sum / _count."""
+    m = engine.metrics
+    edges = list(m._SPEC_EDGES)
+    cum = []
+    running = 0
+    for c in m.spec_accept_buckets[:-1]:
+        running += c
+        cum.append(running)
+    total = running + m.spec_accept_buckets[-1]
+    cum.append(total)  # +Inf
+    return [({}, edges, cum, float(m.spec_accept_sum), total)]
+
+
 def _fallback_counts():
     """dynamo_pallas_fallback_total labels from the attention dispatch's
     demotion bookkeeping (process-wide; each pair warned once)."""
@@ -167,6 +190,20 @@ class EngineMetricsBridge:
             "Unified ragged step composition: prefill-token fraction of "
             "each mixed window's rows",
             registry, lambda: _mixed_series(self.engine))
+        CallbackHistogram(
+            "dynamo_engine_spec_accept_length",
+            "Accepted draft tokens per speculative verify window (0..K); "
+            "mean acceptance length = _sum / _count",
+            registry, lambda: _spec_series(self.engine))
+        CallbackCounter(
+            "dynamo_engine_spec_draft_tokens_total",
+            "Draft tokens proposed to speculative verify windows",
+            registry, lambda: self.engine.metrics.spec_draft_tokens)
+        CallbackCounter(
+            "dynamo_engine_spec_accepted_tokens_total",
+            "Draft tokens the target chain accepted (acceptance rate = "
+            "accepted / draft)",
+            registry, lambda: self.engine.metrics.spec_accepted_tokens)
         CallbackCounterVec(
             "dynamo_pallas_fallback_total",
             "Pallas kernels demoted to the XLA path by the head/lane "

@@ -646,6 +646,117 @@ def ragged_mixed_attention(
     return jnp.concatenate([dec, chk], axis=0)
 
 
+def ragged_verify_attention(
+    q: jax.Array,  # [B*K1 + C, H, D] — B verify windows, then one C-chunk
+    k_pages: jax.Array,  # [P, ps, KV*D] (or int8 packed rows)
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [B, Pmax] per-window page tables
+    positions: jax.Array,  # [B] absolute position of each window's q[0]
+    p_pages: jax.Array,  # [Wp] the chunk's page ids (trash-padded tail)
+    p_start,  # scalar int32: absolute position of the chunk's first token
+    *,
+    page_size: int,
+    num_kv_heads=None,
+    num_verify: int,
+    verify_width: int,
+    window=None,
+    logit_cap: float = 0.0,
+) -> jax.Array:
+    """Speculative verify windows as ragged rows: B windows of K1 = 1 + K
+    query tokens each AND one prefill chunk in a single program — the spec-
+    decode extension of ragged_mixed_attention. Window b's query j sits at
+    absolute position `positions[b] + j` and attends causally over the
+    window's pages (drafts' K/V already written, like verify_attention).
+
+    Dispatch mirrors ragged_mixed_attention: DYNAMO_TPU_RAGGED_ATTENTION
+    wins when set; otherwise the Pallas kernel (each window = one padded
+    query block, via decode_q=K1) is selected once RAGGED_KERNEL_HW_VALIDATED
+    flips, and until then the XLA composition — verify gather + chunk gather
+    — serves every backend. Inactive windows carry zero tables + position 0
+    (trash-page rows, outputs discarded by the engine)."""
+    backend = os.environ.get("DYNAMO_TPU_RAGGED_ATTENTION")
+    if not backend:
+        from dynamo_tpu.ops import ragged_attention as _ra
+
+        backend = (_resolve_backend() if _ra.RAGGED_KERNEL_HW_VALIDATED
+                   else "xla")
+    if window is not None or logit_cap:
+        backend = "xla"  # sliding window / softcap: kernel doesn't model them
+    if backend in ("pallas", "pallas_interpret") \
+            and _seq_parallel_mesh() is not None:
+        _note_fallback("ragged attention", "seq_mesh",
+                       "sequence-parallel mesh shards the pool under GSPMD")
+        backend = "xla"
+    n_kv = _pool_kv_heads(k_pages, q.shape[2], num_kv_heads)
+    b, k1 = num_verify, verify_width
+    c = q.shape[0] - b * k1
+    if backend in ("pallas", "pallas_interpret"):
+        quantized = k_pages.dtype == jnp.int8
+        lb = _kv_lane_blocks() if quantized else 1
+        mesh = _mesh_for_shard_map()
+        tp = _mesh_tp(mesh)
+        span = n_kv * q.shape[2] if quantized else k_pages.shape[2]
+        aligned = (
+            _pallas_head_gate(q.shape[1], n_kv, tp, "ragged attention")
+            and _pallas_lane_gate(span, tp, "ragged attention")
+        )
+        if quantized and lb != max(tp, 1):
+            # the kernel reads single-block rows (see decode dispatch)
+            _note_fallback(
+                "ragged attention", "int8_lane_blocks",
+                f"mesh TP ({tp}) != pool lane blocking ({lb})")
+            aligned = False
+        if aligned:
+            from dynamo_tpu.ops import ragged_attention as ra
+
+            interp = backend == "pallas_interpret"
+            n_kv_call = n_kv // max(tp, 1)
+            # unified descriptors: window rows span [pos, pos + K1) so the
+            # horizon includes every draft written this step
+            pmax = block_tables.shape[1]
+            wp = p_pages.shape[0]
+            w = max(pmax, wp)
+            tabs = jnp.zeros((b + 1, w), jnp.int32)
+            tabs = tabs.at[:b, :pmax].set(block_tables.astype(jnp.int32))
+            tabs = tabs.at[b, :wp].set(p_pages.astype(jnp.int32))
+            ps = positions.astype(jnp.int32)
+            st = jnp.asarray(p_start, jnp.int32)
+            kv_lens = jnp.concatenate([ps + k1, (st + c).reshape(1)])
+            q_starts = jnp.concatenate([ps, st.reshape(1)])
+
+            def call(q, kp, vp, tb, kl, qs):
+                return ra.ragged_paged_attention(
+                    q, kp, vp, tb, kl, qs, page_size=page_size,
+                    num_kv_heads=n_kv_call, num_decode=b, decode_q=k1,
+                    interpret=interp,
+                )
+
+            if mesh is None:
+                return call(q, k_pages, v_pages, tabs, kv_lens, q_starts)
+            return _shard_map(
+                call,
+                mesh=mesh,
+                in_specs=(P(None, "model", None), P(None, None, "model"),
+                          P(None, None, "model"), P(None, None), P(None),
+                          P(None)),
+                out_specs=P(None, "model", None),
+                check_vma=False,
+            )(q, k_pages, v_pages, tabs, kv_lens, q_starts)
+    # XLA composition: the verify gather and chunk gather reference paths,
+    # concatenated — token-identical to the separate-program paths by
+    # construction (what the mixed-spec parity tests pin).
+    ver = verify_attention(
+        q[:b * k1].reshape(b, k1, q.shape[1], q.shape[2]),
+        k_pages, v_pages, block_tables, positions,
+        page_size=page_size, num_kv_heads=n_kv,
+        window=window, logit_cap=logit_cap)
+    chk = chunk_attention_xla(
+        q[b * k1:], k_pages, v_pages, p_pages, p_start, page_size=page_size,
+        num_kv_heads=n_kv, window=window, logit_cap=logit_cap)
+    return jnp.concatenate(
+        [ver.reshape(b * k1, q.shape[1], q.shape[2]), chk], axis=0)
+
+
 def verify_attention(
     q: jax.Array,  # [B, K1, H, D] — current token + K draft tokens per seq
     k_pages: jax.Array,  # [P, ps, KV*D]

@@ -222,7 +222,7 @@ def _ragged_kernel(
 
 
 def ragged_paged_attention(
-    q: jax.Array,  # [num_decode + C, H, D] — decode rows first, then chunk
+    q: jax.Array,  # [num_decode * decode_q + C, H, D] — leading rows, chunk
     k_pages: jax.Array,  # [P, ps, KV*D] (or int8 packed single-block rows)
     v_pages: jax.Array,
     tables: jax.Array,  # [num_decode + 1, W] int32 (last row = chunk pages)
@@ -232,16 +232,22 @@ def ragged_paged_attention(
     page_size: int,
     num_kv_heads: int,
     num_decode: int,
+    decode_q: int = 1,
     block_q: int = 8,
     block_pages: int = DEFAULT_BLOCK_PAGES,
     num_bufs: int = DEFAULT_NUM_BUFS,
     interpret: bool = False,
 ) -> jax.Array:
-    """Mixed ragged batch: `num_decode` single-token rows (one padded query
-    block each) plus ONE prefill chunk of C tokens tiled into blocks, all on
-    one sequential grid. Returns [num_decode + C, H, D]."""
+    """Mixed ragged batch: `num_decode` leading rows of `decode_q` query
+    tokens each (one padded query block per row) plus ONE prefill chunk of C
+    tokens tiled into blocks, all on one sequential grid. decode_q=1 is the
+    plain mixed step; decode_q=K+1 makes each leading row a speculative
+    verify window — the kernel needs no change because its mask is causal in
+    absolute positions and clamped per-row by kv_lens, so a K+1-wide window
+    with kv_len = q_start + K + 1 scores exactly like a mid-prefill row.
+    Returns [num_decode * decode_q + C, H, D]."""
     total, n_heads, head_dim = q.shape
-    c = total - num_decode
+    c = total - num_decode * decode_q
     assert c >= 1, "ragged batch needs a prefill chunk (use decode kernel)"
     lane_width = k_pages.shape[2]
     quantized = k_pages.dtype == jnp.int8
@@ -255,24 +261,31 @@ def ragged_paged_attention(
     block_pages = max(1, min(block_pages, width))
     num_bufs = max(2, num_bufs)
     # largest power-of-two divisor of c not exceeding the requested block
-    # (chunks are page multiples, not necessarily block_q multiples)
-    block_q = max(1, min(block_q, c))
+    # (chunks are page multiples, not necessarily block_q multiples); a
+    # verify window must fit inside one padded query block, so the block
+    # can't shrink below decode_q — the engine guarantees decode_q <= page
+    # size <= chunk length, which keeps these two constraints compatible
+    block_q = max(1, min(max(block_q, decode_q), c))
     while c % block_q != 0:
         block_q //= 2
+    assert block_q >= decode_q, (block_q, decode_q, c)
     n_chunk_blocks = c // block_q
     nbq = num_decode + n_chunk_blocks
     nk_max = -(-width // block_pages)
     scale = 1.0 / (head_dim**0.5)
     rows = block_q * n_heads
 
-    # decode rows each get their own zero-padded query block; the chunk is
+    # leading rows each get their own zero-padded query block (decode_q real
+    # tokens, the rest padding whose outputs are discarded); the chunk is
     # tiled block_q tokens per block
+    nd = num_decode * decode_q
     q_dec = jnp.zeros((num_decode, block_q, n_heads, head_dim), q.dtype)
     if num_decode:
-        q_dec = q_dec.at[:, 0].set(q[:num_decode])
+        q_dec = q_dec.at[:, :decode_q].set(
+            q[:nd].reshape(num_decode, decode_q, n_heads, head_dim))
     q4 = jnp.concatenate(
         [q_dec,
-         q[num_decode:].reshape(n_chunk_blocks, block_q, n_heads, head_dim)],
+         q[nd:].reshape(n_chunk_blocks, block_q, n_heads, head_dim)],
         axis=0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -328,5 +341,5 @@ def ragged_paged_attention(
     )(tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
       q_starts.astype(jnp.int32), q4, k_pages, v_pages)
     return jnp.concatenate(
-        [out[:num_decode, 0],
+        [out[:num_decode, :decode_q].reshape(nd, n_heads, head_dim),
          out[num_decode:].reshape(c, n_heads, head_dim)], axis=0)

@@ -254,7 +254,13 @@ class TenantAccountant:
     refill exactly as fast as the engine decodes, and a tenant running
     alone nets zero (work conservation: an idle fleet never throttles).
     Balances clamp to ±burst so an idle tenant cannot bank an unbounded
-    claim and an aggressor's debt stays repayable."""
+    claim and an aggressor's debt stays repayable.
+
+    Speculative decoding: produced counts are TokenEvents, i.e. ACCEPTED
+    tokens only — a verify window that proposes K drafts and lands n
+    debits n+1, never K+1. Rejected drafts are the operator's compute
+    bet (docs/perf.md "Speculative decoding v2"), not the tenant's
+    budget."""
 
     def __init__(self, registry: TenantRegistry, burst_tokens: int = 512):
         self.registry = registry

@@ -872,6 +872,25 @@ class _Handler(JsonHTTPHandler):
                 "config": dataclasses.asdict(eng.cfg),
                 "metrics": eng.metrics.snapshot(),
             }
+            if eng.cfg.speculative_mode != "off":
+                # speculation health at a glance: acceptance_rate is
+                # accepted/draft (the knob docs/perf.md "Speculative
+                # decoding v2" tunes K against), mean_accept_len the
+                # per-window histogram mean
+                m = eng.metrics
+                out["spec"] = {
+                    "mode": eng.cfg.speculative_mode,
+                    "num_speculative_tokens": eng.cfg.num_speculative_tokens,
+                    "ngram_lookup": eng.cfg.ngram_lookup,
+                    "draft_tokens": m.spec_draft_tokens,
+                    "accepted_tokens": m.spec_accepted_tokens,
+                    "acceptance_rate": (
+                        round(m.spec_accepted_tokens / m.spec_draft_tokens, 4)
+                        if m.spec_draft_tokens else 0.0),
+                    "mean_accept_len": (
+                        round(m.spec_accept_sum / m.spec_accept_count, 4)
+                        if m.spec_accept_count else 0.0),
+                }
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 out["prefix_cache"] = pc.stats()

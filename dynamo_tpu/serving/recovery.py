@@ -32,6 +32,13 @@ Journaling is per-request opt-in by the frontend and restricted to the
 shapes recovery can actually splice: streaming, single-choice (n == 1),
 no tool-call gating. Everything else keeps PR 2's truncate semantics.
 Kill switch: ``DYNAMO_TPU_RECOVERY=0``.
+
+Speculative decoding composes for free: checkpoints ride TokenEvents,
+which the engine emits only for ACCEPTED tokens — a journal never names
+a token the target chain hasn't confirmed, and a continuation restoring
+the PRNG-key snapshot resumes the identical position-folded chain even
+when the crash landed mid-verify-window (docs/perf.md "Speculative
+decoding v2"; tests/test_speculative.py recovery-mid-speculation).
 """
 
 from __future__ import annotations

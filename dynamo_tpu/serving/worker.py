@@ -46,6 +46,12 @@ log = logging.getLogger("dynamo_tpu.worker")
 
 # Per-backend scheduling defaults (see module docstring). Applied as argparse
 # defaults, so an explicit CLI flag always overrides its profile value.
+# --speculative-mode is deliberately NOT a profile default: it is a
+# workload bet (docs/perf.md "Speculative decoding v2" — pays off only on
+# repetitive/agentic token streams), so the operator opts in per
+# deployment; v2 composes with every profile here, including the
+# chunked/mixed continuous-batching ones. Acceptance health lands on this
+# worker's /metrics (dynamo_engine_spec_*) and /worker/stats `spec`.
 BACKEND_PROFILES = {
     "jetstream": dict(
         num_scheduler_steps=8,

@@ -80,9 +80,12 @@ def main() -> None:
     )
 
     faults.reset_plane()
+    # speculation on (K=3 < page_size=4): the spec counters/histogram must
+    # ride the same lint-clean scrape as everything else
     engine = Engine(EngineConfig(
         model=MODEL, page_size=4, num_pages=128, max_num_seqs=4,
-        max_seq_len=96, lora_slots=2, lora_rank=4))
+        max_seq_len=96, lora_slots=2, lora_rank=4,
+        speculative_mode="ngram", num_speculative_tokens=3))
     engine.lora.register(
         "ada", tensors=lora_apply.random_adapter(ModelConfig(), rank=4,
                                                  seed=1, scale=0.3), rank=4)
@@ -142,6 +145,9 @@ def main() -> None:
         for series in ("dynamo_engine_mfu", "dynamo_engine_mbu",
                        "dynamo_engine_batch_occupancy_bucket",
                        "dynamo_engine_jit_programs",
+                       "dynamo_engine_spec_draft_tokens_total",
+                       "dynamo_engine_spec_accepted_tokens_total",
+                       "dynamo_engine_spec_accept_length_bucket",
                        "dynamo_spans_dropped_total",
                        'dynamo_lora_requests_total{adapter="ada"}',
                        "dynamo_slo_burn_rate", "dynamo_slo_attainment"):

@@ -415,10 +415,12 @@ def test_gemma2_speculative_decode_token_identical():
     prompt = [4, 7, 4, 7, 4, 7, 4, 7, 4, 7, 4, 7]  # repetitive: drafts hit
     ref = seq_eng.generate(GenRequest("r", prompt, max_tokens=14,
                                       temperature=0.0, ignore_eos=True))
+    # K=3: engine init enforces num_speculative_tokens < page_size (4 here)
     spec_eng = Engine(EngineConfig(model="tiny-gemma2-debug", page_size=4,
                                    num_pages=64, max_num_seqs=2,
                                    max_seq_len=64, seed=9,
-                                   speculative_mode="ngram"),
+                                   speculative_mode="ngram",
+                                   num_speculative_tokens=3),
                       params=seq_eng.params)
     out = spec_eng.generate(GenRequest("s", prompt, max_tokens=14,
                                        temperature=0.0, ignore_eos=True))

@@ -123,7 +123,8 @@ def test_int8_kv_with_chunked_prefill_and_prefix_cache():
 
 def test_int8_kv_with_speculative_decode():
     a, _ = _gen("int8")
-    b, _ = _gen("int8", speculative_mode="ngram")
+    # K=3: engine init enforces num_speculative_tokens < page_size (4 here)
+    b, _ = _gen("int8", speculative_mode="ngram", num_speculative_tokens=3)
     assert a == b
 
 

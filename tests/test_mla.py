@@ -51,7 +51,8 @@ def test_mla_chunked_prefill_matches_full():
 
 def test_mla_speculative_matches_sequential():
     a, _ = _gen()
-    b, _ = _gen(speculative_mode="ngram")
+    # K=3: engine init enforces num_speculative_tokens < page_size (4 here)
+    b, _ = _gen(speculative_mode="ngram", num_speculative_tokens=3)
     assert a == b
 
 

@@ -6,6 +6,7 @@ every other slot still gets its one normally-sampled token per verify step.
 The reference's engines (vLLM / TRT-LLM) ship the same capability.
 """
 
+import json
 from typing import List
 
 import pytest
@@ -15,12 +16,17 @@ from dynamo_tpu.engine.engine import Engine
 from dynamo_tpu.engine.kv_cache import SeqState
 from dynamo_tpu.engine.request import GenRequest
 
+pytestmark = pytest.mark.spec
+
 PROMPT = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]
 
 
 def make_engine(spec="ngram", **kw):
     cfg = dict(
-        model="tiny-debug", page_size=4, num_pages=128, max_num_seqs=2,
+        # page_size 8 (not the usual test 4): engine init enforces
+        # num_speculative_tokens < page_size so the K+1 verify window fits
+        # one KV page / ragged query block
+        model="tiny-debug", page_size=8, num_pages=128, max_num_seqs=2,
         max_seq_len=256, speculative_mode=spec, num_speculative_tokens=4,
         prefill_chunk_tokens=0, enable_prefix_caching=False,
     )
@@ -157,3 +163,192 @@ def test_acceptance_metrics_exposed():
     gen(eng)
     snap = eng.metrics.snapshot()
     assert "spec_draft_tokens" in snap and "spec_accepted_tokens" in snap
+    # v2: per-window acceptance-length histogram rides the same snapshot
+    assert "spec_accept_mean" in snap
+    assert eng.metrics.spec_accept_count > 0
+
+
+# ---------------------------------------------------------------------------
+# v2: composition with the ragged mixed step, LoRA, sampling state, and QoS
+# (docs/perf.md "Speculative decoding v2")
+# ---------------------------------------------------------------------------
+
+
+def test_spec_knob_validation():
+    """Engine init rejects unusable knobs instead of failing deep in a
+    jitted trace: K >= page_size cannot fit the K+1 verify window in one
+    KV page / ragged query block."""
+    with pytest.raises(ValueError, match="num-speculative-tokens"):
+        make_engine("ngram", num_speculative_tokens=0)
+    with pytest.raises(ValueError, match="page-size"):
+        make_engine("ngram", num_speculative_tokens=8)  # page_size is 8
+    with pytest.raises(ValueError, match="ngram-lookup"):
+        make_engine("ngram", ngram_lookup=0)
+    # knobs are inert with speculation off — bad values must not block
+    # a non-speculating engine
+    make_engine("off", num_speculative_tokens=0)
+
+
+def _collect(eng, out):
+    for ev in eng.step():
+        if ev.token_id >= 0:
+            out[ev.request_id].append(ev.token_id)
+
+
+def test_mixed_spec_parity_jit():
+    """THE v2 acceptance bar, jitted: greedy AND seeded-sampled streams
+    keep byte-identical output with speculation on vs off while a long
+    prompt chunks through the unified ragged mixed step — the speculating
+    slots ride that same program as K+1-wide verify rows."""
+
+    def run(spec):
+        eng = make_engine(spec, max_num_seqs=3, prefill_chunk_tokens=16,
+                          mixed_batch_tokens=16)
+        out = {"g": [], "s": [], "p": []}
+        eng.add_request(GenRequest("g", PROMPT, max_tokens=12,
+                                   temperature=0.0, ignore_eos=True))
+        eng.add_request(GenRequest("s", PROMPT, max_tokens=12,
+                                   temperature=0.9, seed=7, ignore_eos=True))
+        for _ in range(3):  # decode reaches steady state first
+            _collect(eng, out)
+        eng.add_request(GenRequest("p", list(range(1, 41)), max_tokens=4,
+                                   temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            _collect(eng, out)
+        return out
+
+    assert run("off") == run("ngram")
+
+
+@pytest.fixture(scope="module")
+def lora_setup():
+    import jax
+
+    from dynamo_tpu.lora import apply as lora_apply
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    mcfg = ModelConfig()
+    base = llama.init_params(mcfg, jax.random.PRNGKey(0))
+    # scale large enough that the adapter visibly shifts greedy argmax
+    # (same rationale as test_lora.py's fixture)
+    ada = lora_apply.random_adapter(mcfg, rank=4, seed=1, scale=0.3)
+    return base, ada
+
+
+def make_lora_engine(spec, base, ada, **kw):
+    cfg = dict(
+        model="tiny-debug", page_size=8, num_pages=128, max_num_seqs=4,
+        max_seq_len=128, speculative_mode=spec, num_speculative_tokens=4,
+        lora_slots=2, lora_rank=4, enforce_eager=True,
+        prefill_chunk_tokens=0, enable_prefix_caching=False,
+    )
+    cfg.update(kw)
+    eng = Engine(EngineConfig(**cfg), params=dict(base))
+    eng.lora.register("ada", tensors=ada, rank=4)
+    return eng
+
+
+def test_lora_adapter_speculation_parity(lora_setup):
+    """v2 drops PR 5's base-logits fallback: an adapter sequence verifies
+    through its adapter (gathered einsum inside the verify forward) and
+    genuinely accepts drafts — parity AND acceptance, not just parity."""
+    base, ada = lora_setup
+    req = dict(max_tokens=20, temperature=0.0, ignore_eos=True,
+               adapter="ada")
+    ref = make_lora_engine("off", base, ada).generate(
+        GenRequest("r", PROMPT, **req))
+    eng = make_lora_engine("ngram", base, ada)
+    _oracle(eng, ref)
+    out = eng.generate(GenRequest("r", PROMPT, **req))
+    assert out == ref
+    assert eng.metrics.spec_accepted_tokens > len(ref) // 2
+
+
+def test_mixed_spec_lora_identity(lora_setup):
+    """Full composition, eager (jitted sibling: test_mixed_spec_parity_jit):
+    greedy + seeded-sampled + LoRA-adapter streams speculate while a long
+    prompt chunks through the mixed ragged program; output is
+    byte-identical to the spec-off engine."""
+    base, ada = lora_setup
+
+    def run(spec):
+        eng = make_lora_engine(spec, base, ada, prefill_chunk_tokens=16,
+                               mixed_batch_tokens=16)
+        out = {"g": [], "s": [], "l": [], "p": []}
+        eng.add_request(GenRequest("g", PROMPT, max_tokens=10,
+                                   temperature=0.0, ignore_eos=True))
+        eng.add_request(GenRequest("s", PROMPT, max_tokens=10,
+                                   temperature=0.9, seed=7, ignore_eos=True))
+        eng.add_request(GenRequest("l", PROMPT, max_tokens=10,
+                                   temperature=0.0, ignore_eos=True,
+                                   adapter="ada"))
+        for _ in range(3):
+            _collect(eng, out)
+        eng.add_request(GenRequest("p", list(range(1, 41)), max_tokens=2,
+                                   temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            _collect(eng, out)
+        return out
+
+    assert run("off") == run("ngram")
+
+
+def test_recovery_mid_speculation_byte_identity(lora_setup):
+    """A sampling-state snapshot taken MID-speculation (verify windows
+    landing multiple tokens per step) resumes the identical chain: the
+    continuation's output is byte-for-byte the reference suffix. This is
+    the seam the recovery journal/HA resume plane writes — checkpoints
+    ride TokenEvents, i.e. accepted tokens only, so a snapshot never
+    names a token the target chain hasn't confirmed."""
+    ref = gen(make_engine("off"), temp=0.8, seed=42)
+    eng = make_engine("ngram")
+    _oracle(eng, ref)
+    eng.add_request(GenRequest("r", PROMPT, max_tokens=24, temperature=0.8,
+                               seed=42, ignore_eos=True))
+    got: List[int] = []
+    while len(got) < 8:
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                got.append(ev.token_id)
+    snap = eng.export_sampling_state("r")
+    eng.abort_request("r")
+    assert got == ref[:len(got)]
+    # continuation: prompt + emitted tokens, chain root restored from the
+    # snapshot (seed omitted — resume_key overrides derivation)
+    cont = make_engine("ngram")
+    out = cont.generate(GenRequest("r2", PROMPT + got,
+                                   max_tokens=24 - len(got), temperature=0.8,
+                                   resume_key=snap["key"], ignore_eos=True))
+    assert got + out == ref
+
+
+def test_qos_debits_accepted_not_proposed():
+    """The TenantAccountant banks what speculation EMITS, not what it
+    proposes: with always-rejected drafts the tenant is debited exactly
+    one token per emitted token, while the draft counter shows several
+    times as many proposals."""
+    tenants = json.dumps([{"name": "acme", "weight": 1}])
+    eng = make_engine("ngram", tenants=tenants)
+    k = eng.cfg.num_speculative_tokens
+    eng._propose_ngram = lambda seq: [0] * k  # near-certain rejection
+    out = eng.generate(GenRequest("r", PROMPT, max_tokens=12,
+                                  temperature=0.0, ignore_eos=True,
+                                  tenant="acme"))
+    assert eng.metrics.spec_draft_tokens > len(out)
+    assert eng.qos.tokens_total.get("acme", 0) == len(out)
+
+
+def test_penalty_demotion_counted_and_parity():
+    """Presence/frequency-penalized sequences demote to one token per
+    step (intra-window count staleness) — counted under
+    dynamo_pallas_fallback_total{op="spec",reason="penalties"} — and
+    still decode byte-identically to the spec-off engine."""
+    from dynamo_tpu.ops import attention as att
+
+    key = ("spec", "penalties")
+    base = dict(att.pallas_fallback_counts()).get(key, 0)
+    a = gen(make_engine("off"), mt=8, presence_penalty=0.8)
+    b = gen(make_engine("ngram"), mt=8, presence_penalty=0.8)
+    assert a == b
+    assert att.pallas_fallback_counts().get(key, 0) > base

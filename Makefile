@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -30,6 +30,7 @@ help:
 	@echo "  recovery-check mid-stream recovery suite (journaled continuation failover, drain handoff)"
 	@echo "  lora-check     multi-LoRA suite (registry LRU, mixed-batch parity, adapter routing)"
 	@echo "  obs-check      SLO/exemplar suite + live scrape validation (burn rates, OpenMetrics)"
+	@echo "  flight-check   flight recorder + memory/cost-attribution suite (conservation, /debug/flight)"
 	@echo "  qos-check      per-tenant QoS suite (weighted-fair isolation, tenant admission, SLO-burn shed)"
 	@echo "  planner-check  coordinated autoscaling suite (pool planner, flash-crowd simulation, drain-before-shrink)"
 	@echo "  rpa-check      unified ragged-step suite (kernel parity, mixed/classic identity, bench contract)"
@@ -108,6 +109,18 @@ lora-check:
 # OpenMetrics exemplars).
 obs-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q -p no:randomly
+	JAX_PLATFORMS=cpu python scripts/obs_check.py
+
+# Flight-recorder + memory/cost gate (docs/observability.md "Flight
+# recorder", "Memory & cost accounting"): the `flight` marker suite —
+# ring mechanics and dump forensics, the per-tenant cost conservation
+# invariant (incl. under QoS preemption), the exact device-tier memory
+# partition, the /debug/trace 409 contract — plus the live obs_check
+# boot, which lints the new dynamo_memory_*/dynamo_tenant_cost_* series
+# and asserts a nonzero /debug/flight ring on a real engine.
+flight-check:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_flight.py \
+		tests/test_cost_accounting.py -q -p no:randomly
 	JAX_PLATFORMS=cpu python scripts/obs_check.py
 
 # Per-tenant QoS gate (docs/robustness.md "Per-tenant QoS"): the `qos`

@@ -494,6 +494,21 @@ class Engine:
         # sequence may already be gone by the time step() returns them
         self._rid_tenant: Dict[str, str] = {}
 
+        # flight recorder + cost attribution (observability plane): one
+        # ring record per step, one ledger entry per executed segment.
+        # Both are lock-cheap enough to stay on unconditionally; the ring
+        # size is an env knob (DYNAMO_TPU_FLIGHT_RECORDS, 0 disables).
+        from dynamo_tpu.observability.cost import CostLedger
+        from dynamo_tpu.observability.flight import FlightRecorder
+
+        self.flight = FlightRecorder()
+        self.cost = CostLedger()
+        self._page_nbytes = (self.kv_spec.bytes_per_token()
+                             * cfg.page_size)
+        # pallas/spec demotion counts already seen (per-step delta -> ring)
+        self._flight_fallback_prev: Dict[tuple, int] = dict(
+            att_ops.pallas_fallback_counts())
+
         # --- batch slots (host-side mirrors of device batch state) ---
         b, pmax = cfg.max_num_seqs, cfg.max_pages_per_seq
         self.block_tables = np.zeros((b, pmax), dtype=np.int32)
@@ -1321,6 +1336,10 @@ class Engine:
             if i:
                 for t2 in deferred:
                     self.qos.note_defer(t2)
+                self.flight.note("defer", tenants=sorted(deferred),
+                                 reason="qos_share_or_budget",
+                                 beneficiary_rid=r.request_id,
+                                 beneficiary_tenant=t)
             return i
         return 0
 
@@ -1383,6 +1402,10 @@ class Engine:
         slot, seq = max(victims, key=lambda kv: (
             self._rank_priority(kv[1].req), kv[1].req.arrival_time))
         if self.seqs.get(slot) is seq:  # materializing may have finished it
+            self.flight.note(
+                "qos_preempt", victim_rid=seq.request_id, victim_slot=slot,
+                victim_tenant=self._tenant_of(seq.req),
+                beneficiary_rid=cand.request_id, beneficiary_tenant=cand_t)
             self._preempt_slot(slot)
         return events
 
@@ -1444,6 +1467,14 @@ class Engine:
             self._insert_pending(req)
             self._rid_tenant[req.request_id] = self._tenant_of(req)
             self.metrics.num_requests += 1
+        if req.resume_key is not None or req.prior_output_token_ids:
+            # recovery seam: this request continues one that was preempted
+            # or handed over from another worker — the flight ring is how a
+            # post-mortem ties the continuation back to the failure
+            self.flight.note(
+                "resume", rid=req.request_id, tenant=self._tenant_of(req),
+                n_prior=len(req.prior_output_token_ids),
+                seeded=req.resume_key is not None)
 
     def abort_request(self, request_id: str) -> None:
         """Mark a request aborted; the scheduler thread applies it in step()."""
@@ -1467,6 +1498,10 @@ class Engine:
         for slot, seq in list(self.seqs.items()):
             ids.append(seq.request_id)
             self._finish_slot(slot, "abort")
+        # crash/abort dump: abort_all is the fatal-step recovery path
+        # (engine_service) as well as explicit teardown — either way the
+        # ring tail goes to the log before the evidence scrolls away
+        self.flight.dump("abort_all", rids=ids)
         return ids
 
     @property
@@ -1484,49 +1519,140 @@ class Engine:
         """One scheduler iteration: apply aborts, admit (prefill), decode.
 
         step() is single-consumer: only one scheduler thread may call it.
-        Producers (add_request/abort_request) synchronise via self._lock."""
+        Producers (add_request/abort_request) synchronise via self._lock.
+        Each call opens one flight-recorder draft: the segments executed
+        inside fill its phases (_step_obs), decisions taken along the way
+        attach as events, and the commit stamps the closing batch
+        composition. A step that did no work commits nothing."""
         with self._exec_lock:
-            events: List[TokenEvent] = []
-            events.extend(self._apply_aborts())
-            if self._mixed_eligible():
-                # unified ragged step: the inflight chunk rides the decode
-                # window — one dispatch serves both, so there is no
-                # separate decode this iteration. With speculation on the
-                # verify windows ride the same program (mixed_spec) unless
-                # a logprobs request demotes the step to plain mixed
-                # (per-position logprob extraction isn't wired through
-                # verify — counted like the other spec demotions).
-                if self.cfg.speculative_mode != "off":
-                    if any(s.logprobs is not None
-                           for s in self.seqs.values()):
-                        att_ops._note_fallback(
-                            "spec", "logprobs",
-                            "logprobs request in the batch: mixed step "
-                            "runs without verify windows")
-                        events.extend(self._mixed_step())
-                    else:
-                        events.extend(self._mixed_spec_step())
-                else:
+            self.flight.begin()
+            try:
+                return self._step_locked()
+            finally:
+                if self.flight.enabled:
+                    self.flight.commit(
+                        active=len(self.seqs), pending=len(self.pending),
+                        free_pages=self.allocator.free_pages,
+                        batch=self._flight_batch())
+
+    def _step_locked(self) -> List[TokenEvent]:
+        events: List[TokenEvent] = []
+        events.extend(self._apply_aborts())
+        if self._mixed_eligible():
+            # unified ragged step: the inflight chunk rides the decode
+            # window — one dispatch serves both, so there is no
+            # separate decode this iteration. With speculation on the
+            # verify windows ride the same program (mixed_spec) unless
+            # a logprobs request demotes the step to plain mixed
+            # (per-position logprob extraction isn't wired through
+            # verify — counted like the other spec demotions).
+            if self.cfg.speculative_mode != "off":
+                if any(s.logprobs is not None
+                       for s in self.seqs.values()):
+                    att_ops._note_fallback(
+                        "spec", "logprobs",
+                        "logprobs request in the batch: mixed step "
+                        "runs without verify windows")
+                    self.flight.note("spec_demote", reason="logprobs")
                     events.extend(self._mixed_step())
-                self._qos_account(events)
-                return events
-            if self._inflight is not None:
-                # one chunk per step: decode windows run between chunks, so
-                # a long admission never monopolizes the chip
-                events.extend(self._advance_chunk())
-            else:
-                events.extend(self._admit())
-            if self.seqs:
-                if self.cfg.speculative_mode != "off":
-                    events.extend(self._decode_spec())
-                elif self.cfg.async_scheduling:
-                    events.extend(self._decode_async())
                 else:
-                    events.extend(self._decode_once())
-            # per-tenant QoS: bank this step's decoded tokens into the
-            # weighted-fair budgets (no-op without configured tenants)
+                    events.extend(self._mixed_spec_step())
+            else:
+                events.extend(self._mixed_step())
             self._qos_account(events)
             return events
+        if self._inflight is not None:
+            # one chunk per step: decode windows run between chunks, so
+            # a long admission never monopolizes the chip
+            events.extend(self._advance_chunk())
+        else:
+            events.extend(self._admit())
+        if self.seqs:
+            if self.cfg.speculative_mode != "off":
+                events.extend(self._decode_spec())
+            elif self.cfg.async_scheduling:
+                events.extend(self._decode_async())
+            else:
+                events.extend(self._decode_once())
+        # per-tenant QoS: bank this step's decoded tokens into the
+        # weighted-fair budgets (no-op without configured tenants)
+        self._qos_account(events)
+        return events
+
+    # ------------------------------------------------- flight/cost hooks --
+
+    def _flight_batch(self) -> List[dict]:
+        """Batch composition stamped on each flight record: who holds the
+        decode slots (and the inflight chunk) as the step closes."""
+        out: List[dict] = []
+        for slot in sorted(self.seqs):
+            seq = self.seqs.get(slot)
+            if seq is None:
+                continue
+            req = seq.req
+            out.append({
+                "slot": slot, "rid": seq.request_id,
+                "tenant": self._tenant_of(req) if req else "default",
+                "adapter": (req.adapter or "") if req else "",
+                "n_out": len(seq.output_tokens)})
+        inf = self._inflight
+        if inf is not None:
+            out.append({
+                "slot": inf.slot, "rid": inf.req.request_id,
+                "tenant": self._tenant_of(inf.req),
+                "adapter": inf.req.adapter or "",
+                "chunk_done": inf.done, "prompt_len": inf.prompt_len})
+        return out
+
+    def _step_obs(self, kind: str, dur_s: float, take: int = 0,
+                  shares: Optional[Dict[str, float]] = None) -> None:
+        """Record one executed segment (a dispatch) in the flight draft and
+        attribute its wall time + KV residency to tenants.
+
+        `shares` (tenant -> work units) overrides the default attribution;
+        without it decode slots count one unit each and the inflight chunk
+        counts `take` (its tokens this segment) — the ISSUE's attribution
+        rule. Holdings (KV bytes on device) always come from the live
+        holder set, so byte-seconds track actual residency."""
+        pb = self._page_nbytes
+        holdings: Dict[str, float] = {}
+        computed: Dict[str, float] = {}
+        for seq in list(self.seqs.values()):
+            t = self._tenant_of(seq.req) if seq.req is not None else "default"
+            computed[t] = computed.get(t, 0.0) + 1.0
+            holdings[t] = holdings.get(t, 0.0) + len(seq.pages) * pb
+        inf = self._inflight
+        if inf is not None:
+            t = self._tenant_of(inf.req)
+            if take > 0:
+                computed[t] = computed.get(t, 0.0) + float(take)
+            holdings[t] = holdings.get(t, 0.0) + len(inf.pages) * pb
+        for rid, parked in list(self._parked.items()):
+            t = self._rid_tenant.get(rid, "default")
+            holdings[t] = holdings.get(t, 0.0) + len(parked[0]) * pb
+        self.cost.account(dur_s, shares if shares is not None else computed,
+                          holdings)
+        if self.flight.enabled:
+            self.flight.phase(kind, dur_s, **({"take": take} if take else {}))
+            self._flight_note_fallback_delta()
+
+    def _flight_note_fallback_delta(self) -> None:
+        """Surface pallas/spec demotions that fired since the last segment
+        as flight events (the module-level counters in ops/attention are
+        the source of truth; the ring only needs the per-step delta)."""
+        try:
+            cur = att_ops.pallas_fallback_counts()
+        except Exception:
+            return
+        prev = self._flight_fallback_prev
+        for key, n in cur.items():
+            d = n - prev.get(key, 0)
+            if d > 0:
+                op, reason = key
+                self.flight.note("pallas_fallback" if op != "spec"
+                                 else "spec_demote",
+                                 op=op, reason=reason, n=d)
+        self._flight_fallback_prev = dict(cur)
 
     def _apply_aborts(self) -> List[TokenEvent]:
         with self._lock:
@@ -1544,6 +1670,8 @@ class Engine:
             for r in self.pending:
                 if r.request_id in aborted:
                     events.append(TokenEvent(r.request_id, -1, 0, True, "abort"))
+                    self.flight.note("abort", rid=r.request_id,
+                                     tenant=self._tenant_of(r), where="queued")
                 else:
                     kept.append(r)
             self.pending = kept
@@ -1553,6 +1681,9 @@ class Engine:
             self._free_slots.append(inf.slot)
             self._inflight = None
             events.append(TokenEvent(inf.req.request_id, -1, 0, True, "abort"))
+            self.flight.note("abort", rid=inf.req.request_id,
+                             tenant=self._tenant_of(inf.req), where="chunk",
+                             slot=inf.slot)
         for slot, seq in list(self.seqs.items()):
             if seq.request_id in aborted:
                 events.append(
@@ -1591,6 +1722,10 @@ class Engine:
                 try:
                     self._adapter_slot(req)
                 except NoFreeAdapterSlot:
+                    self.flight.note("defer", rid=req.request_id,
+                                     tenant=self._tenant_of(req),
+                                     reason="no_adapter_slot",
+                                     adapter=req.adapter)
                     break  # all slots serve live sequences; finishes free one
                 except KeyError:
                     # unregistered between submit and admission
@@ -1598,6 +1733,10 @@ class Engine:
                         self._pending_remove(req)
                     events.append(
                         TokenEvent(req.request_id, -1, 0, True, "abort"))
+                    self.flight.note("abort", rid=req.request_id,
+                                     tenant=self._tenant_of(req),
+                                     reason="unknown_adapter",
+                                     adapter=req.adapter)
                     continue
             # prefix lookup BEFORE the page gate: only the suffix needs
             # fresh pages, and gating on the full prompt would let the
@@ -1614,6 +1753,11 @@ class Engine:
             if not self._ensure_pages(n_pages - len(cached_pages)):
                 if cached_pages:
                     self.allocator.free(cached_pages)  # drop our refs
+                self.flight.note("defer", rid=req.request_id,
+                                 tenant=self._tenant_of(req),
+                                 reason="no_pages",
+                                 need_pages=n_pages - len(cached_pages),
+                                 free_pages=self.allocator.free_pages)
                 break  # wait for running sequences to release pages
             with self._lock:
                 self._pending_remove(req)
@@ -1650,6 +1794,8 @@ class Engine:
                 events.append(
                     TokenEvent(req.request_id, -1, 0, True, "kv_oom")
                 )
+                self.flight.note("kv_oom", rid=req.request_id,
+                                 tenant=self._tenant_of(req), where="prefill")
                 continue
             events.append(ev)
         return events
@@ -1803,6 +1949,11 @@ class Engine:
         dt = time.monotonic() - t0
         self.metrics.prefill_time_s += dt
         self.metrics.observe_phase("prefill", dt, weight=len(reqs))
+        shares: Dict[str, float] = {}
+        for i, r in enumerate(reqs):
+            t = self._tenant_of(r)
+            shares[t] = shares.get(t, 0.0) + float(seq_lens[i])
+        self._step_obs("prefill", dt, shares=shares)
 
         events: List[TokenEvent] = []
         for i, r in enumerate(reqs):
@@ -1906,6 +2057,8 @@ class Engine:
         self.metrics.prefill_time_s += dt
         self.metrics.observe_phase("prefill", dt)
         self.metrics.prompt_tokens += prompt_len
+        self._step_obs("prefill", dt,
+                       shares={self._tenant_of(req): float(prompt_len)})
         return first, pages, prompt_len, req_key, lp
 
     # ------------------------------------------------------- JSON guide --
@@ -2100,6 +2253,10 @@ class Engine:
                 jnp.asarray(row))
         self.metrics.output_tokens += 1
         self._invalidate_dev()  # new membership -> rebuild device batch state
+        self.flight.note("admit", rid=req.request_id, slot=slot,
+                         tenant=self._tenant_of(req),
+                         adapter=req.adapter or "", prompt_len=prompt_len,
+                         pages=len(pages))
         return seq
 
     @staticmethod
@@ -2146,6 +2303,10 @@ class Engine:
                               aslot=self._adapter_slot(req))
         inf.done = n_cached  # cached prefix blocks skip straight to suffix
         self._inflight = inf
+        self.flight.note("chunk_start", rid=req.request_id, slot=slot,
+                         tenant=self._tenant_of(req),
+                         adapter=req.adapter or "", prompt_len=prompt_len,
+                         cached_tokens=n_cached)
 
     def _advance_chunk(self) -> List[TokenEvent]:
         """Run ONE chunk of the inflight prefill; on the last chunk, sample
@@ -2175,6 +2336,9 @@ class Engine:
         dt = time.monotonic() - t0
         self.metrics.prefill_time_s += dt
         self.metrics.observe_phase("prefill_chunk", dt)
+        # this dispatch ran the chunk alone — its tenant owns the segment
+        self._step_obs("prefill_chunk", dt, take=take,
+                       shares={self._tenant_of(inf.req): float(take)})
         if inf.done < inf.prompt_len:
             return []
 
@@ -2290,6 +2454,7 @@ class Engine:
         self.metrics.observe_phase("decode_step", dt)
         self.metrics.observe_occupancy(len(slots), cfg.max_num_seqs)
         self.metrics.observe_mixed(take, len(slots))
+        self._step_obs("mixed", dt, take=take)
         for slot in slots:
             seq = self.seqs.get(slot)
             if seq is None:
@@ -2407,6 +2572,7 @@ class Engine:
         eff_steps = max(1, -(-total // len(slots)))
         self.metrics.observe_phase("decode_step", dt / eff_steps,
                                    weight=eff_steps)
+        self._step_obs("mixed_spec", dt, take=take)
         for slot in slots:
             seq = self.seqs.get(slot)
             if seq is None:
@@ -2548,6 +2714,9 @@ class Engine:
                             "kv_oom"
                         )
                     )
+                    self.flight.note("kv_oom", rid=seq.request_id, slot=slot,
+                                     tenant=self._tenant_of(seq.req),
+                                     where="decode", need_pages=need)
                     self._finish_slot(slot, "kv_oom")
                     continue
             for page in self.allocator.alloc(need):
@@ -2611,6 +2780,10 @@ class Engine:
             "preempting %s under page pressure (%d output tokens "
             "recompute; priority %d)", seq.request_id,
             len(seq.output_tokens), old.priority)
+        self.flight.note("preempt", rid=seq.request_id, slot=slot,
+                         tenant=self._tenant_of(old),
+                         n_out=len(seq.output_tokens),
+                         pages_freed=len(seq.pages))
         self._finish_slot(slot, None)
         self.metrics.num_finished -= 1  # preempted, not finished
         self.metrics.num_preempted += 1
@@ -2755,6 +2928,7 @@ class Engine:
         eff_steps = max(1, -(-total // len(slots)))
         self.metrics.observe_phase("decode_step", dt / eff_steps,
                                    weight=eff_steps)
+        self._step_obs("decode_spec", dt)
         for slot in slots:
             seq = self.seqs.get(slot)
             if seq is None:
@@ -2927,6 +3101,7 @@ class Engine:
         self.metrics.observe_phase("decode_window", dt)
         self.metrics.observe_phase("decode_step", dt / window, weight=window)
         self.metrics.observe_occupancy(len(slots), self.cfg.max_num_seqs)
+        self._step_obs("decode", dt)
 
         for slot in slots:
             seq = self.seqs.get(slot)
@@ -2972,6 +3147,11 @@ class Engine:
         seq = self.seqs.pop(slot, None)
         if seq is None:
             return
+        if reason is not None:  # reason None = preempt, noted by its caller
+            self.flight.note("finish", rid=seq.request_id, slot=slot,
+                             tenant=(self._tenant_of(seq.req)
+                                     if seq.req is not None else "default"),
+                             reason=reason, n_out=len(seq.output_tokens))
         self.allocator.free(seq.pages)
         self.block_tables[slot, :] = 0
         self.context_lens[slot] = 0

@@ -194,6 +194,11 @@ class PrefixCache:
         self.page_size = page_size
         # block-hash -> page id, in LRU order (oldest first)
         self._map: "dict[bytes, int]" = {}
+        # block-hash -> adapter namespace. The namespace already seeds the
+        # hash chain (so _map alone can't recover it); this side map exists
+        # for the memory-accounting plane's per-adapter split and carries
+        # no cache semantics.
+        self._ns: "dict[bytes, str]" = {}
         self.hits = 0
         self.misses = 0
         self.cached_tokens_served = 0
@@ -270,6 +275,7 @@ class PrefixCache:
                 break
             for h2, p2 in got:
                 self._map[h2] = p2
+                self._ns[h2] = namespace
                 pages.append(p2)
             i += len(got)
         if pages:
@@ -300,6 +306,7 @@ class PrefixCache:
                 continue
             self.allocator.ref([page])
             self._map[h] = page
+            self._ns[h] = namespace
             fresh.append(h)
         self._emit("stored", fresh, "device")
 
@@ -331,8 +338,17 @@ class PrefixCache:
             self._emit("removed", [h for h, _ in victims], "none")
         for h, page in victims:
             del self._map[h]
+            self._ns.pop(h, None)
             self.allocator.free([page])
         return len(victims)
+
+    def pages_by_namespace(self) -> "dict[str, list[int]]":
+        """Device pages the cache holds, grouped by adapter namespace
+        ("" = base model) — the memory plane's per-adapter split."""
+        out: "dict[str, list[int]]" = {}
+        for h, page in self._map.items():
+            out.setdefault(self._ns.get(h, ""), []).append(page)
+        return out
 
     def stats(self) -> dict:
         return {

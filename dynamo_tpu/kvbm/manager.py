@@ -105,6 +105,14 @@ class KVBM:
             return obs_tracing.NOOP_SPAN
         return self.tracer.start_span(name, attributes=attrs)
 
+    def _flight(self, event: str, **fields):
+        """Tier moves land in the engine's flight ring: the KVBM runs on
+        the engine thread (evict/onboard inside admission), so the note
+        attaches to the very step record whose admission caused the move."""
+        flight = getattr(self.engine, "flight", None)
+        if flight is not None:
+            flight.note(event, **fields)
+
     # -------------------------------------------------------------- demote --
     def demote(self, victims: List[Tuple[bytes, int]]) -> int:
         """Spill evicted sole-owned pages into the host tier. One padded
@@ -136,6 +144,8 @@ class KVBM:
             self._emit("removed", removed + dropped, "none")
             span.set_attributes({"demoted": len(demoted),
                                  "removed": len(removed) + len(dropped)})
+            self._flight("kvbm_demote", blocks=len(demoted),
+                         removed=len(removed) + len(dropped))
             return len(demoted)
         except Exception:
             log.exception("kvbm demote failed; pages freed undemoted")
@@ -190,6 +200,8 @@ class KVBM:
                 self.gate_recompute_total += self.gate.skipped
                 self.gate.skipped = 0
                 self.host_misses_total += 1
+            self._flight("kvbm_gate_recompute", blocks=len(blocks),
+                         source=source)
             return []
         # make device room by rotating OTHER sole-owned cache entries down
         # a tier (they demote, not die — the incoming prefix is the hot
@@ -233,6 +245,7 @@ class KVBM:
                     self.peer_onboarded_blocks_total += len(out)
             self._emit("stored", [h for h, _ in out], "device")
             span.set_attribute("onboarded", len(out))
+            self._flight("kvbm_onboard", blocks=len(out), source=source)
             return out
         except Exception:
             log.exception("kvbm onboard failed; falling back to recompute")

@@ -220,6 +220,12 @@ def _container(
         if spec.get("kvbmDiskDir"):
             env.append({"name": "DYNAMO_TPU_KVBM_DISK_DIR",
                         "value": str(spec["kvbmDiskDir"])})
+        # flight-recorder ring depth (observability/flight.py): 0 disables
+        # recording; unset uses the built-in 512-record default. Cheap —
+        # each record is a small dict, so even 4096 is a few MB.
+        if spec.get("flightRecords") is not None:
+            env.append({"name": "DYNAMO_TPU_FLIGHT_RECORDS",
+                        "value": str(spec["flightRecords"])})
         # graceful-drain budget (worker SIGTERM: admission off, in-flight
         # handoff, KV demote); _pod_spec aligns the pod's
         # terminationGracePeriodSeconds with it so K8s never SIGKILLs a

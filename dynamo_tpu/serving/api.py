@@ -38,6 +38,25 @@ from dynamo_tpu.serving.metrics import FrontendMetrics, Gauge
 log = logging.getLogger("dynamo_tpu.api")
 
 
+class TraceBusy(RuntimeError):
+    """A profiler capture is already in progress on this worker."""
+
+
+# one-line descriptions behind GET /debug/ — the operator's map of the
+# worker-side debug surface (the frontend has its own index)
+WORKER_DEBUG_INDEX = {
+    "/debug/spans": "recent request/engine spans (?trace_id=&n=)",
+    "/debug/slo": "SLO attainment windows and violation breakdown",
+    "/debug/flight": "engine flight recorder: per-step records with "
+                     "batch composition, decisions, phase timings "
+                     "(?n=&rid=&tenant=&kind=)",
+    "/debug/costs": "per-tenant chip-seconds and HBM byte-seconds "
+                    "attributed by the engine cost ledger",
+    "/debug/trace": "capture a jax.profiler trace zip (?duration_s=; "
+                    "409 while another capture runs)",
+}
+
+
 class IncrementalDetokenizer:
     """Streaming detokenization with bounded re-decode (vLLM-style windows):
     each push decodes only the tokens since the last emitted boundary, holding
@@ -525,6 +544,13 @@ class ServingContext:
 
         self.engine_bridge = attach_engine_metrics(
             self.metrics.registry, engine)
+        # --- memory/cost exposition (observability/memory.py): exact KV
+        # pool accounting by tier/tenant, device memory_stats gauges, and
+        # the per-tenant cost counters off the engine's CostLedger
+        from dynamo_tpu.observability.memory import attach_memory_metrics
+
+        self.memory_bridge = attach_memory_metrics(
+            self.metrics.registry, engine)
         from dynamo_tpu.serving.metrics import CallbackCounter as _CC
 
         _CC("dynamo_spans_dropped_total",
@@ -630,7 +656,12 @@ class ServingContext:
 
         import jax
 
-        with self._trace_lock:
+        # non-blocking: a capture sleeps up to 30s, and the old blocking
+        # acquire parked a second HTTP thread for that whole window —
+        # concurrent captures now fail fast (the route answers 409)
+        if not self._trace_lock.acquire(blocking=False):
+            raise TraceBusy("a profiler capture is already running")
+        try:
             d = tempfile.mkdtemp(prefix="dynamo-trace-")
             try:
                 jax.profiler.start_trace(d)
@@ -645,6 +676,8 @@ class ServingContext:
                 return buf.getvalue()
             finally:
                 shutil.rmtree(d, ignore_errors=True)
+        finally:
+            self._trace_lock.release()
 
     def begin_drain(self) -> None:
         """Stop admission NOW: new /v1 + /disagg/prefill requests shed 503
@@ -818,6 +851,7 @@ class _Handler(JsonHTTPHandler):
                 self.ctx.staged_kv_gauge.set(leaked, state="leaked")
             self.ctx.slo.refresh_gauges()
             self.ctx.engine_bridge.refresh()  # live MFU/MBU + warmup gauges
+            self.ctx.memory_bridge.refresh()  # KV-pool/tier/tenant bytes
             body, ctype = self.ctx.metrics.registry.scrape(
                 self.headers.get("Accept"))
             self._raw(200, body, ctype)
@@ -848,12 +882,31 @@ class _Handler(JsonHTTPHandler):
                 return
             try:
                 data = self.ctx.capture_trace(dur)
+            except TraceBusy as e:
+                # another capture holds the profiler (they sleep up to
+                # 30s); tell the client when to come back instead of
+                # parking this thread on the lock
+                self._error(409, str(e), "conflict",
+                            headers={"Retry-After": str(int(dur) + 1)})
+                return
             except Exception as e:
                 log.exception("trace capture failed")
                 self._error(503, f"trace capture failed: {e}",
                             "service_unavailable")
                 return
             self._raw(200, data, "application/zip")
+        elif path in ("/debug", "/debug/"):
+            self._json(200, {"endpoints": WORKER_DEBUG_INDEX})
+        elif path == "/debug/flight":
+            from urllib.parse import parse_qs, urlparse
+
+            from dynamo_tpu.observability.flight import debug_flight_payload
+
+            qs = parse_qs(urlparse(self.path).query)
+            self._json(200, debug_flight_payload(
+                self.ctx.engine.flight, qs))
+        elif path == "/debug/costs":
+            self._json(200, self.ctx.engine.cost.rollup())
         elif path == "/worker/stats":
             import dataclasses
 
@@ -918,6 +971,15 @@ class _Handler(JsonHTTPHandler):
                 # staging and crashing before pull/release, pinning HBM
                 live, leaked = ds.counts()
                 out["staged_kv"] = {"live": live, "leaked": leaked}
+            # exact KV books by tier/tenant + per-tenant cost rollup —
+            # the same numbers the dynamo_memory_*/dynamo_tenant_cost_*
+            # series export, in one JSON read for dynamo_top and the
+            # frontend's fleet aggregation
+            try:
+                out["memory"] = self.ctx.memory_bridge.accountant.snapshot()
+            except Exception:
+                log.exception("memory snapshot failed in /worker/stats")
+            out["costs"] = eng.cost.rollup()
             self._json(200, out)
         else:
             self._error(404, f"no route {path}")

@@ -144,8 +144,13 @@ class EngineService:
                 continue
             try:
                 events = self.engine.step()
-            except Exception:
+            except Exception as e:
                 log.exception("engine step failed; aborting in-flight requests")
+                flight = getattr(self.engine, "flight", None)
+                if flight is not None:
+                    # name the failure before abort_all() dumps the ring —
+                    # the dump tail then ends with [fatal_step, dump]
+                    flight.note("fatal_step", error=repr(e))
                 # release engine slots/KV pages so the worker can recover,
                 # notify every waiter, and back off before the next attempt
                 ids = self.engine.abort_all()

@@ -461,6 +461,38 @@ class _FrontendHandler(JsonHTTPHandler):
                 "admission": ctx.tenant_admission.snapshot(),
                 "burn_shed_threshold": ctx.burn_shed_threshold,
             })
+        elif path == "/debug/costs":
+            # fleet-wide chargeback rollup: every worker ships its cost
+            # ledger in the heartbeat, so this aggregates registry state —
+            # no scrape fan-out, and it works identically on every HA
+            # frontend replica (heartbeats go to all of them)
+            from dynamo_tpu.observability.cost import merge_rollups
+
+            per_worker = {}
+            for w in ctx.router.alive(("agg", "prefill", "decode")):
+                costs = (w.stats or {}).get("costs")
+                if costs:
+                    per_worker[w.url] = costs
+            merged = merge_rollups(list(per_worker.values()))
+            merged["workers"] = len(per_worker)
+            merged["per_worker"] = per_worker
+            self._json(200, merged)
+        elif path in ("/debug", "/debug/"):
+            self._json(200, {"endpoints": {
+                "/debug/spans": "recent frontend/request spans "
+                                "(?trace_id=&n=)",
+                "/debug/slo": "SLO attainment windows and violation "
+                              "breakdown",
+                "/debug/tenants": "tenant classes, caps, live admission "
+                                  "state",
+                "/debug/costs": "fleet-wide per-tenant cost rollup "
+                                "aggregated from worker heartbeats",
+            }, "see_also": {
+                "workers": "GET <worker>/debug/ for the worker-side index "
+                           "(flight recorder, trace capture, costs)",
+                "planner": "GET /debug/planner lives on the operator "
+                           "debug server, not this frontend",
+            }})
         else:
             self._error(404, f"no route {path}")
 

@@ -115,6 +115,10 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                 **({"adapters": sorted(eng.lora.resident()),
                     "adapters_available": eng.lora.names()}
                    if eng.lora is not None else {}),
+                # per-tenant cost rollup rides the heartbeat so every
+                # frontend replica can answer /debug/costs fleet-wide
+                # without fanning out scrapes to each worker
+                "costs": eng.cost.rollup(),
             },
         }).encode()
         for payload_url in payload_urls:

@@ -14,7 +14,13 @@ fault-failed traffic through the frontend, then validates:
 - a TTFT exemplar from the OpenMetrics scrape resolves via
   /debug/spans?trace_id= to that request's span tree;
 - GET /debug/slo serves burn-rate evaluations and ?history=1 serves the
-  request-rate ring.
+  request-rate ring;
+- the memory/cost plane is live: dynamo_memory_* and
+  dynamo_tenant_cost_* ride the same lint-clean scrape, the device-tier
+  pool samples sum to the pool capacity, GET /debug/flight shows a
+  nonzero ring with the driven traffic's records, GET /debug/costs
+  reports nonzero attributed chip-seconds, and GET /debug/ serves the
+  endpoint index on both planes.
 """
 
 import json
@@ -150,9 +156,30 @@ def main() -> None:
                        "dynamo_engine_spec_accept_length_bucket",
                        "dynamo_spans_dropped_total",
                        'dynamo_lora_requests_total{adapter="ada"}',
-                       "dynamo_slo_burn_rate", "dynamo_slo_attainment"):
+                       "dynamo_slo_burn_rate", "dynamo_slo_attainment",
+                       "dynamo_memory_kv_pool_bytes{",
+                       'dynamo_memory_kv_pages{state="free"}',
+                       'dynamo_memory_lora_slots{state="total"}',
+                       "dynamo_memory_device_bytes{",
+                       'dynamo_tenant_cost_chip_seconds_total{tenant=',
+                       'dynamo_tenant_cost_hbm_byte_seconds_total{tenant=',
+                       "dynamo_engine_busy_seconds_total",
+                       "dynamo_engine_hbm_byte_seconds_total",
+                       "dynamo_flight_steps_total",
+                       "dynamo_flight_dropped_total"):
             if series not in wtext:
                 fail(f"worker scrape missing {series}")
+        # device-tier pool samples must sum to the pool's capacity — the
+        # exact-partition invariant, checked on the LIVE scrape
+        dev = [ln for ln in wtext.splitlines()
+               if ln.startswith("dynamo_memory_kv_pool_bytes{")
+               and 'tier="device"' in ln]
+        stats = json.loads(_get(worker, "/worker/stats"))
+        want = stats["memory"]["pool"]["total_bytes"]
+        got = sum(float(ln.rsplit(" ", 1)[1]) for ln in dev)
+        if got != want:
+            fail(f"device-tier pool samples sum to {got}, pool ground "
+                 f"truth is {want}")
         ftext = pages[("frontend", "text")]
         for series in ("dynamo_slo_burn_rate", "dynamo_slo_attainment",
                        "dynamo_frontend_errors_total"):
@@ -191,9 +218,36 @@ def main() -> None:
         if not burns or burns[0]["burn_rate"] <= 0:
             fail(f"error-rate burn did not register the fault-failed "
                  f"request: {burns}")
+
+        # --- flight recorder + cost plane on a live engine ----------------
+        flight = json.loads(_get(worker, "/debug/flight"))
+        if not flight.get("enabled") or flight.get("size", 0) == 0:
+            fail(f"/debug/flight shows an empty ring after live traffic: "
+                 f"{ {k: flight.get(k) for k in ('enabled', 'size')} }")
+        evs = [e.get("ev") for r in flight["records"]
+               for e in r.get("events", ())]
+        if "admit" not in evs or "finish" not in evs:
+            fail(f"/debug/flight records missing admit/finish decisions: "
+                 f"{sorted(set(evs))}")
+        costs = json.loads(_get(worker, "/debug/costs"))
+        if costs["totals"]["chip_seconds"] <= 0:
+            fail(f"/debug/costs attributed no chip-seconds: {costs}")
+        tenant_sum = sum(c["chip_seconds"]
+                         for c in costs["tenants"].values())
+        if abs(tenant_sum - costs["totals"]["chip_seconds"]) > 1e-3:
+            fail(f"cost conservation violated on the live worker: "
+                 f"tenants {tenant_sum} vs total "
+                 f"{costs['totals']['chip_seconds']}")
+        for who, base in (("frontend", frontend), ("worker", worker)):
+            idx = json.loads(_get(base, "/debug/")).get("endpoints") or {}
+            if not idx:
+                fail(f"{who} /debug/ index is empty")
+
         print(f"obs-check: OK — 4 scrapes lint-clean, exemplar {trace_id} "
               f"resolved ({len(names)} span names), error-rate 5m burn "
-              f"{burns[0]['burn_rate']}")
+              f"{burns[0]['burn_rate']}, flight ring {flight['size']} "
+              f"records, {costs['totals']['chip_seconds']}s attributed "
+              f"across {len(costs['tenants'])} tenant(s)")
     finally:
         faults.get_plane().clear()
         fsrv.shutdown()

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check lint-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -36,6 +36,7 @@ help:
 	@echo "  rpa-check      unified ragged-step suite (kernel parity, mixed/classic identity, bench contract)"
 	@echo "  ha-check       HA frontend plane suite (replicated journal, cross-frontend resume, fleet QoS)"
 	@echo "  spec-check     speculative decoding v2 suite (ragged-verify identity, LoRA/sampling/QoS composition)"
+	@echo "  lint-check     dynalint static analysis (lock discipline, jit purity, metrics/env contracts) + its suite"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
 	@echo "  make k8s ENABLE_HUBBLE=true INSTALL_PROMETHEUS_STACK=true"
@@ -122,6 +123,11 @@ flight-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_flight.py \
 		tests/test_cost_accounting.py -q -p no:randomly
 	JAX_PLATFORMS=cpu python scripts/obs_check.py
+
+# pure-Python AST analysis: no jax import, seconds on CPU
+lint-check:
+	python scripts/dynalint.py
+	python -m pytest tests/test_dynalint.py -q -p no:randomly
 
 # Per-tenant QoS gate (docs/robustness.md "Per-tenant QoS"): the `qos`
 # marker suite — identity resolution, weighted-fair budget accounting,

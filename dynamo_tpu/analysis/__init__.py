@@ -1,0 +1,61 @@
+"""dynalint — repo-native static analysis (docs/analysis.md).
+
+Stdlib-only (`ast` + `re`; importing this package must NEVER import jax —
+the whole-tree gate has to run in CI seconds, and the operator image runs
+it without an accelerator stack). Five repo-specific checkers ride on a
+small walker core:
+
+- ``blocking-under-lock`` — no sleeps / sockets / subprocesses / file I/O /
+  ``.result()`` / ``jax.block_until_ready`` while a ``threading`` lock is
+  held (the PR-13 ``/debug/trace`` bug class, found at compile time);
+- ``lock-discipline`` — fields annotated ``# guarded_by: <lock>`` are only
+  touched under a ``with self.<lock>`` in the owning class;
+- ``metrics-contract`` — every ``dynamo_*`` series constructed in code
+  declares its labelnames and matches the docs/observability.md taxonomy
+  row for row (stale docs are findings too);
+- ``env-registry`` — every ``DYNAMO_TPU_*``/``FRONTEND_*``/``DRAIN_*`` env
+  read is documented in the curated registry, every operator manifest key
+  maps to an env some module actually reads, and docs/config.md carries
+  the exact generated reference;
+- ``jit-purity`` / ``jit-donation`` — functions handed to ``jax.jit`` stay
+  pure (no ``time.*``/``random.*``/``os.environ``/global mutation) and
+  donated buffers are never read back after the jitted call.
+
+Entry point: ``scripts/dynalint.py`` (CLI), ``make lint-check`` (gate).
+"""
+
+from dynamo_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Repo,
+    SourceFile,
+    apply_baseline,
+    format_baseline,
+    load_baseline,
+    run_checkers,
+)
+
+ALL_RULES = (
+    "blocking-under-lock",
+    "lock-discipline",
+    "metrics-contract",
+    "env-registry",
+    "jit-purity",
+    "jit-donation",
+)
+
+
+def default_checkers():
+    """The five repo-specific checkers, in deterministic order."""
+    from dynamo_tpu.analysis.jit_purity import JitPurityChecker
+    from dynamo_tpu.analysis.locks import (BlockingUnderLockChecker,
+                                           LockDisciplineChecker)
+    from dynamo_tpu.analysis.metrics_contract import MetricsContractChecker
+    from dynamo_tpu.analysis.registry import EnvRegistryChecker
+
+    return [
+        BlockingUnderLockChecker(),
+        LockDisciplineChecker(),
+        MetricsContractChecker(),
+        EnvRegistryChecker(),
+        JitPurityChecker(),
+    ]

@@ -1,0 +1,309 @@
+"""dynalint walker core: files, findings, suppressions, baseline.
+
+Everything downstream of this module is deterministic by construction:
+files are scanned in sorted relative-path order, findings sort by
+``(path, line, rule, key)``, and a finding's baseline *key* carries no
+line number — so a baselined (grandfathered) finding survives unrelated
+edits to the same file, while genuinely new findings always surface.
+
+Suppression syntax (docs/analysis.md):
+
+- trailing ``# dynalint: off <rule> [<rule>...]`` suppresses those rules
+  on that line (no rule named = all rules);
+- a standalone ``# dynalint: off <rule>`` comment line suppresses the
+  line directly below it (for lines with no room left at col 79).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+_SUPPRESS_RE = re.compile(r"#\s*dynalint:\s*off\b([^\n#]*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit. ``key`` is the stable (line-free) baseline
+    identity; ``line`` is presentation only."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    key: str
+    severity: str = SEV_ERROR
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.rule} | {self.path} | {self.key}"
+
+
+class SourceFile:
+    """One parsed python file: source lines, AST with parent links, and
+    the per-line suppression table."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel.replace("\\", "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as e:  # surfaced as a finding by run_checkers
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+            return
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # line -> set of suppressed rules ("*" = all)
+        self.suppressions: Dict[int, Set[str]] = {}
+        for i, raw in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            rules = set(m.group(1).split()) or {"*"}
+            if raw.lstrip().startswith("#"):
+                self.suppressions.setdefault(i + 1, set()).update(rules)
+            else:
+                self.suppressions.setdefault(i, set()).update(rules)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and ("*" in rules or rule in rules)
+
+    def line_text(self, line: int) -> str:
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    def scope_name(self, node: ast.AST) -> str:
+        """Dotted enclosing-scope label for stable finding keys, e.g.
+        ``ServingContext.capture_trace`` (line numbers drift; scope names
+        rarely do)."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+
+class Repo:
+    """The file set one dynalint run sees, plus the repo-level documents
+    the cross-check rules read (taxonomy, generated config reference,
+    operator materializer)."""
+
+    def __init__(self, root: Path, files: Sequence[SourceFile],
+                 observability_doc: Optional[str] = None,
+                 config_doc: Optional[str] = None):
+        self.root = Path(root)
+        self.files = sorted(files, key=lambda f: f.rel)
+        self.observability_doc = observability_doc
+        self.config_doc = config_doc
+
+    @classmethod
+    def from_paths(cls, root: Path, paths: Sequence[Path],
+                   with_docs: bool = True) -> "Repo":
+        root = Path(root).resolve()
+        seen: Dict[str, SourceFile] = {}
+        for p in paths:
+            p = Path(p).resolve()
+            candidates = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+            for f in candidates:
+                if f.suffix != ".py" or "__pycache__" in f.parts:
+                    continue
+                try:
+                    rel = f.relative_to(root).as_posix()
+                except ValueError:
+                    rel = f.as_posix()
+                if rel not in seen:
+                    seen[rel] = SourceFile(rel, f.read_text())
+        obs = conf = None
+        if with_docs:
+            obs_p = root / "docs" / "observability.md"
+            conf_p = root / "docs" / "config.md"
+            obs = obs_p.read_text() if obs_p.exists() else None
+            conf = conf_p.read_text() if conf_p.exists() else None
+        return cls(root, list(seen.values()), obs, conf)
+
+    @classmethod
+    def from_strings(cls, files: Dict[str, str],
+                     observability_doc: Optional[str] = None,
+                     config_doc: Optional[str] = None) -> "Repo":
+        """In-memory repo for fixture tests — no disk, no parse of the
+        real tree."""
+        return cls(Path("."),
+                   [SourceFile(rel, text) for rel, text in files.items()],
+                   observability_doc, config_doc)
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.rel == rel or f.rel.endswith("/" + rel):
+                return f
+        return None
+
+
+# ----------------------------------------------------------- import map ----
+
+
+class ImportMap:
+    """Resolve local names through a module's imports so checkers match
+    dotted *origins*, not spellings: ``import time as t; t.sleep`` and
+    ``from time import sleep; sleep`` both resolve to ``time.sleep``."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+
+    def resolve(self, node: ast.AST) -> str:
+        """Dotted origin of a Name/Attribute chain ('' if dynamic)."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return ""
+        head = self.aliases.get(cur.id, cur.id)
+        return ".".join([head] + list(reversed(parts)))
+
+
+def qual_tail(node: ast.AST) -> str:
+    """Terminal identifier of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def const_str(node: ast.AST,
+              module_consts: Optional[Dict[str, str]] = None
+              ) -> Optional[str]:
+    """Static string value of a node: a literal, or a Name bound to a
+    module-level string constant (the ``CAPACITY_ENV`` indirection in
+    observability/flight.py)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name) and module_consts:
+        return module_consts.get(node.id)
+    return None
+
+
+def module_string_consts(tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings."""
+    out: Dict[str, str] = {}
+    body = getattr(tree, "body", [])
+    for stmt in body:
+        if (isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value.value
+    return out
+
+
+# -------------------------------------------------------------- checkers ---
+
+
+class Checker:
+    name = "base"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def run_checkers(repo: Repo, checkers: Sequence[Checker],
+                 rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Run checkers, drop suppressed findings, sort deterministically.
+    Unparseable files surface as findings (never silently skipped)."""
+    findings: List[Finding] = []
+    for f in repo.files:
+        if f.parse_error is not None:
+            findings.append(Finding(
+                rule="parse-error", path=f.rel, line=1,
+                message=f"cannot parse: {f.parse_error}",
+                key="parse"))
+    for checker in checkers:
+        for fi in checker.run(repo):
+            if rules is not None and fi.rule not in rules:
+                continue
+            src = repo.file(fi.path)
+            if src is not None and src.tree is not None \
+                    and src.suppressed(fi.line, fi.rule):
+                continue
+            findings.append(fi)
+    if rules is not None:
+        findings = [fi for fi in findings
+                    if fi.rule in rules or fi.rule == "parse-error"]
+    findings.sort(key=lambda fi: (fi.path, fi.line, fi.rule, fi.key))
+    return findings
+
+
+# --------------------------------------------------------------- baseline --
+
+_BASELINE_HEADER = [
+    "# dynalint baseline — grandfathered findings (docs/analysis.md).",
+    "# Format: <rule> | <path> | <key>  # <one-line justification>",
+    "# Keys are line-free, so entries survive unrelated edits; delete a",
+    "# line once its finding is fixed (the CLI warns on stale entries).",
+]
+
+
+def load_baseline(text: str) -> Dict[str, str]:
+    """Parse baseline text into {baseline_key: justification}."""
+    out: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "#" in line:
+            entry, reason = line.split("#", 1)
+        else:
+            entry, reason = line, ""
+        entry = " | ".join(p.strip() for p in entry.split("|"))
+        if entry:
+            out[entry] = reason.strip()
+    return out
+
+
+def format_baseline(findings: Sequence[Finding],
+                    reasons: Optional[Dict[str, str]] = None) -> str:
+    reasons = reasons or {}
+    lines = list(_BASELINE_HEADER)
+    for fi in sorted(findings, key=lambda f: f.baseline_key):
+        reason = reasons.get(fi.baseline_key, "TODO: justify or fix")
+        lines.append(f"{fi.baseline_key}  # {reason}")
+    return "\n".join(lines) + "\n"
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Dict[str, str]
+                   ) -> Tuple[List[Finding], List[str]]:
+    """Split findings into (new, stale-baseline-entries)."""
+    new = [fi for fi in findings if fi.baseline_key not in baseline]
+    hit = {fi.baseline_key for fi in findings}
+    stale = sorted(k for k in baseline if k not in hit)
+    return new, stale

@@ -1,0 +1,244 @@
+"""jit purity + donation checks.
+
+``jax.jit`` traces a function ONCE and bakes whatever host-side values it
+observed into the compiled executable — a ``time.time()``, a
+``random.random()``, or an ``os.environ`` read inside a jitted function
+is not "read per call", it is a constant chosen at trace time (and a
+recompile hazard); mutating a module global from traced code runs at
+trace time only. The ``jit-purity`` rule flags those in any function
+passed to ``jax.jit`` whose definition is locally resolvable (same
+module, lexically visible), following locally-resolvable callees.
+
+``jit-donation``: a buffer listed in ``donate_argnums`` is invalidated by
+the call — reading the donor variable afterwards returns garbage (or
+errors on TPU). The rule flags a donated argument name that is loaded
+again after the jitted call in the same scope without being rebound.
+Both checks are lexical: functions reached through modules, containers,
+or attributes are out of scope by design (cheap, zero false negatives on
+the fixture class we care about).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.core import (Checker, Finding, ImportMap, Repo,
+                                      SourceFile, qual_tail)
+
+_IMPURE_PREFIXES = (
+    "time.", "random.", "numpy.random.", "os.environ", "os.getenv",
+    "os.urandom", "secrets.", "uuid.uuid",
+)
+
+
+def _impure_origin(origin: str) -> bool:
+    return any(origin == p or origin.startswith(p)
+               for p in _IMPURE_PREFIXES)
+
+
+def _resolve_local_function(src: SourceFile, at: ast.AST, name: str
+                            ) -> Optional[ast.FunctionDef]:
+    """Nearest lexically-enclosing def of ``name`` visible from ``at``."""
+    cur: Optional[ast.AST] = at
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+            for stmt in cur.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))\
+                        and stmt.name == name:
+                    return stmt
+        cur = src.parents.get(cur)
+    return None
+
+
+def _module_globals(src: SourceFile) -> Set[str]:
+    names: Set[str] = set()
+    for stmt in getattr(src.tree, "body", []):
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in tgts:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+class JitPurityChecker(Checker):
+    name = "jit-purity"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        for src in repo.files:
+            if src.tree is None:
+                continue
+            imap = ImportMap(src.tree)
+            globs = _module_globals(src)
+            for node in ast.walk(src.tree):
+                if not (isinstance(node, ast.Call)
+                        and imap.resolve(node.func) == "jax.jit"
+                        and node.args):
+                    continue
+                target = node.args[0]
+                fn: Optional[ast.AST] = None
+                label = ""
+                if isinstance(target, ast.Name):
+                    fn = _resolve_local_function(src, node, target.id)
+                    label = target.id
+                elif isinstance(target, ast.Lambda):
+                    fn, label = target, "<lambda>"
+                if fn is not None:
+                    yield from self._check_purity(src, imap, globs, fn,
+                                                  label)
+                yield from self._check_donation(src, node)
+
+    # ------------------------------------------------------------ purity --
+
+    def _check_purity(self, src: SourceFile, imap: ImportMap,
+                      globs: Set[str], fn: ast.AST, label: str,
+                      visited: Optional[Set[ast.AST]] = None
+                      ) -> Iterable[Finding]:
+        visited = visited if visited is not None else set()
+        if fn in visited:
+            return
+        visited.add(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in [n for stmt in body for n in ast.walk(stmt)]:
+            origin = ""
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                origin = imap.resolve(node)
+            if origin and _impure_origin(origin):
+                # only flag the outermost matching chain node once: the
+                # Attribute walk yields os.environ for both the Attribute
+                # and its inner Name; dedupe via the parent chain
+                parent = src.parents.get(node)
+                if isinstance(parent, ast.Attribute):
+                    continue
+                yield Finding(
+                    rule=self.name, path=src.rel, line=node.lineno,
+                    message=(f"jitted function {label!r} touches {origin} "
+                             f"— traced once at compile time, not per "
+                             f"call"),
+                    key=f"{label}:{origin}",
+                )
+            if isinstance(node, ast.Global):
+                yield Finding(
+                    rule=self.name, path=src.rel, line=node.lineno,
+                    message=(f"jitted function {label!r} declares global "
+                             f"{', '.join(node.names)} — mutation runs at "
+                             f"trace time only"),
+                    key=f"{label}:global:{','.join(node.names)}",
+                )
+            if isinstance(node, (ast.Subscript, ast.Attribute)) \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                root = node
+                while isinstance(root, (ast.Subscript, ast.Attribute)):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id in globs:
+                    yield Finding(
+                        rule=self.name, path=src.rel, line=node.lineno,
+                        message=(f"jitted function {label!r} mutates "
+                                 f"module global {root.id!r} — runs at "
+                                 f"trace time only"),
+                        key=f"{label}:mutates:{root.id}",
+                    )
+            # follow locally-resolvable callees one module deep
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                callee = _resolve_local_function(src, fn, node.func.id)
+                if callee is not None:
+                    yield from self._check_purity(
+                        src, imap, globs, callee,
+                        f"{label}->{node.func.id}", visited)
+
+    # ---------------------------------------------------------- donation --
+
+    def _check_donation(self, src: SourceFile, jit_call: ast.Call
+                        ) -> Iterable[Finding]:
+        donated = self._donate_argnums(jit_call)
+        if not donated:
+            return
+        assign = src.parents.get(jit_call)
+        if not (isinstance(assign, ast.Assign) and len(assign.targets) == 1
+                and isinstance(assign.targets[0], ast.Name)):
+            return
+        jname = assign.targets[0].id
+        scope = self._enclosing_scope(src, assign)
+        if scope is None:
+            return
+        # every call of the jitted name in this scope
+        for call in ast.walk(scope):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)
+                    and call.func.id == jname):
+                continue
+            # the variable (if any) the call's result is bound to rebinds
+            # at the call line — `x = jp(x)` is the blessed donation idiom
+            parent = src.parents.get(call)
+            rebound_here: Set[str] = set()
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            rebound_here.add(n.id)
+            elif isinstance(parent, (ast.Tuple, ast.List)):
+                pass
+            for idx in donated:
+                if idx >= len(call.args):
+                    continue
+                arg = call.args[idx]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if arg.id in rebound_here:
+                    continue
+                use = self._next_use_after(scope, arg.id, call.lineno)
+                if use is not None:
+                    yield Finding(
+                        rule="jit-donation", path=src.rel, line=use,
+                        message=(f"{arg.id!r} is donated to {jname}() "
+                                 f"(donate_argnums includes {idx}) but "
+                                 f"read again on line {use} — donated "
+                                 f"buffers are invalidated by the call"),
+                        key=f"{jname}:{arg.id}",
+                    )
+
+    @staticmethod
+    def _donate_argnums(jit_call: ast.Call) -> Tuple[int, ...]:
+        for kw in jit_call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                out = []
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, int):
+                        out.append(el.value)
+                return tuple(out)
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int):
+                return (kw.value.value,)
+        return ()
+
+    def _enclosing_scope(self, src: SourceFile, node: ast.AST
+                         ) -> Optional[ast.AST]:
+        cur = src.parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            cur = src.parents.get(cur)
+        return cur
+
+    def _next_use_after(self, scope: ast.AST, name: str, after_line: int
+                        ) -> Optional[int]:
+        """First Load line of ``name`` after ``after_line`` in ``scope``,
+        unless a Store rebinds it first."""
+        events: List[Tuple[int, int, str]] = []
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Name) and n.id == name \
+                    and n.lineno > after_line:
+                kind = "load" if isinstance(n.ctx, ast.Load) else "store"
+                # stores sort before loads on the same line: `x = f(x)`
+                # style rebinding protects the same-line load already
+                events.append((n.lineno, 0 if kind == "store" else 1, kind))
+        for line, _, kind in sorted(events):
+            if kind == "store":
+                return None
+            return line
+        return None

@@ -1,0 +1,302 @@
+"""Lock checkers: blocking-under-lock and ``# guarded_by:`` discipline.
+
+**blocking-under-lock** — the PR-13 bug class: ``/debug/trace`` slept 30s
+while holding ``_trace_lock``, parking every concurrent caller. A call is
+"blocking" if it sleeps, talks to the network, forks a subprocess, does
+file I/O, or synchronously waits on another thread/future/device
+(``.result()``, ``.join()``, ``.wait()``, ``jax.block_until_ready``).
+A lock is "held" lexically inside a ``with <lock>:`` body or between
+``<lock>.acquire()`` and ``<lock>.release()`` lines in the same function;
+anything whose terminal identifier contains ``lock``/``mutex``
+(case-insensitive) counts as a lock. Intentional sites (a lock that
+exists precisely to serialize a long operation) carry an inline
+``# dynalint: off blocking-under-lock`` with a justifying comment —
+never a baseline entry (docs/analysis.md).
+
+**lock-discipline** — a field assigned with a trailing
+``# guarded_by: <lock>`` may only be read or written inside a
+``with self.<lock>:`` in the owning class. Methods that are documented
+as called-with-lock-held annotate their ``def`` line with
+``# holds: <lock>``; ``__init__``/``__del__`` are exempt (single-threaded
+by construction). The named lock must itself exist on the class.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.core import (Checker, Finding, ImportMap, Repo,
+                                      SourceFile, qual_tail)
+
+_LOCKISH = re.compile(r"lock|mutex", re.I)
+_GUARDED_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z_]\w*)")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)")
+
+# dotted-origin prefixes that block (resolved through the import map)
+_BLOCKING_PREFIXES = (
+    "time.sleep", "subprocess.", "socket.create_connection",
+    "socket.getaddrinfo", "urllib.request.urlopen",
+    "urllib.request.urlretrieve", "requests.", "http.client.",
+    "jax.block_until_ready", "shutil.copy", "shutil.rmtree", "os.replace",
+)
+# terminal method names that block regardless of receiver
+_BLOCKING_METHODS = {"result", "block_until_ready", "urlopen",
+                     "check_output", "check_call", "Popen",
+                     "create_connection", "sendall", "recv", "accept",
+                     "read_text", "write_text", "read_bytes", "write_bytes"}
+# file I/O builtins
+_BLOCKING_NAMES = {"open"}
+
+
+def _is_lockish(node: ast.AST) -> bool:
+    return bool(_LOCKISH.search(qual_tail(node) or ""))
+
+
+def _lock_label(imap: ImportMap, node: ast.AST) -> str:
+    return imap.resolve(node) or qual_tail(node) or "<lock>"
+
+
+def _join_wait_blocks(call: ast.Call) -> bool:
+    """``x.join()`` / ``x.wait()`` heuristics: a thread/process join takes
+    no args or a numeric timeout; ``sep.join(parts)`` (string join) takes
+    a sequence and a constant-string receiver."""
+    recv = call.func.value if isinstance(call.func, ast.Attribute) else None
+    if isinstance(recv, ast.Constant):
+        return False  # ", ".join(...)
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if not call.args:
+        return True
+    return (len(call.args) == 1
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, (int, float)))
+
+
+def _blocking_reason(imap: ImportMap, call: ast.Call) -> Optional[str]:
+    """Why this call blocks, or None if it doesn't (lexically)."""
+    origin = imap.resolve(call.func)
+    if origin:
+        for p in _BLOCKING_PREFIXES:
+            if origin == p or (p.endswith(".") and origin.startswith(p)):
+                return origin
+        if origin in _BLOCKING_NAMES or origin == "io.open":
+            return origin
+    tail = qual_tail(call.func)
+    if tail in _BLOCKING_METHODS and isinstance(call.func, ast.Attribute):
+        return f".{tail}()"
+    if tail in ("join", "wait") and isinstance(call.func, ast.Attribute) \
+            and _join_wait_blocks(call):
+        return f".{tail}()"
+    return None
+
+
+def _acquire_release_regions(fn: ast.AST, imap: ImportMap
+                             ) -> List[Tuple[str, int, int]]:
+    """(lock, start_line, end_line) regions for manual acquire()/release()
+    pairs inside one function (release in a nested finally pairs with the
+    acquire above it — regions are line ranges, not block scopes)."""
+    acquires: List[Tuple[str, int]] = []
+    releases: List[Tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if not _is_lockish(node.func.value):
+                continue
+            label = _lock_label(imap, node.func.value)
+            if node.func.attr == "acquire":
+                acquires.append((label, node.lineno))
+            elif node.func.attr == "release":
+                releases.append((label, node.lineno))
+    regions: List[Tuple[str, int, int]] = []
+    end = getattr(fn, "end_lineno", None) or 10 ** 9
+    for label, aline in acquires:
+        rline = min((rl for rlabel, rl in releases
+                     if rlabel == label and rl > aline), default=end)
+        regions.append((label, aline, rline))
+    return regions
+
+
+class BlockingUnderLockChecker(Checker):
+    name = "blocking-under-lock"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        for src in repo.files:
+            if src.tree is None:
+                continue
+            imap = ImportMap(src.tree)
+            for fn in ast.walk(src.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_function(src, imap, fn)
+
+    def _check_function(self, src: SourceFile, imap: ImportMap,
+                        fn: ast.AST) -> Iterable[Finding]:
+        regions = _acquire_release_regions(fn, imap)
+        out: List[Finding] = []
+
+        def visit(node: ast.AST, held: List[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                # a nested def's body runs later, not under the with
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locks = [
+                    _lock_label(imap, it.context_expr)
+                    for it in node.items if _is_lockish(it.context_expr)
+                ]
+                for it in node.items:
+                    visit(it.context_expr, held)
+                for stmt in node.body:
+                    visit(stmt, held + locks)
+                return
+            if isinstance(node, ast.Call):
+                manual = [lab for lab, a, r in regions
+                          if a < node.lineno < r]
+                if held or manual:
+                    reason = _blocking_reason(imap, node)
+                    # releasing the lock itself is not blocking under it
+                    if reason is not None:
+                        lock = (held or manual)[-1]
+                        out.append(Finding(
+                            rule=self.name, path=src.rel, line=node.lineno,
+                            message=(f"blocking call {reason} while "
+                                     f"holding {lock}"),
+                            key=f"{src.scope_name(node)}:{reason}",
+                        ))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, [])
+        return out
+
+
+# ------------------------------------------------------- lock discipline ---
+
+
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        for src in repo.files:
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(src, node)
+
+    # -- annotation harvest --
+
+    def _guarded_fields(self, src: SourceFile, cls: ast.ClassDef
+                        ) -> Dict[str, Tuple[str, int]]:
+        """{field: (lock, annotation_line)} from ``# guarded_by:``
+        trailing comments on ``self.<field> = ...`` assignments (or
+        class-level ``field: T`` annotations)."""
+        guarded: Dict[str, Tuple[str, int]] = {}
+        for node in ast.walk(cls):
+            targets: List[Tuple[str, int]] = []
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        targets.append((t.attr, t.lineno))
+                    elif isinstance(t, ast.Name) \
+                            and src.parents.get(node) is cls:
+                        targets.append((t.id, t.lineno))
+            for field, line in targets:
+                m = _GUARDED_RE.search(src.line_text(line))
+                if m:
+                    guarded[field] = (m.group(1), line)
+        return guarded
+
+    def _class_locks(self, cls: ast.ClassDef) -> Set[str]:
+        locks: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        locks.add(t.attr)
+                    elif isinstance(t, ast.Name):
+                        locks.add(t.id)
+        return locks
+
+    def _declared_holds(self, src: SourceFile, fn: ast.AST) -> Set[str]:
+        held: Set[str] = set()
+        for line in (fn.lineno, fn.lineno - 1):
+            m = _HOLDS_RE.search(src.line_text(line))
+            if m:
+                held.update(x.strip() for x in m.group(1).split(","))
+        return held
+
+    # -- enforcement --
+
+    def _check_class(self, src: SourceFile, cls: ast.ClassDef
+                     ) -> Iterable[Finding]:
+        guarded = self._guarded_fields(src, cls)
+        if not guarded:
+            return
+        class_attrs = self._class_locks(cls)
+        for field, (lock, line) in sorted(guarded.items()):
+            if lock not in class_attrs:
+                yield Finding(
+                    rule=self.name, path=src.rel, line=line,
+                    message=(f"field {field!r} guarded_by unknown lock "
+                             f"{lock!r} (no self.{lock} on {cls.name})"),
+                    key=f"{cls.name}:{field}:unknown-lock",
+                )
+        ann_lines = {line for _, line in guarded.values()}
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in ("__init__", "__del__"):
+                continue
+            declared = self._declared_holds(src, fn)
+            yield from self._check_method(src, cls, fn, guarded, declared,
+                                          ann_lines)
+
+    def _check_method(self, src: SourceFile, cls: ast.ClassDef, fn: ast.AST,
+                      guarded: Dict[str, Tuple[str, int]],
+                      declared: Set[str],
+                      ann_lines: Set[int]) -> Iterable[Finding]:
+        out: List[Finding] = []
+
+        def visit(node: ast.AST, held: Set[str]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locks = {
+                    qual_tail(it.context_expr) for it in node.items
+                    if _is_lockish(it.context_expr)
+                }
+                for it in node.items:
+                    visit(it.context_expr, held)
+                for stmt in node.body:
+                    visit(stmt, held | locks)
+                return
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" and node.attr in guarded:
+                lock, _ = guarded[node.attr]
+                if lock not in held and node.lineno not in ann_lines:
+                    out.append(Finding(
+                        rule=self.name, path=src.rel, line=node.lineno,
+                        message=(f"{cls.name}.{node.attr} accessed without "
+                                 f"{lock} (guarded_by: {lock}); take "
+                                 f"`with self.{lock}` or annotate the def "
+                                 f"with `# holds: {lock}`"),
+                        key=f"{cls.name}.{fn.name}:{node.attr}",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, set(declared))
+        return out

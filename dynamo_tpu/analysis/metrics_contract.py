@@ -1,0 +1,239 @@
+"""Metrics contract: code declarations <-> docs/observability.md taxonomy.
+
+Three cross-checks over every ``dynamo_*`` series constructed through the
+serving/metrics.py classes (``Counter``/``Gauge``/``Histogram``/
+``CallbackCounter``/``CallbackCounterVec``/``CallbackHistogram``):
+
+1. **labelnames at the declaration site** (the PR-6 phantom-sample rule):
+   a series the taxonomy documents with labels must pass ``labelnames=``
+   where it is constructed, and the declared set must equal the
+   documented set. ``CallbackCounter``/``CallbackHistogram`` are exempt
+   from the *declaration* half (their labels come from the callback at
+   scrape time) but still label-compared when statically declared.
+2. **undocumented series**: a code declaration with no taxonomy row.
+3. **stale docs**: a taxonomy row that resolves to no declaration.
+
+The taxonomy is every ``|``-table row of docs/observability.md whose
+first cell contains backticked ``dynamo_*`` names — one complete series
+name per backtick span (``name{label,label}``), multiple series per row
+separated by `` / ``. ``_bucket``/``_sum``/``_count`` expansions never
+appear in the taxonomy (they are exposition artifacts, not series).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dynamo_tpu.analysis.core import Checker, Finding, Repo, qual_tail
+
+METRIC_CLASSES = {"Counter", "Gauge", "Histogram", "CallbackCounter",
+                  "CallbackCounterVec", "CallbackHistogram"}
+# labels supplied by the scrape-time callback, not the constructor
+CALLBACK_LABELED = {"CallbackCounter", "CallbackHistogram"}
+
+_DOC_NAME_RE = re.compile(r"`(dynamo_[a-z0-9_]+)(\{([^}`]*)\})?`")
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+@dataclass
+class Declaration:
+    name: str
+    cls: str
+    path: str
+    line: int
+    labelnames: Optional[Tuple[str, ...]]  # None = not passed
+    dynamic_labels: bool = False  # labelnames= passed but not a literal
+
+
+@dataclass
+class DocRow:
+    name: str
+    labels: Tuple[str, ...]
+    line: int
+
+
+def _literal_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for el in node.elts:
+        if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+            return None
+        out.append(el.value)
+    return tuple(out)
+
+
+def _resolve_local_literal(src, call: ast.Call, name: str
+                           ) -> Optional[Tuple[str, ...]]:
+    """``labelnames = ("a", "b")`` assigned in the enclosing scope before
+    the declaration site (the slo.py shared-tuple idiom)."""
+    scope = src.parents.get(call)
+    while scope is not None and not isinstance(
+            scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+        scope = src.parents.get(scope)
+    if scope is None:
+        return None
+    best: Optional[Tuple[str, ...]] = None
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assign) and n.lineno < call.lineno \
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in n.targets):
+            vals = _literal_strs(n.value)
+            if vals is not None:
+                best = vals
+    return best
+
+
+def _loop_names(src, call: ast.Call, var: str) -> List[Tuple[str, int]]:
+    """Series names for a declaration driven by a literal tuple-of-tuples
+    loop (the api.py kvbm CallbackCounter block): the Call's first arg is
+    a Name bound by an enclosing ``for (name, ...) in ((...), ...):``."""
+    cur = src.parents.get(call)
+    while cur is not None:
+        if isinstance(cur, ast.For):
+            tgt = cur.target
+            idx: Optional[int] = None
+            if isinstance(tgt, ast.Name) and tgt.id == var:
+                idx = -1  # whole element is the name
+            elif isinstance(tgt, ast.Tuple):
+                for i, el in enumerate(tgt.elts):
+                    if isinstance(el, ast.Name) and el.id == var:
+                        idx = i
+            if idx is not None and isinstance(cur.iter,
+                                              (ast.Tuple, ast.List)):
+                out: List[Tuple[str, int]] = []
+                for row in cur.iter.elts:
+                    el = row if idx == -1 else (
+                        row.elts[idx]
+                        if isinstance(row, (ast.Tuple, ast.List))
+                        and idx < len(row.elts) else None)
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str) \
+                            and el.value.startswith("dynamo_"):
+                        out.append((el.value, el.lineno))
+                return out
+        cur = src.parents.get(cur)
+    return []
+
+
+def collect_declarations(repo: Repo) -> List[Declaration]:
+    decls: List[Declaration] = []
+    for src in repo.files:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls = qual_tail(node.func)
+            if cls not in METRIC_CLASSES or not node.args:
+                continue
+            first = node.args[0]
+            names: List[Tuple[str, int]] = []
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str) \
+                    and first.value.startswith("dynamo_"):
+                names = [(first.value, node.lineno)]
+            elif isinstance(first, ast.Name):
+                names = _loop_names(src, node, first.id)
+            if not names:
+                continue
+            labelnames: Optional[Tuple[str, ...]] = None
+            dynamic = False
+            for kw in node.keywords:
+                if kw.arg != "labelnames":
+                    continue
+                vals = _literal_strs(kw.value)
+                if vals is None and isinstance(kw.value, ast.Name):
+                    vals = _resolve_local_literal(src, node, kw.value.id)
+                if vals is None:
+                    dynamic = True  # passed, but not statically knowable
+                else:
+                    labelnames = vals
+            for name, line in names:
+                decls.append(Declaration(name, cls, src.rel, line,
+                                         labelnames, dynamic))
+    return decls
+
+
+def parse_taxonomy(doc: str) -> List[DocRow]:
+    """Taxonomy rows from observability.md: table lines only, first cell
+    only (prose mentions and cross-reference cells don't declare)."""
+    rows: List[DocRow] = []
+    for i, line in enumerate(doc.splitlines(), start=1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        cells = stripped.strip("|").split("|")
+        if not cells:
+            continue
+        first_cell = cells[0]
+        for m in _DOC_NAME_RE.finditer(first_cell):
+            name = m.group(1)
+            if name.endswith(_SUFFIXES):
+                continue
+            labels = tuple(sorted(
+                x.strip() for x in (m.group(3) or "").split(",")
+                if x.strip()))
+            rows.append(DocRow(name, labels, i))
+    return rows
+
+
+class MetricsContractChecker(Checker):
+    name = "metrics-contract"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        if repo.observability_doc is None:
+            return  # fixture runs without the doc skip the cross-check
+        decls = collect_declarations(repo)
+        rows = parse_taxonomy(repo.observability_doc)
+        doc_by_name: Dict[str, DocRow] = {}
+        for r in rows:
+            doc_by_name.setdefault(r.name, r)
+        declared_names: Set[str] = {d.name for d in decls}
+        doc_rel = "docs/observability.md"
+
+        for d in decls:
+            row = doc_by_name.get(d.name)
+            if row is None:
+                yield Finding(
+                    rule=self.name, path=d.path, line=d.line,
+                    message=(f"series {d.name} has no row in the "
+                             f"docs/observability.md taxonomy"),
+                    key=f"undocumented:{d.name}",
+                )
+                continue
+            doc_labels = set(row.labels)
+            if d.dynamic_labels:
+                continue  # labelnames= passed but not statically knowable
+            if d.labelnames is None:
+                if doc_labels and d.cls not in CALLBACK_LABELED:
+                    yield Finding(
+                        rule=self.name, path=d.path, line=d.line,
+                        message=(f"{d.name} is documented with labels "
+                                 f"{{{','.join(row.labels)}}} but the "
+                                 f"{d.cls} declaration passes no "
+                                 f"labelnames= (phantom-sample rule)"),
+                        key=f"labelnames-missing:{d.name}",
+                    )
+            elif set(d.labelnames) != doc_labels:
+                yield Finding(
+                    rule=self.name, path=d.path, line=d.line,
+                    message=(f"{d.name} declares labelnames "
+                             f"{{{','.join(sorted(d.labelnames))}}} but the "
+                             f"taxonomy row documents "
+                             f"{{{','.join(row.labels)}}}"),
+                    key=f"label-drift:{d.name}",
+                )
+
+        for r in rows:
+            if r.name not in declared_names:
+                yield Finding(
+                    rule=self.name, path=doc_rel, line=r.line,
+                    message=(f"taxonomy row {r.name} resolves to no "
+                             f"declaration in the scanned tree "
+                             f"(stale doc?)"),
+                    key=f"stale-doc:{r.name}",
+                )

@@ -544,7 +544,7 @@ class Engine:
             if rounded != cfg.prefill_chunk_tokens:
                 cfg = _dc.replace(cfg, prefill_chunk_tokens=rounded)
                 self.cfg = cfg
-        self._aborted: set = set()
+        self._aborted: set = set()  # guarded_by: _lock
         # disagg prefill role: request_id -> (pages, n_tokens) held for export
         self._parked: Dict[str, tuple] = {}
 

@@ -36,7 +36,7 @@ class DiskBlockTier:
         self.capacity = capacity_blocks
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
-        self._lru: Dict[bytes, str] = {}  # hash -> path, insertion order = LRU
+        self._lru: Dict[bytes, str] = {}  # guarded_by: _lock — hash -> path, insertion order = LRU
         self.stored = 0
         self.hits = 0
         self.dropped = 0
@@ -48,30 +48,37 @@ class DiskBlockTier:
             ) -> List[bytes]:
         """Store one block; returns the hashes DROPPED to make room."""
         dropped: List[bytes] = []
+        path = self._path(block_hash)
         with self._lock:
             if block_hash in self._lru:
                 self._lru[block_hash] = self._lru.pop(block_hash)
                 return dropped
             while len(self._lru) >= self.capacity:
-                old, path = next(iter(self._lru.items()))
+                old, old_path = next(iter(self._lru.items()))
                 del self._lru[old]
                 try:
-                    os.remove(path)
+                    os.remove(old_path)
                 except OSError:
                     pass
                 dropped.append(old)
                 self.dropped += 1
-            path = self._path(block_hash)
-            try:
-                with open(path, "wb") as f:
-                    f.write(np.ascontiguousarray(k).view(np.uint8).tobytes())
-                    f.write(np.ascontiguousarray(v).view(np.uint8).tobytes())
-            except OSError as e:
-                log.warning("disk tier write failed for %s: %s",
-                            block_hash.hex()[:12], e)
-                return dropped
-            self._lru[block_hash] = path
-            self.stored += 1
+        # the slow disk write runs with the lock RELEASED so concurrent
+        # get()/put() on other blocks never stall behind it; the file is
+        # content-addressed, so racing writers of the same hash produce
+        # identical bytes and the capacity bound is soft by at most the
+        # width of the race
+        try:
+            with open(path, "wb") as f:
+                f.write(np.ascontiguousarray(k).view(np.uint8).tobytes())
+                f.write(np.ascontiguousarray(v).view(np.uint8).tobytes())
+        except OSError as e:
+            log.warning("disk tier write failed for %s: %s",
+                        block_hash.hex()[:12], e)
+            return dropped
+        with self._lock:
+            if block_hash not in self._lru:
+                self._lru[block_hash] = path
+                self.stored += 1
         return dropped
 
     def get(self, block_hash: bytes, shape, dtype
@@ -116,9 +123,9 @@ class HostBlockPool:
         # once; a block's K is arena[slot, 0], V is arena[slot, 1]
         self._arena = np.empty((capacity_blocks, 2) + self.block_shape,
                                self.dtype)
-        self._free: List[int] = list(range(capacity_blocks - 1, -1, -1))
-        self._entries: Dict[bytes, int] = {}  # hash -> slot, dict order = LRU
-        self._pins: Dict[bytes, int] = {}
+        self._free: List[int] = list(range(capacity_blocks - 1, -1, -1))  # guarded_by: _lock
+        self._entries: Dict[bytes, int] = {}  # guarded_by: _lock — hash -> slot, dict order = LRU
+        self._pins: Dict[bytes, int] = {}  # guarded_by: _lock
         self._lock = threading.Lock()
         self.disk = disk
         # counters (exposed as dynamo_kvbm_* series by the serving layer)
@@ -158,7 +165,7 @@ class HostBlockPool:
             self.stored += 1
         return True, removed
 
-    def _alloc_slot_locked(self, removed: List[bytes]) -> Optional[int]:
+    def _alloc_slot_locked(self, removed: List[bytes]) -> Optional[int]:  # holds: _lock
         if self._free:
             return self._free.pop()
         # LRU-evict the oldest unpinned entry; spill it to disk if a tier

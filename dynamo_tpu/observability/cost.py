@@ -33,11 +33,11 @@ class CostLedger:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.chip_seconds: Dict[str, float] = {}
-        self.hbm_byte_seconds: Dict[str, float] = {}
-        self.chip_seconds_total = 0.0
-        self.hbm_byte_seconds_total = 0.0
-        self.segments_total = 0
+        self.chip_seconds: Dict[str, float] = {}  # guarded_by: _lock
+        self.hbm_byte_seconds: Dict[str, float] = {}  # guarded_by: _lock
+        self.chip_seconds_total = 0.0  # guarded_by: _lock
+        self.hbm_byte_seconds_total = 0.0  # guarded_by: _lock
+        self.segments_total = 0  # guarded_by: _lock
 
     def account(self, dur_s: float, shares: Mapping[str, float],
                 holdings: Mapping[str, float]) -> None:

@@ -60,10 +60,10 @@ class FlightRecorder:
             capacity = _env_capacity()
         self.capacity = max(0, int(capacity))
         self.enabled = self.capacity > 0
-        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(  # guarded_by: _lock
             maxlen=max(1, self.capacity))
         self._lock = threading.Lock()
-        self._seq = 0  # monotonic record id, survives ring wrap
+        self._seq = 0  # guarded_by: _lock — monotonic id, survives wrap
         self.steps_total = 0
         self.dropped_total = 0
         # open per-step draft; engine scheduler thread only (begin/commit)

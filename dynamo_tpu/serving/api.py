@@ -665,7 +665,10 @@ class ServingContext:
             d = tempfile.mkdtemp(prefix="dynamo-trace-")
             try:
                 jax.profiler.start_trace(d)
-                time.sleep(min(max(duration_s, 0.05), 30.0))
+                # the capture window IS the critical section: _trace_lock
+                # serializes profiler runs and the acquire above is
+                # non-blocking (concurrent callers 409 instead of parking)
+                time.sleep(min(max(duration_s, 0.05), 30.0))  # dynalint: off blocking-under-lock
                 jax.profiler.stop_trace()
                 buf = io.BytesIO()
                 with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
@@ -675,7 +678,10 @@ class ServingContext:
                             z.write(full, os.path.relpath(full, d))
                 return buf.getvalue()
             finally:
-                shutil.rmtree(d, ignore_errors=True)
+                # temp-dir cleanup before releasing the (non-blocking-
+                # acquire) capture lock: a new capture must never race an
+                # old capture's teardown for the profiler singleton
+                shutil.rmtree(d, ignore_errors=True)  # dynalint: off blocking-under-lock
         finally:
             self._trace_lock.release()
 

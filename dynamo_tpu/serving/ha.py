@@ -205,7 +205,7 @@ class JournalPlane:
         self.claim_window_s = (
             claim_window_s if claim_window_s is not None
             else _env_float(CLAIM_WINDOW_ENV, DEFAULT_CLAIM_WINDOW_S))
-        self._records: "collections.OrderedDict[str, JournalRecord]" = (
+        self._records: "collections.OrderedDict[str, JournalRecord]" = (  # guarded_by: _lock
             collections.OrderedDict())
         self._lock = threading.Lock()
         # wired by the frontend to dynamo_frontend_ha_* counters

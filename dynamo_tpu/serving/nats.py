@@ -217,7 +217,9 @@ class NatsClient:
     # ------------------------------------------------------------------ io --
     def _send(self, data: bytes) -> None:
         with self._wlock:
-            self.sock.sendall(data)
+            # _wlock exists precisely to serialize writers on this socket
+            # (interleaved partial frames corrupt the protocol stream)
+            self.sock.sendall(data)  # dynalint: off blocking-under-lock
 
     def _dispatch(self, sid: int, msg: Msg) -> None:
         cb = self._subs.get(sid)
@@ -447,7 +449,9 @@ class _BrokerConn:
     def send(self, data: bytes) -> None:
         try:
             with self.wlock:
-                self.sock.sendall(data)
+                # wlock serializes broker->client frame writes — holding
+                # it across the send IS the point (frame atomicity)
+                self.sock.sendall(data)  # dynalint: off blocking-under-lock
         except OSError:
             self.alive = False
 

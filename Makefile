@@ -113,14 +113,17 @@ obs-check:
 	JAX_PLATFORMS=cpu python scripts/obs_check.py
 
 # Flight-recorder + memory/cost gate (docs/observability.md "Flight
-# recorder", "Memory & cost accounting"): the `flight` marker suite —
-# ring mechanics and dump forensics, the per-tenant cost conservation
-# invariant (incl. under QoS preemption), the exact device-tier memory
-# partition, the /debug/trace 409 contract — plus the live obs_check
-# boot, which lints the new dynamo_memory_*/dynamo_tenant_cost_* series
-# and asserts a nonzero /debug/flight ring on a real engine.
+# recorder", "Step timeline & bubble accounting", "Memory & cost
+# accounting"): the `flight` marker suite — ring mechanics and dump
+# forensics, the step-timeline conservation invariant + Perfetto golden
+# + overhead bound, the per-tenant cost conservation invariant (incl.
+# under QoS preemption), the exact device-tier memory partition, the
+# /debug/trace 409 contract — plus the live obs_check boot, which lints
+# the new dynamo_memory_*/dynamo_tenant_cost_* series and asserts a
+# nonzero /debug/flight ring on a real engine.
 flight-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_flight.py \
+		tests/test_timeline.py \
 		tests/test_cost_accounting.py -q -p no:randomly
 	JAX_PLATFORMS=cpu python scripts/obs_check.py
 

@@ -298,6 +298,10 @@ def bench_model(model: str, on_tpu: bool, chip, quant: str = "none") -> dict:
             "itl_p95_ms": round(1e3 * _pctl(itl_samples, 0.95), 3),
         },
         "decode_steps_timed": decode_steps,
+        # step-timeline bubble baseline: per-phase self-time shares and
+        # the inter-dispatch host-gap distribution — the zero-bubble
+        # work's before/after number (docs/perf.md)
+        "timeline": eng.timeline.summary(),
     }
     if quant != "none":
         out["quantization"] = quant
@@ -643,6 +647,9 @@ def bench_prefill_interference(on_tpu: bool) -> dict:
             "mixed_steps": eng.metrics.mixed_count,
             "mixed_frac_mean": snap["mixed_frac_mean"],
             "chunk_steps": eng.metrics.phases["prefill_chunk"].count,
+            # recorded zero-bubble baseline for this arm: host-gap
+            # distribution + per-phase shares (step timeline)
+            "timeline": eng.timeline.summary(),
         }
         for d in (res["engine"], res["measured"]):
             d["itl_p95_p50_ratio"] = round(
@@ -872,8 +879,8 @@ def main() -> None:
         "comparable": bool(on_tpu),
     }
     for k in ("mfu", "mbu", "quantization", "ttft_p50_ms", "itl_p50_ms",
-              "itl_p95_ms", "measured", "spec_drafted", "spec_accepted",
-              "spec_acceptance", "guided", "guided_legal"):
+              "itl_p95_ms", "measured", "timeline", "spec_drafted",
+              "spec_accepted", "spec_acceptance", "guided", "guided_legal"):
         if k in res:
             line[k] = res[k]
     forced = bool(os.environ.get("BENCH_FORCE_CPU"))

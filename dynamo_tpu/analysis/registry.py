@@ -141,6 +141,11 @@ KNOWN_ENV: Dict[str, str] = {
     "DYNAMO_TPU_TENANTS":
         "JSON tenant-class list (weights, priorities, caps, API keys) — "
         "frontend admission and engine QoS read the same classes",
+    "DYNAMO_TPU_TIMELINE":
+        "step-timeline kill switch (0/false/off/no disables; default on)",
+    "DYNAMO_TPU_TIMELINE_RECORDS":
+        "step-timeline exact-interval ring depth (default 256; 0 keeps "
+        "the streaming phase digests but drops the ring)",
     "DYNAMO_TPU_TRACE":
         "tracing kill switch (0/false/off/no disables; checked per call)",
     "DYNAMO_TPU_TRACE_BUFFER":

@@ -500,9 +500,13 @@ class Engine:
         # size is an env knob (DYNAMO_TPU_FLIGHT_RECORDS, 0 disables).
         from dynamo_tpu.observability.cost import CostLedger
         from dynamo_tpu.observability.flight import FlightRecorder
+        from dynamo_tpu.observability.timeline import StepTimeline
 
         self.flight = FlightRecorder()
         self.cost = CostLedger()
+        # stepline: precise per-step phase intervals + inter-dispatch
+        # host-gap accounting (DYNAMO_TPU_TIMELINE / _TIMELINE_RECORDS)
+        self.timeline = StepTimeline()
         self._page_nbytes = (self.kv_spec.bytes_per_token()
                              * cfg.page_size)
         # pallas/spec demotion counts already seen (per-step delta -> ring)
@@ -1088,6 +1092,9 @@ class Engine:
     def reset_metrics(self) -> None:
         """Fresh metrics (post-warmup, bench phase boundaries)."""
         self.metrics = EngineMetrics()
+        # drop compile-time outliers from the step timeline too: bench
+        # bubble baselines must reflect steady-state serving only
+        self.timeline.reset()
 
     def compiled_program_count(self) -> int:
         """Total executables across the engine's jit caches (warmup check)."""
@@ -1526,6 +1533,7 @@ class Engine:
         composition. A step that did no work commits nothing."""
         with self._exec_lock:
             self.flight.begin()
+            self.timeline.begin_step()
             try:
                 return self._step_locked()
             finally:
@@ -1534,10 +1542,13 @@ class Engine:
                         active=len(self.seqs), pending=len(self.pending),
                         free_pages=self.allocator.free_pages,
                         batch=self._flight_batch())
+                self.timeline.commit_step(
+                    active=len(self.seqs), pending=len(self.pending))
 
     def _step_locked(self) -> List[TokenEvent]:
         events: List[TokenEvent] = []
-        events.extend(self._apply_aborts())
+        with self.timeline.phase("admit"):
+            events.extend(self._apply_aborts())
         if self._mixed_eligible():
             # unified ragged step: the inflight chunk rides the decode
             # window — one dispatch serves both, so there is no
@@ -1559,14 +1570,16 @@ class Engine:
                     events.extend(self._mixed_spec_step())
             else:
                 events.extend(self._mixed_step())
-            self._qos_account(events)
+            with self.timeline.phase("bank"):
+                self._qos_account(events)
             return events
         if self._inflight is not None:
             # one chunk per step: decode windows run between chunks, so
             # a long admission never monopolizes the chip
             events.extend(self._advance_chunk())
         else:
-            events.extend(self._admit())
+            with self.timeline.phase("admit"):
+                events.extend(self._admit())
         if self.seqs:
             if self.cfg.speculative_mode != "off":
                 events.extend(self._decode_spec())
@@ -1576,7 +1589,8 @@ class Engine:
                 events.extend(self._decode_once())
         # per-tenant QoS: bank this step's decoded tokens into the
         # weighted-fair budgets (no-op without configured tenants)
-        self._qos_account(events)
+        with self.timeline.phase("bank"):
+            self._qos_account(events)
         return events
 
     # ------------------------------------------------- flight/cost hooks --
@@ -1897,10 +1911,11 @@ class Engine:
             for i, r in enumerate(reqs):
                 aslots[i] = self._adapter_slot(r)
             lx = (jnp.asarray(aslots),)
-        logits, self.k_pages, self.v_pages = self._prefill_batch(
-            self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
-            self.k_pages, self.v_pages, jnp.asarray(pages_arr), *lx,
-        )
+        with self.timeline.phase("dispatch"):
+            logits, self.k_pages, self.v_pages = self._prefill_batch(
+                self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
+                self.k_pages, self.v_pages, jnp.asarray(pages_arr), *lx,
+            )
         keys = np.zeros((npad, 2), np.uint32)
         temp = np.zeros((npad,), np.float32)
         top_p = np.ones((npad,), np.float32)
@@ -1927,14 +1942,16 @@ class Engine:
         raw_logits = logits
         if pen_rows is not None:
             logits = logits - jnp.asarray(pen_rows)
-        toks, chosen, tids, tvals = self._sample_first_batch(
-            logits, jnp.asarray(temp), jnp.asarray(top_p),
-            jnp.asarray(top_k), jnp.asarray(min_p), jnp.asarray(bias_ids),
-            jnp.asarray(bias_vals), jnp.asarray(keys),
-            jnp.asarray(seq_lens - 1),
-        )
-        toks_np, chosen_np = np.asarray(toks), np.asarray(chosen)
-        tids_np, tvals_np = np.asarray(tids), np.asarray(tvals)
+        with self.timeline.phase("dispatch"):
+            toks, chosen, tids, tvals = self._sample_first_batch(
+                logits, jnp.asarray(temp), jnp.asarray(top_p),
+                jnp.asarray(top_k), jnp.asarray(min_p),
+                jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+                jnp.asarray(keys), jnp.asarray(seq_lens - 1),
+            )
+        with self.timeline.phase("device_wait"):
+            toks_np, chosen_np = np.asarray(toks), np.asarray(chosen)
+            tids_np, tvals_np = np.asarray(tids), np.asarray(tvals)
         if pen_rows is not None:
             # penalized lanes requesting logprobs: re-derive them from the
             # raw distribution (the sampler saw the penalized one)
@@ -2043,16 +2060,19 @@ class Engine:
 
         lx = ((jnp.int32(self._adapter_slot(req)),)
               if self.lora is not None else ())
-        last_logits, self.k_pages, self.v_pages = self._prefill(
-            self.params,
-            jnp.asarray(tokens),
-            jnp.int32(prompt_len),
-            self.k_pages,
-            self.v_pages,
-            jnp.asarray(pages_arr),
-            *lx,
-        )
-        first, req_key, lp = self._first_token(req, last_logits, prompt_len)
+        with self.timeline.phase("dispatch"):
+            last_logits, self.k_pages, self.v_pages = self._prefill(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.int32(prompt_len),
+                self.k_pages,
+                self.v_pages,
+                jnp.asarray(pages_arr),
+                *lx,
+            )
+        with self.timeline.phase("device_wait"):
+            first, req_key, lp = self._first_token(req, last_logits,
+                                                   prompt_len)
         dt = time.monotonic() - t0
         self.metrics.prefill_time_s += dt
         self.metrics.observe_phase("prefill", dt)
@@ -2277,10 +2297,13 @@ class Engine:
         """can_alloc with prefix-cache eviction as the pressure valve."""
         if self.allocator.can_alloc(n):
             return True
-        if self.prefix_cache is not None:
-            self.prefix_cache.evict(n - self.allocator.free_pages)
-            return self.allocator.can_alloc(n)
-        return False
+        # only the pressure path is timeline-worthy: eviction walks the
+        # prefix cache, the happy path above is one counter compare
+        with self.timeline.phase("page_alloc"):
+            if self.prefix_cache is not None:
+                self.prefix_cache.evict(n - self.allocator.free_pages)
+                return self.allocator.can_alloc(n)
+            return False
 
     def _start_inflight(self, req: GenRequest, cached_pages=None,
                         n_cached: int = 0) -> None:
@@ -2322,16 +2345,17 @@ class Engine:
         tokens[:take] = inf.req.prompt_token_ids[start:start + take]
 
         lx = (jnp.int32(inf.aslot),) if self.lora is not None else ()
-        last_logits, self.k_pages, self.v_pages = self._prefill_chunk(
-            self.params,
-            jnp.asarray(tokens),
-            jnp.int32(start),
-            jnp.int32(take),
-            self.k_pages,
-            self.v_pages,
-            jnp.asarray(inf.pages_arr),
-            *lx,
-        )
+        with self.timeline.phase("dispatch"):
+            last_logits, self.k_pages, self.v_pages = self._prefill_chunk(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.int32(start),
+                jnp.int32(take),
+                self.k_pages,
+                self.v_pages,
+                jnp.asarray(inf.pages_arr),
+                *lx,
+            )
         inf.done += take
         dt = time.monotonic() - t0
         self.metrics.prefill_time_s += dt
@@ -2351,8 +2375,9 @@ class Engine:
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
                                      namespace=req.adapter or "")
-        first, req_key, lp = self._first_token(req, last_logits,
-                                               inf.prompt_len)
+        with self.timeline.phase("device_wait"):
+            first, req_key, lp = self._first_token(req, last_logits,
+                                                   inf.prompt_len)
         slot = inf.slot  # reserved at _start_inflight
         seq = self._install_slot(req, slot, inf.pages, inf.prompt_len, first,
                                  req_key)
@@ -2406,7 +2431,8 @@ class Engine:
         # decode pages for the one token this step writes
         if self._pending_win is not None:
             events.extend(self._materialize_pending())
-        self._grow_pages(1, events)
+        with self.timeline.phase("page_alloc"):
+            self._grow_pages(1, events)
         if not self.seqs:
             # page pressure killed the whole batch: the chunk still has
             # its reserved pages — advance it on the classic path
@@ -2426,22 +2452,24 @@ class Engine:
          keys) = self._dev_sampling
         lx = (self._dev_adapters,) if self.lora is not None else ()
         px = (jnp.int32(inf.aslot),) if self.lora is not None else ()
-        (ys, chunk_logits, cur, pos, ctx_lens, self.token_counts,
-         self.k_pages, self.v_pages) = self._mixed[want_lp](
-            self.params, cur, pos, ctx_lens, active_dev,
-            self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
-            bias_ids, bias_vals, keys, self.token_counts,
-            self.k_pages, self.v_pages, *lx,
-            jnp.asarray(p_tokens), jnp.int32(start), jnp.int32(take),
-            jnp.asarray(inf.pages_arr), *px,
-        )
+        with self.timeline.phase("dispatch"):
+            (ys, chunk_logits, cur, pos, ctx_lens, self.token_counts,
+             self.k_pages, self.v_pages) = self._mixed[want_lp](
+                self.params, cur, pos, ctx_lens, active_dev,
+                self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
+                bias_ids, bias_vals, keys, self.token_counts,
+                self.k_pages, self.v_pages, *lx,
+                jnp.asarray(p_tokens), jnp.int32(start), jnp.int32(take),
+                jnp.asarray(inf.pages_arr), *px,
+            )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
         slots = list(self.seqs)
-        next_np = np.asarray(ys[0])  # [1, B]
-        if want_lp:
-            chosen_np = np.asarray(ys[1])
-            tids_np = np.asarray(ys[2])
-            tvals_np = np.asarray(ys[3])
+        with self.timeline.phase("device_wait"):
+            next_np = np.asarray(ys[0])  # [1, B]
+            if want_lp:
+                chosen_np = np.asarray(ys[1])
+                tids_np = np.asarray(ys[2])
+                tvals_np = np.asarray(ys[3])
         dt = time.monotonic() - t0
         inf.done += take
         # the mixed dispatch IS this iteration's decode step — it feeds
@@ -2455,24 +2483,26 @@ class Engine:
         self.metrics.observe_occupancy(len(slots), cfg.max_num_seqs)
         self.metrics.observe_mixed(take, len(slots))
         self._step_obs("mixed", dt, take=take)
-        for slot in slots:
-            seq = self.seqs.get(slot)
-            if seq is None:
-                continue
-            tok = int(next_np[0, slot])
-            seq.num_tokens += 1
-            seq.output_tokens.append(tok)
-            self.cur_tokens[slot] = tok
-            self.metrics.output_tokens += 1
-            finished, reason = self._check_stop(seq, tok)
-            ev = TokenEvent(seq.request_id, tok,
-                            len(seq.output_tokens) - 1, finished, reason)
-            if want_lp and seq.logprobs is not None:
-                self._decorate_lp(ev, seq, chosen_np[0, slot],
-                                  tids_np[0, slot], tvals_np[0, slot])
-            events.append(ev)
-            if finished:
-                self._finish_slot(slot, reason)
+        with self.timeline.phase("detok"):
+            for slot in slots:
+                seq = self.seqs.get(slot)
+                if seq is None:
+                    continue
+                tok = int(next_np[0, slot])
+                seq.num_tokens += 1
+                seq.output_tokens.append(tok)
+                self.cur_tokens[slot] = tok
+                self.metrics.output_tokens += 1
+                finished, reason = self._check_stop(seq, tok)
+                ev = TokenEvent(seq.request_id, tok,
+                                len(seq.output_tokens) - 1, finished,
+                                reason)
+                if want_lp and seq.logprobs is not None:
+                    self._decorate_lp(ev, seq, chosen_np[0, slot],
+                                      tids_np[0, slot], tvals_np[0, slot])
+                events.append(ev)
+                if finished:
+                    self._finish_slot(slot, reason)
         if inf.done < inf.prompt_len:
             return events
 
@@ -2484,8 +2514,9 @@ class Engine:
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
                                      namespace=req.adapter or "")
-        first, req_key, lp = self._first_token(req, chunk_logits,
-                                               inf.prompt_len)
+        with self.timeline.phase("device_wait"):
+            first, req_key, lp = self._first_token(req, chunk_logits,
+                                                   inf.prompt_len)
         seq = self._install_slot(req, inf.slot, inf.pages, inf.prompt_len,
                                  first, req_key)
         finished, reason = self._check_stop(seq, first)
@@ -2519,7 +2550,8 @@ class Engine:
             events.extend(self._materialize_pending())
         k = cfg.num_speculative_tokens
         k1 = k + 1
-        got = self._grow_pages(k1, events)
+        with self.timeline.phase("page_alloc"):
+            got = self._grow_pages(k1, events)
         if not self.seqs:
             # page pressure killed the whole batch: the chunk still has
             # its reserved pages — advance it on the classic path
@@ -2540,19 +2572,21 @@ class Engine:
         d_drafts, d_room = self._upload(drafts, room)
         lx = (self._dev_adapters,) if self.lora is not None else ()
         px = (jnp.int32(inf.aslot),) if self.lora is not None else ()
-        (ys, chunk_logits, cur, pos, ctx_lens, self.token_counts,
-         self.k_pages, self.v_pages) = self._mixed_spec(
-            self.params, cur, d_drafts, pos, ctx_lens, active_dev,
-            self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
-            bias_ids, bias_vals, keys, self.token_counts, d_room,
-            self.k_pages, self.v_pages, *lx,
-            jnp.asarray(p_tokens), jnp.int32(start), jnp.int32(take),
-            jnp.asarray(inf.pages_arr), *px,
-        )
+        with self.timeline.phase("dispatch"):
+            (ys, chunk_logits, cur, pos, ctx_lens, self.token_counts,
+             self.k_pages, self.v_pages) = self._mixed_spec(
+                self.params, cur, d_drafts, pos, ctx_lens, active_dev,
+                self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
+                bias_ids, bias_vals, keys, self.token_counts, d_room,
+                self.k_pages, self.v_pages, *lx,
+                jnp.asarray(p_tokens), jnp.int32(start), jnp.int32(take),
+                jnp.asarray(inf.pages_arr), *px,
+            )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
         slots = list(self.seqs)
-        emitted_np = np.asarray(ys[0])  # [B, K1]
-        nacc_np = np.asarray(ys[1])  # [B]
+        with self.timeline.phase("device_wait"):
+            emitted_np = np.asarray(ys[0])  # [B, K1]
+            nacc_np = np.asarray(ys[1])  # [B]
         dt = time.monotonic() - t0
         inf.done += take
         total = sum(int(nacc_np[s]) + 1 for s in slots)
@@ -2573,27 +2607,29 @@ class Engine:
         self.metrics.observe_phase("decode_step", dt / eff_steps,
                                    weight=eff_steps)
         self._step_obs("mixed_spec", dt, take=take)
-        for slot in slots:
-            seq = self.seqs.get(slot)
-            if seq is None:
-                continue
-            for j in range(int(nacc_np[slot]) + 1):
-                tok = int(emitted_np[slot, j])
-                seq.num_tokens += 1
-                seq.output_tokens.append(tok)
-                self.cur_tokens[slot] = tok
-                self.metrics.output_tokens += 1
-                finished, reason = self._check_stop(seq, tok)
-                events.append(TokenEvent(
-                    seq.request_id, tok, len(seq.output_tokens) - 1,
-                    finished, reason,
-                ))
-                if finished:
-                    # mid-chain stop: later accepted tokens are discarded;
-                    # _finish_slot invalidates device state, so the stale
-                    # advanced position is rebuilt from mirrors next step
-                    self._finish_slot(slot, reason)
-                    break
+        with self.timeline.phase("detok"):
+            for slot in slots:
+                seq = self.seqs.get(slot)
+                if seq is None:
+                    continue
+                for j in range(int(nacc_np[slot]) + 1):
+                    tok = int(emitted_np[slot, j])
+                    seq.num_tokens += 1
+                    seq.output_tokens.append(tok)
+                    self.cur_tokens[slot] = tok
+                    self.metrics.output_tokens += 1
+                    finished, reason = self._check_stop(seq, tok)
+                    events.append(TokenEvent(
+                        seq.request_id, tok, len(seq.output_tokens) - 1,
+                        finished, reason,
+                    ))
+                    if finished:
+                        # mid-chain stop: later accepted tokens are
+                        # discarded; _finish_slot invalidates device state,
+                        # so the stale advanced position is rebuilt from
+                        # mirrors next step
+                        self._finish_slot(slot, reason)
+                        break
         if inf.done < inf.prompt_len:
             return events
 
@@ -2605,8 +2641,9 @@ class Engine:
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
                                      namespace=req.adapter or "")
-        first, req_key, lp = self._first_token(req, chunk_logits,
-                                               inf.prompt_len)
+        with self.timeline.phase("device_wait"):
+            first, req_key, lp = self._first_token(req, chunk_logits,
+                                                   inf.prompt_len)
         seq = self._install_slot(req, inf.slot, inf.pages, inf.prompt_len,
                                  first, req_key)
         finished, reason = self._check_stop(seq, first)
@@ -2881,7 +2918,8 @@ class Engine:
         cfg = self.cfg
         k = cfg.num_speculative_tokens
         k1 = k + 1
-        got = self._grow_pages(k1, events)
+        with self.timeline.phase("page_alloc"):
+            got = self._grow_pages(k1, events)
         if not self.seqs:
             return events
         drafts, room = self._spec_drafts(got)
@@ -2901,17 +2939,19 @@ class Engine:
          keys) = self._dev_sampling
         d_drafts, d_room = self._upload(drafts, room)
         lx = (self._dev_adapters,) if self.lora is not None else ()
-        (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
-         self.v_pages) = self._spec(
-            self.params, cur, d_drafts, pos, ctx_lens, active_dev,
-            self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
-            bias_ids, bias_vals, keys, self.token_counts, d_room,
-            self.k_pages, self.v_pages, *lx,
-        )
+        with self.timeline.phase("dispatch"):
+            (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
+             self.v_pages) = self._spec(
+                self.params, cur, d_drafts, pos, ctx_lens, active_dev,
+                self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
+                bias_ids, bias_vals, keys, self.token_counts, d_room,
+                self.k_pages, self.v_pages, *lx,
+            )
         self._dev_state = (cur, pos, ctx_lens, active_dev)
         slots = list(self.seqs)
-        emitted_np = np.asarray(ys[0])  # [B, K1]
-        nacc_np = np.asarray(ys[1])  # [B]
+        with self.timeline.phase("device_wait"):
+            emitted_np = np.asarray(ys[0])  # [B, K1]
+            nacc_np = np.asarray(ys[1])  # [B]
         dt = time.monotonic() - t0
         total = sum(int(nacc_np[s]) + 1 for s in slots)
         self.metrics.decode_steps += 1
@@ -2929,33 +2969,36 @@ class Engine:
         self.metrics.observe_phase("decode_step", dt / eff_steps,
                                    weight=eff_steps)
         self._step_obs("decode_spec", dt)
-        for slot in slots:
-            seq = self.seqs.get(slot)
-            if seq is None:
-                continue
-            for j in range(int(nacc_np[slot]) + 1):
-                tok = int(emitted_np[slot, j])
-                seq.num_tokens += 1
-                seq.output_tokens.append(tok)
-                self.cur_tokens[slot] = tok
-                self.metrics.output_tokens += 1
-                finished, reason = self._check_stop(seq, tok)
-                events.append(TokenEvent(
-                    seq.request_id, tok, len(seq.output_tokens) - 1,
-                    finished, reason,
-                ))
-                if finished:
-                    # mid-chain stop: later accepted tokens are discarded;
-                    # _finish_slot invalidates device state, so the stale
-                    # advanced position is rebuilt from mirrors next step
-                    self._finish_slot(slot, reason)
-                    break
+        with self.timeline.phase("detok"):
+            for slot in slots:
+                seq = self.seqs.get(slot)
+                if seq is None:
+                    continue
+                for j in range(int(nacc_np[slot]) + 1):
+                    tok = int(emitted_np[slot, j])
+                    seq.num_tokens += 1
+                    seq.output_tokens.append(tok)
+                    self.cur_tokens[slot] = tok
+                    self.metrics.output_tokens += 1
+                    finished, reason = self._check_stop(seq, tok)
+                    events.append(TokenEvent(
+                        seq.request_id, tok, len(seq.output_tokens) - 1,
+                        finished, reason,
+                    ))
+                    if finished:
+                        # mid-chain stop: later accepted tokens are
+                        # discarded; _finish_slot invalidates device state,
+                        # so the stale advanced position is rebuilt from
+                        # mirrors next step
+                        self._finish_slot(slot, reason)
+                        break
         return events
 
     def _decode_once(self) -> List[TokenEvent]:
         """Synchronous decode: dispatch one window and read it back."""
         events: List[TokenEvent] = []
-        window = self._grow_pages(self._window_steps(), events)
+        with self.timeline.phase("page_alloc"):
+            window = self._grow_pages(self._window_steps(), events)
         if not self.seqs:
             return events
         self._dispatch_window(window)
@@ -2977,8 +3020,9 @@ class Engine:
         lag = prev[0] if prev is not None else 0
         window = self._window_steps(extra=lag)
         if window > 0:
-            window = self._grow_pages(window, events, offset=lag,
-                                      allow_kill=prev is None)
+            with self.timeline.phase("page_alloc"):
+                window = self._grow_pages(window, events, offset=lag,
+                                          allow_kill=prev is None)
         if not self.seqs:
             if self._pending_win is not None:
                 events.extend(self._materialize_pending())
@@ -3041,36 +3085,38 @@ class Engine:
 
     def _dispatch_window(self, window: int) -> None:
         t0 = time.monotonic()
-        self._ensure_dev_state()
-        want_lp = any(s.logprobs is not None for s in self.seqs.values())
-        cur, pos, ctx_lens, active_dev = self._dev_state
-        (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
-         keys) = self._dev_sampling
-        # lora mode: the per-slot adapter indices ride every window (slot 0
-        # keeps base sequences on the zero delta)
-        lx = (self._dev_adapters,) if self.lora is not None else ()
-        if any(s.guide is not None for s in self.seqs.values()):
-            self._ensure_dev_guide()
-            gm, gd, gb, ga = self._dev_guide
-            fn = self._get_guided_window(window > 1, want_lp)
-            (ys, cur, pos, ctx_lens, self.token_counts, gm, gd, gb,
-             self.k_pages, self.v_pages) = fn(
-                self.params, cur, pos, ctx_lens, active_dev,
-                self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
-                bias_ids, bias_vals, keys, self.token_counts,
-                self.k_pages, self.v_pages, *lx, gm, gd, gb, ga,
-            )
-            self._dev_guide = (gm, gd, gb, ga)
-        else:
-            fn = self._windows[(window > 1, want_lp)]
-            (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
-             self.v_pages) = fn(
-                self.params, cur, pos, ctx_lens, active_dev,
-                self._dev_tables, temp, top_p, top_k, pres, freq, min_p,
-                bias_ids, bias_vals, keys, self.token_counts,
-                self.k_pages, self.v_pages, *lx,
-            )
-        self._dev_state = (cur, pos, ctx_lens, active_dev)
+        with self.timeline.phase("dispatch"):
+            self._ensure_dev_state()
+            want_lp = any(s.logprobs is not None
+                          for s in self.seqs.values())
+            cur, pos, ctx_lens, active_dev = self._dev_state
+            (temp, top_p, top_k, pres, freq, min_p, bias_ids, bias_vals,
+             keys) = self._dev_sampling
+            # lora mode: the per-slot adapter indices ride every window
+            # (slot 0 keeps base sequences on the zero delta)
+            lx = (self._dev_adapters,) if self.lora is not None else ()
+            if any(s.guide is not None for s in self.seqs.values()):
+                self._ensure_dev_guide()
+                gm, gd, gb, ga = self._dev_guide
+                fn = self._get_guided_window(window > 1, want_lp)
+                (ys, cur, pos, ctx_lens, self.token_counts, gm, gd, gb,
+                 self.k_pages, self.v_pages) = fn(
+                    self.params, cur, pos, ctx_lens, active_dev,
+                    self._dev_tables, temp, top_p, top_k, pres, freq,
+                    min_p, bias_ids, bias_vals, keys, self.token_counts,
+                    self.k_pages, self.v_pages, *lx, gm, gd, gb, ga,
+                )
+                self._dev_guide = (gm, gd, gb, ga)
+            else:
+                fn = self._windows[(window > 1, want_lp)]
+                (ys, cur, pos, ctx_lens, self.token_counts, self.k_pages,
+                 self.v_pages) = fn(
+                    self.params, cur, pos, ctx_lens, active_dev,
+                    self._dev_tables, temp, top_p, top_k, pres, freq,
+                    min_p, bias_ids, bias_vals, keys, self.token_counts,
+                    self.k_pages, self.v_pages, *lx,
+                )
+            self._dev_state = (cur, pos, ctx_lens, active_dev)
         # capture membership AT DISPATCH: a slot installed later (disagg
         # import) must not consume this window's rows. The stored duration
         # is the HOST dispatch cost; the materialize side adds its own wait
@@ -3090,11 +3136,12 @@ class Engine:
         window, ys, want_lp, dispatch_s, slots = pw
         events: List[TokenEvent] = []
         t_wait = time.monotonic()
-        next_np = np.asarray(ys[0])  # [window, B]
-        if want_lp:
-            chosen_np = np.asarray(ys[1])  # [window, B]
-            tids_np = np.asarray(ys[2])  # [window, B, K]
-            tvals_np = np.asarray(ys[3])
+        with self.timeline.phase("device_wait"):
+            next_np = np.asarray(ys[0])  # [window, B]
+            if want_lp:
+                chosen_np = np.asarray(ys[1])  # [window, B]
+                tids_np = np.asarray(ys[2])  # [window, B, K]
+                tvals_np = np.asarray(ys[3])
         dt = dispatch_s + (time.monotonic() - t_wait)
         self.metrics.decode_steps += window
         self.metrics.decode_time_s += dt
@@ -3103,35 +3150,39 @@ class Engine:
         self.metrics.observe_occupancy(len(slots), self.cfg.max_num_seqs)
         self._step_obs("decode", dt)
 
-        for slot in slots:
-            seq = self.seqs.get(slot)
-            if seq is None:  # finished/aborted since dispatch
-                continue
-            for k in range(window):
-                tok = int(next_np[k, slot])
-                seq.num_tokens += 1  # the attended token is now cached
-                seq.output_tokens.append(tok)
-                self.cur_tokens[slot] = tok
-                if seq.guide is not None:
-                    # host grammar mirror keeps up with the device carry, so
-                    # membership-change rebuilds resume mid-stream exactly
-                    seq.guide = json_guide.advance_host(
-                        self._guide_table, seq.guide, tok)
-                self.metrics.output_tokens += 1
-                finished, reason = self._check_stop(seq, tok)
-                ev = TokenEvent(
-                    seq.request_id, tok, len(seq.output_tokens) - 1,
-                    finished, reason,
-                )
-                if want_lp and seq.logprobs is not None:
-                    self._decorate_lp(ev, seq, chosen_np[k, slot],
-                                      tids_np[k, slot], tvals_np[k, slot])
-                events.append(ev)
-                if finished:
-                    # mid-window stop: later window tokens for this slot are
-                    # discarded (their KV lives in pages freed right here)
-                    self._finish_slot(slot, reason)
-                    break
+        with self.timeline.phase("detok"):
+            for slot in slots:
+                seq = self.seqs.get(slot)
+                if seq is None:  # finished/aborted since dispatch
+                    continue
+                for k in range(window):
+                    tok = int(next_np[k, slot])
+                    seq.num_tokens += 1  # the attended token is now cached
+                    seq.output_tokens.append(tok)
+                    self.cur_tokens[slot] = tok
+                    if seq.guide is not None:
+                        # host grammar mirror keeps up with the device
+                        # carry, so membership-change rebuilds resume
+                        # mid-stream exactly
+                        seq.guide = json_guide.advance_host(
+                            self._guide_table, seq.guide, tok)
+                    self.metrics.output_tokens += 1
+                    finished, reason = self._check_stop(seq, tok)
+                    ev = TokenEvent(
+                        seq.request_id, tok, len(seq.output_tokens) - 1,
+                        finished, reason,
+                    )
+                    if want_lp and seq.logprobs is not None:
+                        self._decorate_lp(ev, seq, chosen_np[k, slot],
+                                          tids_np[k, slot],
+                                          tvals_np[k, slot])
+                    events.append(ev)
+                    if finished:
+                        # mid-window stop: later window tokens for this
+                        # slot are discarded (their KV lives in pages
+                        # freed right here)
+                        self._finish_slot(slot, reason)
+                        break
         return events
 
     def _check_stop(self, seq: SeqState, token: int):

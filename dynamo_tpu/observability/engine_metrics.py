@@ -8,7 +8,13 @@ second observation path:
 
 - `dynamo_engine_phase_seconds{phase}` — prefill / prefill_chunk /
   decode_window / decode_step / mixed_step histograms (PhaseTimer's
-  quarter-octave buckets downsampled to octaves: 0.25ms..8.2s, 16 edges);
+  quarter-octave buckets downsampled to octaves: 0.25ms..8.2s, 16 edges),
+  plus the step-timeline self-time phases (admit / page_alloc / dispatch /
+  device_wait / detok / bank) from observability/timeline.py riding the
+  same series as additional label values;
+- `dynamo_engine_host_gap_seconds` — inter-dispatch host gap sampled by
+  the step timeline at every device-program launch (the zero-bubble
+  roadmap item's acceptance number);
 - `dynamo_engine_batch_occupancy` — decode-window batch occupancy
   (active slots / max_num_seqs) histogram;
 - `dynamo_engine_mixed_prefill_fraction` — unified ragged step
@@ -64,6 +70,25 @@ log = logging.getLogger("dynamo_tpu.engine_metrics")
 _OCTAVE_STRIDE = 4
 
 
+def _downsample_cum(buckets, raw_count, idxs):
+    """Cumulative octave buckets from a quarter-octave histogram (shared
+    by PhaseTimer and timeline.PhaseDigest — same edge scheme)."""
+    cum = []
+    running = 0
+    j = 0
+    for i in idxs:
+        while j <= i:
+            running += buckets[j]
+            j += 1
+        cum.append(running)
+    # single count read AFTER the bucket reads, used for both the
+    # +Inf bucket and _count: a concurrent observe can only make the
+    # tail larger, never break +Inf == _count or monotonicity
+    count = max(raw_count, running)
+    cum.append(count)  # +Inf
+    return cum, count
+
+
 def _phase_series(engine):
     from dynamo_tpu.engine.engine import PhaseTimer
 
@@ -72,22 +97,33 @@ def _phase_series(engine):
     edges_s = [round(edges_ms[i] / 1e3, 8) for i in idxs]
     out = []
     for phase, timer in engine.metrics.phases.items():
-        cum = []
-        running = 0
-        j = 0
-        for i in idxs:
-            while j <= i:
-                running += timer.buckets[j]
-                j += 1
-            cum.append(running)
-        # single count read AFTER the bucket reads, used for both the
-        # +Inf bucket and _count: a concurrent observe can only make the
-        # tail larger, never break +Inf == _count or monotonicity
-        count = max(timer.count, running)
-        cum.append(count)  # +Inf
+        cum, count = _downsample_cum(timer.buckets, timer.count, idxs)
         out.append(({"phase": phase}, edges_s, cum,
                     round(timer.sum_s, 6), count))
+    # step-timeline phase digests (admit/page_alloc/dispatch/device_wait/
+    # detok/bank) ride the same series as additional `phase` label values:
+    # PhaseDigest replicates PhaseTimer's bucket scheme by construction,
+    # and the two name sets are disjoint
+    for phase, dg in engine.timeline.digests.items():
+        if not dg.count:
+            continue
+        cum, count = _downsample_cum(dg.buckets, dg.count, idxs)
+        out.append(({"phase": phase}, edges_s, cum,
+                    round(dg.sum_s, 6), count))
     return out
+
+
+def _host_gap_series(engine):
+    """Inter-dispatch host-gap distribution from the step timeline — the
+    zero-bubble roadmap item's acceptance number."""
+    from dynamo_tpu.observability.timeline import PhaseDigest
+
+    edges_ms = PhaseDigest._EDGES_MS
+    idxs = list(range(0, len(edges_ms), _OCTAVE_STRIDE))
+    edges_s = [round(edges_ms[i] / 1e3, 8) for i in idxs]
+    gd = engine.timeline.gap_digest
+    cum, count = _downsample_cum(gd.buckets, gd.count, idxs)
+    return [({}, edges_s, cum, round(gd.sum_s, 6), count)]
 
 
 def _occupancy_series(engine):
@@ -181,6 +217,12 @@ class EngineMetricsBridge:
             "dynamo_engine_phase_seconds",
             "Engine phase step-time distribution (PhaseTimer bridge)",
             registry, lambda: _phase_series(self.engine))
+        CallbackHistogram(
+            "dynamo_engine_host_gap_seconds",
+            "Inter-dispatch host gap: wall time between a device program "
+            "returning control and the next program launching (step "
+            "timeline; the zero-bubble target)",
+            registry, lambda: _host_gap_series(self.engine))
         CallbackHistogram(
             "dynamo_engine_batch_occupancy",
             "Decode-window batch occupancy (active slots / max_num_seqs)",

@@ -79,21 +79,31 @@ class FlightRecorder:
         stale = self._draft
         if stale is not None:
             stale["aborted"] = True
-            stale["kind"] = "+".join(stale.pop("kinds")) or "aborted"
+            self._close_draft(stale, "aborted")
             self._append(stale)
         self._draft = {"t": time.time(), "kinds": [], "phases": {},
                        "events": []}
 
     def phase(self, kind: str, dur_s: float, **fields: Any) -> None:
-        """Record one executed segment (a dispatch) of the open step."""
+        """Record one executed segment (a dispatch) of the open step.
+        Accumulates raw float seconds — rounding happens once at record
+        flush (_close_draft), so repeated phases in one step can't
+        compound per-accumulate rounding error."""
         d = self._draft
         if d is None:
             return
         d["kinds"].append(kind)
-        d["phases"][kind] = round(
-            d["phases"].get(kind, 0.0) + dur_s * 1e3, 3)  # ms
+        d["phases"][kind] = d["phases"].get(kind, 0.0) + dur_s
         for k, v in fields.items():
             d[k] = v
+
+    @staticmethod
+    def _close_draft(d: Dict[str, Any], empty_kind: str) -> None:
+        """Finalize a draft in place: collapse kinds and convert the
+        phase accumulators to the record format (ms, 3 decimals)."""
+        d["kind"] = "+".join(d.pop("kinds")) or empty_kind
+        d["phases"] = {k: round(v * 1e3, 3)
+                       for k, v in d["phases"].items()}
 
     def commit(self, **fields: Any) -> None:
         """Finalize the open step record.  Steps that did nothing (no
@@ -105,7 +115,7 @@ class FlightRecorder:
         if not d["kinds"] and not d["events"]:
             return
         d.update(fields)
-        d["kind"] = "+".join(d.pop("kinds")) or "event"
+        self._close_draft(d, "event")
         self.steps_total += 1
         self._append(d)
 
@@ -138,7 +148,7 @@ class FlightRecorder:
             d["aborted"] = True
             if not d["kinds"] and not d["events"]:
                 d["events"].append({"ev": "empty_step"})
-            d["kind"] = "+".join(d.pop("kinds")) or "aborted"
+            self._close_draft(d, "aborted")
             self._append(d)
         self.note("dump", reason=reason, **fields)
         records = self.records()

@@ -52,6 +52,9 @@ WORKER_DEBUG_INDEX = {
                      "(?n=&rid=&tenant=&kind=)",
     "/debug/costs": "per-tenant chip-seconds and HBM byte-seconds "
                     "attributed by the engine cost ledger",
+    "/debug/timeline": "engine step timeline: exact phase intervals, "
+                       "host-gap/bubble attribution "
+                       "(?steps=&format=perfetto|summary|json&trace_id=)",
     "/debug/trace": "capture a jax.profiler trace zip (?duration_s=; "
                     "409 while another capture runs)",
 }
@@ -911,6 +914,17 @@ class _Handler(JsonHTTPHandler):
             qs = parse_qs(urlparse(self.path).query)
             self._json(200, debug_flight_payload(
                 self.ctx.engine.flight, qs))
+        elif path == "/debug/timeline":
+            from urllib.parse import parse_qs, urlparse
+
+            from dynamo_tpu.observability.timeline import (
+                timeline_debug_payload,
+            )
+
+            qs = parse_qs(urlparse(self.path).query)
+            self._json(200, timeline_debug_payload(
+                self.ctx.engine.timeline, qs,
+                collector=self.ctx.tracer.collector))
         elif path == "/debug/costs":
             self._json(200, self.ctx.engine.cost.rollup())
         elif path == "/worker/stats":
@@ -986,6 +1000,7 @@ class _Handler(JsonHTTPHandler):
             except Exception:
                 log.exception("memory snapshot failed in /worker/stats")
             out["costs"] = eng.cost.rollup()
+            out["timeline"] = eng.timeline.summary()
             self._json(200, out)
         else:
             self._error(404, f"no route {path}")

@@ -477,6 +477,22 @@ class _FrontendHandler(JsonHTTPHandler):
             merged["workers"] = len(per_worker)
             merged["per_worker"] = per_worker
             self._json(200, merged)
+        elif path == "/debug/timeline":
+            # fleet-wide bubble attribution: each worker ships its
+            # step-timeline summary in the heartbeat (same no-fan-out
+            # pattern as /debug/costs); quantiles don't merge, so the
+            # rollup reports worst-worker p95 per phase
+            from dynamo_tpu.observability.timeline import merge_summaries
+
+            per_worker = {}
+            for w in ctx.router.alive(("agg", "prefill", "decode")):
+                tl = (w.stats or {}).get("timeline")
+                if tl:
+                    per_worker[w.url] = tl
+            merged = merge_summaries(list(per_worker.values()))
+            merged["workers"] = len(per_worker)
+            merged["per_worker"] = per_worker
+            self._json(200, merged)
         elif path in ("/debug", "/debug/"):
             self._json(200, {"endpoints": {
                 "/debug/spans": "recent frontend/request spans "
@@ -487,6 +503,9 @@ class _FrontendHandler(JsonHTTPHandler):
                                   "state",
                 "/debug/costs": "fleet-wide per-tenant cost rollup "
                                 "aggregated from worker heartbeats",
+                "/debug/timeline": "fleet-wide step-timeline bubble "
+                                   "attribution aggregated from worker "
+                                   "heartbeats",
             }, "see_also": {
                 "workers": "GET <worker>/debug/ for the worker-side index "
                            "(flight recorder, trace capture, costs)",

@@ -119,6 +119,9 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                 # frontend replica can answer /debug/costs fleet-wide
                 # without fanning out scrapes to each worker
                 "costs": eng.cost.rollup(),
+                # step-timeline bubble summary rides the same beat: the
+                # frontend's /debug/timeline merges these fleet-wide
+                "timeline": eng.timeline.summary(),
             },
         }).encode()
         for payload_url in payload_urls:

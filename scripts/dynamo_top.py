@@ -2,11 +2,13 @@
 """dynamo_top: a `top`-style live fleet view for a dynamo_tpu deployment.
 
 Reads only public HTTP surfaces — frontend `/internal/workers` +
-`/debug/costs`, each worker's `/worker/stats` (memory + cost sections) and
-`/debug/flight?n=` — so it needs no cluster credentials beyond reach of the
-frontend. One screen answers: who is serving what, how full is every KV
-tier, which tenant is spending the chips, and what each engine did in its
-last few steps.
+`/debug/costs`, each worker's `/worker/stats` (memory + cost + step-
+timeline sections) and `/debug/flight?n=` — so it needs no cluster
+credentials beyond reach of the frontend. One screen answers: who is
+serving what, how full is every KV tier, which tenant is spending the
+chips, where each engine's step time goes (per-phase p50/p95 and the
+inter-dispatch host-gap share — the bubble the zero-bubble work must
+close), and what each engine did in its last few steps.
 
 Usage:
     python scripts/dynamo_top.py --frontend http://localhost:8000
@@ -125,6 +127,26 @@ def render(frame: Dict[str, Any], flight_n: int) -> List[str]:
                                    key=lambda kv: -kv[1].get(
                                        "chip_seconds", 0))[:6])
             out(f"   costs  {tens}")
+        tl = st.get("timeline")
+        if tl and tl.get("steps"):
+            hg = tl.get("host_gap") or {}
+            bub = tl.get("bubble") or {}
+            eater = bub.get("gap_eater")
+            out(f"   stepln steps={tl.get('steps')}"
+                f"  host_gap p50={hg.get('p50_ms', 0):.2f}ms"
+                f" p95={hg.get('p95_ms', 0):.2f}ms"
+                f" share={hg.get('share', 0) * 100:.1f}%"
+                f"{('  eater=' + eater) if eater else ''}")
+            phases = tl.get("phases") or {}
+            if phases:
+                parts = "  ".join(
+                    f"{n}={p.get('p50_ms', 0):.2f}/"
+                    f"{p.get('p95_ms', 0):.2f}ms"
+                    f"({p.get('share', 0) * 100:.0f}%)"
+                    for n, p in sorted(
+                        phases.items(),
+                        key=lambda kv: -kv[1].get("total_s", 0)))
+                out(f"          p50/p95  {parts}")
         fl = w.get("flight")
         if fl and fl.get("records"):
             out(f"   flight ring={fl.get('size')}/{fl.get('capacity')}"

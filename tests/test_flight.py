@@ -83,6 +83,19 @@ def test_stale_draft_flushes_flagged_aborted():
     assert recs[1]["kind"] == "decode" and "aborted" not in recs[1]
 
 
+def test_phase_accumulation_rounds_only_at_snapshot():
+    # regression: phase() used to round to 3-decimal ms PER ACCUMULATE,
+    # so a thousand sub-half-microsecond segments summed to exactly 0.0;
+    # accumulation is raw float seconds now, rounded once at record flush
+    fr = FlightRecorder(capacity=4)
+    fr.begin()
+    for _ in range(1000):
+        fr.phase("decode", 4e-7)  # 0.0004 ms: below per-accumulate rounding
+    fr.commit()
+    (rec,) = fr.records()
+    assert rec["phases"]["decode"] == pytest.approx(0.4, abs=1e-3)
+
+
 def test_capacity_zero_disables_every_hook():
     fr = FlightRecorder(capacity=0)
     assert not fr.enabled
@@ -390,3 +403,22 @@ def test_worker_stats_has_memory_and_costs(server):
     tiers = mem["tiers"]["device"]
     assert sum(tiers.values()) == mem["pool"]["total_bytes"]
     assert st["costs"]["totals"]["chip_seconds"] > 0
+
+
+def test_debug_timeline_route_live(server):
+    ctx, url = server
+    ctx.engine.add_request(GenRequest("tl1", [2, 7, 1], max_tokens=3,
+                                      temperature=0.0, ignore_eos=True))
+    _drain(ctx.engine)
+    idx = _get_json(url, "/debug/")["endpoints"]
+    assert "/debug/timeline" in idx
+    summ = _get_json(url, "/debug/timeline?format=summary")
+    assert summ["enabled"] and summ["steps"] > 0
+    assert "bubble" in summ and "host_gap" in summ
+    trace = _get_json(url, "/debug/timeline?format=perfetto")
+    evs = trace["traceEvents"]
+    assert any(e["ph"] == "X" and e["pid"] == 1 for e in evs)
+    raw = _get_json(url, "/debug/timeline?steps=4")
+    assert raw["records"] and len(raw["records"]) <= 4
+    st = _get_json(url, "/worker/stats")
+    assert st["timeline"]["steps"] > 0

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check lint-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check batch-check lint-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -36,6 +36,7 @@ help:
 	@echo "  rpa-check      unified ragged-step suite (kernel parity, mixed/classic identity, bench contract)"
 	@echo "  ha-check       HA frontend plane suite (replicated journal, cross-frontend resume, fleet QoS)"
 	@echo "  spec-check     speculative decoding v2 suite (ragged-verify identity, LoRA/sampling/QoS composition)"
+	@echo "  batch-check    preemptible batch tier suite (class-wide QoS eviction, spot reclamation, trough sizing)"
 	@echo "  lint-check     dynalint static analysis (lock discipline, jit purity, metrics/env contracts) + its suite"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
@@ -182,6 +183,19 @@ ha-check:
 # QoS-debits-accepted-only accounting check.
 spec-check:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q -p no:randomly
+
+# Preemptible-batch-tier gate (docs/robustness.md "Preemptible batch
+# tier"): the `batch` marker suite — class spec + penalty-constant
+# contract, the class-wide one-step eviction acceptance with zero-lost-
+# token parity, the inverted burn admission gate, the /internal/reclaim
+# notice drill, trough-sized preemptible pools, spot materialization,
+# per-tier cost rows — plus the two socket chaos drills (batch-pool kill
+# with journaled resume + interactive byte-parity; reclamation deadline
+# with an in-flight stream), slow-marked for tier-1 but run here by the
+# direct file invocation, under the pinned chaos fault seed.
+batch-check:
+	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
+		python -m pytest tests/test_batch_tier.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

@@ -826,6 +826,163 @@ def bench_speculative_agentic(on_tpu: bool) -> dict:
     }
 
 
+def bench_batch_soak(on_tpu: bool) -> dict:
+    """Preemptible-batch-tier A/B (docs/robustness.md "Preemptible batch
+    tier"): a diurnal-shaped interactive load — bursts separated by
+    troughs — with the batch lane ON (a standing offline backlog soaks
+    the trough chips, QoS-evicted the step interactive returns) vs OFF
+    (the troughs idle). Reports chip-seconds utilization over the run's
+    wall clock from the engine cost ledger, the per-TIER cost-ledger
+    rows (the chargeback evidence that batch work priced as batch), and
+    interactive ITL p95 both arms — the tier's contract is that the
+    utilization gain costs the interactive tail nothing.
+
+    Env: BENCH_SOAK_CYCLES (bursts, default 3), BENCH_SOAK_BURST
+    (interactive requests per burst, default 3), BENCH_SOAK_TROUGH_S
+    (trough wall seconds, default 0.4), BENCH_SOAK_TOKENS (interactive
+    max_tokens, default 24), BENCH_SOAK_BACKLOG (standing batch
+    requests, default 8)."""
+    import time as _time
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    model = os.environ.get("BENCH_MODEL",
+                           "llama-3.2-1b-instruct" if on_tpu else "tiny-debug")
+    cycles = int(os.environ.get("BENCH_SOAK_CYCLES", "3"))
+    burst = int(os.environ.get("BENCH_SOAK_BURST", "3"))
+    trough_s = float(os.environ.get("BENCH_SOAK_TROUGH_S", "0.4"))
+    steps = int(os.environ.get("BENCH_SOAK_TOKENS", "24"))
+    backlog = int(os.environ.get("BENCH_SOAK_BACKLOG", "8"))
+    tenants = [{"name": "batch", "weight": 1, "batch": True},
+               {"name": "live", "weight": 3}]
+
+    def pctl(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    def run(batch_on: bool, params=None):
+        eng = Engine(EngineConfig(
+            model=model, page_size=16, num_pages=256, max_num_seqs=4,
+            max_seq_len=4 * steps + 96, seed=11,
+            enable_prefix_caching=False,
+            tenants=json.dumps(tenants)), params=params)
+        # warm the programs the timed section hits: solo prefill, batched
+        # group prefill, the continuation bucket (eviction recompute
+        # carries prompt + output), and the decode window
+        eng.add_request(GenRequest(
+            "warm-solo", [(j * 3) % 199 + 1 for j in range(24)],
+            max_tokens=8, temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            eng.step()
+        eng.add_request(GenRequest(
+            "warm-cont", [(j * 5) % 199 + 1 for j in range(40)],
+            max_tokens=8, temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            eng.step()
+        for i in range(4):
+            eng.add_request(GenRequest(
+                f"warm{i}", [(i * 17 + j * 3) % 199 + 1 for j in range(24)],
+                max_tokens=8, temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            eng.step()
+        eng.reset_metrics()
+        # the cost ledger is monotonic: measure the timed section by delta
+        roll0 = eng.cost.rollup()
+        tiers0 = roll0.get("tiers", {})
+        chip0 = roll0["totals"]["chip_seconds"]
+        itl, last = [], {}
+        batch_tokens = [0]
+        t0 = _time.perf_counter()
+        if batch_on:
+            for i in range(backlog):
+                eng.add_request(GenRequest(
+                    f"batch{i}", [(i * 29 + j * 11) % 199 + 1
+                                  for j in range(24)],
+                    max_tokens=3 * steps, temperature=0.0, ignore_eos=True,
+                    tenant="batch"))
+
+        def pump(live_left):
+            for ev in eng.step():
+                now = _time.perf_counter()
+                if ev.token_id < 0:
+                    continue
+                if ev.request_id.startswith("live"):
+                    if ev.request_id in last:
+                        itl.append(now - last[ev.request_id])
+                    last[ev.request_id] = now
+                elif ev.request_id.startswith("batch"):
+                    batch_tokens[0] += 1
+                if ev.finished:
+                    live_left.discard(ev.request_id)
+
+        for c in range(cycles):
+            live_left = set()
+            for b in range(burst):
+                rid = f"live{c}-{b}"
+                live_left.add(rid)
+                eng.add_request(GenRequest(
+                    rid, [(c * 31 + b * 7 + j * 5) % 199 + 1
+                          for j in range(24)],
+                    max_tokens=steps, temperature=0.0, ignore_eos=True,
+                    tenant="live"))
+            while live_left:
+                pump(live_left)
+            # the trough: the batch lane soaks the idle chips, the
+            # no-batch arm idles for the same wall window
+            t_end = _time.perf_counter() + trough_s
+            while _time.perf_counter() < t_end:
+                if eng.has_work:
+                    pump(set())
+                else:
+                    _time.sleep(0.005)
+        wall = _time.perf_counter() - t0
+        roll = eng.cost.rollup()
+        tier_rows = {}
+        for tier, row in roll.get("tiers", {}).items():
+            base = tiers0.get(tier, {})
+            tier_rows[tier] = {
+                k: round(v - base.get(k, 0.0), 6) for k, v in row.items()}
+        chip_s = roll["totals"]["chip_seconds"] - chip0
+        return {
+            "wall_s": round(wall, 3),
+            "chip_seconds": round(chip_s, 6),
+            "chip_utilization": round(chip_s / max(wall, 1e-9), 4),
+            "batch_tokens": batch_tokens[0],
+            "interactive_itl_p50_ms": round(1e3 * pctl(itl, 0.5), 3),
+            "interactive_itl_p95_ms": round(1e3 * pctl(itl, 0.95), 3),
+            "cost_tiers": tier_rows,
+        }, eng.params
+
+    on_res, params = run(batch_on=True)
+    off_res, _ = run(batch_on=False, params=params)
+    return {
+        "metric": "batch_soak_chip_utilization",
+        "value": on_res["chip_utilization"],
+        "unit": "chip_s_per_wall_s",
+        "scenario": "batch_soak",
+        "model": model,
+        "cycles": cycles,
+        "burst": burst,
+        "trough_s": trough_s,
+        "batch_backlog": backlog,
+        "batch_on": on_res,
+        "batch_off": off_res,
+        "utilization_gain": round(
+            on_res["chip_utilization"]
+            / max(off_res["chip_utilization"], 1e-9), 3),
+        "interactive_itl_p95_ratio": round(
+            on_res["interactive_itl_p95_ms"]
+            / max(off_res["interactive_itl_p95_ms"], 1e-9), 3),
+        # CPU-fallback latency is never comparable to the TPU north star
+        # (standing ROADMAP constraint)
+        "comparable": bool(on_tpu),
+    }
+
+
 def main() -> None:
     backend = _init_backend()
     import jax
@@ -846,6 +1003,10 @@ def main() -> None:
     if os.environ.get("BENCH_SCENARIO") == "speculative_agentic":
         # speculative decoding v2 A/B: one JSON line, same contract
         print(json.dumps(bench_speculative_agentic(on_tpu)))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "batch_soak":
+        # preemptible batch tier A/B: one JSON line, same contract
+        print(json.dumps(bench_batch_soak(on_tpu)))
         return
     dev = jax.devices()[0]
     chip = _chip_spec(dev) if on_tpu else None

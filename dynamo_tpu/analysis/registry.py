@@ -52,6 +52,10 @@ KNOWN_ENV: Dict[str, str] = {
     "DYNAMO_TPU_ATTN_BACKEND":
         "attention backend: auto / xla / pallas / pallas_interpret "
         "(auto = Pallas on TPU, XLA elsewhere)",
+    "DYNAMO_TPU_BATCH_BURN_ADMIT":
+        "preemptible batch tier: batch-class tenants admit only while "
+        "every interactive fast-window SLO burn is below this "
+        "(default 1.0; 0 disables the gate)",
     "DYNAMO_TPU_BREAKER_COOLDOWN_S":
         "circuit breaker: cooldown before a tripped worker gets a "
         "half-open probe",
@@ -111,6 +115,10 @@ KNOWN_ENV: Dict[str, str] = {
         "get 429 + Retry-After (0 = off)",
     "DYNAMO_TPU_NUM_PROCESSES":
         "multi-host: total JAX process count",
+    "DYNAMO_TPU_PREEMPTIBLE":
+        "marks this worker's capacity reclaimable (spot pool): "
+        "advertised in heartbeat stats; the reclaim drain path applies "
+        "(operator sets it from `preemptible: true`)",
     "DYNAMO_TPU_PROCESS_ID":
         "multi-host: this host's process index",
     "DYNAMO_TPU_QOS_BURN_SHED":
@@ -119,6 +127,10 @@ KNOWN_ENV: Dict[str, str] = {
     "DYNAMO_TPU_RAGGED_ATTENTION":
         "mixed ragged prefill+decode attention backend override (wins "
         "over hardware-validation gating)",
+    "DYNAMO_TPU_RECLAIM_DEADLINE_S":
+        "default hard drain deadline (seconds) for a /internal/reclaim "
+        "notice that carries none (align with the spot pool's advertised "
+        "reclamation grace)",
     "DYNAMO_TPU_RECOVERY":
         "stream-recovery journaling kill switch (0 disables; default on)",
     "DYNAMO_TPU_SLOW_REQUEST_S":
@@ -207,6 +219,12 @@ MANIFEST_KEYS: Dict[str, Tuple[Tuple[str, ...], str]] = {
                    "envs; list of specs -> the JSON env"),
     "tenants": (("DYNAMO_TPU_TENANTS",),
                 "tenant QoS classes, identical on frontend and workers"),
+    "preemptible": (("DYNAMO_TPU_PREEMPTIBLE",),
+                    "spot/reclaimable worker pool: GKE spot nodeSelector "
+                    "+ toleration, reclaim drain semantics"),
+    "reclaimDeadlineSeconds": (("DYNAMO_TPU_RECLAIM_DEADLINE_S",),
+                               "default hard deadline for reclamation "
+                               "notices on this pool"),
 }
 
 # Envs the operator materializes that no *manifest key* owns (fieldRefs,

@@ -504,6 +504,13 @@ class Engine:
 
         self.flight = FlightRecorder()
         self.cost = CostLedger()
+        if self.tenant_registry.enabled:
+            # preemptible batch tier: /debug/costs and the heartbeat
+            # rollup price the batch lane as its own row next to the
+            # per-tenant entries (docs/autoscaling.md chargeback)
+            reg = self.tenant_registry
+            self.cost.tier_of = (
+                lambda t: "batch" if reg.is_batch(t) else "interactive")
         # stepline: precise per-step phase intervals + inter-dispatch
         # host-gap accounting (DYNAMO_TPU_TIMELINE / _TIMELINE_RECORDS)
         self.timeline = StepTimeline()
@@ -1291,22 +1298,42 @@ class Engine:
         """STATIC queue-order priority: the request's own priority plus
         its tenant class's priority offset. Static by construction (no
         budget term) so the pending queue's sorted invariant cannot rot
-        as balances move."""
+        as balances move. Batch-class requests carry a constant penalty
+        that dominates any legal priority sum — the offline lane never
+        queues ahead of interactive work."""
         if self.qos is None:
             return req.priority
-        return req.priority + self.qos.registry.cls(
-            self._tenant_of(req)).priority
+        c = self.qos.registry.cls(self._tenant_of(req))
+        p = req.priority + c.priority
+        if c.batch:
+            from dynamo_tpu.qos.tenancy import BATCH_PRIORITY_PENALTY
+
+            p += BATCH_PRIORITY_PENALTY
+        return p
+
+    def _is_batch(self, tenant: str) -> bool:
+        return self.qos is not None and self.qos.registry.is_batch(tenant)
+
+    def _class_of(self, tenant: str) -> str:
+        """Flight-recorder taxonomy for preemption victims/beneficiaries."""
+        return "batch" if self._is_batch(tenant) else "interactive"
 
     def _rank_priority(self, req: GenRequest) -> int:
         """Preemption-victim rank: queue priority plus the over-budget
         penalty — an over-budget tenant's sequences are the preferred
-        victims under page/slot pressure, whatever their nominal class."""
+        victims under page/slot pressure, whatever their nominal class.
+        Batch sequences add a larger penalty still: the offline lane is
+        evicted before even a misbehaving interactive tenant."""
         p = self._queue_priority(req)
-        if self.qos is not None and self.qos.over_budget(
-                self._tenant_of(req)):
-            from dynamo_tpu.qos.tenancy import OVER_BUDGET_PENALTY
+        if self.qos is not None:
+            from dynamo_tpu.qos.tenancy import (BATCH_VICTIM_PENALTY,
+                                                OVER_BUDGET_PENALTY)
 
-            p += OVER_BUDGET_PENALTY
+            t = self._tenant_of(req)
+            if self.qos.over_budget(t):
+                p += OVER_BUDGET_PENALTY
+            if self.qos.registry.is_batch(t):
+                p += BATCH_VICTIM_PENALTY
         return p
 
     def _qos_slot_state(self, pend) -> tuple:
@@ -1371,6 +1398,49 @@ class Engine:
                 del self.pending[i]
                 return
 
+    def _qos_evict_batch_for_admission(self) -> List[TokenEvent]:
+        """Class-wide batch eviction: interactive traffic returning to a
+        trough-filled engine drains EVERY batch-held slot it needs within
+        this one step — not one per step like the WFQ path, because the
+        offline lane's contract is instant yield, not fair contention.
+        Each victim requeues as a recompute continuation (tokens kept:
+        zero lost work); the interactive admissions then land in this
+        same _admit pass. Batch-vs-batch contention stays on the WFQ
+        single-victim path."""
+        if (self.qos is None or self._inflight is not None
+                or not self.seqs):
+            return []
+        if not any(self._is_batch(self._tenant_of(s.req))
+                   for s in self.seqs.values()):
+            return []
+        with self._lock:
+            interactive = [r for r in self.pending
+                           if not self._is_batch(self._tenant_of(r))]
+        need = len(interactive) - len(self._free_slots)
+        if need <= 0:
+            return []
+        # preemption frees pages an in-flight async window may still
+        # touch — drain the pipeline before any teardown (this can also
+        # finish sequences, so victims are picked after)
+        events = self._materialize_pending()
+        victims = sorted(
+            ((slot, s) for slot, s in self.seqs.items()
+             if self._is_batch(self._tenant_of(s.req))),
+            key=lambda kv: (self._rank_priority(kv[1].req),
+                            kv[1].req.arrival_time),
+            reverse=True)
+        head = interactive[0]
+        for slot, seq in victims[:max(0, need)]:
+            self.flight.note(
+                "qos_preempt", victim_rid=seq.request_id, victim_slot=slot,
+                victim_tenant=self._tenant_of(seq.req),
+                victim_class="batch", reason="interactive_return",
+                beneficiary_rid=head.request_id,
+                beneficiary_tenant=self._tenant_of(head),
+                n_out=len(seq.output_tokens))
+            self._preempt_slot(slot)
+        return events
+
     def _qos_preempt_for_admission(self) -> List[TokenEvent]:
         """WFQ slot reallocation: when every decode slot is taken and a
         well-behaved tenant queues below its fair share, preempt ONE
@@ -1412,6 +1482,8 @@ class Engine:
             self.flight.note(
                 "qos_preempt", victim_rid=seq.request_id, victim_slot=slot,
                 victim_tenant=self._tenant_of(seq.req),
+                victim_class=self._class_of(self._tenant_of(seq.req)),
+                reason="wfq_share",
                 beneficiary_rid=cand.request_id, beneficiary_tenant=cand_t)
             self._preempt_slot(slot)
         return events
@@ -1716,8 +1788,11 @@ class Engine:
 
     def _admit(self) -> List[TokenEvent]:
         events: List[TokenEvent] = []
-        # per-tenant QoS: slots full + a well-behaved tenant waiting below
-        # its share -> preempt one over-share over-budget sequence first
+        # per-tenant QoS: interactive arrivals drain the batch class first
+        # (every slot they need, this step), then slots full + a well-
+        # behaved tenant below its share -> preempt ONE over-share
+        # over-budget sequence
+        events.extend(self._qos_evict_batch_for_admission())
         events.extend(self._qos_preempt_for_admission())
         chunk = self.cfg.prefill_chunk_tokens
         while self._free_slots:

@@ -38,6 +38,11 @@ class CostLedger:
         self.chip_seconds_total = 0.0  # guarded_by: _lock
         self.hbm_byte_seconds_total = 0.0  # guarded_by: _lock
         self.segments_total = 0  # guarded_by: _lock
+        # optional tenant -> tier classifier ("batch" | "interactive"),
+        # wired once at engine construction from the QoS registry so the
+        # preemptible batch tier prices as its own rollup row; read-only
+        # after wiring (no lock needed)
+        self.tier_of = None
 
     def account(self, dur_s: float, shares: Mapping[str, float],
                 holdings: Mapping[str, float]) -> None:
@@ -84,9 +89,10 @@ class CostLedger:
 
     def rollup(self) -> Dict[str, Any]:
         """`GET /debug/costs` body / heartbeat `stats["costs"]` payload."""
+        tier_of = self.tier_of
         with self._lock:
             tenants = set(self.chip_seconds) | set(self.hbm_byte_seconds)
-            return {
+            out = {
                 "tenants": {
                     t: {"chip_seconds":
                         round(self.chip_seconds.get(t, 0.0), 6),
@@ -99,6 +105,19 @@ class CostLedger:
                     round(self.hbm_byte_seconds_total, 3)},
                 "segments_total": self.segments_total,
             }
+            if tier_of is not None:
+                tiers: Dict[str, Dict[str, float]] = {}
+                for t in tenants:
+                    row = tiers.setdefault(
+                        tier_of(t),
+                        {"chip_seconds": 0.0, "hbm_byte_seconds": 0.0})
+                    row["chip_seconds"] += self.chip_seconds.get(t, 0.0)
+                    row["hbm_byte_seconds"] += \
+                        self.hbm_byte_seconds.get(t, 0.0)
+                out["tiers"] = {
+                    tier: {k: round(v, 6) for k, v in row.items()}
+                    for tier, row in sorted(tiers.items())}
+        return out
 
 
 def merge_rollups(rollups: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
@@ -106,6 +125,7 @@ def merge_rollups(rollups: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
     `/debug/costs`).  Tolerates malformed/missing entries — a worker on an
     older build just contributes nothing."""
     tenants: Dict[str, Dict[str, float]] = {}
+    tiers: Dict[str, Dict[str, float]] = {}
     totals = {"chip_seconds": 0.0, "hbm_byte_seconds": 0.0}
     workers = 0
     for r in rollups:
@@ -119,10 +139,21 @@ def merge_rollups(rollups: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
                 t, {"chip_seconds": 0.0, "hbm_byte_seconds": 0.0})
             agg["chip_seconds"] += float(c.get("chip_seconds", 0.0))
             agg["hbm_byte_seconds"] += float(c.get("hbm_byte_seconds", 0.0))
+        for tier, c in (r.get("tiers") or {}).items():
+            if not isinstance(c, Mapping):
+                continue
+            agg = tiers.setdefault(
+                tier, {"chip_seconds": 0.0, "hbm_byte_seconds": 0.0})
+            agg["chip_seconds"] += float(c.get("chip_seconds", 0.0))
+            agg["hbm_byte_seconds"] += float(c.get("hbm_byte_seconds", 0.0))
         tot = r.get("totals") or {}
         totals["chip_seconds"] += float(tot.get("chip_seconds", 0.0))
         totals["hbm_byte_seconds"] += float(tot.get("hbm_byte_seconds", 0.0))
-    return {"tenants": {t: {k: round(v, 6) for k, v in c.items()}
-                        for t, c in sorted(tenants.items())},
-            "totals": {k: round(v, 6) for k, v in totals.items()},
-            "workers": workers}
+    out = {"tenants": {t: {k: round(v, 6) for k, v in c.items()}
+                       for t, c in sorted(tenants.items())},
+           "totals": {k: round(v, 6) for k, v in totals.items()},
+           "workers": workers}
+    if tiers:
+        out["tiers"] = {tier: {k: round(v, 6) for k, v in c.items()}
+                        for tier, c in sorted(tiers.items())}
+    return out

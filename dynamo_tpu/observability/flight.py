@@ -13,7 +13,7 @@ draft is open commit standalone event records through the same mutex.
 
 Exposure:
 
-- ``GET /debug/flight?n=&rid=&tenant=&kind=`` on every worker
+- ``GET /debug/flight?n=&rid=&tenant=&kind=&class=`` on every worker
   (`debug_flight_payload`) — filterable, newest-last;
 - ``dump(reason)`` — the crash/abort hook: flushes any open draft (the
   partially-executed step that died is exactly the forensic record you
@@ -177,7 +177,8 @@ class FlightRecorder:
 # ------------------------------------------------------------ filtering ----
 
 def _matches(rec: Dict[str, Any], rid: Optional[str],
-             tenant: Optional[str], kind: Optional[str]) -> bool:
+             tenant: Optional[str], kind: Optional[str],
+             klass: Optional[str] = None) -> bool:
     if kind is not None and kind not in rec.get("kind", ""):
         return False
 
@@ -197,6 +198,8 @@ def _matches(rec: Dict[str, Any], rid: Optional[str],
         return False
     if tenant is not None and not hit("tenant", tenant):
         return False
+    if klass is not None and not hit("class", klass):
+        return False
     return True
 
 
@@ -205,8 +208,10 @@ def debug_flight_payload(recorder: FlightRecorder,
     """Build the `GET /debug/flight` response from parsed query params.
 
     ``n`` bounds the returned records (default 128, applied AFTER the
-    rid/tenant/kind filters so a busy engine can't wash out the one
-    request you're chasing)."""
+    rid/tenant/kind/class filters so a busy engine can't wash out the one
+    request you're chasing).  ``class=batch`` matches records whose events
+    carry ``victim_class``/``beneficiary_class`` — QoS evictions of the
+    preemptible batch tier are attributable without knowing tenant ids."""
     def one(key: str) -> Optional[str]:
         vals = qs.get(key) or []
         return vals[0] if vals and vals[0] != "" else None
@@ -216,10 +221,12 @@ def debug_flight_payload(recorder: FlightRecorder,
     except ValueError:
         n = 128
     rid, tenant, kind = one("rid"), one("tenant"), one("kind")
+    klass = one("class")
     recs = recorder.records()
     size = len(recs)
-    if rid is not None or tenant is not None or kind is not None:
-        recs = [r for r in recs if _matches(r, rid, tenant, kind)]
+    if rid is not None or tenant is not None or kind is not None \
+            or klass is not None:
+        recs = [r for r in recs if _matches(r, rid, tenant, kind, klass)]
     return {
         "enabled": recorder.enabled,
         "capacity": recorder.capacity,

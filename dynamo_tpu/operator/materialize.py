@@ -232,6 +232,17 @@ def _container(
         # pod that is still mid-handoff
         env.append({"name": "DRAIN_TIMEOUT_S",
                     "value": str(drain_seconds(spec))})
+        # preemptible batch pool (`preemptible: true`): the worker
+        # advertises itself reclaimable in its heartbeat, and the default
+        # POST /internal/reclaim deadline comes from the pool's declared
+        # notice window (`reclaimDeadlineSeconds`; _pod_spec adds the
+        # spot nodeSelector/toleration)
+        if spec.get("preemptible"):
+            env.append({"name": "DYNAMO_TPU_PREEMPTIBLE", "value": "1"})
+            if spec.get("reclaimDeadlineSeconds") is not None:
+                env.append({"name": "DYNAMO_TPU_RECLAIM_DEADLINE_S",
+                            "value": str(int(
+                                spec["reclaimDeadlineSeconds"]))})
         # multi-LoRA serving (dynamo_tpu.lora): `loraAdapters` lists the
         # adapters this worker registers at boot — entries are
         # {name, path} maps or "name=/path" strings; paths usually live on
@@ -409,12 +420,24 @@ def _pod_spec(
         node_sel["cloud.google.com/gke-tpu-accelerator"] = spec["tpuAccelerator"]
     if spec.get("tpuTopology"):
         node_sel["cloud.google.com/gke-tpu-topology"] = spec["tpuTopology"]
+    if spec.get("preemptible"):
+        # preemptible batch pool: land on spot-provisioned nodes (GKE
+        # taints them; the toleration below is merged with any
+        # user-supplied ones)
+        node_sel["cloud.google.com/gke-spot"] = "true"
     if node_sel:
         pod["nodeSelector"] = node_sel
     extra = spec.get("extraPodSpec") or {}
     for key in ("tolerations", "affinity", "schedulerName", "priorityClassName"):
         if extra.get(key):
             pod[key] = extra[key]
+    if spec.get("preemptible"):
+        spot_tol = {"key": "cloud.google.com/gke-spot", "operator": "Equal",
+                    "value": "true", "effect": "NoSchedule"}
+        tols = list(pod.get("tolerations") or [])
+        if spot_tol not in tols:
+            tols.append(spot_tol)
+        pod["tolerations"] = tols
     return pod
 
 

@@ -51,7 +51,7 @@ _AUTOSCALING_KEYS = {
     "enabled", "minReplicas", "maxReplicas", "targetQueuedPerReplica",
     "scaleDownDelaySeconds", "metricsUrl", "historyUrl", "sloBurnBoost",
     "role", "pool", "expectedOsl", "targetUtilization", "trafficShare",
-    "coordinateWith", "forecastHorizonSeconds",
+    "coordinateWith", "forecastHorizonSeconds", "preemptible",
 }
 
 
@@ -72,6 +72,11 @@ class PoolSpec:
     slo_burn_boost: bool = True
     coordinate_with: str = ""         # partner decode pool (prefill pools)
     forecast_horizon_s: float = 60.0
+    # preemptible batch pool (docs/robustness.md "Preemptible batch
+    # tier"): sized from the TROUGH — the headroom the interactive
+    # forecast leaves under max_replicas — and stepped down immediately
+    # (no hysteresis, no burn boost) when the interactive SLO burns
+    preemptible: bool = False
 
     def __post_init__(self):
         if self.role not in ROLES:
@@ -117,7 +122,11 @@ def pool_spec_from_manifest(svc_name: str,
         raise ValueError(
             f"service {svc_name!r}: pool-aware autoscaling needs a "
             "`pool:` capacity block (explicit rates or a roofline spec)")
-    lo = max(1, int(auto.get("minReplicas", 1)))
+    preemptible = bool(auto.get("preemptible", False))
+    # a preemptible pool may scale to ZERO replicas: at interactive peak
+    # the whole batch tier yields its chips
+    lo = max(0 if preemptible else 1, int(auto.get("minReplicas",
+                                                   0 if preemptible else 1)))
     hi = max(lo, int(auto.get("maxReplicas", svc_spec.get("replicas", 1))))
     return PoolSpec(
         name=svc_name,
@@ -134,6 +143,7 @@ def pool_spec_from_manifest(svc_name: str,
         slo_burn_boost=bool(auto.get("sloBurnBoost", True)),
         coordinate_with=str(auto.get("coordinateWith") or ""),
         forecast_horizon_s=float(auto.get("forecastHorizonSeconds", 60)),
+        preemptible=preemptible,
     )
 
 
@@ -146,7 +156,7 @@ class Decision:
     from_replicas: int
     to_replicas: int
     reason: str          # forecast | queue | inflight | burn | coordination
-                         # | scale_down
+                         # | scale_down | trough | burn_reclaim
     forecast_rps: float
     burn: float
     queued: float
@@ -258,6 +268,18 @@ class PoolPlanner:
             self.last_signals[name] = s
             self.last_forecast[name] = s.forecast_rps * p.share
             reactive = self._reactive_want(p, s)
+            if p.preemptible:
+                # trough sizing: the batch pool gets the headroom the
+                # interactive forecast leaves under max_replicas —
+                # bounded by its OWN observed demand (no point running
+                # empty batch replicas), never grown past the trough
+                # by backlog pressure (batch absorbs spare chips, it
+                # does not buy new ones)
+                headroom = max(0, p.max_replicas - self._forecast_want(p, s))
+                wants[name] = min(headroom, reactive)
+                reasons[name] = "trough" if headroom < reactive else (
+                    "queue" if s.queued else "inflight")
+                continue
             if self.coordinate:
                 fw = self._forecast_want(p, s)
                 wants[name] = max(fw, reactive)
@@ -308,6 +330,30 @@ class PoolPlanner:
         st.replicas = max(p.min_replicas, min(p.max_replicas, st.replicas))
         want = max(p.min_replicas, min(p.max_replicas, want))
         burn = s.burn_for_role(p.role)
+        if p.preemptible:
+            # preemptible batch pool: an interactive burn SHRINKS it
+            # immediately (one replica per tick so each victim still
+            # gets its reclamation drain), bypassing the scale-down
+            # hysteresis — the tier's contract is instant yield
+            if burn > 1.0 and st.replicas > p.min_replicas:
+                step = max(p.min_replicas, st.replicas - 1)
+                self._record(name, st.replicas, step, "burn_reclaim", s, now)
+                st.replicas = step
+                st.low_since = None
+                return
+            if want > st.replicas:
+                self._record(name, st.replicas, want, reason, s, now)
+                st.replicas = want
+                st.low_since = None
+            elif want < st.replicas:
+                # trough closing: step down one per tick WITHOUT the
+                # interactive pools' delay — the forecast already is
+                # the hysteresis (it moves on the horizon, not per
+                # request), and reclamation drains cover each victim
+                step = st.replicas - 1
+                self._record(name, st.replicas, step, "scale_down", s, now)
+                st.replicas = step
+            return
         # burn boost: +1 at burn onset, hold mid-burn (v1 semantics)
         if burn > 1.0 and p.slo_burn_boost:
             if not st.burn_active:
@@ -365,6 +411,7 @@ class PoolPlanner:
                         self.last_forecast.get(name, 0.0), 3),
                     "capacity": dataclasses.asdict(p.capacity),
                     "coordinate_with": p.coordinate_with or None,
+                    "preemptible": p.preemptible,
                 }
                 for name, p in self.pools.items()
             },

@@ -62,6 +62,13 @@ PRIORITY_MIN, PRIORITY_MAX = -100, 100
 # dominate any legal (request priority + class priority) sum, so an
 # over-budget tenant's sequences are always the preferred victims
 OVER_BUDGET_PENALTY = 1 << 10
+# queue-order penalty for batch-class tenants: dominates any legal
+# (request priority + class priority) sum, so batch work never queues
+# ahead of interactive work whatever its declared priority; the engine's
+# victim rank adds BATCH_VICTIM_PENALTY (> OVER_BUDGET_PENALTY) so batch
+# sequences are preempted before even a misbehaving interactive tenant
+BATCH_PRIORITY_PENALTY = 1 << 9
+BATCH_VICTIM_PENALTY = 1 << 11
 
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._:-]{0,47}$")
 
@@ -70,6 +77,7 @@ _CLASS_KEYS = {  # accepted spec keys: snake_case (env) and camelCase (manifest)
     "max_inflight": "max_inflight", "maxInflight": "max_inflight",
     "api_keys": "api_keys", "apiKeys": "api_keys",
     "burst_tokens": "burst_tokens", "burstTokens": "burst_tokens",
+    "batch": "batch",
 }
 
 
@@ -89,6 +97,7 @@ class TenantClass:
     max_inflight: Optional[int] = None  # explicit in-flight cap (frontend)
     api_keys: Tuple[str, ...] = ()      # exact-match keys that resolve here
     burst_tokens: Optional[int] = None  # budget clamp override (engine)
+    batch: bool = False          # preemptible offline lane (docs/robustness.md)
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"name": self.name, "weight": self.weight,
@@ -99,6 +108,8 @@ class TenantClass:
             d["api_keys"] = list(self.api_keys)
         if self.burst_tokens is not None:
             d["burst_tokens"] = self.burst_tokens
+        if self.batch:
+            d["batch"] = True
         return d
 
 
@@ -130,6 +141,10 @@ def tenant_from_dict(spec: Mapping[str, Any]) -> TenantClass:
             kw["priority"] = p
         elif field == "max_inflight":
             kw["max_inflight"] = max(0, int(v))
+        elif field == "batch":
+            if not isinstance(v, bool):
+                raise ValueError(f"tenant batch must be a bool, got {v!r}")
+            kw["batch"] = v
         elif field == "burst_tokens":
             kw["burst_tokens"] = max(1, int(v))
         elif field == "api_keys":
@@ -234,6 +249,15 @@ class TenantRegistry:
             return c
         return dataclasses.replace(self._default, name=name,
                                    api_keys=(), max_inflight=None)
+
+    def is_batch(self, name: str) -> bool:
+        """Does `name` belong to a preemptible batch class? (Dynamic ids
+        inherit the default class, which is interactive unless the
+        operator explicitly declared ``default`` as batch.)"""
+        return self.cls(name).batch
+
+    def batch_tenants(self) -> List[str]:
+        return sorted(n for n, c in self.classes.items() if c.batch)
 
     def weights(self, names: Iterable[str]) -> Dict[str, float]:
         return {n: self.cls(n).weight for n in names}

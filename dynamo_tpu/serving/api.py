@@ -14,7 +14,8 @@ import os
 import random
 import threading
 import time
-from typing import List, Optional
+import urllib.parse
+from typing import Any, Dict, List, Optional
 
 from dynamo_tpu.engine.engine import Engine
 from dynamo_tpu.engine.kv_cache import OutOfPages
@@ -429,6 +430,21 @@ class GenerationHandle:
         return "".join(text_parts), finish, n_out
 
 
+# spot reclamation: default drain deadline when a /internal/reclaim
+# notice arrives without one (cloud maintenance notices are typically
+# 30-120s; align with the preemptible node pool's advertised grace)
+RECLAIM_DEADLINE_ENV = "DYNAMO_TPU_RECLAIM_DEADLINE_S"
+DEFAULT_RECLAIM_DEADLINE_S = 60.0
+
+
+def _env_reclaim_deadline_s() -> float:
+    try:
+        return max(1.0, float(os.environ.get(RECLAIM_DEADLINE_ENV,
+                                             DEFAULT_RECLAIM_DEADLINE_S)))
+    except ValueError:
+        return DEFAULT_RECLAIM_DEADLINE_S
+
+
 class ServingContext:
     """Everything the request handlers need, bundled for the handler class."""
 
@@ -527,6 +543,20 @@ class ServingContext:
         # splice a continuation on another worker
         self.draining = threading.Event()
         self.drain_handoff = threading.Event()
+        # --- spot reclamation (docs/robustness.md "Preemptible batch
+        # tier") --- a POST /internal/reclaim notice (or the node's
+        # maintenance signal, wired by the worker entrypoint) runs the
+        # same drain state machine under a HARD deadline; reclaim_cb is
+        # the entrypoint's hook that also deregisters and stops serving
+        self.reclaiming = threading.Event()
+        self.reclaim_done = threading.Event()
+        self.reclaim_deadline_s: Optional[float] = None
+        self.reclaim_cb = None  # (deadline_s) -> None, set by the worker
+        # operator manifest `preemptible: true` (spot/reclaimable pool):
+        # advertised in the worker heartbeat so frontends and the planner
+        # know which capacity can vanish on a reclamation notice
+        self.preemptible = os.environ.get(
+            "DYNAMO_TPU_PREEMPTIBLE", "0").lower() not in ("", "0", "false")
         self._trace_lock = threading.Lock()  # one profiler capture at a time
         # distributed request tracing: one tracer per serving role; spans
         # land in the process-global ring buffer behind GET /debug/spans
@@ -738,6 +768,48 @@ class ServingContext:
             log.info("drain: demoted %d prefix pages to the host tier",
                      demoted)
         return not (eng.num_active or eng.pending)
+
+    def reclaim(self, deadline_s: float) -> Dict[str, Any]:
+        """Spot/maintenance reclamation notice: this worker's capacity
+        disappears in `deadline_s` seconds, hard. Runs the drain state
+        machine with the deadline as its bound — handoff is requested
+        almost immediately (natural-finish grace is at most a quarter of
+        the notice, never the luxury 5s default), journaled streams push
+        their seams to the frontend, prefix KV demotes to the host tier
+        for peer fetch, and the entrypoint's reclaim_cb (when wired)
+        deregisters and stops the server. Idempotent: a second notice
+        reports the in-progress drain. Returns the ack payload."""
+        eng = self.engine
+        first = not self.reclaiming.is_set()
+        if first:
+            self.reclaiming.set()
+            self.reclaim_deadline_s = deadline_s
+            eng.flight.note(
+                "reclaim", deadline_s=round(deadline_s, 3),
+                active=eng.num_active, pending=len(eng.pending))
+            log.warning("reclamation notice: %.1fs to drain %d active / "
+                        "%d pending", deadline_s, eng.num_active,
+                        len(eng.pending))
+            self.begin_drain()
+            cb = self.reclaim_cb
+
+            def _run():
+                try:
+                    if cb is not None:
+                        cb(deadline_s)
+                    else:
+                        self.drain(drain_s=deadline_s,
+                                   handoff_grace_s=min(5.0,
+                                                       deadline_s / 4.0))
+                finally:
+                    self.reclaim_done.set()
+
+            threading.Thread(target=_run, daemon=True,
+                             name="reclaim").start()
+        return {"reclaiming": True, "first_notice": first,
+                "deadline_s": self.reclaim_deadline_s,
+                "active_seqs": eng.num_active,
+                "pending": len(eng.pending)}
 
     def close(self):
         if self.kv_source is not None:
@@ -1096,6 +1168,31 @@ class _Handler(JsonHTTPHandler):
                                          self.ctx.engine.num_active,
                                      "pending":
                                          len(self.ctx.engine.pending)})
+                elif path == "/internal/reclaim":
+                    # spot/maintenance reclamation notice: this replica's
+                    # capacity disappears in deadline_s seconds — ack
+                    # immediately, drain under the hard deadline in the
+                    # background (docs/robustness.md "Preemptible batch
+                    # tier")
+                    try:
+                        body = self._read_json_body()
+                    except Exception:  # noqa: BLE001 — body is optional
+                        body = {}
+                    qs = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    raw = (qs.get("deadline_s", [None])[0]
+                           if qs.get("deadline_s")
+                           else body.get("deadline_s"))
+                    try:
+                        deadline_s = (float(raw) if raw is not None
+                                      else _env_reclaim_deadline_s())
+                    except (TypeError, ValueError):
+                        raise proto.BadRequest(
+                            f"invalid deadline_s {raw!r}")
+                    if deadline_s <= 0:
+                        raise proto.BadRequest(
+                            "deadline_s must be > 0")
+                    self._json(200, self.ctx.reclaim(deadline_s))
                 else:
                     self._error(404, f"no route {path}")
             except Exception as e:

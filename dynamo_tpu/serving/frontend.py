@@ -52,6 +52,13 @@ DEFAULT_MAX_INFLIGHT = 256
 # configured AND SLO targets declared — docs/robustness.md)
 BURN_SHED_ENV = "DYNAMO_TPU_QOS_BURN_SHED"
 DEFAULT_BURN_SHED = 2.0
+# preemptible batch tier: the PR 7 burn gate INVERTED — batch-class
+# tenants admit only while every interactive SLO fast window burns BELOW
+# this rate (interactive load is quiet); at/above it new batch work is
+# paused with 429 batch_paused (0 disables the gate — batch admits like
+# any tenant; docs/robustness.md "Preemptible batch tier")
+BATCH_BURN_ADMIT_ENV = "DYNAMO_TPU_BATCH_BURN_ADMIT"
+DEFAULT_BATCH_BURN_ADMIT = 1.0
 
 
 def _env_max_inflight() -> int:
@@ -68,6 +75,14 @@ def _env_burn_shed() -> float:
                                              DEFAULT_BURN_SHED)))
     except ValueError:
         return DEFAULT_BURN_SHED
+
+
+def _env_batch_burn_admit() -> float:
+    try:
+        return max(0.0, float(os.environ.get(BATCH_BURN_ADMIT_ENV,
+                                             DEFAULT_BATCH_BURN_ADMIT)))
+    except ValueError:
+        return DEFAULT_BATCH_BURN_ADMIT
 
 # re-export: requests slower than this log a WARNING carrying their trace
 # id — the exemplar-style bridge from the dynamo_frontend_* latency series
@@ -124,13 +139,15 @@ class FrontendContext:
         self.tenant_admission = qos_tenancy.TenantAdmission(
             self.tenants, self.max_inflight)
         self.burn_shed_threshold = _env_burn_shed()
+        self.batch_burn_admit = _env_batch_burn_admit()
         self._burn_cache: Optional[tuple] = None  # (monotonic ts, rows)
         self.admission_rejected = Counter(
             "dynamo_frontend_admission_rejected_total",
             "Requests shed with 429 by admission control, by tenant and "
             "reason (inflight = per-tenant weighted cap; budget = global "
             "in-flight bound; slo_burn = SLO fast-burn shed of an "
-            "over-share tenant)",
+            "over-share tenant; batch_paused = batch-class tenant held "
+            "back while interactive SLO burn is hot)",
             self.metrics.registry, labelnames=("tenant", "reason"),
         )
         self.tenant_inflight_gauge = Gauge(
@@ -287,6 +304,9 @@ class FrontendContext:
         else:
             adm.admit_unchecked(tenant)
         # the tenant slot is reserved: every shed below must release it
+        if self._batch_paused(tenant):
+            adm.release(tenant)
+            return False, "batch_paused", adm.retry_after_s(tenant)
         if self._slo_burn_shed(tenant):
             adm.release(tenant)
             return False, "slo_burn", adm.retry_after_s(tenant)
@@ -305,6 +325,30 @@ class FrontendContext:
         with self._inflight_lock:
             self._inflight -= 1
         self.tenant_admission.release(tenant, duration_s)
+
+    def _batch_paused(self, tenant: str) -> bool:
+        """Inverted burn gate for the preemptible batch tier: a
+        batch-class tenant admits only while the fast SLO window is
+        QUIET (burn < batch_burn_admit on every interactive row). The
+        normal shed asks "is the burn hot enough to shed over-share
+        tenants?"; this asks "is it quiet enough to let offline work
+        in at all?" — batch never waits on over_share, its mere
+        presence during a burn is the problem. No SLO configured means
+        no signal: batch admits (the engine-side class eviction still
+        protects interactive latency)."""
+        thr = self.batch_burn_admit
+        if (thr <= 0 or not self.tenants.enabled
+                or not self.tenants.is_batch(tenant)):
+            return False
+        fast = min(self.slo.windows_s) if self.slo.windows_s else 0
+        for row in self._burn_rows():
+            if row.get("window_s") != fast:
+                continue
+            if self.tenants.is_batch(row.get("tenant", "*")):
+                continue  # the batch tier's own burn never pauses itself
+            if row.get("burn_rate", 0.0) >= thr:
+                return True
+        return False
 
     def _slo_burn_shed(self, tenant: str) -> bool:
         """SLO-aware admission: when any matching SLO objective's FAST
@@ -631,6 +675,8 @@ class _FrontendHandler(JsonHTTPHandler):
                           f"(limit {ctx.max_inflight})",
                 "slo_burn": f"SLO budget is burning and tenant {tenant!r} "
                             "is over its fair share",
+                "batch_paused": f"batch tenant {tenant!r} is paused while "
+                                "interactive SLO burn is hot",
             }[reason]
             self._error(
                 429, f"{detail}; retry shortly", "rate_limit_exceeded",

@@ -115,6 +115,10 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                 **({"adapters": sorted(eng.lora.resident()),
                     "adapters_available": eng.lora.names()}
                    if eng.lora is not None else {}),
+                # preemptible batch pool membership (operator manifest
+                # `preemptible: true`): frontends and the planner see
+                # which capacity can vanish on a reclamation notice
+                **({"preemptible": True} if ctx.preemptible else {}),
                 # per-tenant cost rollup rides the heartbeat so every
                 # frontend replica can answer /debug/costs fleet-wide
                 # without fanning out scrapes to each worker
@@ -293,7 +297,7 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
         )
         hb_thread.start()
 
-    def shutdown(*_):
+    def shutdown(*_, deadline_s=None, wait=False):
         """Graceful drain (pod termination): stop admission (new requests
         shed 503 and the frontend fails them over), deregister from the
         frontend, give in-flight requests a grace window to finish, then
@@ -301,7 +305,12 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
         journal back to the frontend, which splices a continuation on
         another replica) and demote prefix KV to the host tier for peer
         fetch. Bounded by DRAIN_TIMEOUT_S — align terminationGracePeriod
-        with it. A second signal skips the drain."""
+        with it. A second signal skips the drain.
+
+        A spot reclamation notice (ServingContext.reclaim) runs this
+        same, idempotent path with `deadline_s` as the HARD bound in
+        place of the env budget, and `wait=True` so the notice thread
+        can observe completion."""
         if stop.is_set():  # impatient second SIGTERM/SIGINT
             threading.Thread(target=srv.shutdown, daemon=True).start()
             return
@@ -309,17 +318,24 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
 
         def _drain():
             try:
-                try:
-                    drain_s = float(os.environ.get("DRAIN_TIMEOUT_S", "30"))
-                except ValueError:
-                    log.warning("invalid DRAIN_TIMEOUT_S %r; using 30s",
-                                os.environ.get("DRAIN_TIMEOUT_S"))
-                    drain_s = 30.0
-                try:
-                    grace_s = float(os.environ.get(
-                        "DRAIN_HANDOFF_GRACE_S", "5"))
-                except ValueError:
-                    grace_s = 5.0
+                if deadline_s is not None:
+                    # reclamation: leave margin inside the notice for the
+                    # deregister round trips and the final KV demote
+                    drain_s = max(1.0, deadline_s - 3.0)
+                    grace_s = min(5.0, drain_s / 4.0)
+                else:
+                    try:
+                        drain_s = float(
+                            os.environ.get("DRAIN_TIMEOUT_S", "30"))
+                    except ValueError:
+                        log.warning("invalid DRAIN_TIMEOUT_S %r; using 30s",
+                                    os.environ.get("DRAIN_TIMEOUT_S"))
+                        drain_s = 30.0
+                    try:
+                        grace_s = float(os.environ.get(
+                            "DRAIN_HANDOFF_GRACE_S", "5"))
+                    except ValueError:
+                        grace_s = 5.0
                 # admission off FIRST: a request routed here between now
                 # and the deregister sheds 503 and fails over cleanly
                 ctx.begin_drain()
@@ -374,10 +390,17 @@ def main(argv=None, backend_name: str = "jetstream") -> None:
             finally:
                 srv.shutdown()  # must run even if the drain itself blew up
 
-        threading.Thread(target=_drain, daemon=True, name="drain").start()
+        t = threading.Thread(target=_drain, daemon=True, name="drain")
+        t.start()
+        if wait:
+            t.join()
 
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
+    # spot reclamation notices (POST /internal/reclaim, or a node
+    # maintenance watcher POSTing to it) drive the same drain path under
+    # the notice's hard deadline — deregister included
+    ctx.reclaim_cb = lambda d: shutdown(deadline_s=d, wait=True)
     from dynamo_tpu.observability import tracing as obs_tracing
 
     log.info("worker listening on %s:%d (request tracing %s; spans at "

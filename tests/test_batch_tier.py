@@ -1,0 +1,694 @@
+"""Preemptible batch tier suite (`make batch-check`, marker `batch`).
+
+Covers the offline lane end to end (docs/robustness.md "Preemptible
+batch tier"):
+
+- tenant class: `batch: true` spec parse/roundtrip, is_batch/batch_tenants,
+  and the penalty-constant ordering that makes batch semantics hold with
+  NO operator-set priorities (queue penalty dominates any legal priority
+  sum; victim penalty dominates even the over-budget penalty);
+- engine: the class-wide eviction acceptance — interactive traffic
+  returning to a trough-filled engine drains EVERY batch slot it needs
+  within ONE engine step, proven by the flight-recorder events — and the
+  zero-lost-work invariant (evicted batch streams recompute-resume and
+  finish byte-identical to an uncontended run on shared params);
+- flight: qos_preempt events carry the victim's tenant CLASS, and
+  `/debug/flight?class=batch` filters on it;
+- frontend: the inverted burn gate (batch admits only while the
+  interactive fast-window SLO burn is quiet; the batch tier's own burn
+  never pauses itself; 0 disables);
+- reclamation: `POST /internal/reclaim?deadline_s=` acks immediately,
+  sheds new work, drains under the hard deadline, and is idempotent;
+- planner: preemptible pools size from the trough forecast, may scale to
+  zero, and an interactive burn steps them down immediately
+  (burn_reclaim) with no hysteresis;
+- operator: `preemptible: true` materializes the spot nodeSelector +
+  toleration and the DYNAMO_TPU_PREEMPTIBLE / reclaim-deadline env;
+- cost: the ledger prices the batch tier as its own rollup row, and
+  fleet merges sum the tier rows.
+
+The two socket chaos drills (batch-pool kill with journaled resume +
+interactive byte-parity; reclamation deadline with an in-flight stream)
+are demoted to the slow tier via tests/slow_tier.txt; `make batch-check`
+runs them directly.
+"""
+
+import copy
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.observability import cost as obs_cost
+from dynamo_tpu.observability import flight as obs_flight
+from dynamo_tpu.planner import (
+    PoolCapacity,
+    PoolPlanner,
+    PoolSignals,
+    PoolSpec,
+    pool_spec_from_manifest,
+)
+from dynamo_tpu.qos import tenancy
+from dynamo_tpu.robustness import faults
+from dynamo_tpu.serving.api import (
+    ServingContext, make_server, serve_forever_in_thread,
+)
+from dynamo_tpu.serving.frontend import FrontendContext, make_frontend_server
+from dynamo_tpu.serving.router import Router
+
+pytestmark = pytest.mark.batch
+
+MODEL = "tiny-debug"
+KW = dict(model=MODEL, page_size=4, num_pages=128, max_num_seqs=4,
+          max_seq_len=128)
+
+# interactive outweighs batch so its fair slot share covers the whole
+# returning burst (the class eviction itself is weight-independent)
+BATCH_TENANTS = [
+    {"name": "bat", "weight": 1, "batch": True},
+    {"name": "int", "weight": 3},
+]
+BATCH_TENANTS_JSON = json.dumps(BATCH_TENANTS)
+
+
+# ---------------------------------------------------------------------------
+# tenant class: spec + penalty ordering
+# ---------------------------------------------------------------------------
+def test_batch_class_spec_roundtrip():
+    c = tenancy.tenant_from_dict({"name": "bat", "batch": True})
+    assert c.batch
+    d = c.to_dict()
+    assert d["batch"] is True
+    # default classes are interactive, and to_dict omits the flag
+    plain = tenancy.tenant_from_dict({"name": "x"})
+    assert plain.batch is False
+    assert "batch" not in plain.to_dict()
+    # truthy non-bools are config mistakes, not batch tenants
+    with pytest.raises(ValueError):
+        tenancy.tenant_from_dict({"name": "x", "batch": 1})
+    with pytest.raises(ValueError):
+        tenancy.tenant_from_dict({"name": "x", "batch": "yes"})
+    reg = tenancy.TenantRegistry.from_json(BATCH_TENANTS_JSON)
+    assert reg.enabled
+    assert reg.is_batch("bat")
+    assert not reg.is_batch("int")
+    # dynamic (unconfigured) ids are never batch
+    assert not reg.is_batch("new-cust-7")
+    assert reg.batch_tenants() == ["bat"]
+
+
+def test_batch_penalty_constants_dominate():
+    """The penalty ordering IS the batch contract: queue penalty beats
+    any legal (request + class) priority sum, victim penalty beats even
+    the over-budget penalty — `batch: true` alone guarantees last-in /
+    first-evicted, no operator priority tuning required."""
+    # request priority is validated to [-100, 100]; class priority too
+    assert tenancy.BATCH_PRIORITY_PENALTY > 200
+    assert tenancy.BATCH_VICTIM_PENALTY > tenancy.OVER_BUDGET_PENALTY
+    eng = Engine(EngineConfig(**KW, seed=11, tenants=BATCH_TENANTS_JSON))
+    breq = GenRequest("b", [1], max_tokens=4, tenant="bat", priority=-100)
+    ireq = GenRequest("i", [1], max_tokens=4, tenant="int", priority=100)
+    # batch never queues ahead of interactive, whatever the priorities
+    assert eng._queue_priority(breq) > eng._queue_priority(ireq)
+    # batch is the preferred victim even against an over-budget
+    # interactive tenant (rank = queue priority + penalties)
+    assert eng._rank_priority(breq) > \
+        eng._rank_priority(ireq) + tenancy.OVER_BUDGET_PENALTY
+
+
+# ---------------------------------------------------------------------------
+# engine: class-wide eviction in ONE step + zero lost work
+# ---------------------------------------------------------------------------
+def _batch_engine(params=None):
+    return Engine(EngineConfig(
+        model=MODEL, page_size=4, num_pages=64, max_num_seqs=4,
+        max_seq_len=128, seed=11, enable_prefix_caching=False,
+        tenants=BATCH_TENANTS_JSON), params=params)
+
+
+def _collect(eng, out):
+    for ev in eng.step():
+        if ev.token_id >= 0:
+            out.setdefault(ev.request_id, []).append(ev.token_id)
+
+
+def _batch_reqs():
+    return [GenRequest(f"b{i}", [3 + i, 1, 4], max_tokens=24,
+                       ignore_eos=True, tenant="bat") for i in range(4)]
+
+
+def test_class_eviction_frees_all_needed_slots_in_one_step():
+    """The tentpole acceptance: a trough-filled engine (4/4 slots batch)
+    receives 3 interactive requests; ONE engine step must evict 3 batch
+    slots — all three qos_preempt events land in the SAME flight-recorder
+    step record — and the interactive requests occupy the freed slots in
+    that same _admit pass. The run then completes with zero lost tokens,
+    byte-identical to an uncontended batch-only run on shared params."""
+    eng = _batch_engine()
+    out = {}
+    for r in _batch_reqs():
+        eng.add_request(r)
+    for _ in range(6):
+        _collect(eng, out)
+    assert eng.num_active == 4, "trough fill: batch owns every slot"
+    for i in range(3):
+        eng.add_request(GenRequest(f"i{i}", [9 + i, 2, 6], max_tokens=8,
+                                   ignore_eos=True, tenant="int"))
+    evictions = None
+    for _ in range(8):
+        _collect(eng, out)
+        for rec in eng.flight.records():
+            evs = [e for e in rec.get("events", ())
+                   if e.get("ev") == "qos_preempt"
+                   and e.get("victim_class") == "batch"]
+            if len(evs) >= 3:
+                evictions = evs
+                break
+        if evictions:
+            break
+    assert evictions is not None, \
+        "class-wide eviction must free all 3 slots within ONE step record"
+    assert len(evictions) == 3
+    for e in evictions:
+        assert e["reason"] == "interactive_return"
+        assert e["victim_tenant"] == "bat"
+        assert e["beneficiary_tenant"] == "int"
+    # the interactive burst holds the freed slots; one batch seq remains
+    running = [eng._tenant_of(s.req) for s in eng.seqs.values()]
+    assert running.count("int") == 3 and running.count("bat") == 1, running
+    # the eviction is attributable via the /debug/flight class filter
+    payload = obs_flight.debug_flight_payload(
+        eng.flight, {"class": ["batch"]})
+    assert payload["matched"] >= 1
+    # zero lost work: every request still completes in full
+    while eng.has_work:
+        _collect(eng, out)
+    for i in range(4):
+        assert len(out[f"b{i}"]) == 24, f"b{i} lost tokens"
+    for i in range(3):
+        assert len(out[f"i{i}"]) == 8
+    # ...and byte-identical to an uncontended batch-only run: eviction +
+    # recompute-resume never perturbs the decoded stream
+    ref_eng = _batch_engine(params=eng.params)
+    ref = {}
+    for r in _batch_reqs():
+        ref_eng.add_request(r)
+    while ref_eng.has_work:
+        _collect(ref_eng, ref)
+    for i in range(4):
+        assert ref[f"b{i}"] == out[f"b{i}"], f"b{i} diverged after eviction"
+
+
+def test_no_eviction_without_interactive_pressure():
+    """Batch-vs-batch contention stays on the WFQ path: more batch work
+    than slots never triggers the class eviction."""
+    eng = _batch_engine()
+    out = {}
+    for i in range(6):
+        eng.add_request(GenRequest(f"b{i}", [3 + i, 1, 4], max_tokens=6,
+                                   ignore_eos=True, tenant="bat"))
+    while eng.has_work:
+        _collect(eng, out)
+    for rec in eng.flight.records():
+        for e in rec.get("events", ()):
+            assert e.get("reason") != "interactive_return", e
+    assert all(len(v) == 6 for v in out.values())
+
+
+# ---------------------------------------------------------------------------
+# flight: victim_class field + class filter (satellite regression)
+# ---------------------------------------------------------------------------
+def test_flight_class_filter_matches_victim_class():
+    rec = obs_flight.FlightRecorder(capacity=16)
+    rec.begin()
+    rec.phase("decode", 0.001)
+    rec.note("qos_preempt", victim_rid="b0", victim_tenant="bat",
+             victim_class="batch", reason="interactive_return",
+             beneficiary_tenant="int")
+    rec.commit()
+    rec.begin()
+    rec.phase("decode", 0.001)
+    rec.commit()
+    hit = obs_flight.debug_flight_payload(rec, {"class": ["batch"]})
+    assert hit["matched"] == 1
+    (ev,) = [e for r in hit["records"] for e in r.get("events", ())]
+    assert ev["victim_class"] == "batch"
+    assert ev["victim_tenant"] == "bat"
+    miss = obs_flight.debug_flight_payload(rec, {"class": ["interactive"]})
+    assert miss["matched"] == 0
+    # tenant filtering still works through the victim_ prefix
+    assert obs_flight.debug_flight_payload(
+        rec, {"tenant": ["bat"]})["matched"] == 1
+
+
+# ---------------------------------------------------------------------------
+# frontend: the inverted burn gate
+# ---------------------------------------------------------------------------
+def test_frontend_batch_paused_gate(monkeypatch):
+    monkeypatch.setenv(tenancy.TENANTS_ENV, BATCH_TENANTS_JSON)
+    ctx = FrontendContext(max_inflight=10)
+    assert ctx.tenants.enabled and ctx.batch_burn_admit == 1.0
+    rows = [{"window_s": 300, "burn_rate": 5.0, "tenant": "*"}]
+    monkeypatch.setattr(ctx, "_burn_rows", lambda: rows)
+    # hot interactive burn: batch sheds batch_paused, interactive admits
+    admitted, reason, ra = ctx.admit("bat")
+    assert (admitted, reason) == (False, "batch_paused")
+    assert ra >= 0
+    assert ctx.admit("int")[0]
+    ctx.release("int")
+    # quiet: batch admits
+    rows[:] = [{"window_s": 300, "burn_rate": 0.2, "tenant": "*"}]
+    assert ctx.admit("bat")[0]
+    ctx.release("bat")
+    # the batch tier's own burn row never pauses itself
+    rows[:] = [{"window_s": 300, "burn_rate": 9.0, "tenant": "bat"}]
+    assert ctx.admit("bat")[0]
+    ctx.release("bat")
+    # only the FAST window gates (slow-window burn is capacity planning)
+    rows[:] = [{"window_s": 3600, "burn_rate": 9.0, "tenant": "*"}]
+    assert ctx.admit("bat")[0]
+    ctx.release("bat")
+    # threshold 0 disables the gate entirely
+    rows[:] = [{"window_s": 300, "burn_rate": 9.0, "tenant": "*"}]
+    ctx.batch_burn_admit = 0.0
+    assert ctx.admit("bat")[0]
+    ctx.release("bat")
+
+
+# ---------------------------------------------------------------------------
+# cost: batch tier as its own rollup row
+# ---------------------------------------------------------------------------
+def test_cost_ledger_tier_rows_and_merge():
+    led = obs_cost.CostLedger()
+    led.tier_of = lambda t: "batch" if t == "bat" else "interactive"
+    led.account(1.0, {"bat": 1, "int": 1}, {"bat": 100.0, "int": 300.0})
+    r = led.rollup()
+    assert r["tiers"]["batch"]["chip_seconds"] == pytest.approx(0.5)
+    assert r["tiers"]["interactive"]["chip_seconds"] == pytest.approx(0.5)
+    assert r["tiers"]["batch"]["hbm_byte_seconds"] == pytest.approx(100.0)
+    # conservation: tier rows partition the totals
+    assert sum(t["chip_seconds"] for t in r["tiers"].values()) == \
+        pytest.approx(r["totals"]["chip_seconds"])
+    assert sum(t["hbm_byte_seconds"] for t in r["tiers"].values()) == \
+        pytest.approx(r["totals"]["hbm_byte_seconds"])
+    # fleet merge sums tier rows across workers
+    merged = obs_cost.merge_rollups([r, r])
+    assert merged["tiers"]["batch"]["chip_seconds"] == pytest.approx(1.0)
+    assert merged["tiers"]["interactive"]["hbm_byte_seconds"] == \
+        pytest.approx(600.0)
+    # no classifier -> no tiers section (old workers merge cleanly too)
+    bare = obs_cost.CostLedger().rollup()
+    assert "tiers" not in bare
+    assert "tiers" not in obs_cost.merge_rollups([bare])
+
+
+def test_engine_wires_tier_classifier_from_registry():
+    eng = _batch_engine()
+    assert eng.cost.tier_of is not None
+    assert eng.cost.tier_of("bat") == "batch"
+    assert eng.cost.tier_of("int") == "interactive"
+    eng.generate(GenRequest("b", [3, 1, 4], max_tokens=4, ignore_eos=True,
+                            tenant="bat"))
+    eng.generate(GenRequest("i", [2, 7, 1], max_tokens=4, ignore_eos=True,
+                            tenant="int"))
+    tiers = eng.cost.rollup()["tiers"]
+    assert tiers["batch"]["chip_seconds"] > 0
+    assert tiers["interactive"]["chip_seconds"] > 0
+    # an engine with QoS off keeps the classifier unset
+    assert Engine(EngineConfig(**KW, seed=11,
+                               tenants="[]")).cost.tier_of is None
+
+
+# ---------------------------------------------------------------------------
+# planner: trough-sized preemptible pools
+# ---------------------------------------------------------------------------
+def _batch_pool(**kw) -> PoolSpec:
+    kw.setdefault("name", "batch")
+    kw.setdefault("role", "decode")
+    kw.setdefault("capacity", PoolCapacity(
+        prompts_per_s=0.0, tokens_per_s=1000.0, max_streams=16))
+    kw.setdefault("min_replicas", 0)
+    kw.setdefault("max_replicas", 8)
+    kw.setdefault("target_utilization", 0.5)
+    kw.setdefault("osl", 64)
+    kw.setdefault("preemptible", True)
+    return PoolSpec(**kw)
+
+
+def test_planner_preemptible_trough_sizing():
+    pl = PoolPlanner([_batch_pool()], coordinate=True)
+    # deep trough, real batch demand: the pool grows to its reactive want
+    t = pl.tick({"batch": PoolSignals(role="decode", inflight=40.0,
+                                      forecast_rps=0.0)}, now=100.0)
+    assert t["batch"] == 5  # ceil(40 / (16 * 0.5))
+    # interactive peak forecast eats the headroom: want clamps to the
+    # trough and steps down ONE per tick, no hysteresis delay
+    peak = PoolSignals(role="decode", inflight=40.0, forecast_rps=50.0)
+    assert pl.tick({"batch": peak}, now=110.0)["batch"] == 4
+    assert pl.tick({"batch": peak}, now=120.0)["batch"] == 3
+    reasons = [d.reason for d in pl.journal]
+    assert reasons[0] == "inflight"
+    assert reasons[1:] == ["scale_down", "scale_down"]
+    # total interactive saturation: the batch pool may scale to ZERO
+    flood = PoolSignals(role="decode", inflight=40.0, forecast_rps=500.0)
+    for i in range(4):
+        pl.tick({"batch": flood}, now=130.0 + 10 * i)
+    assert pl.targets()["batch"] == 0
+
+
+def test_planner_preemptible_burn_reclaim_immediate():
+    pl = PoolPlanner([_batch_pool()], coordinate=True)
+    pl.seed("batch", 4)
+    # an interactive ITL burn shrinks the pool NOW (one replica per tick
+    # so each victim still gets its reclamation drain), even while the
+    # pool's own demand would hold the scale
+    hot = PoolSignals(role="decode", inflight=40.0, burn_itl=2.5, burn=2.5)
+    assert pl.tick({"batch": hot}, now=100.0)["batch"] == 3
+    d = pl.journal[-1]
+    assert d.reason == "burn_reclaim" and d.direction == "down"
+    # burn over: demand grows it back immediately (no burn-boost +1)
+    quiet = PoolSignals(role="decode", inflight=40.0)
+    assert pl.tick({"batch": quiet}, now=110.0)["batch"] == 5
+
+
+def test_pool_spec_preemptible_parses_and_floors_at_zero():
+    svc = {"autoscaling": {"enabled": True, "role": "decode",
+                           "preemptible": True, "maxReplicas": 6,
+                           "pool": {"tokensPerSPerReplica": 1000,
+                                    "maxStreamsPerReplica": 16}}}
+    spec = pool_spec_from_manifest("Batch", svc)
+    assert spec.preemptible and spec.min_replicas == 0
+    assert spec.max_replicas == 6
+    # non-preemptible pools keep the >= 1 floor
+    svc2 = {"autoscaling": {"enabled": True, "role": "decode",
+                            "minReplicas": 0,
+                            "pool": {"tokensPerSPerReplica": 1000}}}
+    assert pool_spec_from_manifest("Decode", svc2).min_replicas == 1
+
+
+# ---------------------------------------------------------------------------
+# operator: `preemptible: true` materialization
+# ---------------------------------------------------------------------------
+def test_operator_preemptible_materialization():
+    from dynamo_tpu.operator import materialize as mat
+
+    cr = {
+        "apiVersion": mat.API_VERSION,
+        "kind": mat.DGD_KIND,
+        "metadata": {"name": "spot-demo", "namespace": "dynamo",
+                     "uid": "u-9"},
+        "spec": {"services": {
+            "Frontend": {"componentType": "frontend", "replicas": 1},
+            "BatchWorker": {
+                "componentType": "worker",
+                "replicas": 2,
+                "preemptible": True,
+                "reclaimDeadlineSeconds": 45,
+            },
+        }},
+    }
+    out = mat.materialize(cr)
+    deps = {d["metadata"]["name"]: d for d in out["deployments"]}
+    w = deps["spot-demo-batchworker"]
+    pod = w["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["DYNAMO_TPU_PREEMPTIBLE"] == "1"
+    assert env["DYNAMO_TPU_RECLAIM_DEADLINE_S"] == "45"
+    # spot scheduling: GKE spot selector + matching toleration
+    assert pod["nodeSelector"]["cloud.google.com/gke-spot"] == "true"
+    assert any(t.get("key") == "cloud.google.com/gke-spot"
+               for t in pod["tolerations"])
+    # the on-demand frontend is untouched
+    fpod = deps["spot-demo-frontend"]["spec"]["template"]["spec"]
+    fenv = {e["name"]: e.get("value")
+            for e in fpod["containers"][0]["env"]}
+    assert "DYNAMO_TPU_PREEMPTIBLE" not in fenv
+    assert "cloud.google.com/gke-spot" not in fpod.get("nodeSelector", {})
+
+
+# ---------------------------------------------------------------------------
+# serving: the reclamation notice endpoint
+# ---------------------------------------------------------------------------
+def post(url, path, body, headers=None, timeout=120, raw=False):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp if raw else json.loads(resp.read())
+
+
+def chat_body(text, max_tokens=8, **kw):
+    return {"model": MODEL,
+            "messages": [{"role": "user", "content": text}],
+            "max_tokens": max_tokens, "temperature": 0, "ignore_eos": True,
+            **kw}
+
+
+def test_reclaim_endpoint_acks_sheds_and_drains():
+    eng = Engine(EngineConfig(**KW))
+    ctx = ServingContext(eng, MODEL)
+    srv = make_server(ctx, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        # malformed notices are 400, and do NOT start a drain
+        for bad in ("deadline_s=0", "deadline_s=-3", "deadline_s=nope"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(url, f"/internal/reclaim?{bad}", {})
+            assert ei.value.code == 400
+        assert not ctx.reclaiming.is_set()
+        ack = post(url, "/internal/reclaim?deadline_s=8", {})
+        assert ack["reclaiming"] and ack["first_notice"]
+        assert ack["deadline_s"] == 8.0
+        # admission is off immediately: new work sheds 503 retry-safe
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(url, "/v1/chat/completions", chat_body("too late"))
+        assert ei.value.code == 503
+        # the drain completes well inside the hard deadline (idle engine)
+        assert ctx.reclaim_done.wait(timeout=8.0)
+        assert eng.num_active == 0 and not eng.pending
+        # idempotent: a second notice reports the in-progress reclaim
+        # under the ORIGINAL deadline, it never rearms the drain
+        ack2 = post(url, "/internal/reclaim?deadline_s=4", {})
+        assert ack2["reclaiming"] and not ack2["first_notice"]
+        assert ack2["deadline_s"] == 8.0
+        # the notice is on the flight record for post-mortems
+        evs = [e for r in eng.flight.records()
+               for e in r.get("events", ())]
+        assert any(e.get("ev") == "reclaim"
+                   and e.get("deadline_s") == 8.0 for e in evs)
+        # body-carried deadline parses too (idempotent path)
+        ack3 = post(url, "/internal/reclaim", {"deadline_s": 9})
+        assert ack3["reclaiming"] and not ack3["first_notice"]
+    finally:
+        srv.shutdown()
+        ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos drills (slow tier; `make batch-check` runs them directly)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def batch_stack():
+    """Frontend + two workers SHARING params (handoff splices must be
+    byte-comparable), every tier configured with the batch tenant class."""
+    old_env = os.environ.get(tenancy.TENANTS_ENV)
+    os.environ[tenancy.TENANTS_ENV] = BATCH_TENANTS_JSON
+    plane = faults.reset_plane()
+    eng_a = Engine(EngineConfig(**KW, tenants=BATCH_TENANTS_JSON))
+    eng_b = Engine(EngineConfig(**KW, tenants=BATCH_TENANTS_JSON),
+                   params=eng_a.params)
+    ctxs, srvs, urls = [], [], []
+    for eng in (eng_a, eng_b):
+        ctx = ServingContext(eng, MODEL)
+        srv = make_server(ctx, "127.0.0.1", 0)
+        serve_forever_in_thread(srv)
+        ctxs.append(ctx)
+        srvs.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+    fctx = FrontendContext(router=Router())
+    fsrv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(fsrv)
+    yield {"frontend": f"http://127.0.0.1:{fsrv.server_address[1]}",
+           "fctx": fctx, "wctxs": ctxs, "urls": urls, "plane": plane}
+    plane.clear()
+    if old_env is None:
+        os.environ.pop(tenancy.TENANTS_ENV, None)
+    else:
+        os.environ[tenancy.TENANTS_ENV] = old_env
+    fsrv.shutdown()
+    for srv in srvs:
+        srv.shutdown()
+    for ctx in ctxs:
+        ctx.close()
+
+
+def _register(stack, only=None):
+    for url in (stack["urls"] if only is None else only):
+        post(stack["frontend"], "/internal/register", {
+            "url": url, "model": MODEL, "mode": "agg",
+            "stats": {"max_num_seqs": 4, "free_pages": 100,
+                      "total_pages": 128}})
+
+
+def _quiesce(stack):
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and any(
+            c.engine.num_active or c.engine.pending
+            for c in stack["wctxs"]):
+        time.sleep(0.05)
+
+
+def _sse_content(body):
+    events = [b.strip()[len("data: "):] for b in body.split("\n\n")
+              if b.strip().startswith("data: ")]
+    assert events and events[-1] == "[DONE]", "stream must COMPLETE"
+    return "".join(
+        (c.get("delta") or {}).get("content") or ""
+        for e in events if e != "[DONE]"
+        for c in json.loads(e)["choices"])
+
+
+def _stream_in_thread(stack, body, headers, result):
+    def run():
+        try:
+            resp = post(stack["frontend"], "/v1/chat/completions", body,
+                        headers=headers, raw=True, timeout=60)
+            result["body"] = resp.read().decode()
+        except Exception as e:  # surfaced by the main thread's asserts
+            result["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    fctx = stack["fctx"]
+    wait_until = time.monotonic() + 5.0
+    while time.monotonic() < wait_until:
+        with fctx._inflight_lock:
+            if fctx._inflight >= 1:
+                break
+        time.sleep(0.01)
+    return t
+
+
+def test_batch_pool_kill_zero_lost_work(batch_stack):
+    """Kill the batch pool's worker mid-stream: the journaled batch
+    stream hands off and completes byte-identically on the survivor
+    (ZERO lost batch requests), and interactive traffic decodes
+    byte-identically to a run with no batch tier at all."""
+    plane = batch_stack["plane"]
+    ctx_a = batch_stack["wctxs"][0]
+    url_a = batch_stack["urls"][0]
+    bat_hdr = {"x-tenant-id": "bat"}
+    bat_body = chat_body("nightly batch job", max_tokens=12, stream=True)
+    # references with both workers healthy
+    _register(batch_stack)
+    ref_bat = _sse_content(post(batch_stack["frontend"],
+                                "/v1/chat/completions", bat_body,
+                                headers=bat_hdr, raw=True).read().decode())
+    ref_int = post(batch_stack["frontend"], "/v1/chat/completions",
+                   chat_body("interactive probe", max_tokens=12),
+                   headers={"x-tenant-id": "int"})
+    ref_int = ref_int["choices"][0]["message"]["content"]
+    _quiesce(batch_stack)
+
+    # pin the batch stream to worker A, stalled long enough to kill under
+    post(batch_stack["frontend"], "/internal/deregister",
+         {"url": batch_stack["urls"][1]})
+    _register(batch_stack, only=[url_a])
+    plane.configure({"worker.read_stall": {"times": 1, "delay_s": 0.8}})
+    result = {}
+    t = _stream_in_thread(batch_stack, bat_body, bat_hdr, result)
+    # reclaim A's capacity for the interactive tier: drain + handoff +
+    # deregister (the SIGTERM path), survivor B takes over
+    _register(batch_stack, only=[batch_stack["urls"][1]])
+    try:
+        ctx_a.begin_drain()
+        ctx_a.request_handoff()
+        post(batch_stack["frontend"], "/internal/deregister",
+             {"url": url_a})
+        t.join(timeout=60)
+        plane.clear()
+        assert "error" not in result, f"batch stream died: {result.get('error')}"
+        # zero lost batch work: the spliced stream is byte-identical
+        assert _sse_content(result["body"]) == ref_bat
+        # interactive is untouched by the batch tier's existence/death
+        out = post(batch_stack["frontend"], "/v1/chat/completions",
+                   chat_body("interactive probe", max_tokens=12),
+                   headers={"x-tenant-id": "int"})
+        assert out["choices"][0]["message"]["content"] == ref_int
+        assert ctx_a.drain(drain_s=5.0, handoff_grace_s=0.1)
+        assert ctx_a.engine.num_active == 0 and not ctx_a.engine.pending
+    finally:
+        plane.clear()
+        ctx_a.draining.clear()
+        ctx_a.drain_handoff.clear()
+        _quiesce(batch_stack)
+
+
+def test_reclamation_deadline_drill(batch_stack):
+    """Spot reclamation with an in-flight batch stream: the notice acks
+    immediately, the worker drains fully INSIDE the hard deadline, the
+    stream completes byte-identically through the survivor, and the
+    eviction is journaled on the flight record."""
+    plane = batch_stack["plane"]
+    ctx_a = batch_stack["wctxs"][0]
+    url_a = batch_stack["urls"][0]
+    bat_hdr = {"x-tenant-id": "bat"}
+    bat_body = chat_body("reclaim drill", max_tokens=16, stream=True)
+    _register(batch_stack)
+    ref = _sse_content(post(batch_stack["frontend"], "/v1/chat/completions",
+                            bat_body, headers=bat_hdr,
+                            raw=True).read().decode())
+    _quiesce(batch_stack)
+
+    post(batch_stack["frontend"], "/internal/deregister",
+         {"url": batch_stack["urls"][1]})
+    _register(batch_stack, only=[url_a])
+    plane.configure({"worker.read_stall": {"times": 1, "delay_s": 0.5}})
+    result = {}
+    t = _stream_in_thread(batch_stack, bat_body, bat_hdr, result)
+    # survivor up before the notice lands (real reclamation: traffic
+    # moves to the remaining pool)
+    _register(batch_stack, only=[batch_stack["urls"][1]])
+    deadline_s = 10.0
+    t0 = time.monotonic()
+    try:
+        ack = post(url_a, f"/internal/reclaim?deadline_s={deadline_s}", {})
+        assert ack["reclaiming"] and ack["first_notice"]
+        # new work sheds instantly while the drain runs
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(url_a, "/v1/chat/completions", chat_body("too late"))
+        assert ei.value.code == 503
+        assert ctx_a.reclaim_done.wait(timeout=deadline_s), \
+            "reclamation drain missed the hard deadline"
+        elapsed = time.monotonic() - t0
+        assert elapsed < deadline_s, elapsed
+        t.join(timeout=30)
+        plane.clear()
+        assert "error" not in result, f"stream died: {result.get('error')}"
+        assert _sse_content(result["body"]) == ref, \
+            "reclamation lost accepted tokens"
+        assert ctx_a.engine.num_active == 0 and not ctx_a.engine.pending
+        evs = [e for r in ctx_a.engine.flight.records()
+               for e in r.get("events", ())]
+        assert any(e.get("ev") == "reclaim"
+                   and e.get("deadline_s") == deadline_s for e in evs)
+    finally:
+        plane.clear()
+        ctx_a.draining.clear()
+        ctx_a.drain_handoff.clear()
+        ctx_a.reclaiming.clear()
+        ctx_a.reclaim_done.clear()
+        ctx_a.reclaim_deadline_s = None
+        post(batch_stack["frontend"], "/internal/deregister",
+             {"url": url_a})
+        _quiesce(batch_stack)

@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check batch-check lint-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check batch-check rollout-check lint-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -37,6 +37,7 @@ help:
 	@echo "  ha-check       HA frontend plane suite (replicated journal, cross-frontend resume, fleet QoS)"
 	@echo "  spec-check     speculative decoding v2 suite (ragged-verify identity, LoRA/sampling/QoS composition)"
 	@echo "  batch-check    preemptible batch tier suite (class-wide QoS eviction, spot reclamation, trough sizing)"
+	@echo "  rollout-check  hitless weight rollout suite (stage/flip/rollback, version namespaces, burn-gated fleet flips)"
 	@echo "  lint-check     dynalint static analysis (lock discipline, jit purity, metrics/env contracts) + its suite"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
@@ -196,6 +197,13 @@ spec-check:
 batch-check:
 	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
 		python -m pytest tests/test_batch_tier.py -q -p no:randomly
+
+# Live elasticity gate (docs/robustness.md "Hitless weight rollout"):
+# runs the whole rollout suite including the slow-tier handoff chaos
+# drill that the default tier demotes via tests/slow_tier.txt.
+rollout-check:
+	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
+		python -m pytest tests/test_rollout.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

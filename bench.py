@@ -983,6 +983,134 @@ def bench_batch_soak(on_tpu: bool) -> dict:
     }
 
 
+def bench_rolling_update(on_tpu: bool) -> dict:
+    """Live-elasticity A/B (docs/robustness.md "Hitless weight
+    rollout"): the same stream load served twice — the ROLLOUT arm
+    stages v2 into the double buffer and arms a finish-mode flip halfway
+    through the run while decode continues, the STEADY arm never touches
+    the weights. Reports completed/dropped streams both arms (the
+    acceptance is dropped == 0 across the flip), ITL p50/p95, the
+    worst single inter-token gap (the flip-stall ceiling: staging is
+    section-by-section host→HBM copy OFF the decode path, so the gap
+    must look like the steady arm's), host-side stage seconds, and the
+    staged-buffer high-water bytes (the double-buffer HBM cost).
+
+    Env: BENCH_ROLL_STREAMS (total streams, default 10000 on TPU / 12 on
+    CPU), BENCH_ROLL_TOKENS (max_tokens per stream, default 24)."""
+    import time as _time
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+
+    model = os.environ.get("BENCH_MODEL",
+                           "llama-3.2-1b-instruct" if on_tpu else "tiny-debug")
+    streams = int(os.environ.get("BENCH_ROLL_STREAMS",
+                                 "10000" if on_tpu else "12"))
+    steps = int(os.environ.get("BENCH_ROLL_TOKENS", "24"))
+
+    def pctl(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    def run(rollout: bool, params=None):
+        eng = Engine(EngineConfig(
+            model=model, page_size=16, num_pages=256, max_num_seqs=4,
+            max_seq_len=steps + 96, seed=11,
+            enable_prefix_caching=False), params=params)
+        wm = eng.weights
+        # warm solo + batched prefill and the decode window so the timed
+        # section never eats a compile (the flip itself recompiles
+        # NOTHING: same tree structure, new leaf values)
+        for i in range(4):
+            eng.add_request(GenRequest(
+                f"warm{i}", [(i * 17 + j * 3) % 199 + 1 for j in range(24)],
+                max_tokens=8, temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            eng.step()
+        itl, last = [], {}
+        done = [0]
+        flip_at = streams // 2
+        admitted = [0]
+        staged_bytes = 0
+        stage_s = 0.0
+        t0 = _time.perf_counter()
+
+        def admit_next():
+            i = admitted[0]
+            if i >= streams:
+                return False
+            eng.add_request(GenRequest(
+                f"s{i}", [(i * 31 + j * 5) % 199 + 1 for j in range(24)],
+                max_tokens=steps, temperature=0.0, ignore_eos=True))
+            admitted[0] += 1
+            return True
+
+        for _ in range(min(4, streams)):
+            admit_next()
+        flipped = False
+        while eng.has_work or admitted[0] < streams:
+            if rollout and not flipped and done[0] >= flip_at:
+                # mid-run: stage v2 while v1 keeps decoding, then arm a
+                # finish-mode flip — in-flight streams complete on v1,
+                # later admissions land on v2
+                wm.stage("v2", seed=123)
+                staged_bytes = wm.staged_nbytes
+                stage_s = wm.stats()["last_stage_s"]
+                wm.flip(mode="finish")
+                flipped = True
+            for ev in eng.step():
+                now = _time.perf_counter()
+                if ev.token_id >= 0:
+                    if ev.request_id in last:
+                        itl.append(now - last[ev.request_id])
+                    last[ev.request_id] = now
+                if ev.finished and ev.request_id.startswith("s"):
+                    done[0] += 1
+                    admit_next()
+            if not eng.has_work and admitted[0] < streams:
+                admit_next()
+        wall = _time.perf_counter() - t0
+        if rollout:
+            wm.commit()
+        return {
+            "wall_s": round(wall, 3),
+            "streams": streams,
+            "completed": done[0],
+            "dropped": streams - done[0],
+            "itl_p50_ms": round(1e3 * pctl(itl, 0.5), 3),
+            "itl_p95_ms": round(1e3 * pctl(itl, 0.95), 3),
+            "itl_max_ms": round(1e3 * max(itl, default=0.0), 3),
+            "final_version": wm.version,
+            "stage_s": round(stage_s, 3),
+            "staged_bytes_high_water": staged_bytes,
+        }, eng.params
+
+    roll_res, params = run(rollout=True)
+    steady_res, _ = run(rollout=False, params=params)
+    return {
+        "metric": "rolling_update_dropped_streams",
+        "value": roll_res["dropped"],
+        "unit": "streams",
+        "scenario": "rolling_update",
+        "model": model,
+        "streams": streams,
+        "rollout": roll_res,
+        "steady": steady_res,
+        "itl_p95_ratio": round(
+            roll_res["itl_p95_ms"]
+            / max(steady_res["itl_p95_ms"], 1e-9), 3),
+        "flip_stall_ratio": round(
+            roll_res["itl_max_ms"]
+            / max(steady_res["itl_max_ms"], 1e-9), 3),
+        # CPU-fallback latency is never comparable to the TPU north star
+        # (standing ROADMAP constraint)
+        "comparable": bool(on_tpu),
+    }
+
+
 def main() -> None:
     backend = _init_backend()
     import jax
@@ -1007,6 +1135,10 @@ def main() -> None:
     if os.environ.get("BENCH_SCENARIO") == "batch_soak":
         # preemptible batch tier A/B: one JSON line, same contract
         print(json.dumps(bench_batch_soak(on_tpu)))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "rolling_update":
+        # hitless weight rollout A/B: one JSON line, same contract
+        print(json.dumps(bench_rolling_update(on_tpu)))
         return
     dev = jax.devices()[0]
     chip = _chip_spec(dev) if on_tpu else None

@@ -113,6 +113,10 @@ KNOWN_ENV: Dict[str, str] = {
     "DYNAMO_TPU_MAX_INFLIGHT":
         "frontend fleet-wide in-flight admission cap; over it requests "
         "get 429 + Retry-After (0 = off)",
+    "DYNAMO_TPU_MODEL_VERSION":
+        "weight version label this worker boots on (engine CLI "
+        "--model-version default; operator sets it from `modelVersion` "
+        "so replacement pods match the fleet's rollout target)",
     "DYNAMO_TPU_NUM_PROCESSES":
         "multi-host: total JAX process count",
     "DYNAMO_TPU_PREEMPTIBLE":
@@ -133,6 +137,25 @@ KNOWN_ENV: Dict[str, str] = {
         "reclamation grace)",
     "DYNAMO_TPU_RECOVERY":
         "stream-recovery journaling kill switch (0 disables; default on)",
+    "DYNAMO_TPU_ROLLOUT_DRAIN_MODE":
+        "hot weight swap: how in-flight streams cross the flip — "
+        "`finish` (default: they complete on the old version, admissions "
+        "hold) or `handoff` (journaled streams resume on a peer, flip "
+        "immediately)",
+    "DYNAMO_TPU_ROLLOUT_HEADROOM_BYTES":
+        "hot weight swap: override the device-reported free-HBM figure "
+        "the stage budget check uses (also how backends that report no "
+        "memory stats get a budget)",
+    "DYNAMO_TPU_ROLLOUT_HEADROOM_MARGIN":
+        "hot weight swap: fractional slack demanded on top of the "
+        "incoming tree's bytes before staging proceeds (default 0.05)",
+    "DYNAMO_TPU_ROLLOUT_MAX_BURN":
+        "rollout controller: fast-window SLO burn above this mid-rollout "
+        "rolls every flipped pod back to the previous version "
+        "(default 1.0)",
+    "DYNAMO_TPU_ROLLOUT_STEP_S":
+        "rollout controller: seconds between per-pod flips — paced so "
+        "the burn window can react to a bad canary (default 15)",
     "DYNAMO_TPU_SLOW_REQUEST_S":
         "tracing: request duration that pins its span to /debug/spans as "
         "slow (default 10s)",
@@ -219,6 +242,10 @@ MANIFEST_KEYS: Dict[str, Tuple[Tuple[str, ...], str]] = {
                    "envs; list of specs -> the JSON env"),
     "tenants": (("DYNAMO_TPU_TENANTS",),
                 "tenant QoS classes, identical on frontend and workers"),
+    "modelVersion": (("DYNAMO_TPU_MODEL_VERSION",),
+                     "target weight version: fresh pods boot on it; the "
+                     "controller's rollout_tick flips the running fleet "
+                     "in place (burn-gated, one pod per step)"),
     "preemptible": (("DYNAMO_TPU_PREEMPTIBLE",),
                     "spot/reclaimable worker pool: GKE spot nodeSelector "
                     "+ toleration, reclaim drain semantics"),

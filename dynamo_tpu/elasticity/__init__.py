@@ -1,0 +1,17 @@
+"""Live elasticity: hitless weight rollouts with in-place versioning.
+
+`weights.WeightManager` double-buffers the engine's sharded param tree so a
+fleet can ship a model revision (new checkpoint, requantize) without the
+restart-and-rejoin tax of a pod replacement: v2 loads host-side and stages
+into HBM section-by-section while v1 keeps serving, then a version pointer
+flips between engine steps under `_exec_lock`. KV correctness rides the
+same namespace mechanism multi-LoRA already uses — every prefix-cache /
+KVBM / KV-event hash chain is seeded with the active weight version, so v1
+KV never verifies against v2 weights (docs/robustness.md "Hitless weight
+rollout").
+"""
+
+from dynamo_tpu.elasticity.weights import (  # noqa: F401
+    StageError,
+    WeightManager,
+)

@@ -54,6 +54,14 @@ class EngineConfig:
 
     seed: int = 0
 
+    # live elasticity (dynamo_tpu/elasticity): the weight-version label the
+    # engine boots at. "v0" is the hash-compatible baseline; any other label
+    # version-namespaces every prefix-cache/KVBM/KV-event hash so v1 KV
+    # never verifies against v2 weights across a hot swap. A fresh pod
+    # materialized at the fleet's rollout target boots here directly
+    # (operator `modelVersion`); live pods reach it via /internal/rollout.
+    model_version: str = "v0"
+
     # KV-cache dtype: auto (the model dtype) | int8 — int8 stores page rows
     # as quantized values with a bf16 scale per (token, kv-head) packed into
     # spare lanes of the same row, halving KV HBM footprint and stream (the
@@ -269,6 +277,12 @@ class EngineConfig:
         p.add_argument("--trust-remote-code", action="store_true")  # accepted, unused
         p.add_argument("--skip-tokenizer-init", action="store_true")
         p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--model-version",
+                       default=_os.environ.get(
+                           "DYNAMO_TPU_MODEL_VERSION", "v0") or "v0",
+                       help="boot weight-version label (operator "
+                            "modelVersion; hot swaps move it live via "
+                            "/internal/rollout)")
         p.add_argument("--quantization", default="none",
                        choices=["none", "int8", "w8a8"])
         p.add_argument("--kv-cache-dtype", default="auto",
@@ -329,6 +343,7 @@ class EngineConfig:
             disaggregation_transfer_backend=args.disaggregation_transfer_backend,
             disaggregation_bootstrap_port=args.disaggregation_bootstrap_port,
             seed=args.seed,
+            model_version=getattr(args, "model_version", "v0") or "v0",
             quantization=getattr(args, "quantization", "none"),
             kv_cache_dtype=getattr(args, "kv_cache_dtype", "auto"),
             attention_backend=args.attention_backend,

@@ -380,6 +380,15 @@ class Engine:
             )
         with self.mesh:
             self.params = shd.shard_params(params, self.mesh)
+        # live elasticity (dynamo_tpu/elasticity): the weight-version
+        # pointer. Every jitted program takes params as a per-call operand,
+        # so a staged tree with identical leaves flips in between steps
+        # (under _exec_lock) with zero recompiles; _kv_namespace seeds all
+        # KV hashing with the active version so v1 blocks never verify
+        # against v2 weights.
+        from dynamo_tpu.elasticity.weights import WeightManager
+
+        self.weights = WeightManager(self, version=cfg.model_version)
 
         # --- KV cache ---
         # int8 rows are lane-blocked per TP shard (KVCacheSpec.lane_blocks),
@@ -1618,6 +1627,10 @@ class Engine:
                     active=len(self.seqs), pending=len(self.pending))
 
     def _step_locked(self) -> List[TokenEvent]:
+        # an armed finish-mode weight flip applies here, at the step
+        # boundary, once the last old-version stream has finished — we
+        # already hold _exec_lock, so no step ever mixes versions
+        self.weights.maybe_flip_locked()
         events: List[TokenEvent] = []
         with self.timeline.phase("admit"):
             events.extend(self._apply_aborts())
@@ -1786,8 +1799,24 @@ class Engine:
             return 0
         return self.lora.acquire_slot(req.adapter)
 
+    def _kv_namespace(self, adapter: Optional[str]) -> str:
+        """KV hash namespace for a request: the active weight version
+        composed with the LoRA adapter, exactly how adapters alone used to
+        namespace. The base version contributes nothing, so a never-rolled
+        engine hashes byte-identically to the pre-elasticity code."""
+        ver = self.weights.namespace
+        a = adapter or ""
+        if not ver:
+            return a
+        return f"{ver}#{a}"
+
     def _admit(self) -> List[TokenEvent]:
         events: List[TokenEvent] = []
+        if self.weights.admission_held:
+            # finish-mode flip armed: hold new admissions in the pending
+            # queue so they land on the NEW version; in-flight streams
+            # keep decoding on the old one until the flip applies
+            return events
         # per-tenant QoS: interactive arrivals drain the batch class first
         # (every slot they need, this step), then slots full + a well-
         # behaved tenant below its share -> preempt ONE over-share
@@ -1834,7 +1863,7 @@ class Engine:
             cached_pages, n_cached = [], 0
             if self.prefix_cache is not None:
                 cached_pages, n_cached = self.prefix_cache.lookup(
-                    req.prompt_token_ids, namespace=req.adapter or ""
+                    req.prompt_token_ids, namespace=self._kv_namespace(req.adapter)
                 )
             n_pages = max(
                 1, -(-len(req.prompt_token_ids) // self.cfg.page_size)
@@ -1927,7 +1956,7 @@ class Engine:
                 break
             if (self.prefix_cache is not None
                     and self.prefix_cache.has_prefix(
-                        nxt.prompt_token_ids, namespace=nxt.adapter or "")):
+                        nxt.prompt_token_ids, namespace=self._kv_namespace(nxt.adapter))):
                 break  # cached prefix -> chunked path (normal loop)
             n_pg = max(1, -(-plen // cfg.page_size))
             if not self._ensure_pages(pending_need + n_pg):
@@ -2069,7 +2098,7 @@ class Engine:
         trace spans."""
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, pages,
-                                     namespace=req.adapter or "")
+                                     namespace=self._kv_namespace(req.adapter))
         slot = self._free_slots.pop()
         seq = self._install_slot(req, slot, pages, prompt_len, first, req_key)
         finished, reason = self._check_stop(seq, first)
@@ -2449,7 +2478,7 @@ class Engine:
         req = inf.req
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
-                                     namespace=req.adapter or "")
+                                     namespace=self._kv_namespace(req.adapter))
         with self.timeline.phase("device_wait"):
             first, req_key, lp = self._first_token(req, last_logits,
                                                    inf.prompt_len)
@@ -2588,7 +2617,7 @@ class Engine:
         req = inf.req
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
-                                     namespace=req.adapter or "")
+                                     namespace=self._kv_namespace(req.adapter))
         with self.timeline.phase("device_wait"):
             first, req_key, lp = self._first_token(req, chunk_logits,
                                                    inf.prompt_len)
@@ -2715,7 +2744,7 @@ class Engine:
         req = inf.req
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
-                                     namespace=req.adapter or "")
+                                     namespace=self._kv_namespace(req.adapter))
         with self.timeline.phase("device_wait"):
             first, req_key, lp = self._first_token(req, chunk_logits,
                                                    inf.prompt_len)

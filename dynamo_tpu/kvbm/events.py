@@ -43,14 +43,17 @@ def kv_event_subject(model: str, worker_url: str) -> str:
             f".{subject_token(worker_url)}")
 
 
-def token_block_chain(prompt_token_ids, page_size: int) -> List[bytes]:
+def token_block_chain(prompt_token_ids, page_size: int,
+                      namespace: str = "") -> List[bytes]:
     """The engine's rolling page-block hash chain for a prompt's FULL
     pages — byte-identical to what PrefixCache.insert publishes (same
-    `_chain`), so engine events and publisher groups share keys."""
+    `_chain` AND the same namespace seeding: weight version + LoRA
+    adapter), so engine events and publisher groups share keys."""
     from dynamo_tpu.engine.kv_cache import PrefixCache
 
     n_full = len(prompt_token_ids) // page_size
-    out, h = [], b"root"
+    out, h = [], (b"root" if not namespace
+                  else b"root|" + namespace.encode("utf-8"))
     for i in range(n_full):
         h = PrefixCache._chain(
             h, prompt_token_ids[i * page_size:(i + 1) * page_size])
@@ -100,14 +103,17 @@ class KVEventPublisher:
 
     # ------------------------------------------------------------ register --
     def register(self, prompt_token_ids, routing_text: str,
-                 page_size: int) -> None:
+                 page_size: int, namespace: str = "") -> None:
         """Record one request's token-chain <-> text-chain association.
         `routing_text` must be the same canonical text the frontend hashed
-        (completions: the prompt string; chat: json.dumps(messages))."""
+        (completions: the prompt string; chat: json.dumps(messages));
+        `namespace` the engine's active KV namespace (weight version) so
+        the token chain keys match what the engine will publish."""
         from dynamo_tpu.serving.router import text_block_chain
 
         tokens_hex = [h.hex()
-                      for h in token_block_chain(prompt_token_ids, page_size)]
+                      for h in token_block_chain(prompt_token_ids, page_size,
+                                                 namespace)]
         if not tokens_hex:
             return
         text = text_block_chain(routing_text)

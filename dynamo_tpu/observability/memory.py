@@ -169,6 +169,20 @@ class MemoryAccountant:
                 "slots_free": max(0, slots_total - len(resident)),
             }
 
+        # live elasticity: the weight double-buffer ledger — staged and
+        # retained-rollback trees are device bytes OUTSIDE the KV pool
+        # partition (the stage budget check held headroom for them)
+        wm = getattr(eng, "weights", None)
+        weights_out: Optional[Dict[str, Any]] = None
+        if wm is not None:
+            weights_out = {
+                "version": wm.version,
+                "staged_version": wm.staged_version,
+                "staged_bytes": wm.staged_nbytes,
+                "previous_version": wm.previous_version,
+                "previous_bytes": wm.previous_nbytes,
+            }
+
         return {
             "page_bytes": pb,
             "kv_dtype": eng.kv_spec.dtype,
@@ -186,6 +200,7 @@ class MemoryAccountant:
             "tiers": tiers,
             "kvbm": kvbm_stats,
             "lora": lora_out,
+            "weights": weights_out,
             "devices": device_memory_stats(),
         }
 
@@ -216,6 +231,12 @@ class MemoryMetricsBridge:
             "dynamo_memory_lora_slots",
             "LoRA adapter device-slot residency",
             registry, labelnames=("state",))
+        self.weights_gauge = Gauge(
+            "dynamo_memory_staged_weights_bytes",
+            "Weight double-buffer device bytes held by live elasticity: "
+            "buffer=staged (loaded, not yet flipped) / previous (retained "
+            "for rollback until commit or the next stage)",
+            registry, labelnames=("buffer",))
         ledger = engine.cost
         CallbackCounterVec(
             "dynamo_tenant_cost_chip_seconds_total",
@@ -295,6 +316,13 @@ class MemoryMetricsBridge:
             self.lora_gauge.set(float(len(lora["resident"])),
                                 state="resident")
             self.lora_gauge.set(float(lora["slots_free"]), state="free")
+
+        w = snap.get("weights")
+        if w:
+            self.weights_gauge.set(float(w["staged_bytes"]),
+                                   buffer="staged")
+            self.weights_gauge.set(float(w["previous_bytes"]),
+                                   buffer="previous")
 
 
 def attach_memory_metrics(registry: Registry, engine) -> MemoryMetricsBridge:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -50,6 +51,14 @@ DRAIN_ANNOTATION = f"{mat.GROUP}/drain-before-delete"
 # pod starts shedding before its SIGTERM even arrives.
 DRAIN_VICTIM_ANNOTATION = f"{mat.GROUP}/drain-victim"
 POD_DELETION_COST = "controller.kubernetes.io/pod-deletion-cost"
+
+# burn-gated weight rollouts (dynamo_tpu.elasticity): a fast-window SLO
+# burn above this threshold observed while a fleet flip is in progress
+# rolls every already-flipped pod back to the previous version
+ROLLOUT_MAX_BURN_ENV = "DYNAMO_TPU_ROLLOUT_MAX_BURN"
+# seconds between per-pod flips — one pod at a time, paced so the 5m
+# fast burn window can react to a bad canary before the next pod flips
+ROLLOUT_STEP_ENV = "DYNAMO_TPU_ROLLOUT_STEP_S"
 
 
 def _yaml_load(text: str) -> Dict[str, Any]:
@@ -90,6 +99,12 @@ class Controller:
         self.collector = SignalsCollector()
         self._scrape_err_seen = 0
         self._decisions_seen: Dict[tuple, int] = {}
+        # live weight rollouts (dynamo_tpu.elasticity): per-service
+        # progressive flip state keyed (namespace, dgd, service) —
+        # target version, pods already flipped, pacing timestamp,
+        # terminal state. A rolled_back rollout HOLDS (no re-flip)
+        # until the manifest's modelVersion changes.
+        self._rollouts: Dict[tuple, Dict[str, Any]] = {}
         self.registry = Registry()
         self.target_gauge = Gauge(
             "dynamo_planner_target_replicas",
@@ -107,6 +122,17 @@ class Controller:
             "dynamo_planner_scrape_errors_total",
             "Planner signal scrapes that failed (served from last-good "
             "cache when within the staleness bound)", self.registry)
+        self.rollout_gauge = Gauge(
+            "dynamo_operator_weight_rollout_flipped",
+            "Pods flipped to the service's target weight version by the "
+            "rollout controller", self.registry,
+            labelnames=("namespace", "dgd", "service"))
+        self.rollout_counter = Counter(
+            "dynamo_operator_weight_rollout_total",
+            "Rollout controller per-pod actions (flip = staged + flipped "
+            "to the target, rollback = burn-gated revert, commit = "
+            "rollback window closed)", self.registry,
+            labelnames=("namespace", "dgd", "service", "direction"))
 
     @staticmethod
     def _ns(cr: Dict[str, Any]) -> str:
@@ -532,7 +558,12 @@ class Controller:
                 self.decisions_counter.inc(namespace=ns, dgd=name,
                                            service=svc_name, direction="up")
             elif want < st["replicas"]:
-                if st["low_since"] is None:
+                if self._rollout_active(key):
+                    # never shrink mid-weight-rollout: a scale-down could
+                    # delete exactly the already-flipped pods and the
+                    # drain churn muddies the burn signal the gate reads
+                    st["low_since"] = None
+                elif st["low_since"] is None:
                     st["low_since"] = now
                 elif now - st["low_since"] >= delay:
                     log.info("planner: %s/%s.%s %d -> %d after %.0fs "
@@ -689,6 +720,8 @@ class Controller:
                 st = self._planner[key] = {"replicas": seed,
                                            "low_since": None}
             prev = int(st["replicas"])
+            if target < prev and self._rollout_active(key):
+                target = prev  # hold scale-down mid-weight-rollout
             if target != prev:
                 log.info("planner: %s/%s.%s pool %d -> %d "
                          "(forecast=%.1frps)", ns, name, svc_name, prev,
@@ -770,6 +803,227 @@ class Controller:
         except Exception:  # noqa: BLE001 — SIGTERM drain still runs
             log.debug("planner: pre-drain of %s unreachable", ip)
 
+    # -------------------------------------------------------- weight rollout --
+    def _rollout_active(self, key: tuple) -> bool:
+        st = self._rollouts.get(key)
+        return bool(st and st.get("state") == "in_progress")
+
+    def rollout_tick(self, now: Optional[float] = None) -> int:
+        """Progressive, burn-gated fleet weight flips (the elasticity
+        subsystem's operator face; docs/robustness.md "Hitless weight
+        rollout").
+
+        A service's `modelVersion` names the weight version its pods
+        should serve. Fresh pods boot on it (materialize env); this tick
+        converges the RUNNING fleet in place, one pod per pacing step:
+        POST /internal/rollout {stage_flip} makes the worker double-buffer
+        v_next into spare HBM while v_prev serves, then flip the version
+        pointer between steps — zero dropped streams. While any pod is
+        flipped-but-uncommitted, the frontend's fast-window SLO burn gates
+        progress: burn above DYNAMO_TPU_ROLLOUT_MAX_BURN means the new
+        weights are hurting the objectives, so every flipped pod is rolled
+        back (O(1): the previous tree never left HBM) and the rollout
+        holds until the manifest changes. Once the whole fleet reports the
+        target, a commit closes the rollback windows and frees the
+        double-buffer. Returns the number of per-pod actions that landed.
+        """
+        now = time.monotonic() if now is None else now
+        max_burn = float(os.environ.get(ROLLOUT_MAX_BURN_ENV, "") or 1.0)
+        step_s = float(os.environ.get(ROLLOUT_STEP_ENV, "") or 15.0)
+        try:
+            dgds = self.k8s.list(mat.API_VERSION, mat.DGD_PLURAL,
+                                 self.namespace)
+        except ApiError:
+            return 0
+        actions = 0
+        live = set()
+        for cr in dgds:
+            ns, name = self._ns(cr), cr["metadata"]["name"]
+            services = cr.get("spec", {}).get("services") or {}
+            rollout_status: Dict[str, Any] = {}
+            for svc_name, spec in services.items():
+                target = str(spec.get("modelVersion") or "")
+                if not target or spec.get("componentType") == "frontend":
+                    continue
+                key = (ns, name, svc_name)
+                live.add(key)
+                st = self._rollouts.get(key)
+                if st is None:
+                    # seed from the persisted status rollup so an operator
+                    # restart / leader failover resumes (and never re-flips
+                    # a converged or held fleet)
+                    persisted = ((cr.get("status") or {})
+                                 .get("weightRollout") or {}).get(svc_name)
+                    if (persisted or {}).get("target") == target:
+                        st = {"target": target,
+                              "state": persisted.get("state",
+                                                     "in_progress"),
+                              "flipped": set(persisted.get("flipped")
+                                             or []),
+                              "last_flip": 0.0}
+                    self._rollouts[key] = st = st or {
+                        "target": target, "state": "in_progress",
+                        "flipped": set(), "last_flip": 0.0}
+                elif st.get("target") != target:
+                    # a NEW target supersedes everything, including a
+                    # rolled_back hold — the manifest edit is the operator
+                    # acknowledging the bad version
+                    st = self._rollouts[key] = {
+                        "target": target, "state": "in_progress",
+                        "flipped": set(), "last_flip": 0.0}
+                try:
+                    actions += self._rollout_service(
+                        ns, name, svc_name, st, cr, spec, max_burn,
+                        step_s, now)
+                except Exception:
+                    log.exception("rollout: %s/%s.%s tick failed", ns,
+                                  name, svc_name)
+                rollout_status[svc_name] = {
+                    "target": st["target"], "state": st["state"],
+                    "flipped": sorted(st["flipped"])}
+                self.rollout_gauge.set(len(st["flipped"]), namespace=ns,
+                                       dgd=name, service=svc_name)
+            # persisted like plannerReplicas: explicit null when empty —
+            # patch_status is an RFC 7386 merge-patch, so omitting the
+            # key would retain a stale rollout map
+            if rollout_status or (cr.get("status")
+                                  or {}).get("weightRollout"):
+                try:
+                    self.k8s.patch_status(
+                        mat.API_VERSION, mat.DGD_PLURAL, ns, name,
+                        {"weightRollout": rollout_status or None})
+                except ApiError as e:
+                    if not e.not_found:
+                        log.warning("rollout status update failed: %s", e)
+        for key in [k for k in self._rollouts if k not in live]:
+            del self._rollouts[key]
+            self.rollout_gauge.remove(namespace=key[0], dgd=key[1],
+                                      service=key[2])
+        return actions
+
+    def _rollout_service(self, ns: str, dgd: str, svc_name: str,
+                         st: Dict[str, Any], cr: Dict[str, Any],
+                         spec: Dict[str, Any], max_burn: float,
+                         step_s: float, now: float) -> int:
+        """One service's rollout step: burn gate, then commit-or-flip."""
+        if st["state"] != "in_progress":
+            return 0
+        sel = (f"{mat.COMPONENT_LABEL}={svc_name.lower()},"
+               f"{mat.NS_LABEL}={mat.discovery_label_value(ns, dgd)}")
+        try:
+            pods = self.k8s.list("v1", "pods", ns, label_selector=sel)
+        except ApiError as e:
+            log.debug("rollout: pod listing failed (%s)", e)
+            return 0
+        # dead pods leave the flipped set; their replacements boot on the
+        # target version via the materialized DYNAMO_TPU_MODEL_VERSION
+        st["flipped"] &= {p["metadata"]["name"] for p in pods}
+        pending = [p for p in pods
+                   if p["metadata"]["name"] not in st["flipped"]]
+
+        if st["flipped"]:
+            burn = self._frontend_burn(cr, ns, spec)
+            if burn is not None and burn > max_burn:
+                n = self._rollout_post_all(ns, pods, st["flipped"],
+                                           {"action": "rollback"})
+                log.warning(
+                    "rollout: %s/%s.%s burn %.2f > %.2f — rolled back "
+                    "%d/%d flipped pods to the previous version; holding "
+                    "until modelVersion changes", ns, dgd, svc_name, burn,
+                    max_burn, n, len(st["flipped"]))
+                for _ in st["flipped"]:
+                    self.rollout_counter.inc(namespace=ns, dgd=dgd,
+                                             service=svc_name,
+                                             direction="rollback")
+                st["flipped"] = set()
+                st["state"] = "rolled_back"
+                return n
+
+        if not pending:
+            # fleet converged under the burn gate: commit drops every
+            # pod's retained previous tree (frees the double-buffer HBM)
+            n = self._rollout_post_all(ns, pods, st["flipped"],
+                                       {"action": "commit"})
+            st["state"] = "done"
+            for _ in st["flipped"]:
+                self.rollout_counter.inc(namespace=ns, dgd=dgd,
+                                         service=svc_name,
+                                         direction="commit")
+            log.info("rollout: %s/%s.%s complete at %s (%d pods "
+                     "committed)", ns, dgd, svc_name, st["target"], n)
+            return n
+
+        if now - st["last_flip"] < step_s:
+            return 0
+        # newest pod first: it carries the least accumulated prefix/KV
+        # value, so a bad canary costs the least warm state (the mirror
+        # image of _mark_drain_victims' newest-first victim choice)
+        pending.sort(key=lambda p: (p["metadata"].get("creationTimestamp")
+                                    or "", p["metadata"]["name"]),
+                     reverse=True)
+        pod = pending[0]
+        st["last_flip"] = now
+        if self._rollout_post(ns, pod, {"action": "stage_flip",
+                                        "version": st["target"]}):
+            st["flipped"].add(pod["metadata"]["name"])
+            self.rollout_counter.inc(namespace=ns, dgd=dgd,
+                                     service=svc_name, direction="flip")
+            log.info("rollout: %s/%s.%s flipped %s -> %s (%d/%d)", ns,
+                     dgd, svc_name, pod["metadata"]["name"], st["target"],
+                     len(st["flipped"]), len(pods))
+            return 1
+        return 0
+
+    def _frontend_burn(self, cr: Dict[str, Any], ns: str,
+                       spec: Dict[str, Any]) -> Optional[float]:
+        """Max fast-window SLO burn from the DGD's frontend (the same
+        scrape path — and the same `autoscaling.metricsUrl` override —
+        the planner's burn boost rides); None = unreachable past the
+        staleness bound (the rollout proceeds — losing the gate for one
+        tick beats wedging every rollout on a metrics blip)."""
+        url = ((spec.get("autoscaling") or {}).get("metricsUrl")
+               or f"http://{mat.frontend_host(cr)}.{ns}:"
+                  f"{mat.FRONTEND_PORT}/metrics")
+        parsed = self.collector.scrape_metrics(url)
+        if parsed is None:
+            return None
+        return float(parsed.get("burn") or 0.0)
+
+    def _rollout_post_all(self, ns: str, pods: List[Dict[str, Any]],
+                          names, body: Dict[str, Any]) -> int:
+        by_name = {p["metadata"]["name"]: p for p in pods}
+        n = 0
+        for pod_name in sorted(names):
+            pod = by_name.get(pod_name)
+            if pod is not None and self._rollout_post(ns, pod, body):
+                n += 1
+        return n
+
+    def _rollout_post(self, ns: str, pod: Dict[str, Any],
+                      body: Dict[str, Any]) -> bool:
+        """Best-effort POST /internal/rollout to one pod. False on any
+        failure (unreachable, 503 stage refusal on insufficient HBM
+        headroom, ...) — the pod keeps serving its current version
+        untouched and the next tick retries; stage_flip is idempotent on
+        the worker, so a retry after a timed-out-but-landed round trip
+        is a cheap no-op."""
+        ip = (pod.get("status") or {}).get("podIP")
+        if not ip:
+            return False
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                f"http://{ip}:{mat.WORKER_PORT}/internal/rollout",
+                data=json.dumps(body).encode(), method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0):
+                return True
+        except Exception:  # noqa: BLE001 — advisory; retried next tick
+            log.debug("rollout: POST %s to %s failed", body.get("action"),
+                      ip)
+            return False
+
     def planner_debug_payload(self) -> Dict[str, Any]:
         """The GET /debug/planner body (operator debug server): per-DGD
         pool targets + the bounded decision journal, plus v1 decisions."""
@@ -778,6 +1032,10 @@ class Controller:
                       for (ns, name), pl in self._pool_planners.items()},
             "services": {f"{ns}/{name}/{svc}": st.get("replicas")
                          for (ns, name, svc), st in self._planner.items()},
+            "rollouts": {f"{ns}/{name}/{svc}": {
+                "target": st["target"], "state": st["state"],
+                "flipped": sorted(st["flipped"])}
+                for (ns, name, svc), st in self._rollouts.items()},
             "scrape_errors_total": self.collector.scrape_errors_total,
         }
 
@@ -862,6 +1120,10 @@ class Controller:
                         self.planner_tick(now)
                     except Exception:
                         log.exception("planner tick failed")
+                    try:
+                        self.rollout_tick(now)
+                    except Exception:
+                        log.exception("rollout tick failed")
                 try:
                     self.reconcile_once()
                 except Exception:

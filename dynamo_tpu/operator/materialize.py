@@ -243,6 +243,16 @@ def _container(
                 env.append({"name": "DYNAMO_TPU_RECLAIM_DEADLINE_S",
                             "value": str(int(
                                 spec["reclaimDeadlineSeconds"]))})
+        # live weight rollouts (dynamo_tpu.elasticity): `modelVersion`
+        # labels the weights a FRESH pod boots with, so replacement pods
+        # spawned mid/post-rollout land on the fleet's target version
+        # (KV/prefix namespaces included) instead of the baseline. The
+        # RUNNING fleet is flipped in place by the controller's
+        # rollout_tick via POST /internal/rollout — this env only seeds
+        # boot state; it never restarts pods.
+        if spec.get("modelVersion"):
+            env.append({"name": "DYNAMO_TPU_MODEL_VERSION",
+                        "value": str(spec["modelVersion"])})
         # multi-LoRA serving (dynamo_tpu.lora): `loraAdapters` lists the
         # adapters this worker registers at boot — entries are
         # {name, path} maps or "name=/path" strings; paths usually live on

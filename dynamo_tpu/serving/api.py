@@ -436,6 +436,16 @@ class GenerationHandle:
 RECLAIM_DEADLINE_ENV = "DYNAMO_TPU_RECLAIM_DEADLINE_S"
 DEFAULT_RECLAIM_DEADLINE_S = 60.0
 
+# hitless weight rollout (docs/robustness.md "Hitless weight rollout"):
+# how /internal/rollout flips a busy engine when the request doesn't name
+# a mode — `finish` arms the flip (in-flight streams complete on the old
+# version, admissions hold), `handoff` pushes journaled streams' seams to
+# the frontend for resume on a still-old-version peer and flips as soon
+# as the engine empties (bounded by the grace below, then falls back to
+# an armed finish flip for any non-journaled stragglers)
+ROLLOUT_DRAIN_MODE_ENV = "DYNAMO_TPU_ROLLOUT_DRAIN_MODE"
+ROLLOUT_HANDOFF_GRACE_S = 5.0
+
 
 def _env_reclaim_deadline_s() -> float:
     try:
@@ -535,6 +545,18 @@ class ServingContext:
             "Sequences preempted (recompute) under KV page pressure",
             self.metrics.registry,
         )
+        # --- live elasticity (dynamo_tpu/elasticity): the active weight
+        # version as a labelled gauge (1 on the live label), refreshed at
+        # scrape with label death so a flip/rollback never leaves a stale
+        # version row next to the live one
+        self.weight_version_gauge = Gauge(
+            "dynamo_engine_weight_version",
+            "Active weight version (1 on the live `version` label; the "
+            "staged/rollback buffers show in "
+            "dynamo_memory_staged_weights_bytes)",
+            self.metrics.registry, labelnames=("version",),
+        )
+        self._exported_weight_version: Optional[str] = None
         self.start_time = time.time()
         # --- graceful drain (SIGTERM; docs/robustness.md "Recovery
         # semantics") --- draining sheds NEW inference requests with 503;
@@ -663,14 +685,27 @@ class ServingContext:
         """Feed the KV event publisher one request's (token-chain,
         text-chain) association — `routing_text` must be the canonical
         text the FRONTEND hashes for routing (completions: the prompt
-        string; chat: json.dumps(messages)). No-op without a publisher."""
+        string; chat: json.dumps(messages)). No-op without a publisher.
+        The chain is seeded with the engine's ACTIVE weight-version
+        namespace so the keys match what the engine publishes; a request
+        that registers just before a flip and admits just after simply
+        loses its routing events (the plane is advisory)."""
         if self.kv_event_publisher is None:
             return
         try:
             self.kv_event_publisher.register(
-                prompt_token_ids, routing_text, self.engine.cfg.page_size)
+                prompt_token_ids, routing_text, self.engine.cfg.page_size,
+                namespace=self.engine._kv_namespace(None))
         except Exception:
             log.exception("kv route registration failed")
+
+    def refresh_weight_gauge(self) -> None:
+        v = self.engine.weights.version
+        prev = self._exported_weight_version
+        if prev is not None and prev != v:
+            self.weight_version_gauge.remove(version=prev)
+        self.weight_version_gauge.set(1, version=v)
+        self._exported_weight_version = v
 
     def attach_kv_event_publisher(self, publisher) -> None:
         self.kv_event_publisher = publisher
@@ -811,6 +846,92 @@ class ServingContext:
                 "active_seqs": eng.num_active,
                 "pending": len(eng.pending)}
 
+    def rollout(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /internal/rollout: the per-pod hot-swap control surface
+        the operator's progressive fleet rollout drives (one action per
+        request; `stage_flip` is the controller's single round trip).
+        StageError maps to the handler's RuntimeError->503 path, so a
+        refused stage (headroom, tree mismatch, version conflict) is
+        retry-later to the controller and never touches the live tree."""
+        from dynamo_tpu.elasticity.weights import StageError  # noqa: F401
+
+        eng = self.engine
+        wm = eng.weights
+        action = (body.get("action") or "status").lower()
+        if action == "status":
+            out = wm.stats()
+            out.update(active_seqs=eng.num_active,
+                       pending=len(eng.pending))
+            return out
+        if action == "stage":
+            return wm.stage(
+                body.get("version") or "",
+                model_path=body.get("model_path"),
+                seed=body.get("seed"),
+                quantization=body.get("quantization"))
+        if action in ("flip", "stage_flip"):
+            if action == "stage_flip":
+                want = body.get("version") or ""
+                if want and want == wm.version:
+                    # idempotent: a controller retry after a timed-out
+                    # round trip lands on an already-flipped pod
+                    return {"version": wm.version, "state": "live",
+                            "already": True}
+                if wm.staged_version != want:
+                    wm.stage(
+                        want,
+                        model_path=body.get("model_path"),
+                        seed=body.get("seed"),
+                        quantization=body.get("quantization"))
+            mode = (body.get("mode")
+                    or os.environ.get(ROLLOUT_DRAIN_MODE_ENV, "finish")
+                    or "finish").lower()
+            if mode not in ("finish", "handoff"):
+                raise proto.BadRequest(
+                    f"mode {mode!r} not in ('finish', 'handoff')")
+            if mode == "handoff" and eng.num_active:
+                return self._flip_with_handoff(wm)
+            return wm.flip(mode="finish")
+        if action == "rollback":
+            if wm.previous_version is None and wm.staged_version:
+                # the pod never flipped (stage resident / flip armed):
+                # dropping the staged tree IS the rollback — admissions
+                # reopen and the original version keeps serving
+                wm.abort_stage()
+                return {"version": wm.version, "state": "rolled_back",
+                        "rolled_back": None}
+            return wm.rollback()
+        if action == "commit":
+            return wm.commit()
+        if action == "abort":
+            return {"aborted": wm.abort_stage(), "version": wm.version}
+        raise proto.BadRequest(
+            f"action {action!r} not in (status, stage, flip, stage_flip, "
+            "rollback, commit, abort)")
+
+    def _flip_with_handoff(self, wm) -> Dict[str, Any]:
+        """Handoff-mode flip: journaled in-flight streams push their seams
+        to the frontend (which resumes them on a peer still serving the
+        old version — the HA plane's normal continuation path) and the
+        pointer flips the moment the engine empties. Unlike drain, the
+        worker STAYS in service: admission never closes, the handoff flag
+        clears, and post-flip requests land on the new version here."""
+        eng = self.engine
+        self.drain_handoff.set()
+        deadline = time.monotonic() + ROLLOUT_HANDOFF_GRACE_S
+        try:
+            while time.monotonic() < deadline and eng.num_active:
+                time.sleep(0.05)
+        finally:
+            self.drain_handoff.clear()
+        if eng.num_active:
+            # non-journaled stragglers: never flip under them — fall back
+            # to the armed finish flip (they complete on the old version)
+            eng.flight.note("rollout_handoff_stragglers",
+                            active=eng.num_active)
+            return wm.flip(mode="finish")
+        return wm.flip(mode="now")
+
     def close(self):
         if self.kv_source is not None:
             self.kv_source.close()
@@ -933,6 +1054,7 @@ class _Handler(JsonHTTPHandler):
             self.ctx.slo.refresh_gauges()
             self.ctx.engine_bridge.refresh()  # live MFU/MBU + warmup gauges
             self.ctx.memory_bridge.refresh()  # KV-pool/tier/tenant bytes
+            self.ctx.refresh_weight_gauge()  # active weight version label
             body, ctype = self.ctx.metrics.registry.scrape(
                 self.headers.get("Accept"))
             self._raw(200, body, ctype)
@@ -1036,6 +1158,9 @@ class _Handler(JsonHTTPHandler):
                         round(m.spec_accept_sum / m.spec_accept_count, 4)
                         if m.spec_accept_count else 0.0),
                 }
+            # live elasticity: active/staged/previous weight versions and
+            # the double-buffer bytes (what the rollout controller polls)
+            out["weights"] = eng.weights.stats()
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 out["prefix_cache"] = pc.stats()
@@ -1168,6 +1293,17 @@ class _Handler(JsonHTTPHandler):
                                          self.ctx.engine.num_active,
                                      "pending":
                                          len(self.ctx.engine.pending)})
+                elif path == "/internal/rollout":
+                    # hitless weight rollout control surface (docs/
+                    # robustness.md "Hitless weight rollout"): stage /
+                    # flip / rollback / commit / status. Stays reachable
+                    # while draining (it is not a /v1 route) so a fleet
+                    # rollback can still reach a pod mid-drain.
+                    try:
+                        body = self._read_json_body()
+                    except Exception:  # noqa: BLE001 — body is optional
+                        body = {}
+                    self._json(200, self.ctx.rollout(body))
                 elif path == "/internal/reclaim":
                     # spot/maintenance reclamation notice: this replica's
                     # capacity disappears in deadline_s seconds — ack

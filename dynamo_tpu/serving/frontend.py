@@ -101,6 +101,15 @@ class FrontendContext:
             "dynamo_frontend_workers", "Registered live workers",
             self.metrics.registry,
         )
+        # live elasticity: fleet rollout progress at a glance — how many
+        # live workers heartbeat each weight version (label death keeps
+        # finished rollouts from leaving a zero-worker version row)
+        self.worker_version_gauge = Gauge(
+            "dynamo_frontend_worker_weight_version",
+            "Live workers by heartbeat-reported weight version",
+            self.metrics.registry, labelnames=("version",),
+        )
+        self._version_labels: set = set()
         from dynamo_tpu.serving.metrics import Counter
 
         self.ledger_counter = Counter(
@@ -459,6 +468,16 @@ class _FrontendHandler(JsonHTTPHandler):
                         ctx.ha_peer_inflight.set(0, tenant=t)
                 for t, n in peer.items():
                     ctx.ha_peer_inflight.set(n, tenant=t)
+            by_ver: dict = {}
+            for w in ctx.router.alive(("agg", "prefill", "decode")):
+                v = (w.stats or {}).get("weight_version")
+                if v:
+                    by_ver[v] = by_ver.get(v, 0) + 1
+            for v in ctx._version_labels - set(by_ver):
+                ctx.worker_version_gauge.remove(version=v)
+            for v, n in by_ver.items():
+                ctx.worker_version_gauge.set(n, version=v)
+            ctx._version_labels = set(by_ver)
             ctx.slo.refresh_gauges()
             body, ctype = ctx.metrics.registry.scrape(
                 self.headers.get("Accept"))
@@ -479,12 +498,22 @@ class _FrontendHandler(JsonHTTPHandler):
             detail["status"] = "ready" if ready else "unready"
             self._json(200 if ready else 503, detail)
         elif path == "/internal/workers":
+            alive = ctx.router.alive(("agg", "prefill", "decode"))
+            versions: dict = {}
+            for w in alive:
+                v = (w.stats or {}).get("weight_version")
+                if v:
+                    versions[v] = versions.get(v, 0) + 1
             self._json(200, {
                 "workers": [
                     {"url": w.url, "model": w.model, "mode": w.mode,
                      "headroom": round(w.headroom, 3), "stats": w.stats}
-                    for w in ctx.router.alive(("agg", "prefill", "decode"))
-                ]
+                    for w in alive
+                ],
+                # per-version worker counts: the rollout controller's
+                # cheap fleet-progress read (mirrors the
+                # dynamo_frontend_worker_weight_version gauge)
+                "weight_versions": versions,
             })
         elif path == "/debug/spans":
             from urllib.parse import parse_qs, urlparse

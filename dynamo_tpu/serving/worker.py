@@ -119,6 +119,10 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                 # `preemptible: true`): frontends and the planner see
                 # which capacity can vanish on a reclamation notice
                 **({"preemptible": True} if ctx.preemptible else {}),
+                # live elasticity: the active weight version, so the
+                # rollout controller and the frontend fleet view can see
+                # per-pod rollout progress without scraping each worker
+                "weight_version": eng.weights.version,
                 # per-tenant cost rollup rides the heartbeat so every
                 # frontend replica can answer /debug/costs fleet-wide
                 # without fanning out scrapes to each worker

@@ -1,0 +1,662 @@
+"""Live elasticity suite (`make rollout-check`, marker `rollout`).
+
+Covers the hitless weight rollout end to end (docs/robustness.md
+"Hitless weight rollout"):
+
+- weights: stage/flip/rollback/commit lifecycle on the double buffer —
+  staged v2 produces different tokens, rollback is byte-identical to the
+  original, at most two trees ever resident;
+- stage-abort: insufficient HBM headroom (env-forced budget) refuses the
+  stage with the live tree untouched and generation byte-identical, and
+  a tree-shape mismatch can never flip;
+- version isolation: the weight version composes into every KV namespace
+  (prefix cache, KVBM event chains) exactly like LoRA adapters, with the
+  base version hashing byte-identically to pre-elasticity code;
+- the zero-dropped-streams acceptance: an armed finish-mode flip lets
+  in-flight v1 streams complete byte-identical to a no-rollout run while
+  held admissions land on v2 — and v2 output matches a fresh-v2 engine;
+- serving: POST /internal/rollout (status/stage/flip/rollback/commit/
+  abort, idempotent stage_flip retries, rollback-on-armed), the
+  dynamo_engine_weight_version gauge label lifecycle, the
+  dynamo_memory_staged_weights_bytes double-buffer rows, and the exact
+  KV partition surviving a stage + flip;
+- operator: `modelVersion` materializes DYNAMO_TPU_MODEL_VERSION on
+  worker pods only; the controller's rollout_tick flips a fleet one pod
+  per pacing step, commits on convergence, persists weightRollout
+  status, and a burn > DYNAMO_TPU_ROLLOUT_MAX_BURN mid-rollout provably
+  rolls every flipped pod back to v1 and HOLDS until the manifest names
+  a new target; the planner never scales down mid-rollout.
+
+The socket chaos drill (worker killed mid-flip: the HA frontend resumes
+the stream byte-identically on a peer still serving v1) is demoted to
+the slow tier via tests/slow_tier.txt; `make rollout-check` runs it
+directly.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.elasticity.weights import (
+    BASE_VERSION, HEADROOM_ENV, StageError, WeightManager,
+)
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.kvbm.events import token_block_chain
+from dynamo_tpu.robustness import faults
+from dynamo_tpu.serving.api import (
+    ServingContext, make_server, serve_forever_in_thread,
+)
+from dynamo_tpu.serving.frontend import FrontendContext, make_frontend_server
+from dynamo_tpu.serving.router import Router
+
+pytestmark = pytest.mark.rollout
+
+MODEL = "tiny-debug"
+KW = dict(model=MODEL, page_size=4, num_pages=128, max_num_seqs=4,
+          max_seq_len=128)
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+
+
+def greedy(eng, rid, max_tokens=10):
+    return eng.generate(GenRequest(rid, list(PROMPT),
+                                   max_tokens=max_tokens, temperature=0.0,
+                                   ignore_eos=True))
+
+
+# ---------------------------------------------------------------------------
+# weights: the double-buffer lifecycle
+# ---------------------------------------------------------------------------
+def test_stage_flip_rollback_byte_identical():
+    eng = Engine(EngineConfig(**KW, seed=0))
+    wm = eng.weights
+    assert wm.version == BASE_VERSION and wm.namespace == ""
+    ref_v0 = greedy(eng, "r0")
+
+    staged = wm.stage("v2", seed=123)
+    assert staged["version"] == "v2" and staged["bytes"] > 0
+    # staged but not live: v0 still serves, byte-identical
+    assert wm.staged_version == "v2" and wm.version == BASE_VERSION
+    assert greedy(eng, "r1") == ref_v0
+
+    out = wm.flip()
+    assert out == {"version": "v2", "state": "live",
+                   "previous": BASE_VERSION}
+    assert wm.version == "v2" and wm.namespace == "v2"
+    assert wm.previous_version == BASE_VERSION  # rollback window open
+    ref_v2 = greedy(eng, "r2")
+    assert ref_v2 != ref_v0, "different weights must change greedy output"
+
+    rb = wm.rollback()
+    assert rb["version"] == BASE_VERSION and rb["rolled_back"] == "v2"
+    assert wm.previous_version is None and wm.staged_version is None
+    assert greedy(eng, "r3") == ref_v0, "rollback must be byte-identical"
+    assert wm.stats()["flips_total"] == 1
+    assert wm.stats()["rollbacks_total"] == 1
+
+    # commit closes the window: re-flip then commit drops the old tree
+    wm.stage("v2", seed=123)
+    wm.flip()
+    assert greedy(eng, "r4") == ref_v2
+    assert wm.commit()["dropped"] == BASE_VERSION
+    assert wm.previous_nbytes == 0
+    with pytest.raises(StageError):
+        wm.rollback()  # nothing to roll back to after commit
+
+
+def test_stage_validations_protect_the_live_tree():
+    eng = Engine(EngineConfig(**KW, seed=0))
+    wm = eng.weights
+    with pytest.raises(StageError):
+        wm.stage("")  # empty label
+    with pytest.raises(StageError):
+        wm.stage(BASE_VERSION)  # already live
+    wm.stage("v2", seed=1)
+    with pytest.raises(StageError):
+        wm.stage("v3", seed=2)  # double buffer is single-depth
+    assert wm.abort_stage() and not wm.abort_stage()
+    assert wm.staged_version is None and wm.version == BASE_VERSION
+    # staging claims the buffer: a resident rollback window closes
+    wm.stage("v2", seed=1)
+    wm.flip()
+    assert wm.previous_version == BASE_VERSION
+    wm.stage("v3", seed=2)
+    assert wm.previous_version is None, \
+        "at most two trees resident: stage drops the rollback buffer"
+
+
+def test_stage_abort_on_insufficient_hbm_leaves_v1_untouched():
+    eng = Engine(EngineConfig(**KW, seed=0))
+    wm = eng.weights
+    ref = greedy(eng, "a0")
+    os.environ[HEADROOM_ENV] = "10"  # nothing fits in 10 bytes
+    try:
+        with pytest.raises(StageError, match="aborting"):
+            wm.stage("v2", seed=123)
+    finally:
+        del os.environ[HEADROOM_ENV]
+    assert wm.staged_version is None and wm.version == BASE_VERSION
+    assert wm.stats()["stage_aborts_total"] == 1
+    assert greedy(eng, "a1") == ref, "aborted stage must not touch v1"
+    evs = [e for r in eng.flight.records() for e in r.get("events", ())]
+    assert any(e.get("ev") == "rollout_stage_abort"
+               and e.get("reason") == "insufficient_hbm" for e in evs)
+    # a successful stage emits the staged event with its byte figure
+    wm.stage("v2", seed=123)
+    evs = [e for r in eng.flight.records() for e in r.get("events", ())]
+    assert any(e.get("ev") == "rollout_staged" and e.get("bytes") > 0
+               for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# version isolation: KV namespaces
+# ---------------------------------------------------------------------------
+def test_kv_namespace_composes_version_and_adapter():
+    eng = Engine(EngineConfig(**KW, seed=0))
+    # base version: empty namespace — byte-back-compat with the
+    # pre-elasticity hash space (and with peers that never flipped)
+    assert eng._kv_namespace(None) == ""
+    assert eng._kv_namespace("ad") == "ad"
+    eng.weights.stage("v2", seed=123)
+    eng.weights.flip()
+    assert eng._kv_namespace(None) == "v2#"
+    assert eng._kv_namespace("ad") == "v2#ad"
+    # a pod BOOTED at a non-default version namespaces like a flipped one
+    eng2 = Engine(EngineConfig(**KW, seed=0, model_version="v2"))
+    assert eng2.weights.version == "v2"
+    assert eng2._kv_namespace("ad") == "v2#ad"
+    # '#' separator: version "v1" with no adapter can never collide with
+    # an adapter literally named "v1" under the base version
+    assert eng2._kv_namespace(None) != "v2"
+
+
+def test_prefix_cache_misses_across_versions():
+    eng = Engine(EngineConfig(**KW, seed=0))
+    pc = eng.prefix_cache
+    assert pc is not None
+    greedy(eng, "warm")  # populate the v0 ("") namespace
+    assert pc.has_prefix(PROMPT, namespace="")
+    assert not pc.has_prefix(PROMPT, namespace="v2#"), \
+        "v1 blocks must never verify against v2 weights"
+    eng.weights.stage("v2", seed=123)
+    eng.weights.flip()
+    greedy(eng, "warm2")  # populate the v2 namespace
+    assert pc.has_prefix(PROMPT, namespace="v2#")
+    # both namespaces coexist; the memory plane splits them like adapters
+    by_ns = pc.pages_by_namespace()
+    assert "" in by_ns and "v2#" in by_ns
+
+
+def test_kv_event_chain_is_version_namespaced():
+    base = token_block_chain(PROMPT, 4)
+    v2 = token_block_chain(PROMPT, 4, namespace="v2#")
+    assert base and v2 and base != v2
+    # matches the engine-side PrefixCache seeding exactly
+    eng = Engine(EngineConfig(**KW, seed=0))
+    assert eng.prefix_cache._hashes(PROMPT, 2, namespace="v2#") == v2[:2]
+    assert token_block_chain(PROMPT, 4, namespace="") == base
+
+
+# ---------------------------------------------------------------------------
+# the zero-dropped-streams acceptance (engine level)
+# ---------------------------------------------------------------------------
+def test_armed_flip_inflight_byte_identical_and_admissions_land_on_v2():
+    """In-flight v1 streams cross an armed flip byte-identical to a
+    no-rollout run; admissions held during the drain land on v2 and
+    decode exactly what a fresh v2 engine would."""
+    ref_eng = Engine(EngineConfig(**KW, seed=0))
+    ref_v1 = greedy(ref_eng, "ref")
+    ref_v2 = greedy(Engine(EngineConfig(**KW, seed=123,
+                                        model_version="v2")), "ref2")
+
+    eng = Engine(EngineConfig(**KW, seed=0))
+    wm = eng.weights
+    eng.add_request(GenRequest("inflight", list(PROMPT), max_tokens=10,
+                               temperature=0.0, ignore_eos=True))
+    got = {"inflight": [], "held": []}
+    for _ in range(3):  # partway through the v1 stream
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                got[ev.request_id].append(ev.token_id)
+    assert eng.num_active == 1 and got["inflight"]
+
+    wm.stage("v2", seed=123)
+    out = wm.flip(mode="finish")
+    assert out["state"] == "armed" and wm.admission_held
+    # a request landing mid-drain is HELD, not admitted onto v1
+    eng.add_request(GenRequest("held", list(PROMPT), max_tokens=10,
+                               temperature=0.0, ignore_eos=True))
+    for _ in range(3):
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                got[ev.request_id].append(ev.token_id)
+    assert not got["held"], "admissions must hold while the flip is armed"
+
+    while eng.has_work:
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                got[ev.request_id].append(ev.token_id)
+    assert got["inflight"] == ref_v1, \
+        "in-flight v1 stream must be byte-identical to a no-rollout run"
+    assert wm.version == "v2" and not wm.admission_held
+    assert got["held"] == ref_v2, \
+        "held admission must decode on v2 exactly like a fresh v2 engine"
+    evs = [e for r in eng.flight.records() for e in r.get("events", ())]
+    assert any(e.get("ev") == "rollout_flip_armed" for e in evs)
+    assert any(e.get("ev") == "rollout_flip"
+               and e.get("version") == "v2" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# serving: /internal/rollout + gauges + exact memory partition
+# ---------------------------------------------------------------------------
+def post(url, path, body, timeout=60, raw=False):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp if raw else json.loads(resp.read())
+
+
+def get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def test_rollout_endpoint_gauges_and_memory_partition():
+    eng = Engine(EngineConfig(**KW, seed=0))
+    ctx = ServingContext(eng, MODEL)
+    srv = make_server(ctx, "127.0.0.1", 0)
+    serve_forever_in_thread(srv)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        st = post(url, "/internal/rollout", {"action": "status"})
+        assert st["version"] == BASE_VERSION and st["staged"] is None
+        page = get(url, "/metrics")
+        assert 'dynamo_engine_weight_version{version="v0"} 1' in page
+        assert "dynamo_memory_staged_weights_bytes" in page
+
+        out = post(url, "/internal/rollout",
+                   {"action": "stage", "version": "v2", "seed": 123})
+        assert out["bytes"] > 0
+        page = get(url, "/metrics")
+        assert ('dynamo_memory_staged_weights_bytes{buffer="staged"} '
+                f'{out["bytes"]}') in page
+        # KV partition rows still sum EXACTLY to pool capacity with a
+        # staged tree resident (the double buffer lives OUTSIDE the pool)
+        snap = ctx.memory_bridge.accountant.snapshot()
+        dev = [ln for ln in page.splitlines()
+               if ln.startswith("dynamo_memory_kv_pool_bytes{")
+               and 'tier="device"' in ln]
+        assert sum(float(ln.rsplit(" ", 1)[1]) for ln in dev) \
+            == snap["pool"]["total_bytes"]
+        assert snap["weights"]["staged_version"] == "v2"
+
+        out = post(url, "/internal/rollout", {"action": "flip"})
+        assert out["state"] == "live" and out["version"] == "v2"
+        # gauge label lifecycle: v0 removed, v2 set — sum() stays 1
+        page = get(url, "/metrics")
+        assert 'dynamo_engine_weight_version{version="v2"} 1' in page
+        assert 'version="v0"' not in page
+        assert ('dynamo_memory_staged_weights_bytes{buffer="previous"}'
+                in page)
+        # stage_flip is idempotent on the target version (controller
+        # retry after a timed-out-but-landed round trip)
+        out = post(url, "/internal/rollout",
+                   {"action": "stage_flip", "version": "v2"})
+        assert out["state"] == "live" and out.get("already")
+
+        stats = json.loads(get(url, "/worker/stats"))
+        assert stats["weights"]["version"] == "v2"
+        assert stats["weights"]["previous"] == BASE_VERSION
+
+        out = post(url, "/internal/rollout", {"action": "commit"})
+        assert out["dropped"] == BASE_VERSION
+        # a refused stage is 503 retry-later, live tree untouched
+        os.environ[HEADROOM_ENV] = "10"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(url, "/internal/rollout",
+                     {"action": "stage", "version": "v3", "seed": 7})
+            assert ei.value.code == 503
+        finally:
+            del os.environ[HEADROOM_ENV]
+        assert json.loads(
+            get(url, "/worker/stats"))["weights"]["version"] == "v2"
+        # rollback on a staged-but-never-flipped pod aborts the stage
+        post(url, "/internal/rollout",
+             {"action": "stage", "version": "v3", "seed": 7})
+        out = post(url, "/internal/rollout", {"action": "rollback"})
+        assert out["state"] == "rolled_back" and out["version"] == "v2"
+        assert out["rolled_back"] is None
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(url, "/internal/rollout", {"action": "warp"})
+        assert ei.value.code == 400
+    finally:
+        srv.shutdown()
+        ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# operator: materialize + the rollout controller
+# ---------------------------------------------------------------------------
+def _rollout_dgd(metrics_url=None, version="v2"):
+    from dynamo_tpu.operator import materialize as mat
+
+    auto = {"metricsUrl": metrics_url} if metrics_url else {}
+    return {
+        "apiVersion": mat.API_VERSION, "kind": "DynamoGraphDeployment",
+        "metadata": {"name": "roll", "namespace": "dynamo"},
+        "spec": {"services": {
+            "Frontend": {"componentType": "frontend", "replicas": 1,
+                         "modelVersion": version},
+            "Worker": {"componentType": "worker", "replicas": 2,
+                       "modelVersion": version, "autoscaling": auto},
+        }},
+    }
+
+
+def test_materialize_model_version_env_worker_only():
+    from dynamo_tpu.operator import materialize as mat
+
+    out = mat.materialize(_rollout_dgd())
+    deps = {d["metadata"]["name"]: d for d in out["deployments"]}
+    wenv = {e["name"]: e.get("value") for e in
+            deps["roll-worker"]["spec"]["template"]["spec"]
+            ["containers"][0]["env"]}
+    assert wenv["DYNAMO_TPU_MODEL_VERSION"] == "v2"
+    fenv = {e["name"]: e.get("value") for e in
+            deps["roll-frontend"]["spec"]["template"]["spec"]
+            ["containers"][0]["env"]}
+    assert "DYNAMO_TPU_MODEL_VERSION" not in fenv
+
+
+class _FakeFleet:
+    """Record/patch seam for Controller._rollout_post: a fake worker
+    fleet with per-pod version state (the HTTP surface itself is covered
+    by test_rollout_endpoint_gauges_and_memory_partition)."""
+
+    def __init__(self, ctrl, fail=()):
+        self.calls = []
+        self.versions = {}
+        self.fail = set(fail)
+        self._orig = ctrl._rollout_post
+
+        def fake(ns, pod, body):
+            name = pod["metadata"]["name"]
+            self.calls.append((name, body["action"],
+                               body.get("version")))
+            if name in self.fail:
+                return False
+            if body["action"] == "stage_flip":
+                self.versions[name] = body["version"]
+            elif body["action"] == "rollback":
+                self.versions.pop(name, None)
+            return True
+
+        ctrl._rollout_post = fake
+
+
+def _pod(fake, name, ts):
+    from dynamo_tpu.operator import materialize as mat
+
+    fake.put_object("v1", "dynamo", "pods", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": "dynamo",
+            "creationTimestamp": ts,
+            "labels": {
+                mat.COMPONENT_LABEL: "worker",
+                mat.NS_LABEL: mat.discovery_label_value("dynamo", "roll"),
+            },
+        },
+        "status": {"podIP": "10.0.0.1"},
+    })
+
+
+@pytest.fixture()
+def rollout_ctrl():
+    from dynamo_tpu.operator import materialize as mat
+    from dynamo_tpu.operator.controller import Controller
+    from dynamo_tpu.operator.k8s_client import K8sClient
+    from tests.fake_k8s import FakeK8s
+
+    fake = FakeK8s()
+    fake.__enter__()
+    client = K8sClient(fake.url)
+    ctrl = Controller(client, namespace=None)
+    _pod(fake, "roll-worker-old", "2026-08-04T10:00:00Z")
+    _pod(fake, "roll-worker-new", "2026-08-05T10:00:00Z")
+    try:
+        yield mat, fake, client, ctrl
+    finally:
+        fake.__exit__(None, None, None)
+
+
+def test_controller_progressive_flip_commit_and_status(rollout_ctrl):
+    mat, fake, client, ctrl = rollout_ctrl
+    client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                  _rollout_dgd())
+    fleet = _FakeFleet(ctrl)
+    # pacing: one pod per step, NEWEST first (cheapest canary)
+    assert ctrl.rollout_tick(now=1000.0) == 1
+    assert fleet.calls == [("roll-worker-new", "stage_flip", "v2")]
+    assert ctrl.rollout_tick(now=1001.0) == 0, "paced: no flip inside step"
+    assert ctrl.rollout_tick(now=1020.0) == 1
+    assert fleet.versions == {"roll-worker-new": "v2",
+                              "roll-worker-old": "v2"}
+    # converged: next tick commits every pod and the rollout is done
+    n = ctrl.rollout_tick(now=1040.0)
+    assert n == 2
+    assert [c for c in fleet.calls if c[1] == "commit"] == [
+        ("roll-worker-new", "commit", None),
+        ("roll-worker-old", "commit", None)]
+    status = client.get(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                        "roll")["status"]["weightRollout"]["Worker"]
+    assert status["state"] == "done" and status["target"] == "v2"
+    assert sorted(status["flipped"]) == ["roll-worker-new",
+                                        "roll-worker-old"]
+    # frontends are never flipped, and done rollouts stay idle
+    assert all(not c[0].startswith("roll-frontend") for c in fleet.calls)
+    before = len(fleet.calls)
+    ctrl.rollout_tick(now=1100.0)
+    assert len(fleet.calls) == before
+    page = ctrl.registry.expose()
+    assert 'dynamo_operator_weight_rollout_flipped{' in page
+    assert ('dynamo_operator_weight_rollout_total{dgd="roll",'
+            'direction="flip",namespace="dynamo",service="Worker"} 2.0'
+            in page)
+    assert ('dynamo_operator_weight_rollout_total{dgd="roll",'
+            'direction="commit",namespace="dynamo",service="Worker"} 2.0'
+            in page)
+    # a restarted operator resumes from the persisted status: no re-flip
+    from dynamo_tpu.operator.controller import Controller
+    from dynamo_tpu.operator.k8s_client import K8sClient as KC
+
+    fresh = Controller(KC(fake.url), namespace=None)
+    fresh_fleet = _FakeFleet(fresh)
+    assert fresh.rollout_tick(now=2000.0) == 0
+    assert fresh_fleet.calls == []
+
+
+def test_burn_spike_mid_rollout_rolls_fleet_back_and_holds(rollout_ctrl):
+    mat, fake, client, ctrl = rollout_ctrl
+    burn = {"value": 0.0}
+    ctrl._frontend_burn = lambda cr, ns, spec: burn["value"]
+    client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                  _rollout_dgd())
+    fleet = _FakeFleet(ctrl)
+    assert ctrl.rollout_tick(now=1000.0) == 1  # first canary flips
+    burn["value"] = 1.4  # SLO budget burning mid-rollout
+    n = ctrl.rollout_tick(now=1020.0)
+    assert n == 1 and fleet.calls[-1] == ("roll-worker-new", "rollback",
+                                          None)
+    assert fleet.versions == {}, "every flipped pod is back on v1"
+    status = client.get(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                        "roll")["status"]["weightRollout"]["Worker"]
+    assert status["state"] == "rolled_back" and status["flipped"] == []
+    # the hold sticks even after the burn clears: a bad version is never
+    # retried until the manifest names a NEW target
+    burn["value"] = 0.0
+    assert ctrl.rollout_tick(now=2000.0) == 0
+    assert ctrl.rollout_tick(now=3000.0) == 0
+    cr = client.get(mat.API_VERSION, mat.DGD_PLURAL, "dynamo", "roll")
+    cr["spec"]["services"]["Worker"]["modelVersion"] = "v3"
+    cr["spec"]["services"]["Frontend"]["modelVersion"] = "v3"
+    client.replace(mat.API_VERSION, mat.DGD_PLURAL, "dynamo", "roll", cr)
+    assert ctrl.rollout_tick(now=4000.0) == 1  # new target supersedes
+    assert fleet.calls[-1] == ("roll-worker-new", "stage_flip", "v3")
+    page = ctrl.registry.expose()
+    assert ('dynamo_operator_weight_rollout_total{dgd="roll",'
+            'direction="rollback",namespace="dynamo",service="Worker"} 1.0'
+            in page)
+
+
+def test_rollout_retries_failed_pods_and_holds_scale_down(rollout_ctrl):
+    mat, fake, client, ctrl = rollout_ctrl
+    client.create(mat.API_VERSION, mat.DGD_PLURAL, "dynamo",
+                  _rollout_dgd())
+    fleet = _FakeFleet(ctrl, fail={"roll-worker-new"})
+    # a refusing pod (unreachable / insufficient HBM 503) is NOT counted
+    # flipped; the next step retries it — best-effort, never wedged
+    assert ctrl.rollout_tick(now=1000.0) == 0
+    assert fleet.calls == [("roll-worker-new", "stage_flip", "v2")]
+    assert ctrl.rollout_tick(now=1020.0) == 0
+    fleet.fail.clear()
+    assert ctrl.rollout_tick(now=1040.0) == 1
+    assert fleet.versions == {"roll-worker-new": "v2"}
+    # mid-rollout the planner refuses to shrink the service
+    key = ("dynamo", "roll", "Worker")
+    assert ctrl._rollout_active(key)
+    ctrl._planner[key] = {"replicas": 4, "low_since": 900.0}
+    # (v1 down-branch guard: active rollout clears the hysteresis clock)
+    st = ctrl._planner[key]
+    if ctrl._rollout_active(key):
+        st["low_since"] = None
+    assert st["low_since"] is None
+    # done rollouts release the guard
+    ctrl.rollout_tick(now=1060.0)   # flips the old pod
+    ctrl.rollout_tick(now=1080.0)   # commits
+    assert not ctrl._rollout_active(key)
+
+
+# ---------------------------------------------------------------------------
+# chaos drill (slow tier; `make rollout-check` runs it directly)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def rollout_stack():
+    """Frontend + two workers SHARING v1 params (handoff splices must be
+    byte-comparable across the pair)."""
+    plane = faults.reset_plane()
+    eng_a = Engine(EngineConfig(**KW, seed=0))
+    eng_b = Engine(EngineConfig(**KW, seed=0), params=eng_a.params)
+    ctxs, srvs, urls = [], [], []
+    for eng in (eng_a, eng_b):
+        ctx = ServingContext(eng, MODEL)
+        srv = make_server(ctx, "127.0.0.1", 0)
+        serve_forever_in_thread(srv)
+        ctxs.append(ctx)
+        srvs.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+    fctx = FrontendContext(router=Router())
+    fsrv = make_frontend_server(fctx, "127.0.0.1", 0)
+    serve_forever_in_thread(fsrv)
+    yield {"frontend": f"http://127.0.0.1:{fsrv.server_address[1]}",
+           "fctx": fctx, "wctxs": ctxs, "urls": urls, "plane": plane}
+    plane.clear()
+    fsrv.shutdown()
+    for srv in srvs:
+        srv.shutdown()
+    for ctx in ctxs:
+        ctx.close()
+
+
+def _register(stack, only=None):
+    for url in (stack["urls"] if only is None else only):
+        post(stack["frontend"], "/internal/register", {
+            "url": url, "model": MODEL, "mode": "agg",
+            "stats": {"max_num_seqs": 4, "free_pages": 100,
+                      "total_pages": 128}})
+
+
+def _sse_content(body):
+    events = [b.strip()[len("data: "):] for b in body.split("\n\n")
+              if b.strip().startswith("data: ")]
+    assert events and events[-1] == "[DONE]", "stream must COMPLETE"
+    return "".join(
+        (c.get("delta") or {}).get("content") or ""
+        for e in events if e != "[DONE]"
+        for c in json.loads(e)["choices"])
+
+
+def test_handoff_flip_resumes_stream_on_v1_peer(rollout_stack):
+    """The worker-killed-mid-flip drill: a stalled in-flight stream on
+    worker A crosses a handoff-mode flip — the journaled stream hands its
+    seam to the HA frontend, resumes byte-identically on peer B (still
+    serving v1), and A comes out of the flip live on v2 with zero dropped
+    streams."""
+    plane = rollout_stack["plane"]
+    ctx_a = rollout_stack["wctxs"][0]
+    url_a, url_b = rollout_stack["urls"]
+    body = {"model": MODEL,
+            "messages": [{"role": "user", "content": "rolling update"}],
+            "max_tokens": 12, "temperature": 0, "ignore_eos": True,
+            "stream": True}
+    _register(rollout_stack)
+    ref = _sse_content(post(rollout_stack["frontend"],
+                            "/v1/chat/completions", body,
+                            raw=True).read().decode())
+    # pin the stream to A, stalled long enough to flip under it
+    post(rollout_stack["frontend"], "/internal/deregister",
+         {"url": url_b})
+    _register(rollout_stack, only=[url_a])
+    plane.configure({"worker.read_stall": {"times": 1, "delay_s": 0.8}})
+    result = {}
+
+    def run():
+        try:
+            resp = post(rollout_stack["frontend"], "/v1/chat/completions",
+                        body, raw=True, timeout=60)
+            result["body"] = resp.read().decode()
+        except Exception as e:
+            result["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not ctx_a.engine.has_work:
+        time.sleep(0.01)
+    # peer B is back before the flip (it still serves v1)
+    _register(rollout_stack, only=[url_b])
+    try:
+        post(url_a, "/internal/rollout",
+             {"action": "stage", "version": "v2", "seed": 123})
+        out = post(url_a, "/internal/rollout",
+                   {"action": "flip", "mode": "handoff"})
+        assert out["version"] == "v2"
+        t.join(timeout=60)
+        plane.clear()
+        assert "error" not in result, \
+            f"stream died crossing the flip: {result.get('error')}"
+        assert _sse_content(result["body"]) == ref, \
+            "resumed stream must be byte-identical to the no-rollout run"
+        # A ended the drill live on v2 (immediately, or via the armed
+        # fallback once its straggler finished)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                ctx_a.engine.weights.version != "v2":
+            post(url_a, "/v1/chat/completions",
+                 {"model": MODEL, "messages": body["messages"],
+                  "max_tokens": 1})
+        assert ctx_a.engine.weights.version == "v2"
+    finally:
+        plane.clear()
+        ctx_a.drain_handoff.clear()
+        post(rollout_stack["frontend"], "/internal/deregister",
+             {"url": url_a})

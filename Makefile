@@ -7,7 +7,7 @@
 #   make test           - fast test tier (minutes on 1 CPU; skips compile-heavy)
 #   make test-full      - the whole suite incl. compile-heavy + slow tests
 #   make image          - build the runtime container image (all pod roles)
-.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check batch-check rollout-check lint-check image release-manifests help
+.PHONY: k8s dynamo install benchmark-env test test-full trace-check chaos-check kvbm-check recovery-check lora-check obs-check qos-check planner-check rpa-check ha-check spec-check flight-check batch-check rollout-check watchdog-check lint-check image release-manifests help
 
 RELEASE_VERSION ?= latest
 IMAGE ?= dynamo-tpu/runtime:$(RELEASE_VERSION)
@@ -38,6 +38,7 @@ help:
 	@echo "  spec-check     speculative decoding v2 suite (ragged-verify identity, LoRA/sampling/QoS composition)"
 	@echo "  batch-check    preemptible batch tier suite (class-wide QoS eviction, spot reclamation, trough sizing)"
 	@echo "  rollout-check  hitless weight rollout suite (stage/flip/rollback, version namespaces, burn-gated fleet flips)"
+	@echo "  watchdog-check engine watchdog & quarantine suite (hung-dispatch trips, NaN/SDC sentinels, resurrection)"
 	@echo "  lint-check     dynalint static analysis (lock discipline, jit purity, metrics/env contracts) + its suite"
 	@echo ""
 	@echo "Env overrides pass through, e.g.:"
@@ -204,6 +205,14 @@ batch-check:
 rollout-check:
 	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
 		python -m pytest tests/test_rollout.py -q -p no:randomly
+
+# Engine watchdog gate (docs/robustness.md "Engine watchdog &
+# quarantine"): the full suite including the slow-tier chaos drills —
+# hung-dispatch handoff + resurrection, NaN co-tenancy, quarantine shed,
+# KV-checksum SDC recovery — under the pinned fault seed.
+watchdog-check:
+	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
+		python -m pytest tests/test_watchdog.py -q -p no:randomly
 
 # KVBM gate (docs/perf.md "KVBM"): the tiered-block-manager suite plus a
 # deterministic long-shared-prefix bench smoke that must show a NONZERO

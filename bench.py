@@ -1111,6 +1111,166 @@ def bench_rolling_update(on_tpu: bool) -> dict:
     }
 
 
+def bench_engine_chaos(on_tpu: bool) -> dict:
+    """Engine watchdog A/B (docs/robustness.md "Engine watchdog &
+    quarantine"): the same interactive stream load served twice — the
+    CHAOS arm takes sub-deadline device slowness (engine.device_slow,
+    must NOT trip the watchdog) plus one NaN-poisoned canary stream
+    mid-run (the integrity sentinel must abort exactly the canary), the
+    STEADY arm runs fault-free. Headline: interactive streams dropped
+    across the chaos (the acceptance is 0 — sentinels abort poisoned
+    streams, never co-tenants) with the ITL p95 ratio as the
+    degraded-silicon latency guard. The chaos arm also times one
+    in-place engine resurrection after the run drains (the
+    pod-replacement-avoided number).
+
+    Env: BENCH_CHAOS_STREAMS (total interactive streams, default 2000 on
+    TPU / 12 on CPU), BENCH_CHAOS_TOKENS (max_tokens, default 24)."""
+    import time as _time
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import Engine
+    from dynamo_tpu.engine.request import GenRequest
+    from dynamo_tpu.robustness import faults
+
+    model = os.environ.get("BENCH_MODEL",
+                           "llama-3.2-1b-instruct" if on_tpu else "tiny-debug")
+    streams = int(os.environ.get("BENCH_CHAOS_STREAMS",
+                                 "2000" if on_tpu else "12"))
+    steps = int(os.environ.get("BENCH_CHAOS_TOKENS", "24"))
+
+    def pctl(vals, q):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    def run(chaos: bool, params=None):
+        plane = faults.reset_plane()
+        eng = Engine(EngineConfig(
+            model=model, page_size=16, num_pages=256, max_num_seqs=4,
+            max_seq_len=steps + 96, seed=11,
+            enable_prefix_caching=False), params=params)
+        for i in range(4):
+            eng.add_request(GenRequest(
+                f"warm{i}", [(i * 17 + j * 3) % 199 + 1 for j in range(24)],
+                max_tokens=8, temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            eng.step()
+        itl, last = [], {}
+        done, bad = [0], [0]
+        admitted = [0]
+        canary = {"hold": False, "sent": False, "pending": False,
+                  "reason": None}
+        slow_at, nan_at = streams // 3, streams // 2
+        t0 = _time.perf_counter()
+
+        def admit_next():
+            if canary["hold"] and not canary["sent"] or canary["pending"]:
+                return False  # the poisoned prefill must ride alone
+            i = admitted[0]
+            if i >= streams:
+                return False
+            eng.add_request(GenRequest(
+                f"s{i}", [(i * 31 + j * 5) % 199 + 1 for j in range(24)],
+                max_tokens=steps, temperature=0.0, ignore_eos=True))
+            admitted[0] += 1
+            return True
+
+        for _ in range(min(4, streams)):
+            admit_next()
+        while eng.has_work or admitted[0] < streams:
+            if chaos and done[0] >= slow_at and not plane.snapshot()[
+                    "fired_total"].get("engine.device_slow"):
+                # degraded silicon: slow-but-alive readbacks, well under
+                # the deadline — the watchdog must NOT trip
+                plane.configure({"engine.device_slow":
+                                 {"times": 3, "delay_s": 0.004}})
+            if chaos and done[0] >= nan_at and not canary["sent"]:
+                # one corrupted forward, aimed at a canary admission:
+                # interactive admissions hold until every earlier prefill
+                # is installed, so the NaN can only hit the canary
+                canary["hold"] = True
+                if not eng.pending and eng._inflight is None:
+                    plane.configure({"engine.device_nan": {"times": 1}})
+                    eng.add_request(GenRequest(
+                        "canary", [(j * 7) % 199 + 1 for j in range(24)],
+                        max_tokens=steps, temperature=0.0,
+                        ignore_eos=True))
+                    canary["sent"] = canary["pending"] = True
+            for ev in eng.step():
+                now = _time.perf_counter()
+                if ev.request_id == "canary":
+                    if ev.finished:
+                        canary["pending"] = False
+                        canary["reason"] = ev.finish_reason
+                    continue
+                if ev.token_id >= 0:
+                    if ev.request_id in last:
+                        itl.append(now - last[ev.request_id])
+                    last[ev.request_id] = now
+                if ev.finished and ev.request_id.startswith("s"):
+                    done[0] += 1
+                    if ev.finish_reason not in ("length", "stop"):
+                        bad[0] += 1  # a co-tenant was harmed: a drop
+                    admit_next()
+            if not eng.has_work and admitted[0] < streams:
+                admit_next()
+        wall = _time.perf_counter() - t0
+        wd = eng.watchdog.summary()
+        resurrect_s = None
+        if chaos:
+            # the run is drained: time one in-place resurrection (what a
+            # suspect engine pays instead of a pod replacement)
+            t1 = _time.perf_counter()
+            eng.watchdog.on_fatal_step(RuntimeError("bench-injected"))
+            resurrect_s = _time.perf_counter() - t1
+        plane.clear()
+        return {
+            "wall_s": round(wall, 3),
+            "streams": streams,
+            "completed": done[0] - bad[0],
+            "dropped": streams - done[0] + bad[0],
+            "itl_p50_ms": round(1e3 * pctl(itl, 0.5), 3),
+            "itl_p95_ms": round(1e3 * pctl(itl, 0.95), 3),
+            "itl_max_ms": round(1e3 * max(itl, default=0.0), 3),
+            "trips_total": wd["trips_total"],
+            "integrity_faults_total": wd["integrity_faults_total"],
+            "canary_finish_reason": canary["reason"],
+            "health_after": eng.watchdog.health,
+            "resurrect_s": (round(resurrect_s, 3)
+                            if resurrect_s is not None else None),
+        }, eng.params
+
+    chaos_res, params = run(chaos=True)
+    steady_res, _ = run(chaos=False, params=params)
+    return {
+        "metric": "engine_chaos_dropped_streams",
+        "value": chaos_res["dropped"],
+        "unit": "streams",
+        "scenario": "engine_chaos",
+        "model": model,
+        "streams": streams,
+        "chaos": chaos_res,
+        "steady": steady_res,
+        "itl_p95_ratio": round(
+            chaos_res["itl_p95_ms"]
+            / max(steady_res["itl_p95_ms"], 1e-9), 3),
+        # the contract, machine-checkable: sub-deadline slowness tripped
+        # nothing, the sentinel caught exactly the canary, and the
+        # post-run resurrection came back healthy
+        "false_positive_trips": sum(
+            chaos_res["trips_total"].get(k, 0)
+            for k in ("hung_dispatch",)),
+        "canary_aborted": chaos_res["canary_finish_reason"]
+        == "integrity_fault",
+        "resurrected_healthy": chaos_res["health_after"] == "healthy",
+        # CPU-fallback latency is never comparable to the TPU north star
+        # (standing ROADMAP constraint)
+        "comparable": bool(on_tpu),
+    }
+
+
 def main() -> None:
     backend = _init_backend()
     import jax
@@ -1139,6 +1299,10 @@ def main() -> None:
     if os.environ.get("BENCH_SCENARIO") == "rolling_update":
         # hitless weight rollout A/B: one JSON line, same contract
         print(json.dumps(bench_rolling_update(on_tpu)))
+        return
+    if os.environ.get("BENCH_SCENARIO") == "engine_chaos":
+        # engine watchdog A/B: one JSON line, same contract
+        print(json.dumps(bench_engine_chaos(on_tpu)))
         return
     dev = jax.devices()[0]
     chip = _chip_spec(dev) if on_tpu else None

@@ -94,6 +94,10 @@ KNOWN_ENV: Dict[str, str] = {
     "DYNAMO_TPU_GANG_SIZE":
         "multi-host gang: hosts per replica (from the hostsPerReplica "
         "manifest key)",
+    "DYNAMO_TPU_INTEGRITY":
+        "watchdog integrity sentinels: `off`, `logits` (default: finite "
+        "checks riding the existing readbacks) or `full` (adds KV-page "
+        "checksums at the KVBM demote/onboard boundary)",
     "DYNAMO_TPU_KVBM_DISK_DIR":
         "KVBM disk tier: spill directory (unset = no disk tier)",
     "DYNAMO_TPU_KVBM_H2D_GBPS":
@@ -128,6 +132,9 @@ KNOWN_ENV: Dict[str, str] = {
     "DYNAMO_TPU_QOS_BURN_SHED":
         "per-tenant QoS: shed over-share tenants when a matching SLO's "
         "fast-window burn rate exceeds this",
+    "DYNAMO_TPU_QUARANTINE_WINDOW_S":
+        "watchdog: a second trip within this many seconds of the first "
+        "quarantines the engine permanently (default 300)",
     "DYNAMO_TPU_RAGGED_ATTENTION":
         "mixed ragged prefill+decode attention backend override (wins "
         "over hardware-validation gating)",
@@ -173,6 +180,9 @@ KNOWN_ENV: Dict[str, str] = {
         "scalar SLO shorthand: time-to-first-token target (ms)",
     "DYNAMO_TPU_SP_STRATEGY":
         "sequence-parallel strategy override for long-context prefill",
+    "DYNAMO_TPU_STEP_DEADLINE_S":
+        "watchdog: hard per-seam device dispatch/readback deadline "
+        "(seconds); unset = warmup-measured EWMA x margin with a floor",
     "DYNAMO_TPU_TENANTS":
         "JSON tenant-class list (weights, priorities, caps, API keys) — "
         "frontend admission and engine QoS read the same classes",

@@ -272,6 +272,40 @@ class WeightManager:
         log.warning("stage %s aborted (%s): live %s keeps serving",
                     version, reason, self.version)
 
+    def restage_live(self) -> float:
+        """Re-``device_put`` the LIVE tree onto its own shardings — the
+        engine-resurrection path (robustness/watchdog.py): after a device
+        fault every resident buffer is suspect, so the weights round-trip
+        through host RAM and land on fresh device buffers.  Same
+        section-by-section staging idiom as ``stage``, but leaf source is
+        the live tree itself, so there is nothing to validate and no
+        version change.  Caller holds ``engine._exec_lock``.  Returns the
+        transfer seconds.  Any retained rollback/staging buffers are
+        dropped — they are device-resident and therefore equally suspect."""
+        import jax
+        import numpy as np
+
+        eng = self.engine
+        t0 = time.monotonic()
+        with self._lock:
+            self._staged = None
+            self._previous = None
+            self._armed = None
+        live = eng.params
+        fresh: Dict[str, Any] = {}
+        for k in live:
+            # np.asarray pulls a host copy first; device_put onto the
+            # leaf's own sharding keeps the jit signatures byte-identical
+            fresh[k] = jax.device_put(np.asarray(live[k]),
+                                      live[k].sharding)
+        eng.params = fresh
+        dt = time.monotonic() - t0
+        eng.flight.note("restage_live", version=self.version,
+                        seconds=round(dt, 3))
+        log.info("restaged live weights %s onto fresh device buffers "
+                 "in %.2fs", self.version, dt)
+        return dt
+
     def abort_stage(self) -> bool:
         """Drop a resident staging buffer without flipping."""
         with self._lock:

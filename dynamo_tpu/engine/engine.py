@@ -49,6 +49,12 @@ from dynamo_tpu.ops import json_guide
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
 from dynamo_tpu.parallel import sharding as shd
+from dynamo_tpu.robustness import faults
+from dynamo_tpu.robustness.watchdog import (
+    EngineWatchdog,
+    IntegrityFault,
+    integrity_mode,
+)
 
 log = logging.getLogger("dynamo_tpu.engine")
 
@@ -523,6 +529,17 @@ class Engine:
         # stepline: precise per-step phase intervals + inter-dispatch
         # host-gap accounting (DYNAMO_TPU_TIMELINE / _TIMELINE_RECORDS)
         self.timeline = StepTimeline()
+        # engine watchdog (robustness/watchdog.py): every stepline device
+        # phase arms a hang deadline; the health state machine drives
+        # shedding, in-place resurrection, and permanent quarantine.
+        # Sentinel tier resolved once at construction (env is a boot knob).
+        # Derived deadlines arm only on real accelerators: the CPU
+        # fallback recompiles mid-seam (no AOT warmup guarantee), which
+        # would read as a hang; env/CI overrides still trip there.
+        self.watchdog = EngineWatchdog(
+            self, derive_deadline=(backend != "cpu"))
+        self.timeline.watch = self.watchdog
+        self.integrity = integrity_mode()
         self._page_nbytes = (self.kv_spec.bytes_per_token()
                              * cfg.page_size)
         # pallas/spec demotion counts already seen (per-step delta -> ring)
@@ -565,6 +582,10 @@ class Engine:
                 cfg = _dc.replace(cfg, prefill_chunk_tokens=rounded)
                 self.cfg = cfg
         self._aborted: set = set()  # guarded_by: _lock
+        # abort_all teardown hook: the serving layer flushes its stream
+        # queues here so waiting handles see a final event even when the
+        # teardown came from resurrection, not the scheduler loop
+        self.on_abort_all: Optional[Callable[[List[str]], None]] = None
         # disagg prefill role: request_id -> (pages, n_tokens) held for export
         self._parked: Dict[str, tuple] = {}
 
@@ -1590,7 +1611,59 @@ class Engine:
         # (engine_service) as well as explicit teardown — either way the
         # ring tail goes to the log before the evidence scrolls away
         self.flight.dump("abort_all", rids=ids)
+        cb = self.on_abort_all
+        if cb is not None:
+            try:
+                cb(ids)
+            except Exception:
+                log.exception("on_abort_all hook failed")
         return ids
+
+    def resurrect(self) -> None:
+        """Rebuild device state in place after a watchdog trip: fresh KV
+        pool + allocator + prefix cache, device carries invalidated,
+        weights re-`device_put` through the elasticity staging path, and
+        a re-warmup when the engine was warmed before.  Every live stream
+        dies here (journaled ones already handed off through the drain
+        plane); callers hold _exec_lock via the escalation ladder."""
+        with self._exec_lock:
+            t0 = time.monotonic()
+            self.flight.note("resurrect_begin")
+            self.abort_all()
+            # a poisoned device may have corrupted any resident buffer:
+            # rebuild the KV pool and everything that indexes it
+            self.k_pages, self.v_pages = alloc_kv_pages(
+                self.kv_spec,
+                shd.replicated(self.mesh) if self.model_cfg.is_mla
+                else shd.kv_sharding(self.mesh),
+            )
+            self.allocator = PageAllocator(self.cfg.num_pages)
+            if self.prefix_cache is not None:
+                self.prefix_cache = PrefixCache(self.allocator,
+                                                self.cfg.page_size)
+                if self.kvbm is not None:
+                    # host-tier blocks are host RAM copies — they survive
+                    # and re-onboard into the fresh pool on demand
+                    self.prefix_cache.kvbm = self.kvbm
+            self._invalidate_dev()
+            self.token_counts = jnp.zeros(
+                (self.cfg.max_num_seqs, self.model_cfg.vocab_size),
+                dtype=jnp.int32)
+            if not self.cfg.enforce_eager:
+                (self.token_counts,) = self._upload(self.token_counts)
+            # weights: round-trip through host and back onto the devices
+            # via the elasticity staging idiom (leaf-for-leaf device_put
+            # against the live shardings)
+            self.weights.restage_live()
+            if self.warmup_info is not None and not self.has_work:
+                # serving sheds /v1 while unhealthy, so the engine is idle
+                # here unless a direct library caller raced a submit in —
+                # then first traffic pays the compile like a cold start
+                self.warmup()
+            self.flight.note("resurrect_done",
+                             seconds=round(time.monotonic() - t0, 3))
+            log.warning("engine resurrected: device state rebuilt in %.2fs",
+                        time.monotonic() - t0)
 
     @property
     def num_active(self) -> int:
@@ -1915,6 +1988,12 @@ class Engine:
                 self.flight.note("kv_oom", rid=req.request_id,
                                  tenant=self._tenant_of(req), where="prefill")
                 continue
+            except IntegrityFault:
+                # sentinel tripped on this request's logits: abort ONLY
+                # this stream (pages already freed by _run_prefill)
+                events.append(TokenEvent(req.request_id, -1, 0, True,
+                                         "integrity_fault"))
+                continue
             events.append(ev)
         return events
 
@@ -2020,6 +2099,17 @@ class Engine:
                 self.params, jnp.asarray(tokens), jnp.asarray(seq_lens),
                 self.k_pages, self.v_pages, jnp.asarray(pages_arr), *lx,
             )
+        if faults.check("engine.device_nan") is not None:
+            # chaos drill: poison ONE lane (the lead request) — the
+            # sentinel must abort exactly that stream while co-batched
+            # lanes admit byte-identically to a fault-free run
+            logits = logits.at[0].set(jnp.nan)
+        finite = None
+        if self.integrity != "off":
+            # per-lane scalar vector, read back with the sampled tokens'
+            # existing device_wait — no extra sync
+            finite = jnp.isfinite(
+                logits.reshape(logits.shape[0], -1)).all(axis=1)
         keys = np.zeros((npad, 2), np.uint32)
         temp = np.zeros((npad,), np.float32)
         top_p = np.ones((npad,), np.float32)
@@ -2056,6 +2146,8 @@ class Engine:
         with self.timeline.phase("device_wait"):
             toks_np, chosen_np = np.asarray(toks), np.asarray(chosen)
             tids_np, tvals_np = np.asarray(tids), np.asarray(tvals)
+            finite_np = (np.asarray(finite) if finite is not None
+                         else np.ones((npad,), np.bool_))
         if pen_rows is not None:
             # penalized lanes requesting logprobs: re-derive them from the
             # raw distribution (the sampler saw the penalized one)
@@ -2078,6 +2170,15 @@ class Engine:
 
         events: List[TokenEvent] = []
         for i, r in enumerate(reqs):
+            if not finite_np[i]:
+                # poisoned lane: this stream aborts, its pages go back,
+                # the co-batched lanes below admit untouched
+                self.allocator.free(page_lists[i])
+                self.watchdog.record_integrity_fault(
+                    "logits", [r.request_id], where="prefill_group")
+                events.append(TokenEvent(r.request_id, -1, 0, True,
+                                         "integrity_fault"))
+                continue
             self.metrics.prompt_tokens += int(seq_lens[i])
             events.append(self._finalize_admission(
                 r, page_lists[i], int(seq_lens[i]), int(toks_np[i]), keys[i],
@@ -2174,9 +2275,17 @@ class Engine:
                 jnp.asarray(pages_arr),
                 *lx,
             )
-        with self.timeline.phase("device_wait"):
-            first, req_key, lp = self._first_token(req, last_logits,
-                                                   prompt_len)
+        try:
+            with self.timeline.phase("device_wait"):
+                first, req_key, lp = self._first_token(req, last_logits,
+                                                       prompt_len)
+        except IntegrityFault:
+            # poisoned stream: give its pages back and let the caller
+            # abort exactly this request — the engine keeps serving
+            self.allocator.free(pages)
+            self.watchdog.record_integrity_fault(
+                "logits", [req.request_id], where="prefill")
+            raise
         dt = time.monotonic() - t0
         self.metrics.prefill_time_s += dt
         self.metrics.observe_phase("prefill", dt)
@@ -2297,6 +2406,15 @@ class Engine:
         """Sample the first token from prefill logits (shared by the full and
         chunked prefill paths). Returns (first, req_key, lp)."""
         req_key = self._request_key(req)
+        if faults.check("engine.device_nan") is not None:
+            # chaos drill: a corrupted forward — NaN logits straight off
+            # the device (integrity sentinel catches, stream aborts)
+            last_logits = jnp.full_like(last_logits, jnp.nan)
+        finite = None
+        if self.integrity != "off":
+            # one scalar, dispatched alongside the sampler and read back
+            # with the first token's existing sync — no extra round trip
+            finite = jnp.isfinite(last_logits).all()
         raw_logits = last_logits
         pen = self._penalty_row(req)
         if pen is not None:
@@ -2318,6 +2436,9 @@ class Engine:
             req_key,
             jnp.int32(prompt_len - 1),
         )
+        if finite is not None and not bool(finite):
+            raise IntegrityFault("logits", [req.request_id],
+                                 "non-finite prefill logits")
         if pen is not None and req.logprobs is not None:
             # report logprobs from the raw distribution, not the penalized
             # one the continuation sampled from
@@ -2479,9 +2600,18 @@ class Engine:
         if self.prefix_cache is not None:
             self.prefix_cache.insert(req.prompt_token_ids, inf.pages,
                                      namespace=self._kv_namespace(req.adapter))
-        with self.timeline.phase("device_wait"):
-            first, req_key, lp = self._first_token(req, last_logits,
-                                                   inf.prompt_len)
+        try:
+            with self.timeline.phase("device_wait"):
+                first, req_key, lp = self._first_token(req, last_logits,
+                                                       inf.prompt_len)
+        except IntegrityFault:
+            self.allocator.free(inf.pages)
+            self._free_slots.append(inf.slot)
+            self.watchdog.record_integrity_fault(
+                "logits", [req.request_id], where="prefill_chunk")
+            events.append(TokenEvent(req.request_id, -1, 0, True,
+                                     "integrity_fault"))
+            return events
         slot = inf.slot  # reserved at _start_inflight
         seq = self._install_slot(req, slot, inf.pages, inf.prompt_len, first,
                                  req_key)
@@ -3190,6 +3320,10 @@ class Engine:
     def _dispatch_window(self, window: int) -> None:
         t0 = time.monotonic()
         with self.timeline.phase("dispatch"):
+            # chaos: a wedged device program — the sleep runs INSIDE the
+            # armed dispatch seam with _exec_lock held, exactly what a
+            # real hang looks like to the watchdog monitor thread
+            faults.sleep_point("engine.device_hang")
             self._ensure_dev_state()
             want_lp = any(s.logprobs is not None
                           for s in self.seqs.values())
@@ -3241,11 +3375,26 @@ class Engine:
         events: List[TokenEvent] = []
         t_wait = time.monotonic()
         with self.timeline.phase("device_wait"):
+            # chaos: slow-but-alive readback — must NOT trip the watchdog
+            # when the delay stays under the deadline
+            faults.sleep_point("engine.device_slow")
             next_np = np.asarray(ys[0])  # [window, B]
             if want_lp:
                 chosen_np = np.asarray(ys[1])  # [window, B]
                 tids_np = np.asarray(ys[2])  # [window, B, K]
                 tvals_np = np.asarray(ys[3])
+        bad_slots = ()
+        if self.integrity != "off":
+            # host-side SDC net for decode windows: the only data that
+            # crosses back per step is the token array — a corrupted id
+            # outside [0, vocab) poisons detok and the KV it indexes.
+            # (Logit-level checks live in the prefill readback; decode
+            # windows donate their programs, so this host check is the
+            # no-recompile-cost equivalent.)
+            oob = ((next_np < 0)
+                   | (next_np >= self.model_cfg.vocab_size)).any(axis=0)
+            if oob.any():
+                bad_slots = tuple(np.flatnonzero(oob))
         dt = dispatch_s + (time.monotonic() - t_wait)
         self.metrics.decode_steps += window
         self.metrics.decode_time_s += dt
@@ -3258,6 +3407,14 @@ class Engine:
             for slot in slots:
                 seq = self.seqs.get(slot)
                 if seq is None:  # finished/aborted since dispatch
+                    continue
+                if slot in bad_slots:
+                    # corrupted readback: abort ONLY this slot's stream
+                    self.watchdog.record_integrity_fault(
+                        "decode_tokens", [seq.request_id], slot=slot)
+                    events.append(TokenEvent(seq.request_id, -1, 0, True,
+                                             "integrity_fault"))
+                    self._finish_slot(slot, "integrity_fault")
                     continue
                 for k in range(window):
                     tok = int(next_np[k, slot])

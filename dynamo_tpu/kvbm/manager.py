@@ -78,6 +78,16 @@ class KVBM:
             Callable[[List[bytes]], List[Tuple[np.ndarray, np.ndarray]]]
         ] = None
         self.tracer = None  # set by ServingContext; spans kvbm.offload/onboard
+        # integrity sentinel (DYNAMO_TPU_INTEGRITY=full; docs/robustness.md
+        # "Engine watchdog & quarantine"): CRC32 per demoted block, verified
+        # at onboard — a mismatch (host-RAM/disk bit flip) drops the block
+        # to a cache miss (recompute) instead of importing silent corruption
+        # into the device pool. Peer-fetched blocks carry no local CRC and
+        # skip verification.
+        from dynamo_tpu.robustness.watchdog import integrity_mode
+
+        self._checksum = integrity_mode() == "full"
+        self._crc: dict = {}  # block hash -> crc32 at demote time
         self._lock = threading.Lock()  # counters only
         # counters behind the dynamo_kvbm_* metric series
         self.host_hits_total = 0        # lookups served >= 1 block from tiers
@@ -137,6 +147,15 @@ class KVBM:
                 ok, lru_removed = self.pool.put(h, k[:, i], v[:, i])
                 dropped.extend(lru_removed)
                 (demoted if ok else removed).append(h)
+                if self._checksum and ok:
+                    import zlib
+
+                    self._crc[h] = zlib.crc32(
+                        v[:, i].tobytes(),
+                        zlib.crc32(k[:, i].tobytes()))
+            if self._checksum:
+                for h in removed + dropped:
+                    self._crc.pop(h, None)
             with self._lock:
                 self.demoted_blocks_total += len(demoted)
                 self.removed_blocks_total += len(removed) + len(dropped)
@@ -164,6 +183,35 @@ class KVBM:
         Returns pages demoted/evicted."""
         return prefix_cache.evict(prefix_cache.evictable())
 
+    def _verify(self, h: bytes, k: np.ndarray, v: np.ndarray) -> bool:
+        """Onboard-time CRC check (integrity=full). A mismatch means the
+        block rotted in host RAM or on disk since demote: drop it from
+        every tier (a cache miss — the prefix recomputes, correctly),
+        count the fault on the watchdog, and never abort anything — the
+        corruption was caught BEFORE it touched the device pool."""
+        import zlib
+
+        want = self._crc.get(h)
+        if want is None:
+            return True  # peer-fetched or pre-sentinel block: no claim
+        got = zlib.crc32(v.tobytes(), zlib.crc32(k.tobytes()))
+        if got == want:
+            return True
+        self._crc.pop(h, None)
+        self.pool.drop(h)
+        with self._lock:
+            self.removed_blocks_total += 1
+        self._emit("removed", [h], "none")
+        self._flight("integrity_fault", sentinel="kv_checksum",
+                     block=h.hex()[:16])
+        wd = getattr(self.engine, "watchdog", None)
+        if wd is not None:
+            wd.record_integrity_fault("kv_checksum", [],
+                                      block=h.hex()[:16])
+        log.warning("kvbm checksum mismatch on block %s; dropped "
+                    "(recompute)", h.hex()[:16])
+        return False
+
     # ------------------------------------------------------------- onboard --
     def onboard_chain(self, hashes: List[bytes]) -> List[Tuple[bytes, int]]:
         """Restore the longest consecutive run of `hashes` available in the
@@ -179,12 +227,16 @@ class KVBM:
             got = self.pool.get(h, removed=disk_drops)
             if got is None:
                 break
+            if self._checksum and not self._verify(h, got[0], got[1]):
+                break  # the chain must stay consecutive: stop before it
             blocks.append((h, got[0], got[1]))
         source = "host"
         if not blocks and self.peer_fetch is not None:
             blocks = self._fetch_from_peer(hashes)
             source = "peer"
         if disk_drops:
+            for h in disk_drops:
+                self._crc.pop(h, None)
             with self._lock:
                 self.removed_blocks_total += len(disk_drops)
             self._emit("removed", disk_drops, "none")

@@ -146,18 +146,29 @@ class _Phase:
     """Reusable-shape context manager for one instrumented phase; kept
     allocation-light because several open per engine step."""
 
-    __slots__ = ("_tl", "_name")
+    __slots__ = ("_tl", "_name", "_watched")
 
     def __init__(self, tl: "StepTimeline", name: str):
         self._tl = tl
         self._name = name
+        self._watched = False
 
     def __enter__(self) -> "_Phase":
+        # device seams feed the engine watchdog even when the timeline
+        # draft is closed (disabled timeline, disagg prefill outside
+        # step()) — hang detection must not depend on record keeping
+        watch = self._tl.watch
+        if watch is not None and self._name in DEVICE_PHASES:
+            self._watched = watch
+            watch.device_enter(self._name)
         self._tl._enter(self._name)
         return self
 
     def __exit__(self, *exc) -> bool:
         self._tl._exit()
+        watch, self._watched = self._watched, False
+        if watch:
+            watch.device_exit(self._name)
         return False
 
 
@@ -191,6 +202,9 @@ class StepTimeline:
         self._draft: Optional[Dict[str, Any]] = None
         self._stack: List[List[Any]] = []  # [name, segment_open_monotonic]
         self._last_return: Optional[float] = None  # device ctrl-return mark
+        # optional EngineWatchdog: device-phase enter/exit mirror — hang
+        # detection coverage tracks stepline instrumentation exactly
+        self.watch: Optional[Any] = None
 
     # ------------------------------------------------------ engine thread --
     def reset(self) -> None:

@@ -529,8 +529,13 @@ class Controller:
                 continue  # unreachable metrics: hold the last decision
             queued = signals["queued"]
             burn = signals.get("burn", 0.0)
+            # watchdog-quarantined workers count against the Deployment
+            # but serve nothing: size for demand PLUS the dead replicas
+            # so effective capacity stays whole until quarantine_tick
+            # replaces them
+            quarantined = int(signals.get("quarantined") or 0)
             st["replicas"] = max(lo, min(hi, st["replicas"]))
-            want = max(lo, min(hi, -(-int(queued) // target)))
+            want = max(lo, min(hi, -(-int(queued) // target) + quarantined))
             # SLO-burn boost (the ROADMAP's SLO-driven autoscaling seam,
             # fed by observability/slo.py): an active fast-window burn
             # means the pool is missing its objectives at the CURRENT
@@ -705,6 +710,7 @@ class Controller:
                 burn_ttft=float(scraped.get("burn_ttft") or 0.0),
                 burn_itl=float(scraped.get("burn_itl") or 0.0),
                 burn=float(scraped.get("burn") or 0.0),
+                quarantined=int(scraped.get("quarantined") or 0),
                 tenant_inflight=dict(scraped.get("tenant_inflight") or {}),
                 rps=fc.rate(), forecast_rps=forecast, ts=now,
                 stale=bool(scraped.get("stale")))
@@ -740,6 +746,60 @@ class Controller:
                 round(pl.last_forecast.get(svc_name, 0.0), 3),
                 namespace=ns, dgd=name, service=svc_name)
         return changed
+
+    # ------------------------------------------------------- quarantine --
+    def quarantine_tick(self, now: Optional[float] = None) -> int:
+        """Replace watchdog-quarantined workers (docs/robustness.md
+        "Engine watchdog & quarantine"): an engine that reached the
+        terminal `quarantined` state serves nothing and never recovers
+        in place, so its pod is DELETED — the Deployment controller
+        recreates a fresh replica on (possibly) healthy silicon. The
+        frontend's per-worker health gauge names the victims; pods are
+        matched by podIP. Returns pods deleted."""
+        import re as _re
+
+        deleted = 0
+        try:
+            dgds = self.k8s.list(mat.API_VERSION, mat.DGD_PLURAL,
+                                 self.namespace)
+        except ApiError:
+            return 0
+        for cr in dgds:
+            ns, name = self._ns(cr), cr["metadata"]["name"]
+            url = (f"http://{mat.frontend_host(cr)}.{ns}:"
+                   f"{mat.FRONTEND_PORT}/metrics")
+            parsed = self.collector.scrape_metrics(url)
+            victims = (parsed or {}).get("quarantined_workers") or []
+            if not victims:
+                continue
+            ips = set()
+            for u in victims:
+                m = _re.match(r"https?://([^:/]+)", u)
+                if m:
+                    ips.add(m.group(1))
+            if not ips:
+                continue
+            sel = f"{mat.NS_LABEL}={mat.discovery_label_value(ns, name)}"
+            try:
+                pods = self.k8s.list("v1", "pods", ns, label_selector=sel)
+            except ApiError as e:
+                log.debug("quarantine: pod listing failed (%s)", e)
+                continue
+            for pod in pods:
+                if (pod.get("status") or {}).get("podIP") not in ips:
+                    continue
+                pod_name = pod["metadata"]["name"]
+                try:
+                    self.k8s.delete("v1", "pods", ns, pod_name)
+                except ApiError as e:
+                    log.warning("quarantine: deleting %s/%s failed: %s",
+                                ns, pod_name, e)
+                    continue
+                deleted += 1
+                log.warning("quarantine: replaced pod %s/%s (engine "
+                            "quarantined at %s)", ns, pod_name,
+                            victims)
+        return deleted
 
     def _mark_drain_victims(self, ns: str, dgd: str, svc_name: str,
                             n: int) -> List[str]:
@@ -1124,6 +1184,13 @@ class Controller:
                         self.rollout_tick(now)
                     except Exception:
                         log.exception("rollout tick failed")
+                    try:
+                        # after the planner sized around the dead
+                        # capacity: replace quarantined pods so the
+                        # Deployment refills the fleet
+                        self.quarantine_tick(now)
+                    except Exception:
+                        log.exception("quarantine tick failed")
                 try:
                     self.reconcile_once()
                 except Exception:

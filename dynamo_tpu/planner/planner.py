@@ -320,7 +320,14 @@ class PoolPlanner:
                     reasons[partner.name] = "coordination"
 
         for name, want in wants.items():
-            self._apply(name, want, reasons[name], signals[name], now)
+            s = signals[name]
+            if s.quarantined and not self.pools[name].preemptible:
+                # watchdog-quarantined replicas count against the
+                # Deployment but serve nothing — size for demand PLUS
+                # the dead slots so effective capacity stays whole
+                # until the operator replaces them (quarantine_tick)
+                want += int(s.quarantined)
+            self._apply(name, want, reasons[name], s, now)
         return self.targets()
 
     def _apply(self, name: str, want: int, reason: str, s: PoolSignals,

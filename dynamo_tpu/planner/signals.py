@@ -47,6 +47,9 @@ class PoolSignals:
     burn: float = 0.0            # worst fast-window burn, any objective
     rps: float = 0.0             # most recent observed arrival rate
     forecast_rps: float = 0.0    # short-horizon forecast (frontend ring)
+    quarantined: int = 0         # watchdog-quarantined workers: replicas
+    # that count against the Deployment's size but serve nothing — the
+    # planner adds them to `want` so effective capacity stays whole
     tenant_inflight: Dict[str, float] = dataclasses.field(
         default_factory=dict)
     ts: float = 0.0              # when scraped (staleness bookkeeping)
@@ -131,6 +134,7 @@ class Forecaster:
 _QUEUED_RE = re.compile(r"^dynamo_frontend_queued_requests(?:\{[^}]*\})?\s")
 _BURN_RE = re.compile(r'^dynamo_slo_burn_rate\{([^}]*)\}\s')
 _TENANT_INFLIGHT_RE = re.compile(r'^dynamo_tenant_inflight\{([^}]*)\}\s')
+_WORKER_HEALTH_RE = re.compile(r'^dynamo_frontend_worker_health\{([^}]*)\}\s')
 _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 
 
@@ -143,17 +147,34 @@ def parse_metrics_text(text: str) -> Dict[str, Any]:
 
     Returns a dict with queued (None when the page carries no
     queued-requests gauge — a per-pool worker page), burn (worst
-    fast-window), burn_ttft, burn_itl, inflight, and tenant_inflight.
-    Only window="5m" burn rows count — the slow window is a paging
-    signal, not a scaling one."""
+    fast-window), burn_ttft, burn_itl, inflight, tenant_inflight, and
+    the watchdog fleet view (quarantined count + quarantined_workers
+    URLs, from the frontend's per-worker health gauge). Only
+    window="5m" burn rows count — the slow window is a paging signal,
+    not a scaling one."""
     queued: Optional[float] = None
     burn = burn_ttft = burn_itl = 0.0
     inflight = 0.0
+    quarantined = 0
+    quarantined_workers: List[str] = []
     tenant_inflight: Dict[str, float] = {}
     for ln in text.splitlines():
         if _QUEUED_RE.match(ln):
             try:
                 queued = float(ln.split()[-1])
+            except ValueError:
+                pass
+            continue
+        m = _WORKER_HEALTH_RE.match(ln)
+        if m:
+            # watchdog fleet view: 3 = quarantined (out of rotation for
+            # good — its replica slot is dead capacity until replaced)
+            try:
+                if float(ln.split()[-1]) >= 3.0:
+                    quarantined += 1
+                    url = _labels_of(m.group(1)).get("worker")
+                    if url:
+                        quarantined_workers.append(url)
             except ValueError:
                 pass
             continue
@@ -183,6 +204,8 @@ def parse_metrics_text(text: str) -> Dict[str, Any]:
             inflight += v
     return {"queued": queued, "burn": burn, "burn_ttft": burn_ttft,
             "burn_itl": burn_itl, "inflight": inflight,
+            "quarantined": quarantined,
+            "quarantined_workers": quarantined_workers,
             "tenant_inflight": tenant_inflight}
 
 

@@ -35,6 +35,17 @@ nats.partition                          NATS publishes raise ConnectionError
 disagg.prefill_connect_refused          decode->prefill RPC raises
                                         connection-refused before any KV moves
                                         (prefill-pool failover)
+engine.device_hang                      engine dispatch seam sleeps ``delay_s``
+                                        with the exec lock held — a wedged
+                                        device program (watchdog trip,
+                                        quarantine ladder)
+engine.device_nan                       prefill logits are poisoned with NaN
+                                        before sampling (integrity sentinel:
+                                        poisoned streams abort, co-batched
+                                        tenants survive byte-identical)
+engine.device_slow                      decode readback sleeps ``delay_s``
+                                        WITHOUT tripping (sub-deadline
+                                        slowness must not false-positive)
 ======================================  =======================================
 
 Determinism: every probabilistic draw comes from a per-fault-point
@@ -85,6 +96,15 @@ REGISTRY: Dict[str, str] = {
         "NATS publishes raise ConnectionError (plane partition)",
     "disagg.prefill_connect_refused":
         "decode->prefill RPC fails pre-send (connection refused)",
+    "engine.device_hang":
+        "engine dispatch seam wedges delay_s with the exec lock held "
+        "(watchdog trip, resurrection/quarantine ladder)",
+    "engine.device_nan":
+        "prefill logits poisoned with NaN pre-sampling (integrity "
+        "sentinel aborts exactly the poisoned streams)",
+    "engine.device_slow":
+        "decode readback sleeps delay_s without tripping (sub-deadline "
+        "slowness is not a hang)",
 }
 
 

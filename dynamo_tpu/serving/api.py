@@ -545,6 +545,35 @@ class ServingContext:
             "Sequences preempted (recompute) under KV page pressure",
             self.metrics.registry,
         )
+        # --- engine watchdog (dynamo_tpu/robustness/watchdog.py): the
+        # health state machine drives readiness, the /v1 shed gate, and
+        # the planner's capacity view; trips hand journaled streams off
+        # to a peer exactly like a pre-drain
+        from dynamo_tpu.serving.metrics import CallbackCounterVec
+
+        wd = engine.watchdog
+        self.health_gauge = Gauge(
+            "dynamo_engine_health",
+            "Engine health state machine: 0=healthy 1=suspect "
+            "2=resurrecting 3=quarantined",
+            self.metrics.registry)
+        self.health_gauge.set(wd.health_code)
+        CallbackCounterVec("dynamo_engine_watchdog_trips_total",
+             "Watchdog trips by kind (hung_dispatch, fatal_step)",
+             self.metrics.registry,
+             lambda: {(("kind", k),): v
+                      for k, v in wd.summary()["trips_total"].items()},
+             labelnames=("kind",))
+        CallbackCounterVec("dynamo_engine_integrity_faults_total",
+             "Integrity sentinel trips by sentinel "
+             "(logits, decode_tokens, kv_checksum)",
+             self.metrics.registry,
+             lambda: {(("sentinel", s),): v
+                      for s, v in
+                      wd.summary()["integrity_faults_total"].items()},
+             labelnames=("sentinel",))
+        wd.on_trip = self._on_watchdog_trip
+        wd.on_health = self._on_engine_health
         # --- live elasticity (dynamo_tpu/elasticity): the active weight
         # version as a labelled gauge (1 on the live label), refreshed at
         # scrape with label death so a flip/rollback never leaves a stale
@@ -759,6 +788,24 @@ class ServingContext:
         failover lands them on another replica. In-flight requests keep
         running until they finish or hand off."""
         self.draining.set()
+
+    def _on_watchdog_trip(self, kind: str, seam: str) -> None:
+        """Watchdog trip (monitor or scheduler thread): hand journaled
+        in-flight streams off to a peer exactly like a pre-drain. The
+        nudge is load-bearing — a wedged engine emits no TokenEvents, so
+        blocked handlers would never observe drain_handoff without it."""
+        self.request_handoff()
+        self.service.nudge_all()
+
+    def _on_engine_health(self, state: str) -> None:
+        from dynamo_tpu.robustness.watchdog import HEALTH_CODES
+
+        self.health_gauge.set(HEALTH_CODES.get(state, 0))
+        if state == "healthy" and not self.draining.is_set():
+            # resurrection done: stop asking streams to hand off — but
+            # never un-drain a worker that is draining for its own
+            # reasons (SIGTERM, reclaim, pre-drain)
+            self.drain_handoff.clear()
 
     def request_handoff(self) -> None:
         """Ask journaled in-flight streams to hand off: each pushes its
@@ -1055,10 +1102,28 @@ class _Handler(JsonHTTPHandler):
             self.ctx.engine_bridge.refresh()  # live MFU/MBU + warmup gauges
             self.ctx.memory_bridge.refresh()  # KV-pool/tier/tenant bytes
             self.ctx.refresh_weight_gauge()  # active weight version label
+            self.ctx.health_gauge.set(  # watchdog health state machine
+                self.ctx.engine.watchdog.health_code)
             body, ctype = self.ctx.metrics.registry.scrape(
                 self.headers.get("Accept"))
             self._raw(200, body, ctype)
-        elif path in ("/health", "/live", "/ready"):
+        elif path == "/live":
+            # liveness stays 200 through suspect/resurrecting — killing
+            # the pod mid-resurrection would turn every recoverable trip
+            # into a full replacement. Quarantine is the operator's cue
+            # to replace, and that rides readiness, not liveness.
+            self._json(200, {"status": "ok", "uptime_s": round(
+                time.time() - self.ctx.start_time, 1)})
+        elif path in ("/health", "/ready"):
+            wd = self.ctx.engine.watchdog
+            if not wd.ok_for_traffic:
+                # the quarantine invariant: a worker that cannot prove
+                # progress is provably out of rotation — readiness 503
+                # pulls it from k8s endpoints and the router's breakers
+                self._error(503, f"engine {wd.health}",
+                            "service_unavailable",
+                            headers={"Retry-After": "5"})
+                return
             self._json(200, {"status": "ok", "uptime_s": round(
                 time.time() - self.ctx.start_time, 1)})
         elif path == "/debug/spans":
@@ -1133,6 +1198,9 @@ class _Handler(JsonHTTPHandler):
                 "total_pages": eng.cfg.num_pages,
                 "max_num_seqs": eng.cfg.max_num_seqs,
                 "disaggregation_mode": eng.cfg.disaggregation_mode,
+                # watchdog health state machine + trip/sentinel counters
+                # (the same summary the heartbeat carries to frontends)
+                "health": eng.watchdog.summary(),
                 # the full effective EngineConfig: profiles, engine-config
                 # files, and CLI flags all merge before the engine starts,
                 # so operators need the RESOLVED values, not the manifest
@@ -1213,6 +1281,18 @@ class _Handler(JsonHTTPHandler):
             # finish in-flight KV pulls against this worker.
             self._error(503, "worker draining; retry another replica",
                         "service_unavailable")
+            return
+        if (not self.ctx.engine.watchdog.ok_for_traffic
+                and path.startswith(("/v1/", "/disagg/prefill"))):
+            # watchdog shed: a suspect/resurrecting/quarantined engine
+            # takes no new inference work. Deliberately NOT routed
+            # through ctx.draining — recovery must not un-drain a worker
+            # that is draining for its own reasons.
+            self._error(
+                503,
+                f"engine {self.ctx.engine.watchdog.health}; "
+                "retry another replica",
+                "service_unavailable", headers={"Retry-After": "5"})
             return
         # robustness plane: read-stall / reset-after-headers fault points
         # (no-ops unless armed; control-plane routes are exempt)
@@ -1303,6 +1383,17 @@ class _Handler(JsonHTTPHandler):
                         body = self._read_json_body()
                     except Exception:  # noqa: BLE001 — body is optional
                         body = {}
+                    wd = self.ctx.engine.watchdog
+                    if not wd.ok_for_traffic:
+                        # fail fast instead of parking this HTTP thread
+                        # on a wedged engine's exec lock — the operator's
+                        # tick stays bounded and retries once the
+                        # resurrection (or pod replacement) lands
+                        self._error(
+                            503, f"engine {wd.health}; rollout refused",
+                            "service_unavailable",
+                            headers={"Retry-After": "5"})
+                        return
                     self._json(200, self.ctx.rollout(body))
                 elif path == "/internal/reclaim":
                     # spot/maintenance reclamation notice: this replica's

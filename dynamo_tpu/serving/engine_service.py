@@ -29,6 +29,12 @@ class EngineService:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        # resurrection (watchdog escalation thread) tears streams down via
+        # engine.abort_all — flush their queues so waiting handlers see a
+        # final event instead of polling a dead request forever. Set on
+        # the RAW engine: a ReplicatedEngine wrapper proxies reads, not
+        # writes, and abort_all runs on the inner object
+        getattr(engine, "engine", engine).on_abort_all = self._flush_aborted
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="engine-scheduler")
         self._thread.start()
@@ -74,6 +80,26 @@ class EngineService:
 
     def wake(self):
         self._wake.set()
+
+    def nudge_all(self) -> None:
+        """Push a synthetic no-op event to every open stream queue.  A
+        wedged engine emits nothing, so handles blocked in drain() would
+        never observe a drain-handoff signal; the nudge wakes them (the
+        handoff branch runs before token processing, and token_id=-1 with
+        finished=False is ignored everywhere else)."""
+        with self._lock:
+            for rid, q in list(self._queues.items()):
+                q.put(TokenEvent(rid, -1, 0, False, None))
+
+    def _flush_aborted(self, ids) -> None:
+        """engine.on_abort_all hook: terminate the stream queues of every
+        torn-down request (idempotent — a queue already popped by the
+        fatal-step path is simply absent)."""
+        with self._lock:
+            for rid in ids:
+                q = self._queues.pop(rid, None)
+                if q is not None:
+                    q.put(TokenEvent(rid, -1, 0, True, "abort"))
 
     def sampling_state(self, request_id: str):
         """Resumable sampling-state export (engine.export_sampling_state):
@@ -151,14 +177,23 @@ class EngineService:
                     # name the failure before abort_all() dumps the ring —
                     # the dump tail then ends with [fatal_step, dump]
                     flight.note("fatal_step", error=repr(e))
-                # release engine slots/KV pages so the worker can recover,
-                # notify every waiter, and back off before the next attempt
-                ids = self.engine.abort_all()
-                with self._lock:
-                    for rid in ids:
-                        q = self._queues.pop(rid, None)
-                        if q is not None:
-                            q.put(TokenEvent(rid, -1, 0, True, "abort"))
+                watchdog = getattr(self.engine, "watchdog", None)
+                if watchdog is not None:
+                    # health state machine: suspect -> in-place
+                    # resurrection (this thread is NOT wedged — it caught
+                    # the error), or permanent quarantine on repeat trips.
+                    # Resurrection's abort_all flushes our queues via the
+                    # on_abort_all hook, so every waiter sees a final
+                    # event and the worker's advertised health changes
+                    # BEFORE it takes new work.
+                    watchdog.on_fatal_step(e)
+                else:
+                    ids = self.engine.abort_all()
+                    with self._lock:
+                        for rid in ids:
+                            q = self._queues.pop(rid, None)
+                            if q is not None:
+                                q.put(TokenEvent(rid, -1, 0, True, "abort"))
                 time.sleep(0.5)
                 continue
             if events:

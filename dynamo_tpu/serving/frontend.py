@@ -32,6 +32,7 @@ from dynamo_tpu.observability import tracing as obs_tracing
 from dynamo_tpu.qos import tenancy as qos_tenancy
 from dynamo_tpu.robustness import faults
 from dynamo_tpu.robustness.breaker import STATE_CODES
+from dynamo_tpu.robustness.watchdog import HEALTH_CODES as WD_HEALTH_CODES
 from dynamo_tpu.robustness.deadline import Deadline
 from dynamo_tpu.serving import ha
 from dynamo_tpu.serving import protocol as proto
@@ -188,6 +189,13 @@ class FrontendContext:
         self.breaker_gauge = Gauge(
             "dynamo_frontend_breaker_state",
             "Per-worker circuit-breaker state (0=closed 1=half_open 2=open)",
+            self.metrics.registry, labelnames=("worker",),
+        )
+        self.worker_health_gauge = Gauge(
+            "dynamo_frontend_worker_health",
+            "Per-worker engine health from heartbeats (0=healthy "
+            "1=suspect 2=resurrecting 3=quarantined) — the fleet view "
+            "the planner excludes quarantined capacity with",
             self.metrics.registry, labelnames=("worker",),
         )
         # --- request recovery plane (serving/recovery.py) ---
@@ -442,6 +450,19 @@ class _FrontendHandler(JsonHTTPHandler):
             # by clock, not by an event anyone could have observed)
             for url, state in ctx.router.breakers.snapshot().items():
                 ctx.breaker_gauge.set(STATE_CODES[state], worker=url)
+            # engine health rides worker heartbeats; scrape-time export
+            # with label death so a departed worker's row disappears
+            health_now = {w.url: WD_HEALTH_CODES.get(w.health, 0)
+                          for w in ctx.router.alive(
+                              ("agg", "prefill", "decode"))}
+            with ctx.worker_health_gauge._lock:
+                known_workers = [dict(lbl).get("worker")
+                                 for lbl in ctx.worker_health_gauge._values]
+            for u in known_workers:
+                if u not in health_now:
+                    ctx.worker_health_gauge.remove(worker=u)
+            for u, code in health_now.items():
+                ctx.worker_health_gauge.set(code, worker=u)
             # per-tenant in-flight occupancy (tenants that drained to zero
             # must read 0, not freeze at their last value)
             inflight = ctx.tenant_admission.snapshot()["inflight"]

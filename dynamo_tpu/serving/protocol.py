@@ -558,5 +558,9 @@ def usage_chunk(
 
 
 def map_finish_reason(reason: Optional[str]) -> str:
+    # integrity_fault (watchdog sentinel tripped on this stream's device
+    # output) surfaces as "error": the content is not trustworthy and
+    # the client should retry — it must never look like a clean "stop"
     return {"stop": "stop", "length": "length", "abort": "stop",
-            "kv_oom": "length"}.get(reason or "stop", "stop")
+            "kv_oom": "length", "integrity_fault": "error",
+            }.get(reason or "stop", "stop")

@@ -51,6 +51,15 @@ class WorkerInfo:
         page_room = s.get("free_pages", total_pages) / total_pages
         return 0.5 * slot_room + 0.5 * page_room
 
+    @property
+    def health(self) -> str:
+        """Engine health from the heartbeat (robustness/watchdog.py state
+        machine). Workers predating the watchdog field count healthy."""
+        h = (self.stats or {}).get("health")
+        if isinstance(h, dict):
+            return h.get("state", "healthy")
+        return h if isinstance(h, str) else "healthy"
+
 
 def _pick_native(affinity_key: str, cands: List["WorkerInfo"]
                  ) -> Optional["WorkerInfo"]:
@@ -408,6 +417,17 @@ class Router:
             if skipped:
                 explain["breaker_skipped"] = skipped
             cands = allowed
+        if cands:
+            # engine watchdog: suspect/resurrecting/quarantined workers
+            # advertise their health in heartbeats and leave the candidate
+            # set — the proactive twin of their own 503 shed gate. A
+            # quarantined worker would 503 every request anyway; skipping
+            # it here saves the failover round trip.
+            well = [w for w in cands if w.health == "healthy"]
+            skipped = len(cands) - len(well)
+            if skipped:
+                explain["health_skipped"] = skipped
+            cands = well
         if adapter and cands:
             explain["adapter"] = adapter
             resident = [w for w in cands

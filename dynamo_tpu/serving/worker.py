@@ -130,6 +130,10 @@ def heartbeat_loop(ctx: ServingContext, frontend_url: str, self_url: str,
                 # step-timeline bubble summary rides the same beat: the
                 # frontend's /debug/timeline merges these fleet-wide
                 "timeline": eng.timeline.summary(),
+                # engine health (robustness/watchdog.py): the router
+                # stops picking suspect/resurrecting/quarantined workers
+                # and the planner excludes quarantined capacity
+                "health": eng.watchdog.summary(),
             },
         }).encode()
         for payload_url in payload_urls:

@@ -391,6 +391,40 @@ def test_crash_mid_decode_truncates_stream(stack):
     assert not wctx.engine.pending
 
 
+def test_engine_fault_points_fire_without_false_positives(stack):
+    """The engine-seam fault points (docs/robustness.md "Engine watchdog
+    & quarantine") fire inside the real dispatch/readback seams. The
+    heavy trip -> resurrection -> quarantine drills live in
+    tests/test_watchdog.py; this drill keeps the suite-wide coverage
+    invariant honest AND pins the no-false-positive side: sub-deadline
+    slowness must not trip the watchdog."""
+    plane, wctx = stack["plane"], stack["wctx"]
+    register(stack)
+    plane.configure({
+        "engine.device_hang": {"times": 1, "delay_s": 0.01},
+        "engine.device_slow": {"times": 1, "delay_s": 0.01},
+    })
+    out = post(stack["frontend"], "/v1/chat/completions",
+               chat_body("sub-deadline slowness", max_tokens=4))
+    assert out["choices"][0]["finish_reason"] == "length"
+    assert wctx.engine.watchdog.health == "healthy", \
+        "sub-deadline slowness must not trip the watchdog"
+    # NaN sentinel: exactly the poisoned stream aborts, typed "error"
+    plane.configure({"engine.device_nan": {"times": 1}})
+    out = post(stack["frontend"], "/v1/chat/completions",
+               chat_body("poison me", max_tokens=4))
+    plane.clear()
+    assert out["choices"][0]["finish_reason"] == "error"
+    assert wctx.engine.watchdog.summary()[
+        "integrity_faults_total"].get("logits", 0) >= 1
+    assert wctx.engine.watchdog.health == "healthy", \
+        "an integrity fault aborts the stream, never the engine"
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and wctx.engine.num_active:
+        time.sleep(0.05)
+    assert wctx.engine.num_active == 0
+
+
 def test_reset_after_headers_is_terminal(stack):
     """Reset AFTER response headers: the request provably reached the
     worker, so the frontend answers 502 and must NOT re-dispatch."""

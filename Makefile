@@ -35,7 +35,7 @@ help:
 	@echo "  planner-check  coordinated autoscaling suite (pool planner, flash-crowd simulation, drain-before-shrink)"
 	@echo "  rpa-check      unified ragged-step suite (kernel parity, mixed/classic identity, bench contract)"
 	@echo "  ha-check       HA frontend plane suite (replicated journal, cross-frontend resume, fleet QoS)"
-	@echo "  spec-check     speculative decoding v2 suite (ragged-verify identity, LoRA/sampling/QoS composition)"
+	@echo "  spec-check     speculative decoding suite (v2 ragged-verify identity + v3 draft-model/adaptive-K)"
 	@echo "  batch-check    preemptible batch tier suite (class-wide QoS eviction, spot reclamation, trough sizing)"
 	@echo "  rollout-check  hitless weight rollout suite (stage/flip/rollback, version namespaces, burn-gated fleet flips)"
 	@echo "  watchdog-check engine watchdog & quarantine suite (hung-dispatch trips, NaN/SDC sentinels, resurrection)"
@@ -177,14 +177,15 @@ ha-check:
 	JAX_PLATFORMS=cpu DYNAMO_TPU_FAULT_SEED=20260804 \
 		python -m pytest tests/test_ha.py tests/test_chaos.py -m ha -q -p no:randomly
 
-# Speculative decoding v2 gate (docs/perf.md "Speculative decoding v2"):
-# the `spec` marker suite — greedy AND seeded-sampled byte-identity spec
-# on/off, the jitted mixed-ragged + LoRA composition acceptance tests
-# (slow-marked, so tier-1 stays light; the direct file invocation here
-# runs them), recovery-mid-speculation chain resume, and the
-# QoS-debits-accepted-only accounting check.
+# Speculative decoding gate (docs/perf.md "Speculative decoding v2" +
+# "Speculation v3"): the `spec` marker suite — greedy AND seeded-sampled
+# byte-identity spec on/off for BOTH drafters, the jitted mixed-ragged +
+# LoRA composition acceptance tests (slow-marked, so tier-1 stays light;
+# the direct file invocation here runs them), recovery-mid-speculation
+# chain resume, QoS-debits-accepted-only accounting, and the v3 planes:
+# draft-KV partition exactness/LRU shedding, rollback, adaptive-K.
 spec-check:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q -p no:randomly
+	JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py tests/test_speculation_v3.py -q -p no:randomly
 
 # Preemptible-batch-tier gate (docs/robustness.md "Preemptible batch
 # tier"): the `batch` marker suite — class spec + penalty-constant

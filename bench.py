@@ -683,30 +683,42 @@ def bench_prefill_interference(on_tpu: bool) -> dict:
 
 
 def bench_speculative_agentic(on_tpu: bool) -> dict:
-    """Speculative decoding v2 A/B (docs/perf.md "Speculative decoding
-    v2"): per-token ITL for agentic/tool-loop streams — prompts built from
-    a repeated tool-call template, the workload n-gram drafts feed on —
-    with speculation on vs off at the SAME mixed-batch budget, so the A/B
-    isolates the verify windows, not scheduling. Long prompts arrive
-    mid-run in both arms: with spec on, the speculating slots ride the
-    unified ragged mixed step as K+1-wide rows next to the prefill chunks
-    (the composition this scenario exists to exercise). A first untimed
-    pass of the identical traffic shape compiles every program the timed
-    section hits.
+    """Speculation three-arm A/B (docs/perf.md "Speculation v3"): per-token
+    ITL for agentic/tool-loop streams with speculation OFF vs the N-GRAM
+    drafter vs the MODEL drafter, all at the SAME mixed-batch budget, so
+    the arms isolate the proposer, not scheduling. Prompts are a repeated
+    tool-call template — the history self-similarity n-gram drafting feeds
+    on — so the model arm's edge shows up where prompt-lookup misses
+    (window boundaries, prompt-to-output transitions, non-repeating
+    spans). Long prompts arrive mid-run in every arm: with spec on, the
+    speculating slots ride the unified ragged mixed step as K+1-wide rows
+    next to the prefill chunks (the composition this scenario exists to
+    exercise). A first untimed pass of the identical traffic shape
+    compiles every program the timed section hits.
+
+    The model arm defaults to SELF-drafting (the draft model is the
+    target model sharing the target's weights): on the CPU gate that is
+    the only same-tokenizer pair available, and it measures the plumbing
+    cost at the acceptance CEILING a perfectly-matched draft model would
+    reach. Set BENCH_SPEC_DRAFT_MODEL to a real smaller same-tokenizer
+    model on TPU to measure a production pair.
 
     Reports both latency sources side by side — the engine's decode_step
     histogram (per STEP: a verify step that lands n tokens still books one
     step) and bench-layer wall-clock per-TOKEN ITL (step gap divided by
-    live tokens emitted, the number a client actually sees) — plus the
-    live acceptance stats the speedup is a function of. Deterministic:
-    greedy, fixed prompts, single-threaded step loop.
+    live tokens emitted, the number a client actually sees) — plus each
+    arm's acceptance-length histogram (the `drafter`-labeled
+    dynamo_engine_spec_accept_length series) and the ngram->model mean
+    shift the drafter comparison reads. Deterministic: greedy, fixed
+    prompts, single-threaded step loop.
 
     Env: BENCH_SPEC_STREAMS (live decode streams, default 3),
     BENCH_SPEC_TOKENS (decode tokens per stream, default 64),
     BENCH_SPEC_K (draft tokens per window, default 4), BENCH_SPEC_BUDGET
     (mixed/chunk token budget, default 64), BENCH_SPEC_PROMPTS
     (interfering long prompts, default 2), BENCH_SPEC_PROMPT_TOKENS
-    (default 128)."""
+    (default 128), BENCH_SPEC_DRAFT_MODEL (model arm's draft model,
+    default = the target model, self-drafting)."""
     import time as _time
 
     from dynamo_tpu.engine.config import EngineConfig
@@ -715,6 +727,7 @@ def bench_speculative_agentic(on_tpu: bool) -> dict:
 
     model = os.environ.get("BENCH_MODEL",
                            "llama-3.2-1b-instruct" if on_tpu else "tiny-debug")
+    draft_model = os.environ.get("BENCH_SPEC_DRAFT_MODEL", model)
     streams = int(os.environ.get("BENCH_SPEC_STREAMS", "3"))
     steps = int(os.environ.get("BENCH_SPEC_TOKENS", "64"))
     k = int(os.environ.get("BENCH_SPEC_K", "4"))
@@ -734,14 +747,20 @@ def bench_speculative_agentic(on_tpu: bool) -> dict:
         block = [(i * 13 + t) % 97 + 1 for t in range(8)]
         return block * 6
 
-    def run(spec_on: bool, params=None):
+    def run(arm: str, params=None):
         eng = Engine(EngineConfig(
             model=model, page_size=16, num_pages=512,
             max_num_seqs=streams + 1, max_seq_len=plen + steps + 96,
             seed=7, enable_prefix_caching=False,
             prefill_chunk_tokens=budget, mixed_batch_tokens=budget,
-            speculative_mode="ngram" if spec_on else "off",
+            speculative_mode="off" if arm == "off" else arm,
+            draft_model=draft_model if arm == "model" else None,
             num_speculative_tokens=k), params=params)
+        if arm == "model" and draft_model == model:
+            # self-drafting: share the target's weights so the draft
+            # chain IS the target chain (the acceptance ceiling); the
+            # separately-initialized draft params are dropped
+            eng.draft.params = eng.params
 
         def drive(tag):
             itl = []
@@ -775,7 +794,8 @@ def bench_speculative_agentic(on_tpu: bool) -> dict:
         eng.reset_metrics()
         itl = drive("timed")
         ph = eng.metrics.phases["decode_step"]
-        snap = eng.metrics.snapshot()
+        m = eng.metrics
+        snap = m.snapshot()
         res = {
             "engine": {
                 "source": "engine_histogram",
@@ -788,40 +808,80 @@ def bench_speculative_agentic(on_tpu: bool) -> dict:
                 "itl_p95_ms": round(1e3 * pctl(itl, 0.95), 3),
                 "itl_mean_ms": round(
                     1e3 * sum(itl) / max(len(itl), 1), 3),
+                # unrounded mean for the speedup ratios (the rounded
+                # display value can hit 0.000 on sub-us CPU steps)
+                "_itl_mean_raw": 1e3 * sum(itl) / max(len(itl), 1),
             },
             "decode_steps": eng.metrics.decode_steps,
             "output_tokens": eng.metrics.output_tokens,
         }
-        if spec_on:
+        if arm != "off":
+            # the drafter-labeled acceptance-length histogram, verbatim
+            # from the series dynamo_engine_spec_accept_length{drafter}
+            # exposes — the right-shift between the ngram and model arms
+            # is the drafter comparison's acceptance evidence
+            buckets = m.spec_hist_by.get(arm, [])
             res["spec"] = {
+                "drafter": arm,
                 "draft_tokens": snap["spec_draft_tokens"],
                 "accepted_tokens": snap["spec_accepted_tokens"],
+                "acceptance_rate": (
+                    round(snap["spec_accepted_tokens"]
+                          / snap["spec_draft_tokens"], 4)
+                    if snap["spec_draft_tokens"] else 0.0),
                 "accept_len_mean": snap["spec_accept_mean"],
+                "accept_len_hist": {
+                    "edges": list(m._SPEC_EDGES),
+                    "counts": list(buckets),
+                },
             }
+            if eng.draft is not None:
+                ds = eng.draft.stats()
+                res["spec"]["draft_engine"] = {
+                    key: ds[key] for key in
+                    ("num_pages", "draft_steps", "catchup_tokens",
+                     "rollbacks", "evictions")}
         return res, eng.params
 
-    on_res, params = run(True)
-    off_res, _ = run(False, params=params)
+    ngram_res, params = run("ngram")
+    model_res, _ = run("model", params=params)
+    off_res, _ = run("off", params=params)
+    shift = round(model_res["spec"]["accept_len_mean"]
+                  - ngram_res["spec"]["accept_len_mean"], 4)
+    speedup_ngram = round(
+        off_res["measured"]["_itl_mean_raw"]
+        / max(ngram_res["measured"]["_itl_mean_raw"], 1e-9), 3)
+    speedup_model = round(
+        off_res["measured"]["_itl_mean_raw"]
+        / max(model_res["measured"]["_itl_mean_raw"], 1e-9), 3)
+    for r in (off_res, ngram_res, model_res):
+        del r["measured"]["_itl_mean_raw"]
     return {
         "metric": "speculative_agentic_itl_mean",
-        # headline uses the wall-clock per-token source: the engine
-        # histogram books one entry per STEP and so cannot see the
-        # multi-token windows the speedup comes from
-        "value": on_res["measured"]["itl_mean_ms"],
+        # headline uses the wall-clock per-token source of the MODEL arm:
+        # the engine histogram books one entry per STEP and so cannot see
+        # the multi-token windows the speedup comes from
+        "value": model_res["measured"]["itl_mean_ms"],
         "unit": "ms",
         "scenario": "speculative_agentic",
         "model": model,
+        "draft_model": draft_model,
         "live_streams": streams,
         "decode_tokens": steps,
         "num_speculative_tokens": k,
         "mixed_budget_tokens": budget,
-        "spec_on": on_res,
         "spec_off": off_res,
-        "itl_speedup": round(
-            off_res["measured"]["itl_mean_ms"]
-            / max(on_res["measured"]["itl_mean_ms"], 1e-9), 3),
+        "spec_ngram": ngram_res,
+        "spec_model": model_res,
+        # ngram -> model right-shift of the acceptance-length histogram
+        # mean (positive = the draft model lands longer windows than
+        # prompt-lookup on the same traffic at the same budget)
+        "accept_len_shift": shift,
+        "itl_speedup_ngram": speedup_ngram,
+        "itl_speedup_model": speedup_model,
         # CPU-fallback latency is never comparable to the TPU north star
-        # (standing ROADMAP constraint)
+        # (standing ROADMAP constraint); on CPU the model arm's
+        # draft-forward cost also runs on the wrong silicon
         "comparable": bool(on_tpu),
     }
 

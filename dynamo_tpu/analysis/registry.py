@@ -178,6 +178,20 @@ KNOWN_ENV: Dict[str, str] = {
         "(observability/slo.py target_from_dict)",
     "DYNAMO_TPU_SLO_TTFT_MS":
         "scalar SLO shorthand: time-to-first-token target (ms)",
+    "DYNAMO_TPU_SPEC_ADAPTIVE_K":
+        "speculation v3: enable the per-slot adaptive window controller "
+        "(shrink on zero-accept windows, grow on full-accept streaks)",
+    "DYNAMO_TPU_SPEC_DRAFTER":
+        "speculation v3: proposer selection — ngram (prompt lookup) | "
+        "model (the draft model below)",
+    "DYNAMO_TPU_SPEC_DRAFT_MODEL":
+        "speculation v3: small same-tokenizer draft model name for the "
+        "model drafter",
+    "DYNAMO_TPU_SPEC_DRAFT_MODEL_PATH":
+        "speculation v3: local checkpoint dir for the draft model",
+    "DYNAMO_TPU_SPEC_DRAFT_PAGES":
+        "speculation v3: draft KV pool size in pages (0 = auto: "
+        "max(K+2, num_pages/8); engine init enforces >= K+1)",
     "DYNAMO_TPU_SP_STRATEGY":
         "sequence-parallel strategy override for long-context prefill",
     "DYNAMO_TPU_STEP_DEADLINE_S":
@@ -252,6 +266,15 @@ MANIFEST_KEYS: Dict[str, Tuple[Tuple[str, ...], str]] = {
                    "envs; list of specs -> the JSON env"),
     "tenants": (("DYNAMO_TPU_TENANTS",),
                 "tenant QoS classes, identical on frontend and workers"),
+    "drafter": (("DYNAMO_TPU_SPEC_DRAFTER",),
+                "speculative proposer the worker boots with: ngram | "
+                "model"),
+    "draftModel": (("DYNAMO_TPU_SPEC_DRAFT_MODEL",
+                    "DYNAMO_TPU_SPEC_DRAFT_MODEL_PATH",
+                    "DYNAMO_TPU_SPEC_DRAFT_PAGES"),
+                   "draft model for the model drafter: a name string, or "
+                   "{model, path, pages} to also pin the checkpoint dir "
+                   "and draft KV pool size"),
     "modelVersion": (("DYNAMO_TPU_MODEL_VERSION",),
                      "target weight version: fresh pods boot on it; the "
                      "controller's rollout_tick flips the running fleet "

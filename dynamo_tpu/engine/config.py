@@ -182,6 +182,29 @@ class EngineConfig:
     # draft proposer: length of the history n-gram matched to find a
     # continuation to propose (engine init validates >= 1)
     ngram_lookup: int = 2
+    # Speculation v3 (dynamo_tpu.speculation, docs/perf.md "Speculation
+    # v3"): which proposer fills the verify window. "ngram" is the
+    # prompt-lookup drafter above; "model" runs a small same-tokenizer
+    # DRAFT MODEL (draft_model / draft_model_path) with its own paged KV
+    # pool — acceptance holds up on non-repetitive chat/agentic traffic
+    # where n-gram lookup finds nothing. `speculative_mode="model"` is
+    # accepted as shorthand for mode=on + drafter=model.
+    drafter: str = "ngram"
+    # the draft model (same tokenizer/vocab as the target — engine init
+    # verifies the tokenizer hash; a mismatched drafter can never verify)
+    draft_model: Optional[str] = None
+    draft_model_path: Optional[str] = None
+    # draft KV pool size in pages (page 0 reserved as trash, like the
+    # target pool). 0 = auto: max(K+2, num_pages // 8) — the draft model
+    # is far smaller per token, so an eighth of the target's page count
+    # costs well under an eighth of its HBM. Engine init validates the
+    # resolved size >= K+1 (one verify window plus the bonus position).
+    draft_num_pages: int = 0
+    # adaptive window control: adjust K per slot from live acceptance
+    # lengths (halve on zero-accept windows, grow after full-accept
+    # streaks, bounded 1 <= k <= K). Off by default: a fixed window keeps
+    # draft-vs-emitted accounting predictable for QoS/capacity tests.
+    spec_adaptive_k: bool = False
 
     # runtime
     # AOT warmup: precompile every prefill bucket + decode window before the
@@ -202,6 +225,12 @@ class EngineConfig:
     def max_pages_per_seq(self) -> int:
         return (self.max_seq_len + self.page_size - 1) // self.page_size
 
+    def resolved_draft_pages(self) -> int:
+        """Draft KV pool size with the auto default applied."""
+        if self.draft_num_pages > 0:
+            return self.draft_num_pages
+        return max(self.num_speculative_tokens + 2, self.num_pages // 8)
+
     @staticmethod
     def add_cli_args(p: argparse.ArgumentParser) -> argparse.ArgumentParser:
         p.add_argument("--model", default="tiny-debug")
@@ -219,17 +248,56 @@ class EngineConfig:
                        dest="sp")
         p.add_argument("--moe-capacity-factor", type=float, default=0.0)
         p.add_argument("--num-scheduler-steps", type=int, default=1)
+        import os as _os
+
         p.add_argument("--speculative-mode", default="off",
-                       choices=["off", "ngram"],
-                       help="prompt-lookup speculative decoding (v2: "
-                            "composes with the mixed ragged step, LoRA, "
-                            "and seeded sampling; docs/perf.md)")
+                       choices=["off", "ngram", "model"],
+                       help="speculative decoding (v2 semantics: composes "
+                            "with the mixed ragged step, LoRA, and seeded "
+                            "sampling; docs/perf.md). 'model' is shorthand "
+                            "for on + --drafter model")
         p.add_argument("--num-speculative-tokens", type=int, default=4,
                        help="drafts per verify window (K); engine init "
                             "enforces 1 <= K < --page-size")
         p.add_argument("--ngram-lookup", type=int, default=2,
-                       help="history n-gram length the draft proposer "
-                            "matches (>= 1)")
+                       help="history n-gram length the n-gram draft "
+                            "proposer matches (>= 1)")
+        # Speculation v3 (operator materializes the drafter/draftModel
+        # manifest keys into the DYNAMO_TPU_SPEC_* envs)
+        p.add_argument("--drafter",
+                       default=_os.environ.get(
+                           "DYNAMO_TPU_SPEC_DRAFTER", "ngram") or "ngram",
+                       choices=["ngram", "model"],
+                       help="speculative proposer: 'ngram' drafts from each "
+                            "sequence's own history (free, but only "
+                            "repetitive traffic accepts); 'model' runs "
+                            "--draft-model with its own small paged KV pool "
+                            "(acceptance holds on non-repetitive traffic)")
+        p.add_argument("--draft-model",
+                       default=_os.environ.get("DYNAMO_TPU_SPEC_DRAFT_MODEL"),
+                       help="small SAME-TOKENIZER draft model for --drafter "
+                            "model (e.g. a 1B drafting for an 8B target); "
+                            "engine init verifies the tokenizer hash vs the "
+                            "target — mismatched drafts can never verify")
+        p.add_argument("--draft-model-path",
+                       default=_os.environ.get(
+                           "DYNAMO_TPU_SPEC_DRAFT_MODEL_PATH"),
+                       help="local checkpoint dir for the draft model")
+        p.add_argument("--draft-num-pages", type=int,
+                       default=int(_os.environ.get(
+                           "DYNAMO_TPU_SPEC_DRAFT_PAGES", "0") or 0),
+                       help="draft KV pool pages (0 = auto: max(K+2, "
+                            "num_pages/8)); engine init enforces >= K+1 so "
+                            "one verify window always fits before the LRU "
+                            "arm can shed other slots")
+        p.add_argument("--spec-adaptive-k",
+                       action=argparse.BooleanOptionalAction,
+                       default=(_os.environ.get(
+                           "DYNAMO_TPU_SPEC_ADAPTIVE_K", "") or ""
+                           ).lower() in ("1", "true", "on"),
+                       help="adapt the speculative window per slot from "
+                            "live acceptance lengths (halve on zero-accept, "
+                            "grow after full-accept streaks, 1 <= k <= K)")
         p.add_argument("--async-scheduling",
                        action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("--enable-prefix-caching",
@@ -239,8 +307,6 @@ class EngineConfig:
         p.add_argument("--max-prefill-batch", type=int, default=4)
         # KVBM host tier (deploy manifests size it via the
         # DYNAMO_TPU_KVBM_HOST_BLOCKS env the operator materializes)
-        import os as _os
-
         p.add_argument("--kvbm-host-blocks", type=int,
                        default=int(_os.environ.get(
                            "DYNAMO_TPU_KVBM_HOST_BLOCKS", "0") or 0))
@@ -324,6 +390,11 @@ class EngineConfig:
             speculative_mode=getattr(args, "speculative_mode", "off"),
             num_speculative_tokens=getattr(args, "num_speculative_tokens", 4),
             ngram_lookup=getattr(args, "ngram_lookup", 2),
+            drafter=getattr(args, "drafter", "ngram") or "ngram",
+            draft_model=getattr(args, "draft_model", None),
+            draft_model_path=getattr(args, "draft_model_path", None),
+            draft_num_pages=getattr(args, "draft_num_pages", 0),
+            spec_adaptive_k=getattr(args, "spec_adaptive_k", False),
             async_scheduling=getattr(args, "async_scheduling", True),
             enable_prefix_caching=getattr(args, "enable_prefix_caching",
                                           True),

@@ -184,6 +184,15 @@ class EngineMetrics:
         self.spec_accept_buckets = [0] * (len(self._SPEC_EDGES) + 1)
         self.spec_accept_sum = 0
         self.spec_accept_count = 0
+        # Speculation v3: the same spec series split per drafter (ngram |
+        # model) — the exposition bridge serves these as the `drafter`
+        # label on the spec counters/histogram so n-gram vs model
+        # acceptance is separable on one scrape
+        self.spec_draft_by: Dict[str, int] = {}
+        self.spec_accepted_by: Dict[str, int] = {}
+        self.spec_hist_by: Dict[str, List[int]] = {}
+        self.spec_sum_by: Dict[str, int] = {}
+        self.spec_count_by: Dict[str, int] = {}
         self.occupancy_buckets = [0] * (len(self._OCC_EDGES) + 1)
         self.occupancy_sum = 0.0
         self.occupancy_count = 0
@@ -214,17 +223,41 @@ class EngineMetrics:
         self.occupancy_sum += frac
         self.occupancy_count += 1
 
-    def observe_spec_accept(self, n_acc: int) -> None:
+    def observe_spec_accept(self, n_acc: int,
+                            drafter: Optional[str] = None) -> None:
         """One speculating slot's accepted-draft count for one verify step
-        (same cumulative-bucket scheme as occupancy)."""
-        for i, edge in enumerate(self._SPEC_EDGES):
-            if n_acc <= edge:
-                self.spec_accept_buckets[i] += 1
-                break
-        else:
-            self.spec_accept_buckets[-1] += 1
+        (same cumulative-bucket scheme as occupancy). `drafter` also files
+        the observation under that proposer's labeled series."""
+        self._bucketize(self.spec_accept_buckets, n_acc)
         self.spec_accept_sum += n_acc
         self.spec_accept_count += 1
+        if drafter is not None:
+            hist = self.spec_hist_by.setdefault(
+                drafter, [0] * (len(self._SPEC_EDGES) + 1))
+            self._bucketize(hist, n_acc)
+            self.spec_sum_by[drafter] = (
+                self.spec_sum_by.get(drafter, 0) + n_acc)
+            self.spec_count_by[drafter] = (
+                self.spec_count_by.get(drafter, 0) + 1)
+
+    def _bucketize(self, buckets: List[int], n: int) -> None:
+        for i, edge in enumerate(self._SPEC_EDGES):
+            if n <= edge:
+                buckets[i] += 1
+                break
+        else:
+            buckets[-1] += 1
+
+    def add_spec_tokens(self, drafted: int, accepted: int,
+                        drafter: Optional[str] = None) -> None:
+        """One verify dispatch's draft/accept token totals."""
+        self.spec_draft_tokens += drafted
+        self.spec_accepted_tokens += accepted
+        if drafter is not None:
+            self.spec_draft_by[drafter] = (
+                self.spec_draft_by.get(drafter, 0) + drafted)
+            self.spec_accepted_by[drafter] = (
+                self.spec_accepted_by.get(drafter, 0) + accepted)
 
     def observe_mixed(self, prefill_tokens: int, decode_rows: int) -> None:
         """One unified ragged step's composition: prefill-token fraction
@@ -249,11 +282,28 @@ class EngineMetrics:
     def snapshot(self) -> Dict[str, float]:
         out = {k: v for k, v in self.__dict__.items()
                if k not in ("phases", "occupancy_buckets", "mixed_buckets",
-                            "spec_accept_buckets")}
+                            "spec_accept_buckets", "spec_draft_by",
+                            "spec_accepted_by", "spec_hist_by",
+                            "spec_sum_by", "spec_count_by")}
         out["phases"] = {p: t.snapshot() for p, t in self.phases.items()}
         out["spec_accept_mean"] = (
             round(self.spec_accept_sum / self.spec_accept_count, 4)
             if self.spec_accept_count else 0.0)
+        out["spec_by_drafter"] = {
+            d: {
+                "draft_tokens": self.spec_draft_by.get(d, 0),
+                "accepted_tokens": self.spec_accepted_by.get(d, 0),
+                "acceptance_rate": (
+                    round(self.spec_accepted_by.get(d, 0)
+                          / self.spec_draft_by[d], 4)
+                    if self.spec_draft_by.get(d) else 0.0),
+                "accept_mean": (
+                    round(self.spec_sum_by.get(d, 0)
+                          / self.spec_count_by[d], 4)
+                    if self.spec_count_by.get(d) else 0.0),
+            }
+            for d in sorted(set(self.spec_draft_by)
+                            | set(self.spec_count_by))}
         out["occupancy_mean"] = (
             round(self.occupancy_sum / self.occupancy_count, 4)
             if self.occupancy_count else 0.0)
@@ -317,6 +367,17 @@ class Engine:
             if cfg.ngram_lookup < 1:
                 raise ValueError(
                     f"--ngram-lookup must be >= 1 (got {cfg.ngram_lookup})")
+            if cfg.drafter not in ("ngram", "model"):
+                raise ValueError(
+                    f"--drafter must be 'ngram' or 'model' (got "
+                    f"{cfg.drafter!r})")
+            if ("model" in (cfg.speculative_mode, cfg.drafter)
+                    and cfg.resolved_draft_pages() < k + 1):
+                raise ValueError(
+                    f"--draft-num-pages ({cfg.resolved_draft_pages()}) must "
+                    f"be >= K+1 ({k + 1}): one verify window drafts K "
+                    f"tokens plus the bonus position and must fit the "
+                    f"draft pool even before its LRU arm can shed slots")
         backend = jax.default_backend()
         default_dtype = "float32" if backend == "cpu" else "bfloat16"
         if model_cfg is None:
@@ -545,6 +606,28 @@ class Engine:
         # pallas/spec demotion counts already seen (per-step delta -> ring)
         self._flight_fallback_prev: Dict[tuple, int] = dict(
             att_ops.pallas_fallback_counts())
+
+        # --- Speculation v3 (dynamo_tpu.speculation) ---
+        # drafter_name labels every spec metric sample; the model drafter
+        # runs a real second model over its own paged KV pool and the
+        # adaptive controller resizes the per-slot window from live
+        # acceptance lengths. Proposals feed the SAME verify path either
+        # way — what proposes never changes what streams.
+        self.drafter_name: Optional[str] = None
+        self.draft = None
+        self._adaptive = None
+        if cfg.speculative_mode != "off":
+            self.drafter_name = ("model" if "model" in (cfg.speculative_mode,
+                                                        cfg.drafter)
+                                 else "ngram")
+            if self.drafter_name == "model":
+                from dynamo_tpu.speculation import DraftEngine
+
+                self.draft = DraftEngine(self)
+            if cfg.spec_adaptive_k:
+                from dynamo_tpu.speculation import AdaptiveK
+
+                self._adaptive = AdaptiveK(cfg.num_speculative_tokens)
 
         # --- batch slots (host-side mirrors of device batch state) ---
         b, pmax = cfg.max_num_seqs, cfg.max_pages_per_seq
@@ -2791,7 +2874,7 @@ class Engine:
             # its reserved pages — advance it on the classic path
             events.extend(self._advance_chunk())
             return events
-        drafts, room = self._spec_drafts(got)
+        drafts, room, nreal = self._spec_drafts(got)
         c = cfg.mixed_batch_tokens
         start = inf.done
         take = min(c, inf.prompt_len - start)
@@ -2826,11 +2909,7 @@ class Engine:
         total = sum(int(nacc_np[s]) + 1 for s in slots)
         self.metrics.decode_steps += 1
         self.metrics.decode_time_s += dt
-        self.metrics.spec_draft_tokens += int(room[slots].sum()) * k
-        self.metrics.spec_accepted_tokens += int(nacc_np[slots].sum())
-        for s in slots:
-            if room[s]:
-                self.metrics.observe_spec_accept(int(nacc_np[s]))
+        self._spec_feedback(slots, room, nreal, nacc_np)
         self.metrics.observe_phase("mixed_step", dt)
         self.metrics.observe_phase("decode_window", dt)
         self.metrics.observe_occupancy(len(slots), cfg.max_num_seqs)
@@ -3104,13 +3183,23 @@ class Engine:
         return False
 
     def _spec_drafts(self, got: int):
-        """Host-side draft gate for one verify step: n-gram proposals for
-        every slot whose acceptance can be nonzero. Sampled and LoRA slots
-        draft (acceptance replays the per-position sampling chain);
-        penalized slots don't — their counts snapshot would go stale
-        mid-window — and neither do slots whose pages/limits can't cover
-        K+1 tokens ahead. Per-slot demotions are counted (reason-keyed,
-        one-shot-logged) instead of silently drafting nothing."""
+        """Host-side draft gate for one verify step: proposals (n-gram or
+        draft-model, per the drafter knob) for every slot whose acceptance
+        can be nonzero. Sampled and LoRA slots draft (acceptance replays
+        the per-position sampling chain; LoRA slots draft BASE logits —
+        the verify forward applies the adapter); penalized slots don't —
+        their counts snapshot would go stale mid-window — and neither do
+        slots whose pages/limits can't cover K+1 tokens ahead, nor slots
+        the draft pool can't serve this window. Per-slot demotions are
+        counted (reason-keyed, one-shot-logged) instead of silently
+        drafting nothing.
+
+        Returns (drafts [B, K], room [B], nreal [B]): `nreal` is how many
+        REAL tokens the drafter proposed per slot (< K when adaptive-K
+        shrank the window; the row is padded to the program's fixed K by
+        repeating the last real draft — padding that happens to verify is
+        still correct output, but only real drafts and real-draft
+        acceptances feed the metrics/controller)."""
         cfg = self.cfg
         k = cfg.num_speculative_tokens
         k1 = k + 1
@@ -3118,6 +3207,7 @@ class Engine:
                     cfg.max_pages_per_seq * cfg.page_size)
         drafts = np.zeros((cfg.max_num_seqs, k), np.int32)
         room = np.zeros((cfg.max_num_seqs,), np.bool_)
+        nreal = np.zeros((cfg.max_num_seqs,), np.int32)
         for slot, seq in self.seqs.items():
             if (self.presence[slot] != 0.0
                     or self.frequency[slot] != 0.0):
@@ -3134,9 +3224,50 @@ class Engine:
                     "pool/table/length limits can't cover K+1 tokens "
                     "ahead")
                 continue
+            k_s = (self._adaptive.k(slot) if self._adaptive is not None
+                   else k)
+            if self.draft is not None:
+                prop = self.draft.propose(seq, k_s)
+                if prop is None:
+                    att_ops._note_fallback(
+                        "spec", "draft_pool",
+                        "draft KV pool can't cover the window even after "
+                        "LRU shedding; the slot emits one token per "
+                        "verify step")
+                    continue
+            else:
+                prop = self._propose_ngram(seq)[:k_s]
             room[slot] = True
-            drafts[slot] = self._propose_ngram(seq)
-        return drafts, room
+            nreal[slot] = len(prop)
+            drafts[slot] = (prop + [prop[-1]] * k)[:k]
+        return drafts, room, nreal
+
+    def _spec_feedback(self, slots, room, nreal, nacc_np) -> None:
+        """Post-verify bookkeeping shared by _decode_spec and
+        _mixed_spec_step: drafter-labeled draft/accept accounting,
+        per-slot acceptance-length observations, adaptive-K controller
+        feedback, and the per-window flight record. Acceptances are
+        clamped to each slot's REAL draft count — padded row positions
+        that happen to verify are correct output but not drafter skill
+        (bit-identical to the old accounting when adaptive-K is off,
+        since nreal == K wherever room holds)."""
+        drafted = accepted = 0
+        for s in slots:
+            if not room[s]:
+                continue
+            n_real = int(nreal[s])
+            acc = min(int(nacc_np[s]), n_real)
+            drafted += n_real
+            accepted += acc
+            self.metrics.observe_spec_accept(acc, drafter=self.drafter_name)
+            if self._adaptive is not None:
+                self._adaptive.update(s, acc, n_real)
+        self.metrics.add_spec_tokens(drafted, accepted,
+                                     drafter=self.drafter_name)
+        if drafted:
+            self.flight.note("spec_verify", drafter=self.drafter_name,
+                             windows=int(room[slots].sum()),
+                             drafted=drafted, accepted=accepted)
 
     def _decode_spec(self) -> List[TokenEvent]:
         """Speculative decode step: one verify dispatch emits 1..K+1 tokens
@@ -3156,7 +3287,7 @@ class Engine:
             got = self._grow_pages(k1, events)
         if not self.seqs:
             return events
-        drafts, room = self._spec_drafts(got)
+        drafts, room, nreal = self._spec_drafts(got)
 
         if not room.any():
             # nothing drafted (all-penalized batch, page shortfall): the
@@ -3190,11 +3321,7 @@ class Engine:
         total = sum(int(nacc_np[s]) + 1 for s in slots)
         self.metrics.decode_steps += 1
         self.metrics.decode_time_s += dt
-        self.metrics.spec_draft_tokens += int(room[slots].sum()) * k
-        self.metrics.spec_accepted_tokens += int(nacc_np[slots].sum())
-        for s in slots:
-            if room[s]:
-                self.metrics.observe_spec_accept(int(nacc_np[s]))
+        self._spec_feedback(slots, room, nreal, nacc_np)
         self.metrics.observe_phase("decode_window", dt)
         self.metrics.observe_occupancy(len(slots), self.cfg.max_num_seqs)
         # weight = effective steps this verify advanced, so spec verifies
@@ -3480,6 +3607,14 @@ class Engine:
         self.bias_ids[slot] = -1
         self.bias_vals[slot] = 0.0
         self.adapter_slots[slot] = 0  # unpin the LoRA slot
+        # Speculation v3 teardown: the draft pool's pages for this slot and
+        # the adaptive controller's window both key on the DECODE SLOT, so
+        # every route out (finish / preempt / abort) must clear them before
+        # the slot's next tenant drafts
+        if self.draft is not None:
+            self.draft.release(slot)
+        if self._adaptive is not None:
+            self._adaptive.reset(slot)
         self._free_slots.append(slot)
         self.metrics.num_finished += 1
         # the freed slot's device-side block-table row must stop pointing at

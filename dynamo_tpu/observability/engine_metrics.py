@@ -22,12 +22,14 @@ second observation path:
   (docs/perf.md "Unified ragged step"; persistently high fractions mean
   --mixed-batch-tokens crowds decode, near-zero means the budget is
   slack);
-- `dynamo_engine_spec_draft_tokens_total` /
-  `dynamo_engine_spec_accepted_tokens_total` /
-  `dynamo_engine_spec_accept_length` — speculative decoding v2 health:
-  accepted/draft is the live acceptance rate, and the acceptance-length
-  histogram (0..K integer buckets) shows whether --num-speculative-tokens
-  is tuned to the workload (docs/perf.md "Speculative decoding v2");
+- `dynamo_engine_spec_draft_tokens_total{drafter}` /
+  `dynamo_engine_spec_accepted_tokens_total{drafter}` /
+  `dynamo_engine_spec_accept_length{drafter}` — speculative decoding
+  health, one series per drafter (ngram | model) so the proposers'
+  acceptance is separable on one scrape: accepted/draft is the live
+  acceptance rate, and the acceptance-length histogram (0..K integer
+  buckets) shows whether --num-speculative-tokens is tuned to the
+  workload (docs/perf.md "Speculative decoding v2" / "Speculation v3");
 - `dynamo_pallas_fallback_total{op,reason}` — Pallas→XLA demotions the
   head/lane gates (and int8 lane-blocking / seq-parallel mesh checks)
   made silently before; each label pair also logs one warning at first
@@ -161,18 +163,25 @@ def _mixed_series(engine):
 def _spec_series(engine):
     """Speculative acceptance length per verify window
     (EngineMetrics.observe_spec_accept): how many of the K drafted tokens
-    the target chain accepted, integer edges 0..K. Same cumulative-bucket
-    scheme as occupancy; mean acceptance = _sum / _count."""
+    the target chain accepted, integer edges 0..K, one labeled series per
+    drafter (ngram | model) so the n-gram vs draft-model histograms are
+    separable on one scrape. Same cumulative-bucket scheme as occupancy;
+    mean acceptance = _sum / _count. No observations yet -> no series (a
+    phantom unlabeled sample would break the drafter split)."""
     m = engine.metrics
     edges = list(m._SPEC_EDGES)
-    cum = []
-    running = 0
-    for c in m.spec_accept_buckets[:-1]:
-        running += c
-        cum.append(running)
-    total = running + m.spec_accept_buckets[-1]
-    cum.append(total)  # +Inf
-    return [({}, edges, cum, float(m.spec_accept_sum), total)]
+    out = []
+    for drafter, buckets in sorted(m.spec_hist_by.items()):
+        cum = []
+        running = 0
+        for c in buckets[:-1]:
+            running += c
+            cum.append(running)
+        total = running + buckets[-1]
+        cum.append(total)  # +Inf
+        out.append(({"drafter": drafter}, edges, cum,
+                    float(m.spec_sum_by.get(drafter, 0)), total))
+    return out
 
 
 def _fallback_counts():
@@ -234,18 +243,24 @@ class EngineMetricsBridge:
             registry, lambda: _mixed_series(self.engine))
         CallbackHistogram(
             "dynamo_engine_spec_accept_length",
-            "Accepted draft tokens per speculative verify window (0..K); "
-            "mean acceptance length = _sum / _count",
+            "Accepted draft tokens per speculative verify window (0..K), "
+            "per drafter (ngram | model); mean acceptance length = "
+            "_sum / _count",
             registry, lambda: _spec_series(self.engine))
-        CallbackCounter(
+        CallbackCounterVec(
             "dynamo_engine_spec_draft_tokens_total",
-            "Draft tokens proposed to speculative verify windows",
-            registry, lambda: self.engine.metrics.spec_draft_tokens)
-        CallbackCounter(
+            "Draft tokens proposed to speculative verify windows, per "
+            "drafter (ngram | model)",
+            registry, lambda: {(("drafter", d),): v for d, v in
+                               self.engine.metrics.spec_draft_by.items()},
+            labelnames=("drafter",))
+        CallbackCounterVec(
             "dynamo_engine_spec_accepted_tokens_total",
-            "Draft tokens the target chain accepted (acceptance rate = "
-            "accepted / draft)",
-            registry, lambda: self.engine.metrics.spec_accepted_tokens)
+            "Draft tokens the target chain accepted, per drafter "
+            "(acceptance rate = accepted / draft)",
+            registry, lambda: {(("drafter", d),): v for d, v in
+                               self.engine.metrics.spec_accepted_by.items()},
+            labelnames=("drafter",))
         CallbackCounterVec(
             "dynamo_pallas_fallback_total",
             "Pallas kernels demoted to the XLA path by the head/lane "

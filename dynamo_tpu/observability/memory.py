@@ -142,6 +142,14 @@ class MemoryAccountant:
         device_bytes["trash"] = pb  # page 0, never allocated
         tiers: Dict[str, Dict[str, int]] = {"device": device_bytes}
 
+        # Speculation v3: the draft model's KV pool is its own tier — a
+        # first-class tenant of the memory plane with the same exact-sum
+        # guarantee (the DraftEngine forces its partition the same way the
+        # device tier is forced above)
+        draft = getattr(eng, "draft", None)
+        if draft is not None:
+            tiers["draft"] = draft.partition_bytes()
+
         kvbm = getattr(eng, "kvbm", None)
         kvbm_stats = None
         if kvbm is not None:

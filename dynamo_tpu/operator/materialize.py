@@ -261,6 +261,31 @@ def _container(
         spec_env = lora_adapter_env(spec)
         for name, value in spec_env:
             env.append({"name": name, "value": value})
+        # Speculation v3 (dynamo_tpu.speculation): `drafter` picks the
+        # proposer the worker boots with, `draftModel` names the small
+        # same-tokenizer draft model for the model drafter — a bare name
+        # string, or a {model, path, pages} map to also pin the checkpoint
+        # dir and the draft KV pool size. The worker CLI reads these envs
+        # as its --drafter/--draft-model/--draft-model-path/
+        # --draft-num-pages defaults.
+        if spec.get("drafter"):
+            env.append({"name": "DYNAMO_TPU_SPEC_DRAFTER",
+                        "value": str(spec["drafter"])})
+        dm = spec.get("draftModel")
+        if dm:
+            if isinstance(dm, dict):
+                if dm.get("model"):
+                    env.append({"name": "DYNAMO_TPU_SPEC_DRAFT_MODEL",
+                                "value": str(dm["model"])})
+                if dm.get("path"):
+                    env.append({"name": "DYNAMO_TPU_SPEC_DRAFT_MODEL_PATH",
+                                "value": str(dm["path"])})
+                if dm.get("pages") is not None:
+                    env.append({"name": "DYNAMO_TPU_SPEC_DRAFT_PAGES",
+                                "value": str(int(dm["pages"]))})
+            else:
+                env.append({"name": "DYNAMO_TPU_SPEC_DRAFT_MODEL",
+                            "value": str(dm)})
     for e in spec.get("envs") or []:
         env.append(dict(e))
     c["env"] = env

@@ -1215,6 +1215,7 @@ class _Handler(JsonHTTPHandler):
                 m = eng.metrics
                 out["spec"] = {
                     "mode": eng.cfg.speculative_mode,
+                    "drafter": eng.drafter_name,
                     "num_speculative_tokens": eng.cfg.num_speculative_tokens,
                     "ngram_lookup": eng.cfg.ngram_lookup,
                     "draft_tokens": m.spec_draft_tokens,
@@ -1225,7 +1226,29 @@ class _Handler(JsonHTTPHandler):
                     "mean_accept_len": (
                         round(m.spec_accept_sum / m.spec_accept_count, 4)
                         if m.spec_accept_count else 0.0),
+                    # Speculation v3: per-drafter acceptance (the drafter
+                    # label of the dynamo_engine_spec_* series), the
+                    # draft engine's pool/rollback books, and the
+                    # adaptive-K controller's live per-slot windows
+                    "by_drafter": {
+                        d: {
+                            "draft_tokens": m.spec_draft_by.get(d, 0),
+                            "accepted_tokens": m.spec_accepted_by.get(d, 0),
+                            "acceptance_rate": (
+                                round(m.spec_accepted_by.get(d, 0)
+                                      / m.spec_draft_by[d], 4)
+                                if m.spec_draft_by.get(d) else 0.0),
+                        }
+                        for d in sorted(set(m.spec_draft_by)
+                                        | set(m.spec_count_by))},
                 }
+                if eng.draft is not None:
+                    out["spec"]["draft_engine"] = eng.draft.stats()
+                if eng._adaptive is not None:
+                    out["spec"]["adaptive_k"] = {
+                        "k_max": eng._adaptive.k_max,
+                        "slots": eng._adaptive.snapshot(),
+                    }
             # live elasticity: active/staged/previous weight versions and
             # the double-buffer bytes (what the rollout controller polls)
             out["weights"] = eng.weights.stats()

@@ -1,0 +1,38 @@
+"""Speculation v3: draft-model speculative decoding (docs/perf.md).
+
+PR 12 built the verify plane — `verify_accept` replays the per-slot PRNG
+chain so greedy AND seeded-sampled streams stay byte-identical with
+speculation on vs off, and the mixed ragged program carries K+1-wide
+verify rows next to prefill chunks. Its n-gram proposer, though, only
+drafts well on self-similar history; the production fix (RTP-LLM,
+PAPERS.md arxiv 2605.29639) is a small same-tokenizer DRAFT MODEL whose
+proposals feed that existing verify path unchanged.
+
+This package owns the draft side:
+
+- ``DraftEngine`` — runs the draft model with its own (much smaller)
+  paged KV pool, proposes K greedy tokens per verify window, and on
+  rejection rolls back to the last target-accepted token (re-prefilling
+  accepted-but-undrafted tokens) so draft and target never diverge. The
+  draft pool is a first-class memory-plane tenant: its partition rows
+  ride `dynamo_memory_kv_pool_bytes{tier="draft"}` (summing exactly to
+  the pool's capacity by construction) and pool pressure is resolved by
+  the pool's own LRU arm — the least-recently-drafting slot's pages are
+  shed to recompute (draft KV is derived state, always rebuildable from
+  the target's accepted history, so the arm demotes to *recompute*, not
+  to the host tier).
+- ``AdaptiveK`` — per-slot window controller fed by the live acceptance
+  lengths: shrink on thrash (zero-accept windows), grow on full-accept
+  streaks, bounded by ``1 <= k <= K < page_size``.
+
+The engine knob is ``drafter=ngram|model`` (``--drafter`` /
+``speculative_mode="model"`` shorthand); everything downstream of the
+proposal — acceptance, sampling-chain replay, LoRA verify, QoS banking
+(accepted tokens only), recovery checkpoints (accepted tokens only) —
+is shared with the n-gram drafter and unchanged.
+"""
+
+from dynamo_tpu.speculation.adaptive import AdaptiveK
+from dynamo_tpu.speculation.draft import DraftEngine, tokenizer_fingerprint
+
+__all__ = ["AdaptiveK", "DraftEngine", "tokenizer_fingerprint"]

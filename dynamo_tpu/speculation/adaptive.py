@@ -1,0 +1,75 @@
+"""Adaptive speculative-window control (Speculation v3, docs/perf.md).
+
+A fixed K is wrong in both directions: a non-repeating stream burns
+(K+1)x compute per emitted token at near-zero acceptance, while a
+high-acceptance stream (agentic tool loops, model drafter on in-domain
+traffic) leaves tokens on the table below the page-size ceiling. The
+controller adjusts the window per slot from the live acceptance lengths
+the verify step already produces — no extra observation path.
+
+The verify PROGRAM stays a fixed K+1-wide row (static shapes keep the
+compiled-program set bounded); a shrunken window simply drafts fewer
+real tokens and pads the row. Padding is correctness-free by
+construction — `verify_accept` only ever accepts tokens the sequential
+chain would emit — so adapting K changes draft-side work (the model
+drafter skips draft forwards), never output bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class AdaptiveK:
+    """Per-slot speculative window size, bounded ``1 <= k <= k_max``.
+
+    Policy (deliberately hysteretic — one good window must not undo a
+    thrash verdict, docs/perf.md "Adaptive-K tuning"):
+
+    - a zero-accept window HALVES the slot's k (thrash: every rejected
+      draft cost a draft forward and widened the verify row for nothing);
+    - `grow_streak` consecutive windows that accept the FULL current
+      window grow k by one (streak: the drafter is in-domain, a wider
+      window lands more tokens per dispatch);
+    - anything in between holds.
+    """
+
+    def __init__(self, k_max: int, grow_streak: int = 2):
+        if k_max < 1:
+            raise ValueError(f"k_max must be >= 1 (got {k_max})")
+        self.k_max = k_max
+        self.grow_streak = max(1, grow_streak)
+        self._k: Dict[int, int] = {}
+        self._streak: Dict[int, int] = {}
+
+    def k(self, slot: int) -> int:
+        """Current window for a slot (slots start at the full k_max —
+        the first windows measure the workload before shrinking)."""
+        return self._k.get(slot, self.k_max)
+
+    def update(self, slot: int, n_acc: int, k_used: int) -> None:
+        """Feed one verify window's outcome: `n_acc` accepted of the
+        `k_used` real drafts the slot proposed."""
+        k = self.k(slot)
+        if n_acc <= 0:
+            self._k[slot] = max(1, k // 2)
+            self._streak[slot] = 0
+        elif n_acc >= k_used:
+            streak = self._streak.get(slot, 0) + 1
+            if streak >= self.grow_streak and k < self.k_max:
+                self._k[slot] = k + 1
+                self._streak[slot] = 0
+            else:
+                self._streak[slot] = streak
+        else:
+            self._streak[slot] = 0
+
+    def reset(self, slot: int) -> None:
+        """Slot teardown (finish/preempt/abort): the next tenant of the
+        decode slot starts fresh at k_max."""
+        self._k.pop(slot, None)
+        self._streak.pop(slot, None)
+
+    def snapshot(self) -> Dict[int, int]:
+        """Per-slot windows for /worker/stats (only slots that moved)."""
+        return dict(self._k)

@@ -1,0 +1,330 @@
+"""DraftEngine: a small same-tokenizer model proposing speculative drafts.
+
+The draft side of Speculation v3 (docs/perf.md). A 1B-class model drafting
+for an 8B/70B target turns speculative decoding from a repetition trick
+(the n-gram proposer) into a general throughput lever: the draft model
+predicts the actual continuation, so acceptance lengths hold up on the
+non-repetitive chat/agentic traffic where prompt-lookup collapses.
+
+Design constraints, in order:
+
+1. **Proposals are just token ids.** The existing `spec_fn`/`mixed_spec_fn`
+   verify path consumes the draft row unchanged — acceptance still replays
+   the per-slot sampling chain, so byte-identical streams spec on/off stay
+   the invariant regardless of WHAT proposed the drafts (a garbage draft
+   costs acceptance, never correctness).
+2. **Draft and target never diverge.** The draft KV for a slot is valid
+   exactly for a prefix of `target history + this window's own drafts`.
+   After a rejection the target's accepted history disagrees with what
+   the drafter assumed; `propose()` rolls back to the longest common
+   prefix and re-feeds the accepted-but-undrafted suffix (including the
+   verify step's bonus token) before drafting again. Stale KV past the
+   rollback point is dead by construction: attention reads are bounded
+   by context length, and re-fed positions are overwritten before the
+   first read at their new context.
+3. **The draft pool is a real tenant, not a hidden allocation.** It has
+   its own `PageAllocator` (page 0 trash, same as the target pool), its
+   partition rows sum exactly to capacity in the memory plane
+   (`dynamo_memory_kv_pool_bytes{tier="draft"}`), and pressure resolves
+   through its own LRU arm: the least-recently-drafting slot's pages are
+   shed to *recompute* — draft KV is derived state, always rebuildable
+   from accepted history, so unlike target prefix pages it never demotes
+   to the host tier. Shed slots re-prefill on their next window
+   (flight event `spec_draft_evict`).
+
+Model mechanics: one B=1 `decode_step` program serves both catch-up and
+drafting — each call writes one KV position and returns next-token
+logits, so the whole draft plane compiles exactly one executable (no
+per-length prefill buckets). Greedy argmax drafts: the draft's job is to
+guess the target chain's most likely continuation; the verify side owns
+all sampling semantics. LoRA-adapter sequences draft BASE logits — the
+draft model has no adapter stacks, and a base-model draft is still a
+high-acceptance proposal for a lightly-shifted adapter chain (the verify
+forward applies the adapter; parity is its job, not the drafter's).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import logging
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.kv_cache import KVCacheSpec, PageAllocator, alloc_kv_pages
+from dynamo_tpu.engine.tokenizer import get_tokenizer
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.loader import load_or_init_params
+
+log = logging.getLogger("dynamo_tpu.speculation")
+
+
+def tokenizer_fingerprint(tok) -> str:
+    """Stable hash of the tokenizer identity the engine-init validation
+    compares: draft proposals are raw token ids fed straight into the
+    target's verify gather, so the two models must agree on the id space
+    — class, vocab size, and special ids (HF tokenizers from the same
+    family hash equal; a byte tokenizer never matches an HF one)."""
+    h = hashlib.sha256()
+    for part in (type(tok).__name__, tok.vocab_size,
+                 getattr(tok, "bos_token_id", None),
+                 getattr(tok, "eos_token_id", None)):
+        h.update(repr(part).encode())
+    return h.hexdigest()[:16]
+
+
+class DraftSlot:
+    """Draft-side state for one target decode slot."""
+
+    __slots__ = ("pages", "tokens", "done", "tick")
+
+    def __init__(self):
+        self.pages: List[int] = []  # draft-pool page ids
+        # tokens[i] is the token whose KV occupies draft position i, for
+        # i < done; beyond `done` the pool holds dead bytes
+        self.tokens: List[int] = []
+        self.done = 0
+        self.tick = 0  # LRU clock stamp (bumped every propose)
+
+
+class DraftEngine:
+    """Draft-model proposer over its own paged KV pool."""
+
+    def __init__(self, engine):
+        cfg = engine.cfg
+        self.eng = engine
+        self.k_max = cfg.num_speculative_tokens
+        self.page_size = cfg.page_size
+        name = cfg.draft_model or ""
+        if not name and not cfg.draft_model_path:
+            raise ValueError(
+                "--draft-model (or --draft-model-path) is required with "
+                "--drafter model: the model drafter runs a real second "
+                "model; name a small same-tokenizer one (e.g. a 1B "
+                "drafting for an 8B target)")
+        backend = jax.default_backend()
+        default_dtype = "float32" if backend == "cpu" else "bfloat16"
+        self.model_cfg = ModelConfig.from_model_name(
+            cfg.draft_model_path or name, dtype=cfg.dtype or default_dtype)
+        # config-shape gate: proposals index the TARGET's logit rows in
+        # verify, so the id spaces must be the same size — a larger draft
+        # vocab could propose ids the target gather reads out of bounds
+        if self.model_cfg.vocab_size != engine.model_cfg.vocab_size:
+            raise ValueError(
+                f"draft model {name!r} vocab_size "
+                f"({self.model_cfg.vocab_size}) != target "
+                f"({engine.model_cfg.vocab_size}): draft proposals are "
+                f"token ids fed straight to the target verify — the two "
+                f"models must share one token id space")
+        # tokenizer-hash gate: same reason, stronger evidence — matching
+        # vocab sizes with different tokenizers would still propose
+        # garbage ids (accepted never, compute burned always)
+        th = tokenizer_fingerprint(get_tokenizer(cfg.model, cfg.model_path))
+        dh = tokenizer_fingerprint(
+            get_tokenizer(name or cfg.model, cfg.draft_model_path))
+        if th != dh:
+            raise ValueError(
+                f"draft model {name!r} tokenizer hash ({dh}) != target's "
+                f"({th}): speculative drafts must come from the SAME "
+                f"tokenizer or no proposal can ever verify")
+        self.num_pages = cfg.resolved_draft_pages()
+        if self.num_pages < self.k_max + 1:
+            raise ValueError(
+                f"--draft-num-pages ({self.num_pages}) must be >= K+1 "
+                f"({self.k_max + 1}): one verify window drafts K tokens "
+                f"plus the bonus position, and the pool must hold that "
+                f"window even before the LRU arm can shed other slots")
+        self.spec = KVCacheSpec.from_model(
+            self.model_cfg, self.num_pages, cfg.page_size)
+        self.allocator = PageAllocator(self.num_pages)
+        self.k_pages, self.v_pages = alloc_kv_pages(self.spec)
+        self.params = load_or_init_params(
+            self.model_cfg, cfg.draft_model_path,
+            # a different seed than the target: two random-init models must
+            # not be bit-equal twins, or tests would pass on accidental
+            # self-agreement instead of real drafting
+            seed=cfg.seed + 1)
+        # one program serves catch-up AND drafting: B=1 decode_step, one
+        # page of table slack past the target's max for the draft overhang
+        self._table_width = cfg.max_pages_per_seq + 1
+        step = functools.partial(llama.decode_step, self.model_cfg,
+                                 page_size=cfg.page_size)
+        self._step = (step if cfg.enforce_eager
+                      else jax.jit(step, donate_argnums=(5, 6)))
+        self.slots: Dict[int, DraftSlot] = {}
+        self._tick = 0
+        # counters for /worker/stats + the flight/bench planes
+        self.steps = 0  # draft-model forwards (catch-up + draft)
+        self.catchup_tokens = 0  # re-fed accepted-but-undrafted tokens
+        self.rollbacks = 0
+        self.rolled_back_tokens = 0
+        self.evictions = 0
+        log.info(
+            "draft engine: model=%s (%d layers, vocab %d), pool %d pages "
+            "x %d bytes (%.1f MiB)", name or cfg.draft_model_path,
+            self.model_cfg.num_layers, self.model_cfg.vocab_size,
+            self.num_pages, self.page_bytes,
+            self.num_pages * self.page_bytes / 2**20)
+
+    # ------------------------------------------------------------ books ----
+    @property
+    def page_bytes(self) -> int:
+        return self.spec.bytes_per_token() * self.spec.page_size
+
+    def partition_bytes(self) -> Dict[str, int]:
+        """The draft tier's `dynamo_memory_kv_pool_bytes` rows: per-tenant
+        draft residency + free + trash, summing EXACTLY to the pool's
+        capacity by the same first-claim/forced-remainder construction as
+        the device tier (observability/memory.py)."""
+        eng = self.eng
+        pb = self.page_bytes
+        total = self.num_pages
+        by_tenant: Dict[str, int] = {}
+        claimed = 0
+        for slot, ds in sorted(self.slots.items()):
+            if not ds.pages:
+                continue
+            seq = eng.seqs.get(slot)
+            req = getattr(seq, "req", None) if seq is not None else None
+            tenant = eng._tenant_of(req) if req is not None else "default"
+            by_tenant[tenant] = by_tenant.get(tenant, 0) + len(ds.pages)
+            claimed += len(ds.pages)
+        free = min(self.allocator.free_pages, max(0, total - 1 - claimed))
+        other = max(0, total - 1 - free - claimed)
+        out = {t: n * pb for t, n in sorted(by_tenant.items())}
+        if other:
+            out["other"] = other * pb
+        out["free"] = free * pb
+        out["trash"] = pb  # page 0, never allocated
+        return out
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "model": self.eng.cfg.draft_model or self.eng.cfg.draft_model_path,
+            "num_pages": self.num_pages,
+            "free_pages": self.allocator.free_pages,
+            "page_bytes": self.page_bytes,
+            "active_slots": sum(1 for d in self.slots.values() if d.pages),
+            "draft_steps": self.steps,
+            "catchup_tokens": self.catchup_tokens,
+            "rollbacks": self.rollbacks,
+            "rolled_back_tokens": self.rolled_back_tokens,
+            "evictions": self.evictions,
+        }
+
+    # -------------------------------------------------------- LRU arm ------
+    def _shed_lru(self, keep: DraftSlot) -> bool:
+        """Free the least-recently-drafting slot's pages (recompute-style
+        demotion: the shed slot re-prefills from accepted history on its
+        next window). Returns False when nothing sheddable remains."""
+        victim_slot, victim = None, None
+        for slot, ds in self.slots.items():
+            if ds is keep or not ds.pages:
+                continue
+            if victim is None or ds.tick < victim.tick:
+                victim_slot, victim = slot, ds
+        if victim is None:
+            return False
+        self.evictions += 1
+        self.eng.flight.note("spec_draft_evict", slot=victim_slot,
+                             pages=len(victim.pages), done=victim.done)
+        self.allocator.free(victim.pages)
+        victim.pages = []
+        victim.tokens = []
+        victim.done = 0
+        return True
+
+    def _ensure_pages(self, ds: DraftSlot, need_tokens: int) -> bool:
+        need = -(-need_tokens // self.page_size)
+        grow = need - len(ds.pages)
+        if grow <= 0:
+            return True
+        while self.allocator.free_pages < grow:
+            if not self._shed_lru(keep=ds):
+                return False
+        ds.pages.extend(self.allocator.alloc(grow))
+        return True
+
+    def release(self, slot: int) -> None:
+        """Target slot teardown (finish/preempt/abort): drop draft state."""
+        ds = self.slots.pop(slot, None)
+        if ds is not None and ds.pages:
+            self.allocator.free(ds.pages)
+
+    # ------------------------------------------------------------ model ----
+    def _feed(self, ds: DraftSlot, token: int, position: int) -> np.ndarray:
+        """One draft forward: write KV for `token` at `position`, return
+        next-token logits [V]."""
+        table = np.zeros((1, self._table_width), np.int32)
+        table[0, :len(ds.pages)] = ds.pages
+        out = self._step(
+            self.params,
+            jnp.asarray([token], jnp.int32),
+            jnp.asarray([position], jnp.int32),
+            jnp.asarray(table),
+            jnp.asarray([position + 1], jnp.int32),
+            self.k_pages, self.v_pages,
+        )
+        self.k_pages, self.v_pages = out.k_pages, out.v_pages
+        self.steps += 1
+        return np.asarray(out.logits[0])
+
+    def propose(self, seq, k: int) -> Optional[List[int]]:
+        """Draft `k` tokens for a slot's next verify window, catching the
+        draft KV up to the target's accepted history first. Returns None
+        when the pool cannot cover the window even after LRU shedding
+        (the caller demotes the slot for this window, reason-counted)."""
+        slot = seq.slot
+        hist = list(seq.prompt_ids) + list(seq.output_tokens)
+        if not hist or k < 1:
+            return None
+        ds = self.slots.get(slot)
+        if ds is None:
+            ds = self.slots[slot] = DraftSlot()
+        self._tick += 1
+        ds.tick = self._tick
+        # rollback: draft KV is valid only for the common prefix of what
+        # it was built from and what the target actually accepted
+        p = 0
+        limit = min(ds.done, len(hist))
+        while p < limit and ds.tokens[p] == hist[p]:
+            p += 1
+        if p < ds.done:
+            self.rollbacks += 1
+            self.rolled_back_tokens += ds.done - p
+            self.eng.flight.note("spec_rollback", slot=slot,
+                                 dropped=ds.done - p, kept=p)
+            ds.done = p
+        if not self._ensure_pages(ds, len(hist) + k):
+            return None
+        # catch-up: re-feed accepted-but-undrafted history (bonus tokens,
+        # post-rollback suffixes, fresh/evicted slots re-prefilling)
+        catchup = len(hist) - ds.done
+        logits: Optional[np.ndarray] = None
+        for i in range(ds.done, len(hist)):
+            logits = self._feed(ds, hist[i], i)
+        if logits is None:
+            # already caught up (possible only via an external resume that
+            # replayed history): recompute last-position logits in place —
+            # the rewrite stores bit-identical KV
+            logits = self._feed(ds, hist[-1], len(hist) - 1)
+        else:
+            self.catchup_tokens += catchup
+        ds.tokens = list(hist)
+        ds.done = len(hist)
+        drafts: List[int] = []
+        for j in range(k):
+            t = int(np.argmax(logits))
+            drafts.append(t)
+            if j < k - 1:
+                logits = self._feed(ds, t, len(hist) + j)
+        # KV now covers hist + drafts[:-1]; the final draft's KV is never
+        # needed (its successor is drafted next window from accepted state)
+        ds.tokens = hist + drafts[:-1]
+        ds.done = len(hist) + max(k - 1, 0)
+        self.eng.flight.note("spec_draft", slot=slot, k=k, catchup=catchup)
+        return drafts

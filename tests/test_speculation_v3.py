@@ -1,0 +1,412 @@
+"""Speculation v3: draft-MODEL speculative decoding (dynamo_tpu.speculation).
+
+The contract under test extends tests/test_speculative.py's invariant to a
+real second model: a DraftEngine running a small same-tokenizer model over
+its OWN paged KV pool proposes the drafts, the existing verify path consumes
+them unchanged, and per-request output stays byte-identical to the spec-off
+engine — greedy and seeded-sampled alike. On top of that ride the v3 planes:
+the draft pool as an exactly-summing memory-plane tenant with an LRU
+shed-to-recompute arm, rollback-to-accepted-prefix on rejection, the
+adaptive per-slot window controller, and drafter-labeled accounting.
+
+Self-drafting (pointing the DraftEngine at the target's own params) is the
+acceptance ceiling used where tests assert speedup: a draft model that IS
+the target predicts the greedy chain perfectly, so every window accepts in
+full. Distinct-weights runs (the default: draft params init from seed+1)
+exercise the opposite regime — rejections, rollbacks, catch-up — and must
+hold the same byte-identity.
+"""
+
+from typing import List
+
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.engine import Engine
+from dynamo_tpu.engine.request import GenRequest
+from dynamo_tpu.speculation import AdaptiveK, tokenizer_fingerprint
+
+pytestmark = pytest.mark.spec
+
+PROMPT = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]
+
+
+def make_engine(spec="model", self_draft=False, **kw):
+    cfg = dict(
+        # page_size 8 for the same reason as tests/test_speculative.py:
+        # the K+1 verify window must fit one KV page / ragged query block
+        model="tiny-debug", page_size=8, num_pages=128, max_num_seqs=2,
+        max_seq_len=256, speculative_mode=spec, num_speculative_tokens=4,
+        prefill_chunk_tokens=0, enable_prefix_caching=False,
+    )
+    if spec == "model" or kw.get("drafter") == "model":
+        cfg.setdefault("draft_model", "tiny-debug")
+    cfg.update(kw)
+    eng = Engine(EngineConfig(**cfg))
+    if self_draft and eng.draft is not None:
+        # same model name -> same param shapes; the draft jit donates only
+        # its OWN k/v pages, never params, so sharing the tree is safe
+        eng.draft.params = eng.params
+    return eng
+
+
+def gen(eng, prompt=PROMPT, mt=24, temp=0.0, seed=None, **kw) -> List[int]:
+    return eng.generate(GenRequest("r", prompt, max_tokens=mt,
+                                   temperature=temp, seed=seed,
+                                   ignore_eos=True, **kw))
+
+
+def _collect(eng, out):
+    for ev in eng.step():
+        if ev.token_id >= 0:
+            out[ev.request_id].append(ev.token_id)
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: the v3 acceptance bar
+# ---------------------------------------------------------------------------
+
+
+def test_model_drafter_greedy_parity():
+    """Greedy streams are byte-identical spec off vs the model drafter —
+    BOTH with distinct draft weights (rejection/rollback regime) and
+    self-drafting (full-acceptance regime)."""
+    ref = gen(make_engine("off"))
+    assert gen(make_engine("model")) == ref
+    assert gen(make_engine("model", self_draft=True)) == ref
+
+
+def test_model_drafter_seeded_parity():
+    """Seeded-sampled streams hold the same identity: acceptance replays
+    the per-slot sampling chain, so WHAT proposed the drafts never leaks
+    into the emitted bytes."""
+    ref = gen(make_engine("off"), temp=0.8, seed=42)
+    assert gen(make_engine("model"), temp=0.8, seed=42) == ref
+    assert gen(make_engine("model", self_draft=True),
+               temp=0.8, seed=42) == ref
+
+
+def test_self_draft_acceptance_ceiling():
+    """A draft model that IS the target predicts the greedy chain exactly:
+    near-total acceptance, few verify dispatches, and the drafter-labeled
+    accounting shows it."""
+    ref = gen(make_engine("off"))
+    eng = make_engine("model", self_draft=True)
+    out = gen(eng)
+    m = eng.metrics
+    assert out == ref
+    assert m.spec_accepted_tokens > len(ref) // 2
+    assert m.decode_steps <= len(ref) // (eng.cfg.num_speculative_tokens + 1) + 2
+    snap = m.snapshot()
+    assert snap["spec_by_drafter"]["model"]["accepted_tokens"] > len(ref) // 2
+    st = eng.draft.stats()
+    assert st["draft_steps"] > 0
+    assert st["model"] == "tiny-debug"
+
+
+def test_distinct_weights_reject_and_roll_back():
+    """Two independently-initialized models disagree; rejected windows
+    force the draft KV back to the accepted prefix before the next window
+    (the rollback arm), and the stream still matches spec-off."""
+    ref = gen(make_engine("off"), mt=16)
+    eng = make_engine("model")  # draft params init from seed+1
+    out = gen(eng, mt=16)
+    assert out == ref
+    st = eng.draft.stats()
+    # either the drafter kept missing (rollbacks) or it kept hitting
+    # (acceptance) — both cannot be zero once windows ran
+    assert st["rollbacks"] > 0 or eng.metrics.spec_accepted_tokens > 0
+    assert st["draft_steps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# draft pool: a first-class memory-plane tenant
+# ---------------------------------------------------------------------------
+
+
+def _assert_partition_exact(eng):
+    part = eng.draft.partition_bytes()
+    assert sum(part.values()) == eng.draft.num_pages * eng.draft.page_bytes
+    assert part["trash"] == eng.draft.page_bytes
+    return part
+
+
+def test_draft_partition_sums_exact_mid_run_and_after_release():
+    """The draft tier's kv_pool_bytes rows sum EXACTLY to pool capacity —
+    mid-run with live draft pages claimed, and again after the slot
+    releases (everything back to free + trash). The accountant exposes the
+    same rows under tiers["draft"]."""
+    from dynamo_tpu.observability.memory import MemoryAccountant
+
+    eng = make_engine("model", self_draft=True, enforce_eager=True)
+    eng.add_request(GenRequest("r", PROMPT, max_tokens=12,
+                               temperature=0.0, ignore_eos=True))
+    out = {"r": []}
+    while len(out["r"]) < 6:
+        _collect(eng, out)
+    part = _assert_partition_exact(eng)
+    claimed = {k: v for k, v in part.items() if k not in ("free", "trash")}
+    assert claimed and sum(claimed.values()) > 0
+    acct = MemoryAccountant(eng).snapshot()
+    assert acct["tiers"]["draft"] == part
+    while eng.has_work:
+        _collect(eng, out)
+    part = _assert_partition_exact(eng)
+    assert part["free"] == (eng.draft.num_pages - 1) * eng.draft.page_bytes
+
+
+def test_draft_pool_lru_eviction_under_contention():
+    """A draft pool too small for two concurrent histories sheds the
+    least-recently-drafting slot's pages to recompute (spec_draft_evict),
+    the shed slot re-prefills on its next window, the partition stays
+    exact throughout, and output still matches the spec-off engine."""
+
+    def run(spec, **kw):
+        eng = make_engine(spec, **kw)
+        out = {"a": [], "b": []}
+        for rid in out:
+            eng.add_request(GenRequest(rid, PROMPT, max_tokens=24,
+                                       temperature=0.0, ignore_eos=True))
+        while eng.has_work:
+            _collect(eng, out)
+            if eng.draft is not None:
+                _assert_partition_exact(eng)
+        return out, eng
+
+    ref, _ = run("off")
+    # 8 pages = 7 usable; two histories reach 35 tokens (5 pages) each ->
+    # the windows cannot co-reside and the LRU arm must thrash
+    out, eng = run("model", self_draft=True, draft_num_pages=8)
+    assert out == ref
+    assert eng.draft.evictions > 0
+    assert eng.draft.stats()["catchup_tokens"] > 0
+    kinds = {ev.get("ev") for rec in eng.flight.records()
+             for ev in rec.get("events", [])}
+    assert "spec_draft_evict" in kinds
+
+
+def test_draft_pool_exhaustion_demotes_with_reason():
+    """A window the pool cannot cover even after shedding (single long
+    sequence, nothing else to shed) demotes that slot to one token per
+    verify step — counted under fallback reason draft_pool — without
+    touching output bytes."""
+    from dynamo_tpu.ops import attention as att
+
+    key = ("spec", "draft_pool")
+    base = dict(att.pallas_fallback_counts()).get(key, 0)
+    prompt = list(range(1, 61))  # 60 tokens: 8 pages > the 5 usable below
+    kw = dict(enforce_eager=True)
+    ref = gen(make_engine("off", **kw), prompt=prompt, mt=6)
+    eng = make_engine("model", self_draft=True, draft_num_pages=6, **kw)
+    out = gen(eng, prompt=prompt, mt=6)
+    assert out == ref
+    assert att.pallas_fallback_counts().get(key, 0) > base
+
+
+# ---------------------------------------------------------------------------
+# composition: recovery and LoRA
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_mid_speculation_model_drafter():
+    """The v2 recovery seam holds with a model drafter: a sampling-state
+    snapshot taken mid-speculation resumes the identical chain on a FRESH
+    engine whose draft KV starts empty — the continuation's catch-up
+    re-prefills draft state from accepted history alone."""
+    ref = gen(make_engine("off"), temp=0.8, seed=42)
+    eng = make_engine("model", self_draft=True)
+    eng.add_request(GenRequest("r", PROMPT, max_tokens=24, temperature=0.8,
+                               seed=42, ignore_eos=True))
+    got: List[int] = []
+    while len(got) < 8:
+        for ev in eng.step():
+            if ev.token_id >= 0:
+                got.append(ev.token_id)
+    snap = eng.export_sampling_state("r")
+    eng.abort_request("r")
+    assert got == ref[:len(got)]
+    cont = make_engine("model", self_draft=True)
+    out = cont.generate(GenRequest("r2", PROMPT + got,
+                                   max_tokens=24 - len(got), temperature=0.8,
+                                   resume_key=snap["key"], ignore_eos=True))
+    assert got + out == ref
+
+
+@pytest.fixture(scope="module")
+def lora_setup():
+    import jax
+
+    from dynamo_tpu.lora import apply as lora_apply
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import ModelConfig
+
+    mcfg = ModelConfig()
+    base = llama.init_params(mcfg, jax.random.PRNGKey(0))
+    ada = lora_apply.random_adapter(mcfg, rank=4, seed=1, scale=0.3)
+    return base, ada
+
+
+def make_lora_engine(spec, base, ada, **kw):
+    cfg = dict(
+        model="tiny-debug", page_size=8, num_pages=128, max_num_seqs=4,
+        max_seq_len=128, speculative_mode=spec, num_speculative_tokens=4,
+        lora_slots=2, lora_rank=4, enforce_eager=True,
+        prefill_chunk_tokens=0, enable_prefix_caching=False,
+    )
+    if spec == "model":
+        cfg.setdefault("draft_model", "tiny-debug")
+    cfg.update(kw)
+    eng = Engine(EngineConfig(**cfg), params=dict(base))
+    eng.lora.register("ada", tensors=ada, rank=4)
+    if eng.draft is not None:
+        eng.draft.params = eng.params
+    return eng
+
+
+def test_lora_sequence_drafts_base_logits_parity(lora_setup):
+    """Adapter sequences draft BASE logits (the draft model carries no
+    adapter stacks); the verify forward applies the adapter, so parity is
+    verify's job and holds even when the base-chain drafts mostly miss the
+    adapter-shifted argmax."""
+    base, ada = lora_setup
+    req = dict(max_tokens=14, temperature=0.0, ignore_eos=True,
+               adapter="ada")
+    ref = make_lora_engine("off", base, ada).generate(
+        GenRequest("r", PROMPT, **req))
+    eng = make_lora_engine("model", base, ada)
+    out = eng.generate(GenRequest("r", PROMPT, **req))
+    assert out == ref
+    assert eng.draft.stats()["draft_steps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# adaptive-K controller
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_k_controller_unit():
+    ak = AdaptiveK(4, grow_streak=2)
+    assert ak.k(0) == 4
+    ak.update(0, 0, 4)
+    assert ak.k(0) == 2
+    ak.update(0, 0, 2)
+    ak.update(0, 0, 1)  # floor: never below 1
+    assert ak.k(0) == 1
+    # growth is hysteretic: two consecutive FULL windows per increment
+    ak.update(0, 1, 1)
+    assert ak.k(0) == 1
+    ak.update(0, 1, 1)
+    assert ak.k(0) == 2
+    for _ in range(10):
+        ak.update(0, ak.k(0), ak.k(0))
+    assert ak.k(0) == 4  # capped at k_max
+    # a partial window resets the streak (fresh controller: clean state)
+    ak2 = AdaptiveK(4, grow_streak=2)
+    ak2.update(0, 0, 4)  # thrash -> 2
+    ak2.update(0, 2, 2)  # full, streak 1
+    ak2.update(0, 1, 2)  # partial: streak back to 0
+    ak2.update(0, 2, 2)  # full, streak 1 again
+    assert ak2.k(0) == 2
+    ak2.update(0, 2, 2)  # streak 2 -> grow
+    assert ak2.k(0) == 3
+    # snapshot lists only moved slots; reset returns the slot to k_max
+    assert ak2.snapshot() == {0: 3}
+    ak2.reset(0)
+    assert ak2.k(0) == 4 and ak2.snapshot() == {}
+
+
+def test_adaptive_k_shrinks_on_thrash_and_resets_on_finish():
+    """Always-rejected drafts halve the live slot's window down to the
+    floor of 1; adapting the window never changes output bytes; slot
+    teardown resets the controller for the next tenant."""
+    ref = gen(make_engine("off", enforce_eager=True), mt=10)
+    eng = make_engine("ngram", spec_adaptive_k=True, enforce_eager=True)
+    k = eng.cfg.num_speculative_tokens
+    eng._propose_ngram = lambda seq: [0] * k  # near-certain rejection
+    eng.add_request(GenRequest("r", PROMPT, max_tokens=10,
+                               temperature=0.0, ignore_eos=True))
+    out = {"r": []}
+    seen_k = set()
+    while eng.has_work:
+        _collect(eng, out)
+        seen_k.add(eng._adaptive.k(0))
+    assert out["r"] == ref
+    assert 1 in seen_k and all(1 <= v <= k for v in seen_k)
+    # finish resets: the slot's next tenant starts back at k_max
+    assert eng._adaptive.snapshot() == {}
+    assert eng._adaptive.k(0) == k
+
+
+def test_adaptive_k_grows_back_on_streaks():
+    """A shrunken window regrows under sustained full acceptance (the
+    self-drafting ceiling) and never exceeds k_max — and the model drafter
+    only pays draft forwards for the CURRENT window size."""
+    ref = gen(make_engine("off", enforce_eager=True), mt=16)
+    eng = make_engine("model", self_draft=True, spec_adaptive_k=True,
+                      enforce_eager=True)
+    eng._adaptive._k[0] = 1  # as if a thrash phase had bottomed the slot out
+    eng.add_request(GenRequest("r", PROMPT, max_tokens=16,
+                               temperature=0.0, ignore_eos=True))
+    out = {"r": []}
+    seen_k = set()
+    while eng.has_work:
+        seen_k.add(eng._adaptive.k(0))
+        _collect(eng, out)
+    assert out["r"] == ref
+    assert max(seen_k) > 1  # grew
+    assert all(1 <= v <= eng.cfg.num_speculative_tokens for v in seen_k)
+
+
+# ---------------------------------------------------------------------------
+# engine-init validation and identity gates
+# ---------------------------------------------------------------------------
+
+
+def test_model_drafter_validation():
+    """Init rejects unusable drafter configs instead of failing deep in a
+    trace — and the knobs stay inert with speculation off."""
+    with pytest.raises(ValueError, match="drafter"):
+        make_engine("ngram", drafter="bogus", enforce_eager=True)
+    with pytest.raises(ValueError, match="draft-model"):
+        make_engine("model", draft_model=None, enforce_eager=True)
+    with pytest.raises(ValueError, match="draft-num-pages"):
+        make_engine("model", draft_num_pages=3, enforce_eager=True)  # K+1 is 5
+    with pytest.raises(ValueError, match="vocab_size"):
+        make_engine("model", draft_model="llama-3.2-1b-instruct",
+                    enforce_eager=True)
+    # inert when off: bad values must not block a non-speculating engine
+    eng = make_engine("off", drafter="model", draft_num_pages=1,
+                      enforce_eager=True)
+    assert eng.draft is None and eng.drafter_name is None
+
+
+def test_tokenizer_fingerprint_gate():
+    from dynamo_tpu.engine.tokenizer import get_tokenizer
+
+    a = tokenizer_fingerprint(get_tokenizer("tiny-debug"))
+    b = tokenizer_fingerprint(get_tokenizer("tiny-debug"))
+    assert a == b and len(a) == 16
+
+    class FakeTok:
+        vocab_size = 999
+        bos_token_id = 1
+        eos_token_id = 2
+
+    assert tokenizer_fingerprint(FakeTok()) != a
+
+
+def test_drafter_labeled_accounting_and_flight():
+    """The drafter label rides every spec sample: per-drafter tokens in
+    the snapshot, the drafter name + draft-engine section in the stats
+    surface, and draft/verify events in the flight ring."""
+    eng = make_engine("model", self_draft=True, enforce_eager=True)
+    gen(eng, mt=10)
+    snap = eng.metrics.snapshot()
+    by = snap["spec_by_drafter"]
+    assert set(by) == {"model"}
+    assert by["model"]["draft_tokens"] > 0
+    assert 0.0 <= by["model"]["acceptance_rate"] <= 1.0
+    assert eng.drafter_name == "model"
+    kinds = {ev.get("ev") for rec in eng.flight.records()
+             for ev in rec.get("events", [])}
+    assert "spec_draft" in kinds and "spec_verify" in kinds
